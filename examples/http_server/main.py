@@ -1,0 +1,54 @@
+"""Canonical HTTP server example.
+
+Mirrors the reference's examples/http-server: /greet echo, /redis, /trace,
+CRUD entity, error handling — the app the echo-handler benchmark (BASELINE.md
+config #1) drives.
+"""
+
+import dataclasses
+
+import gofr_tpu
+from gofr_tpu.http.response import Raw
+
+
+@dataclasses.dataclass
+class Employee:
+    id: int = dataclasses.field(default=0, metadata={"sql": "auto_increment"})
+    name: str = ""
+    role: str = ""
+
+
+async def greet(ctx: gofr_tpu.Context):
+    return "Hello World!"
+
+
+async def hello_name(ctx: gofr_tpu.Context):
+    name = ctx.param("name") or "there"
+    return f"Hello {name}!"
+
+
+async def raw_handler(ctx: gofr_tpu.Context):
+    return Raw({"plain": True})
+
+
+async def fail_handler(ctx: gofr_tpu.Context):
+    raise gofr_tpu.errors.EntityNotFound("id", ctx.path_param("id"))
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    app.get("/greet", greet)
+    app.get("/hello", hello_name)
+    app.get("/raw", raw_handler)
+    app.get("/missing/{id}", fail_handler)
+    if app.container.sql is not None:
+        app.container.sql.exec(
+            "CREATE TABLE IF NOT EXISTS employee"
+            " (id INTEGER PRIMARY KEY AUTOINCREMENT, name TEXT, role TEXT)"
+        )
+        app.add_rest_handlers(Employee)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -1,0 +1,36 @@
+"""MNIST MLP served at POST /predict — BASELINE.md config #2.
+
+The minimum end-to-end TPU slice (SURVEY §7 phase 3): a JAX model mounted in
+the ``ml`` datasource, dynamic batching on, step time + HBM gauges flowing to
+/metrics on :2121.
+"""
+
+import numpy as np
+
+import gofr_tpu
+from gofr_tpu.models.mlp import mnist_mlp
+
+
+async def predict(ctx: gofr_tpu.Context):
+    body = await ctx.bind()
+    image = np.asarray(body.get("image"), dtype=np.float32)
+    if image.shape != (28, 28) and image.shape != (784,):
+        raise gofr_tpu.errors.InvalidParam("image (want 28x28 or flat 784)")
+    logits = await ctx.ml.predict("mnist", image.reshape(784))
+    probs = np.exp(logits - logits.max())
+    probs /= probs.sum()
+    return {
+        "digit": int(np.argmax(logits)),
+        "probs": [round(float(p), 5) for p in probs],
+    }
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    app.register_model("mnist", mnist_mlp(), batching=True)
+    app.post("/predict", predict)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

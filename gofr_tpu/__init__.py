@@ -1,0 +1,58 @@
+"""gofr-tpu: a TPU-native serving framework with GoFr's ergonomics.
+
+A brand-new framework with the capabilities of the reference Go microservice
+framework (nidhey27/gofr — see SURVEY.md): App composition root, DI container,
+transport-agnostic handlers, observability by default, inter-service clients,
+migrations, pub/sub, cron, websockets — plus a first-class TPU model runtime:
+JAX/PJRT execution engines, dynamic request batching, pjit/GSPMD sharding over
+device meshes, continuous-batching LLM serving, and Pallas kernels for the
+hot ops.
+
+Quick start::
+
+    import gofr_tpu
+
+    app = gofr_tpu.new_app()
+
+    async def greet(ctx):
+        return "Hello World!"
+
+    app.get("/greet", greet)
+    app.run()
+"""
+
+from .app import App, new_app
+from .cmd import CMD, new_cmd
+from .config import Config, EnvConfig, MapConfig
+from .context import Context
+from .http import errors
+from .http.response import File, Raw, Redirect, Response, Template
+from .logging import Level, Logger, new_logger
+from .migration import Migrate
+
+__version__ = "0.1.0"
+
+# GoFr-style constructor aliases
+new = new_app
+
+__all__ = [
+    "App",
+    "CMD",
+    "Config",
+    "Context",
+    "EnvConfig",
+    "File",
+    "Level",
+    "Logger",
+    "MapConfig",
+    "Migrate",
+    "Raw",
+    "Redirect",
+    "Response",
+    "Template",
+    "errors",
+    "new",
+    "new_app",
+    "new_cmd",
+    "new_logger",
+]

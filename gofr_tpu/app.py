@@ -1,0 +1,433 @@
+"""App — the composition root.
+
+Mirrors the reference's App (pkg/gofr/gofr.go:46-131): construction reads
+config, builds the DI container and tracer, assembles the HTTP server with the
+fixed middleware chain (http_server.go:36-42), a separate metrics server
+(metrics_server.go:24-48), and a gRPC server; registers default routes
+(health, liveness, favicon, swagger — gofr.go:92-106); ``run`` starts all
+servers concurrently and performs signal-driven graceful shutdown with a
+bounded drain (gofr.go:149-245, shutdown.go:11-32).
+
+TPU-native additions: ``register_model`` mounts a JAX/PJRT model into the
+``ml`` datasource, and ``enable_dynamic_batching`` coalesces concurrent
+requests into device-sized batches — the north-star features from
+BASELINE.json that the reference (a pure-Go microservice framework) lacks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import time
+from typing import Any, Callable
+
+from aiohttp import web
+
+from .config import Config, new_env_config
+from .container import Container, new_container
+from .context import Context
+from .handler import (
+    HandlerFunc,
+    alive_handler,
+    catch_all_handler,
+    health_handler,
+    invoke,
+    wrap_handler,
+)
+from .http import middleware as mw
+from .logging import Logger
+from .tracing import new_tracer
+
+__all__ = ["App", "new_app"]
+
+DEFAULT_HTTP_PORT = 8000
+DEFAULT_GRPC_PORT = 9000
+DEFAULT_METRICS_PORT = 2121
+SHUTDOWN_GRACE_PERIOD = 30.0  # reference gofr.go:38-41
+
+
+class App:
+    def __init__(self, config: Config | None = None, config_dir: str = "./configs") -> None:
+        self.config: Config = config if config is not None else new_env_config(config_dir)
+        self.container: Container = new_container(self.config)
+        self.logger: Logger = self.container.logger
+        self.tracer = new_tracer(self.config, self.logger)
+        self.container.tracer = self.tracer
+
+        self.http_port = int(self.config.get_or_default("HTTP_PORT", str(DEFAULT_HTTP_PORT)))
+        self.grpc_port = int(self.config.get_or_default("GRPC_PORT", str(DEFAULT_GRPC_PORT)))
+        self.metrics_port = int(
+            self.config.get_or_default("METRICS_PORT", str(DEFAULT_METRICS_PORT))
+        )
+        timeout_cfg = self.config.get_or_default("REQUEST_TIMEOUT", "")
+        self.request_timeout: float | None = float(timeout_cfg) if timeout_cfg else None
+
+        self._routes: list[tuple[str, str, HandlerFunc]] = []
+        self._static_routes: list[tuple[str, str]] = []
+        self._auth_middlewares: list = []
+        self._ws_routes: dict[str, HandlerFunc] = {}
+        self._subscriptions: dict[str, HandlerFunc] = {}
+        self._grpc_services: list = []
+        self._cron = None
+        self._http_registered = False
+        self._runner: web.AppRunner | None = None
+        self._metrics_runner: web.AppRunner | None = None
+        self._grpc_server = None
+        self._shutdown_event: asyncio.Event | None = None
+        self._background_tasks: list[asyncio.Task] = []
+        self._on_shutdown_hooks: list[Callable] = []
+
+        self.logger.infof(
+            "starting %s (gofr-tpu) http=:%d grpc=:%d metrics=:%d",
+            self.container.app_name, self.http_port, self.grpc_port, self.metrics_port,
+        )
+
+    # ------------------------------------------------------------------ routes
+    def add_route(self, method: str, pattern: str, handler: HandlerFunc) -> None:
+        self._routes.append((method.upper(), pattern, handler))
+
+    def get(self, pattern: str, handler: HandlerFunc) -> None:
+        self.add_route("GET", pattern, handler)
+
+    def post(self, pattern: str, handler: HandlerFunc) -> None:
+        self.add_route("POST", pattern, handler)
+
+    def put(self, pattern: str, handler: HandlerFunc) -> None:
+        self.add_route("PUT", pattern, handler)
+
+    def patch(self, pattern: str, handler: HandlerFunc) -> None:
+        self.add_route("PATCH", pattern, handler)
+
+    def delete(self, pattern: str, handler: HandlerFunc) -> None:
+        self.add_route("DELETE", pattern, handler)
+
+    def head(self, pattern: str, handler: HandlerFunc) -> None:
+        self.add_route("HEAD", pattern, handler)
+
+    def options(self, pattern: str, handler: HandlerFunc) -> None:
+        self.add_route("OPTIONS", pattern, handler)
+
+    def add_static_files(self, route: str, directory: str) -> None:
+        """Serve a directory of static files (reference router.go:57-93;
+        the openapi.json-403 guard is applied in the wrapper)."""
+        self._static_routes.append((route.rstrip("/") or "/", os.path.abspath(directory)))
+
+    def websocket(self, pattern: str, handler: HandlerFunc) -> None:
+        """Register a websocket route (reference websocket.go:23-66): the
+        handler is re-invoked per inbound message; its return value is
+        serialized back over the socket; ``ctx.bind()`` yields the frame."""
+        self._ws_routes[pattern] = handler
+
+    # -------------------------------------------------------------- transports
+    def subscribe(self, topic: str, handler: HandlerFunc) -> None:
+        """Register a pub/sub consumer (reference gofr.go:618-632)."""
+        if self.container.pubsub is None:
+            self.logger.errorf("subscriber not configured; ignoring Subscribe(%s)", topic)
+            return
+        self._subscriptions[topic] = handler
+
+    def sub_command(self, pattern: str, handler: HandlerFunc, description: str = "") -> None:
+        raise RuntimeError("sub_command is only available on CMD apps (use new_cmd())")
+
+    def register_service(self, service_desc, impl) -> None:
+        """Register a gRPC service (reference grpc.go:68-79); the container is
+        injected as ``impl.container`` so RPC methods reach datasources."""
+        try:
+            impl.container = self.container
+        except AttributeError:
+            pass
+        self._grpc_services.append((service_desc, impl))
+
+    def add_http_service(self, name: str, address: str, *options: Any) -> None:
+        """Register an outbound HTTP client (reference gofr.go:314-324)."""
+        from .service import new_http_service
+
+        if name in self.container.services:
+            self.logger.warnf("service %s already registered, overwriting", name)
+        self.container.services[name] = new_http_service(
+            address,
+            self.logger,
+            self.container.metrics_manager,
+            self.tracer,
+            *options,
+        )
+
+    # ---------------------------------------------------------------- verticals
+    def add_cron_job(self, schedule: str, job_name: str, fn: HandlerFunc) -> None:
+        """6-field cron with seconds (reference cron.go:65,322)."""
+        from .cron import Cron
+
+        if self._cron is None:
+            self._cron = Cron(self.container, self.tracer)
+        self._cron.add_job(schedule, job_name, fn)
+
+    def migrate(self, migrations: dict[int, Any]) -> None:
+        from .migration import run as migration_run
+
+        migration_run(migrations, self.container)
+
+    def add_rest_handlers(self, entity: type) -> None:
+        """Auto-register CRUD routes for a dataclass entity (reference
+        crud_handlers.go:66-146)."""
+        from .crud import register_crud_handlers
+
+        register_crud_handlers(self, entity)
+
+    def register_model(self, name: str, model: Any, **kwargs: Any) -> None:
+        """Mount a JAX model into the ml datasource (TPU-native; green-field)."""
+        from .ml import MLDatasource
+
+        if self.container.ml is None:
+            self.container.ml = MLDatasource(self.logger, self.container.metrics_manager)
+        self.container.ml.register(name, model, **kwargs)
+
+    # -------------------------------------------------------------------- auth
+    def enable_basic_auth(self, username: str, password: str) -> None:
+        users = {username: password}
+        self.enable_basic_auth_with_validator(
+            lambda u, p: users.get(u) is not None and mw.constant_time_equals(users[u], p)
+        )
+
+    def enable_basic_auth_with_validator(self, validator: Callable[[str, str], bool]) -> None:
+        self._auth_middlewares.append(mw.basic_auth_middleware(validator))
+
+    def enable_api_key_auth(self, *keys: str) -> None:
+        keyset = set(keys)
+        self.enable_api_key_auth_with_validator(lambda k: k in keyset)
+
+    def enable_api_key_auth_with_validator(self, validator: Callable[[str], bool]) -> None:
+        self._auth_middlewares.append(mw.api_key_auth_middleware(validator))
+
+    def enable_oauth(
+        self,
+        decoder: Callable[[str], dict] | None = None,
+        *,
+        allow_unverified: bool = False,
+    ) -> None:
+        """Bearer-token auth. ``decoder`` must verify the signature and return
+        claims; without one the app refuses to start unless the caller
+        explicitly opts into unverified-claims mode (tests only)."""
+        if decoder is None and not allow_unverified:
+            raise ValueError(
+                "enable_oauth requires a verifying decoder; pass "
+                "allow_unverified=True only for tests"
+            )
+        self._auth_middlewares.append(mw.oauth_middleware(None, decoder))
+
+    def add_middleware(self, middleware) -> None:
+        """User middleware appended after the built-in chain (reference
+        UseMiddleware)."""
+        self._auth_middlewares.append(middleware)
+
+    def on_shutdown(self, hook: Callable) -> None:
+        self._on_shutdown_hooks.append(hook)
+
+    # -------------------------------------------------------------- http build
+    def _registered_methods(self) -> str:
+        methods = sorted({m for m, _, _ in self._routes})
+        return ", ".join(methods + ["OPTIONS"]) if methods else "GET, OPTIONS"
+
+    def _build_http_app(self) -> web.Application:
+        chain = [
+            mw.tracer_middleware(self.tracer),
+            mw.logging_middleware(self.logger),
+            mw.cors_middleware(
+                mw.CORSConfig.from_config(self.config), self._registered_methods
+            ),
+            mw.metrics_middleware(self.container.metrics_manager),
+            *self._auth_middlewares,
+        ]
+        aio_middlewares = [self._adapt_middleware(f) for f in chain]
+        app = web.Application(middlewares=aio_middlewares, client_max_size=64 * 1024 * 1024)
+
+        # default routes (reference gofr.go:92-106)
+        app.router.add_get(
+            "/.well-known/health", wrap_handler(health_handler(self.container), self.container)
+        )
+        app.router.add_get(
+            "/.well-known/alive", wrap_handler(alive_handler, self.container)
+        )
+        app.router.add_get("/favicon.ico", self._favicon_handler)
+        self._maybe_add_swagger(app)
+
+        for method, pattern, handler in self._routes:
+            app.router.add_route(
+                method, pattern, wrap_handler(handler, self.container, self.request_timeout)
+            )
+        for pattern, ws_handler in self._ws_routes.items():
+            from .websocket import websocket_route_handler
+
+            app.router.add_get(
+                pattern, websocket_route_handler(ws_handler, self.container)
+            )
+        for route, directory in self._static_routes:
+            app.router.add_get(route + "/{filename:.*}", self._static_handler(directory))
+
+        # catch-all 404 with the JSON envelope (reference handler.go:132)
+        app.router.add_route(
+            "*", "/{tail:.*}", wrap_handler(catch_all_handler, self.container)
+        )
+        return app
+
+    @staticmethod
+    def _adapt_middleware(func) -> Any:
+        @web.middleware
+        async def adapted(request: web.Request, handler):
+            return await func(request, handler)
+
+        return adapted
+
+    async def _favicon_handler(self, _: web.Request) -> web.Response:
+        path = os.path.join(os.path.dirname(__file__), "static", "favicon.ico")
+        try:
+            with open(path, "rb") as fh:
+                return web.Response(body=fh.read(), content_type="image/x-icon")
+        except FileNotFoundError:
+            return web.Response(status=404)
+
+    def _maybe_add_swagger(self, app: web.Application) -> None:
+        """Serve ./static/openapi.json + a Swagger UI page when present
+        (reference gofr.go:98-106, swagger.go:22-55)."""
+        spec_path = os.path.abspath("./static/openapi.json")
+        if not os.path.exists(spec_path):
+            return
+        from .swagger import swagger_ui_handler, openapi_handler
+
+        app.router.add_get("/.well-known/openapi.json", openapi_handler(spec_path))
+        app.router.add_get("/.well-known/swagger", swagger_ui_handler())
+        self.logger.info("swagger UI enabled at /.well-known/swagger")
+
+    def _static_handler(self, directory: str):
+        async def handler(request: web.Request) -> web.StreamResponse:
+            filename = request.match_info.get("filename", "")
+            if filename.endswith("openapi.json"):
+                return web.json_response(
+                    {"error": {"message": "403 forbidden"}}, status=403
+                )
+            full = os.path.abspath(os.path.join(directory, filename or "index.html"))
+            try:
+                inside = os.path.commonpath([full, directory]) == directory
+            except ValueError:
+                inside = False
+            if not inside or not os.path.isfile(full):
+                return web.json_response(
+                    {"error": {"message": "route not registered"}}, status=404
+                )
+            return web.FileResponse(full)
+
+        return handler
+
+    def _build_metrics_app(self) -> web.Application:
+        """Separate metrics server (reference metrics_server.go:24-48): refresh
+        process/TPU gauges on every scrape, then expose Prometheus text."""
+
+        async def metrics_handler(_: web.Request) -> web.Response:
+            self.container.refresh_process_metrics()
+            text = self.container.metrics_manager.expose_text()
+            return web.Response(text=text, content_type="text/plain", charset="utf-8")
+
+        app = web.Application()
+        app.router.add_get("/metrics", metrics_handler)
+        return app
+
+    # --------------------------------------------------------------------- run
+    def run(self) -> None:
+        """Start all servers; block until SIGINT/SIGTERM; drain gracefully."""
+        try:
+            asyncio.run(self._run_async())
+        except KeyboardInterrupt:
+            pass
+
+    async def _run_async(self) -> None:
+        self._shutdown_event = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, self._shutdown_event.set)
+            except NotImplementedError:  # pragma: no cover - non-unix
+                pass
+        await self.start()
+        await self._shutdown_event.wait()
+        self.logger.info("shutdown signal received; draining")
+        await self.shutdown()
+
+    async def start(self) -> None:
+        """Start servers without blocking (used by run() and by tests)."""
+        t0 = time.perf_counter()
+        self._metrics_runner = web.AppRunner(self._build_metrics_app())
+        await self._metrics_runner.setup()
+        await web.TCPSite(self._metrics_runner, "0.0.0.0", self.metrics_port).start()
+        self.logger.infof("metrics server on :%d/metrics", self.metrics_port)
+
+        self._runner = web.AppRunner(self._build_http_app(), access_log=None)
+        await self._runner.setup()
+        cert, key = self.config.get("CERT_FILE"), self.config.get("KEY_FILE")
+        ssl_ctx = None
+        if cert and key:
+            import ssl
+
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(cert, key)
+        await web.TCPSite(self._runner, "0.0.0.0", self.http_port, ssl_context=ssl_ctx).start()
+        self.logger.infof("http server on :%d (%s)", self.http_port, "https" if ssl_ctx else "http")
+
+        if self._grpc_services:
+            from .grpc import start_grpc_server
+
+            self._grpc_server = await start_grpc_server(
+                self._grpc_services, self.grpc_port, self.logger, self.tracer,
+                self.container,
+            )
+            self.logger.infof("grpc server on :%d", self.grpc_port)
+
+        # subscriber loops (reference gofr.go:279-295)
+        for topic, handler in self._subscriptions.items():
+            from .subscriber import start_subscriber
+
+            self._background_tasks.append(
+                asyncio.create_task(
+                    start_subscriber(topic, handler, self.container, self.tracer),
+                    name=f"subscriber-{topic}",
+                )
+            )
+        if self._cron is not None:
+            self._background_tasks.append(
+                asyncio.create_task(self._cron.run(), name="cron")
+            )
+        self.logger.infof("startup complete in %.0fms", (time.perf_counter() - t0) * 1e3)
+
+    async def shutdown(self) -> None:
+        """Graceful drain with a bounded timeout, then force-close (reference
+        gofr.go:219-245 + shutdown.go:11-32)."""
+
+        async def _drain() -> None:
+            for task in self._background_tasks:
+                task.cancel()
+            for task in self._background_tasks:
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+            if self._grpc_server is not None:
+                await self._grpc_server.stop(grace=5)
+            for hook in self._on_shutdown_hooks:
+                result = hook()
+                if asyncio.iscoroutine(result):
+                    await result
+            if self._runner is not None:
+                await self._runner.cleanup()
+            if self._metrics_runner is not None:
+                await self._metrics_runner.cleanup()
+            await self.container.close()
+
+        try:
+            await asyncio.wait_for(_drain(), timeout=SHUTDOWN_GRACE_PERIOD)
+        except asyncio.TimeoutError:
+            self.logger.error("graceful shutdown timed out; forcing exit")
+        self.tracer.shutdown()
+        self.logger.info("server shutdown complete")
+
+
+def new_app(config: Config | None = None, config_dir: str = "./configs") -> App:
+    return App(config=config, config_dir=config_dir)

@@ -1,0 +1,178 @@
+"""CLI application mode.
+
+Mirrors the reference's CMD vertical (pkg/gofr/cmd.go:36-151 + pkg/gofr/cmd/):
+``new_cmd()`` builds an app with a container and file logger but no servers;
+subcommands register with regex-capable patterns; ``run`` matches
+``sys.argv[1]``, parses ``-k=v`` / ``--flag`` arguments into params
+(cmd/request.go:24-130), prints ``-h/--help`` output, and hands the handler a
+Context whose responder writes results to stdout and errors to stderr
+(cmd/responder.go:8-19). ``ctx.out`` exposes the terminal helpers (spinners,
+progress bars, colors — pkg/gofr/cmd/terminal/).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from typing import Any
+
+from ..config import Config, new_env_config
+from ..container import Container, new_container
+from ..context import Context
+from ..handler import HandlerFunc
+from ..logging import new_file_logger
+from ..tracing import new_tracer
+from .terminal import Out
+
+__all__ = ["CMD", "new_cmd"]
+
+
+class CMDRequest:
+    """Request over argv: ``-k=v``, ``--flag`` (true), positional ignored."""
+
+    def __init__(self, args: list[str]) -> None:
+        self.args = args
+        self._params: dict[str, str] = {}
+        for arg in args:
+            if not arg.startswith("-"):
+                continue
+            body = arg.lstrip("-")
+            if not body:
+                continue
+            if "=" in body:
+                k, _, v = body.partition("=")
+                self._params[k] = v
+            else:
+                self._params[body] = "true"
+
+    def param(self, key: str) -> str:
+        return self._params.get(key, "")
+
+    def params(self, key: str) -> list[str]:
+        v = self._params.get(key)
+        return v.split(",") if v else []
+
+    def path_param(self, key: str) -> str:
+        return self.param(key)
+
+    async def bind(self, model: type | None = None) -> Any:
+        """Reflectively bind flags into a model (reference cmd/request.go:99-130)."""
+        if model is None:
+            return dict(self._params)
+        from ..http.request import bind_to_model
+
+        return bind_to_model(self._params, model)
+
+    def host_name(self) -> str:
+        import socket
+
+        return socket.gethostname()
+
+    def context(self) -> Any:
+        return None
+
+
+class _Route:
+    def __init__(self, pattern: str, handler: HandlerFunc, description: str, help_text: str):
+        self.pattern = pattern
+        self.handler = handler
+        self.description = description
+        self.help_text = help_text
+        self.regex = re.compile(f"^{pattern}$")
+
+
+class CMD:
+    """A command-line app: subcommand router over argv."""
+
+    def __init__(self, config: Config | None = None, config_dir: str = "./configs") -> None:
+        self.config = config if config is not None else new_env_config(config_dir)
+        # file (or null) logger BEFORE container construction so datasource
+        # connect logs never pollute command stdout (reference NewCMD uses a
+        # file logger for the same reason, gofr.go:134-146)
+        logger = new_file_logger(self.config.get_or_default("CMD_LOGS_FILE", ""))
+        self.container: Container = new_container(self.config, logger=logger)
+        self.tracer = new_tracer(self.config, logger)
+        self.container.tracer = self.tracer
+        self._routes: list[_Route] = []
+        self.out = Out()
+
+    def sub_command(self, pattern: str, handler: HandlerFunc,
+                    description: str = "", help_text: str = "") -> None:
+        self._routes.append(_Route(pattern, handler, description, help_text))
+
+    # App-parity verticals usable from CLI apps
+    def add_cron_job(self, schedule: str, name: str, fn: HandlerFunc) -> None:
+        raise RuntimeError("cron requires a running server; use new_app()")
+
+    def migrate(self, migrations: dict[int, Any]) -> None:
+        from ..migration import run as migration_run
+
+        migration_run(migrations, self.container)
+
+    def _print_help(self) -> None:
+        print("Available commands:")
+        for r in self._routes:
+            line = f"  {r.pattern}"
+            if r.description:
+                line += f"\t{r.description}"
+            print(line)
+            if r.help_text:
+                print(f"      {r.help_text}")
+
+    def run(self, argv: list[str] | None = None) -> int:
+        """Match the subcommand, run its handler, print result/error.
+
+        Returns the process exit code (0 success, 1 error) rather than
+        exiting, so tests can drive it in-process.
+        """
+        import asyncio
+        import inspect
+
+        argv = list(sys.argv[1:] if argv is None else argv)
+        sub = ""
+        for a in argv:
+            if not a.startswith("-"):
+                sub = a
+                break
+        if not sub or sub in ("-h", "--help", "help"):
+            self._print_help()
+            return 0
+        if "-h" in argv or "--help" in argv:
+            for r in self._routes:
+                if r.regex.match(sub):
+                    print(r.help_text or r.description or r.pattern)
+                    return 0
+            self._print_help()
+            return 0
+        for r in self._routes:
+            if r.regex.match(sub):
+                req = CMDRequest(argv)
+                ctx = Context(req, self.container, out=self.out)
+                try:
+                    if inspect.iscoroutinefunction(r.handler):
+                        result = asyncio.run(r.handler(ctx))
+                    else:
+                        result = r.handler(ctx)
+                        if inspect.isawaitable(result):
+                            result = asyncio.run(result)
+                except Exception as exc:
+                    print(str(exc) or type(exc).__name__, file=sys.stderr)
+                    return 1
+                if result is not None:
+                    print(result if isinstance(result, str) else _render(result))
+                return 0
+        print(f"unknown command: {sub}", file=sys.stderr)
+        self._print_help()
+        return 1
+
+
+def _render(result: Any) -> str:
+    import json
+
+    from ..http.responder import to_jsonable
+
+    return json.dumps(to_jsonable(result), indent=2)
+
+
+def new_cmd(config: Config | None = None, config_dir: str = "./configs") -> CMD:
+    return CMD(config=config, config_dir=config_dir)

@@ -1,0 +1,180 @@
+"""Terminal / TUI helpers for CLI apps.
+
+Mirrors the reference's terminal package (pkg/gofr/cmd/terminal/output.go:12-45
+defines 40+ ANSI operations; spinner.go has dot/globe spinners; progress.go a
+progress bar). ``ctx.out`` in CLI handlers exposes this surface.
+"""
+
+from __future__ import annotations
+
+import itertools
+import shutil
+import sys
+import threading
+import time
+from typing import TextIO
+
+__all__ = ["Out", "Spinner", "ProgressBar"]
+
+_CSI = "\x1b["
+
+
+class Out:
+    """ANSI terminal operations over a writer (default stdout)."""
+
+    def __init__(self, writer: TextIO | None = None) -> None:
+        self._w = writer if writer is not None else sys.stdout
+
+    def _emit(self, code: str) -> None:
+        self._w.write(_CSI + code)
+        self._w.flush()
+
+    # printing ---------------------------------------------------------------
+    def print(self, *args) -> None:
+        self._w.write(" ".join(str(a) for a in args))
+        self._w.flush()
+
+    def println(self, *args) -> None:
+        self._w.write(" ".join(str(a) for a in args) + "\n")
+        self._w.flush()
+
+    def printf(self, fmt: str, *args) -> None:
+        self._w.write(fmt % args if args else fmt)
+        self._w.flush()
+
+    # cursor -----------------------------------------------------------------
+    def set_cursor_position(self, row: int, col: int) -> None:
+        self._emit(f"{row};{col}H")
+
+    def cursor_up(self, n: int = 1) -> None:
+        self._emit(f"{n}A")
+
+    def cursor_down(self, n: int = 1) -> None:
+        self._emit(f"{n}B")
+
+    def cursor_forward(self, n: int = 1) -> None:
+        self._emit(f"{n}C")
+
+    def cursor_back(self, n: int = 1) -> None:
+        self._emit(f"{n}D")
+
+    def save_cursor(self) -> None:
+        self._emit("s")
+
+    def restore_cursor(self) -> None:
+        self._emit("u")
+
+    def hide_cursor(self) -> None:
+        self._emit("?25l")
+
+    def show_cursor(self) -> None:
+        self._emit("?25h")
+
+    # clearing ---------------------------------------------------------------
+    def clear_screen(self) -> None:
+        self._emit("2J")
+        self.set_cursor_position(1, 1)
+
+    def clear_line(self) -> None:
+        self._emit("2K")
+        self._w.write("\r")
+        self._w.flush()
+
+    def clear_line_right(self) -> None:
+        self._emit("0K")
+
+    # colors -----------------------------------------------------------------
+    def set_color(self, color256: int) -> None:
+        self._emit(f"38;5;{color256}m")
+
+    def set_bg_color(self, color256: int) -> None:
+        self._emit(f"48;5;{color256}m")
+
+    def bold(self) -> None:
+        self._emit("1m")
+
+    def underline(self) -> None:
+        self._emit("4m")
+
+    def reset(self) -> None:
+        self._emit("0m")
+
+    def colored(self, text: str, color256: int) -> str:
+        return f"{_CSI}38;5;{color256}m{text}{_CSI}0m"
+
+    # geometry ---------------------------------------------------------------
+    def size(self) -> tuple[int, int]:
+        ts = shutil.get_terminal_size()
+        return ts.lines, ts.columns
+
+    def is_terminal(self) -> bool:
+        try:
+            return self._w.isatty()
+        except (AttributeError, ValueError):
+            return False
+
+    # widgets ----------------------------------------------------------------
+    def spinner(self, style: str = "dots") -> "Spinner":
+        return Spinner(self, style)
+
+    def progress_bar(self, total: int) -> "ProgressBar":
+        return ProgressBar(self, total)
+
+
+_SPINNER_FRAMES = {
+    "dots": ["⠋", "⠙", "⠹", "⠸", "⠼", "⠴", "⠦", "⠧", "⠇", "⠏"],
+    "globe": ["🌍", "🌎", "🌏"],
+    "line": ["-", "\\", "|", "/"],
+}
+
+
+class Spinner:
+    def __init__(self, out: Out, style: str = "dots", interval: float = 0.08) -> None:
+        self._out = out
+        self._frames = _SPINNER_FRAMES.get(style, _SPINNER_FRAMES["dots"])
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.message = ""
+
+    def spin(self, message: str = "") -> "Spinner":
+        self.message = message
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        for frame in itertools.cycle(self._frames):
+            if self._stop.is_set():
+                return
+            self._out.clear_line()
+            self._out.print(f"{frame} {self.message}")
+            time.sleep(self._interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1)
+        self._out.clear_line()
+
+
+class ProgressBar:
+    def __init__(self, out: Out, total: int, width: int = 40) -> None:
+        self._out = out
+        self.total = max(1, total)
+        self.current = 0
+        self._width = width
+
+    def incr(self, n: int = 1) -> None:
+        self.current = min(self.total, self.current + n)
+        self._draw()
+
+    def _draw(self) -> None:
+        frac = self.current / self.total
+        filled = int(frac * self._width)
+        bar = "█" * filled + "░" * (self._width - filled)
+        self._out.clear_line()
+        self._out.print(f"[{bar}] {frac * 100:5.1f}%")
+        if self.current >= self.total:
+            self._out.print("\n")

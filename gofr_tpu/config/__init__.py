@@ -1,0 +1,113 @@
+"""Configuration subsystem.
+
+Environment-driven configuration with ``.env`` file overlays, mirroring the
+reference's config layer (reference: pkg/gofr/config/config.go:3-6 defines the
+two-method interface; pkg/gofr/config/godotenv.go:29-81 loads ./configs/.env
+then .{APP_ENV}.env as an overriding overlay, with process env winning last).
+
+The design is the same two-method contract (``get`` / ``get_or_default``) so
+every other subsystem depends only on this tiny surface.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Protocol, runtime_checkable
+
+__all__ = ["Config", "EnvConfig", "MapConfig", "load_env_file", "new_env_config"]
+
+
+@runtime_checkable
+class Config(Protocol):
+    """The configuration contract every subsystem reads through."""
+
+    def get(self, key: str) -> str | None:  # pragma: no cover - protocol
+        ...
+
+    def get_or_default(self, key: str, default: str) -> str:  # pragma: no cover
+        ...
+
+
+def _parse_env_line(line: str) -> tuple[str, str] | None:
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return None
+    if line.startswith("export "):
+        line = line[len("export "):].lstrip()
+    if "=" not in line:
+        return None
+    key, _, value = line.partition("=")
+    key = key.strip()
+    value = value.strip()
+    # Strip matched quotes and trailing inline comments on unquoted values.
+    if len(value) >= 2 and value[0] == value[-1] and value[0] in ("'", '"'):
+        value = value[1:-1]
+    else:
+        hash_idx = value.find(" #")
+        if hash_idx != -1:
+            value = value[:hash_idx].rstrip()
+    return key, value
+
+
+def load_env_file(path: str) -> dict[str, str]:
+    """Parse a dotenv file into a dict. Missing file -> empty dict."""
+    out: dict[str, str] = {}
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for raw in fh:
+                kv = _parse_env_line(raw)
+                if kv is not None:
+                    out[kv[0]] = kv[1]
+    except (FileNotFoundError, IsADirectoryError):
+        return {}
+    return out
+
+
+class EnvConfig:
+    """Config backed by a layered env map: .env < .{APP_ENV}.env < process env.
+
+    Like the reference loader, values from the dotenv files are materialized
+    once at construction; the process environment is consulted live so tests
+    and operators can override at any time.
+    """
+
+    def __init__(self, file_values: Mapping[str, str] | None = None) -> None:
+        self._file_values: dict[str, str] = dict(file_values or {})
+
+    def get(self, key: str) -> str | None:
+        val = os.environ.get(key)
+        if val is not None:
+            return val
+        return self._file_values.get(key)
+
+    def get_or_default(self, key: str, default: str) -> str:
+        val = self.get(key)
+        return val if val is not None else default
+
+
+class MapConfig:
+    """Static config for tests: values come from a plain dict only."""
+
+    def __init__(self, values: Mapping[str, str] | None = None) -> None:
+        self._values = dict(values or {})
+
+    def get(self, key: str) -> str | None:
+        return self._values.get(key)
+
+    def get_or_default(self, key: str, default: str) -> str:
+        return self._values.get(key, default)
+
+
+def new_env_config(config_dir: str = "./configs") -> EnvConfig:
+    """Build the standard layered EnvConfig.
+
+    Loads ``{config_dir}/.env`` first, then overlays
+    ``{config_dir}/.{APP_ENV}.env`` when ``APP_ENV`` is set (reference:
+    pkg/gofr/config/godotenv.go:36-69 uses the same precedence).
+    """
+    values = load_env_file(os.path.join(config_dir, ".env"))
+    app_env = os.environ.get("APP_ENV") or values.get("APP_ENV")
+    if app_env:
+        overlay = load_env_file(os.path.join(config_dir, f".{app_env}.env"))
+        values.update(overlay)
+    return EnvConfig(values)

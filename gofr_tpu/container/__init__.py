@@ -1,0 +1,271 @@
+"""Dependency-injection container.
+
+The reference's Container (pkg/gofr/container/container.go:43-66) is the hub
+holding logger, metrics manager, every datasource handle, and inter-service
+HTTP clients; construction is conditional on config presence
+(container.go:83-150), framework metrics are registered at build time
+(container.go:218-250), and ``Health()`` aggregates per-datasource health into
+UP/DEGRADED (container/health.go:8-94).
+
+This implementation keeps the same shape and adds the TPU-native member the
+reference never had: ``ml`` — the model runtime datasource (engines, mesh,
+dynamic batcher) that BASELINE.json's north star demands.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Protocol, runtime_checkable
+
+from ..config import Config, MapConfig
+from ..logging import Logger, new_logger
+from ..metrics import Manager
+
+__all__ = ["Container", "HealthStatus", "new_container"]
+
+STATUS_UP = "UP"
+STATUS_DOWN = "DOWN"
+STATUS_DEGRADED = "DEGRADED"
+
+
+@runtime_checkable
+class HealthChecker(Protocol):
+    def health_check(self) -> dict: ...
+
+
+@runtime_checkable
+class Provider(Protocol):
+    """Externally-injected datasource contract (reference
+    container/datasources.go:278-290): the app injects observability then
+    connects."""
+
+    def use_logger(self, logger: Any) -> None: ...
+    def use_metrics(self, metrics: Any) -> None: ...
+    def use_tracer(self, tracer: Any) -> None: ...
+    def connect(self) -> None: ...
+
+
+class HealthStatus(dict):
+    """dict payload for /.well-known/health."""
+
+
+class Container:
+    """Holds every injectable the handler Context exposes."""
+
+    def __init__(self, config: Config | None = None, logger: Logger | None = None) -> None:
+        self.config: Config = config or MapConfig()
+        self.logger: Logger = logger or new_logger(
+            self.config.get("LOG_LEVEL") if self.config else None
+        )
+        self.metrics_manager: Manager = Manager()
+        self.tracer = None  # set by App (gofr_tpu.tracing.Tracer)
+        self.app_name = self.config.get_or_default("APP_NAME", "gofr-app")
+        self.app_version = self.config.get_or_default("APP_VERSION", "dev")
+
+        # datasources (None until configured/added)
+        self.sql = None
+        self.redis = None
+        self.kv = None
+        self.file = None
+        self.pubsub = None
+        self.cassandra = None
+        self.clickhouse = None
+        self.mongo = None
+        self.dgraph = None
+        self.solr = None
+        self.opentsdb = None
+        self.ml = None  # TPU model runtime — the new first-class datasource
+
+        self.services: dict[str, Any] = {}  # inter-service HTTP clients
+        self._extra_datasources: dict[str, Any] = {}
+        self.websocket_connections: dict[str, Any] = {}
+
+    # -- registration --------------------------------------------------------
+    def register_framework_metrics(self) -> None:
+        """Default metric set (reference container.go:218-250) + TPU gauges."""
+        m = self.metrics_manager
+        m.new_gauge("app_info", "app info: name and version")
+        m.set_gauge("app_info", 1, app_name=self.app_name, app_version=self.app_version)
+        m.new_histogram("app_http_response", "HTTP response time in seconds")
+        m.new_histogram("app_http_service_response", "outbound HTTP call time in seconds")
+        m.new_histogram("app_sql_stats", "SQL statement time in seconds")
+        m.new_histogram("app_redis_stats", "Redis command time in seconds")
+        m.new_counter("app_pubsub_publish_total_count", "messages published")
+        m.new_counter("app_pubsub_publish_success_count", "messages published OK")
+        m.new_counter("app_pubsub_subscribe_total_count", "messages received")
+        m.new_counter("app_pubsub_subscribe_success_count", "messages handled OK")
+        # process gauges (reference exposes go runtime stats; here: python/proc)
+        m.new_gauge("app_process_memory_bytes", "resident set size")
+        m.new_gauge("app_process_threads", "thread count")
+        m.new_gauge("app_process_uptime_seconds", "seconds since start")
+        # TPU runtime metrics — green-field (BASELINE.json north star)
+        m.new_histogram(
+            "app_tpu_step_seconds", "on-device execute time per step",
+        )
+        m.new_gauge("app_tpu_hbm_bytes_in_use", "HBM bytes in use per device")
+        m.new_gauge("app_tpu_hbm_bytes_limit", "HBM bytes limit per device")
+        m.new_histogram("app_ml_batch_size", "dynamic batcher batch sizes",
+                        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+        m.new_histogram("app_ml_queue_seconds", "request time in batch queue")
+        self._start_time = time.time()
+
+    def refresh_process_metrics(self) -> None:
+        import threading
+
+        m = self.metrics_manager
+        try:
+            import resource
+
+            rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            m.set_gauge("app_process_memory_bytes", rss_kb * 1024)
+        except Exception:
+            pass
+        m.set_gauge("app_process_threads", threading.active_count())
+        m.set_gauge("app_process_uptime_seconds", time.time() - getattr(self, "_start_time", time.time()))
+        if self.ml is not None and hasattr(self.ml, "refresh_device_metrics"):
+            try:
+                self.ml.refresh_device_metrics(m)
+            except Exception:
+                pass
+
+    def metrics(self) -> Manager:
+        return self.metrics_manager
+
+    def add_datasource(self, name: str, ds: Any) -> None:
+        """Inject an external datasource through the Provider protocol
+        (reference external_db.go:10-146)."""
+        if hasattr(ds, "use_logger"):
+            ds.use_logger(self.logger)
+        if hasattr(ds, "use_metrics"):
+            ds.use_metrics(self.metrics_manager)
+        if hasattr(ds, "use_tracer"):
+            ds.use_tracer(self.tracer)
+        if hasattr(ds, "connect"):
+            ds.connect()
+        if hasattr(self, name) and getattr(self, name, None) is None:
+            setattr(self, name, ds)
+        else:
+            self._extra_datasources[name] = ds
+
+    def get_datasource(self, name: str) -> Any:
+        if hasattr(self, name) and getattr(self, name) is not None:
+            return getattr(self, name)
+        return self._extra_datasources.get(name)
+
+    def get_http_service(self, name: str) -> Any:
+        return self.services.get(name)
+
+    # -- health --------------------------------------------------------------
+    def _datasource_items(self) -> list[tuple[str, Any]]:
+        names = [
+            "sql", "redis", "kv", "file", "pubsub", "cassandra", "clickhouse",
+            "mongo", "dgraph", "solr", "opentsdb", "ml",
+        ]
+        items = [(n, getattr(self, n)) for n in names if getattr(self, n) is not None]
+        items.extend(self._extra_datasources.items())
+        return items
+
+    async def health(self) -> HealthStatus:
+        """Aggregate readiness (reference container/health.go:8-94): overall
+        DEGRADED if any datasource or service reports DOWN."""
+        out = HealthStatus()
+        overall = STATUS_UP
+        for name, ds in self._datasource_items():
+            checker = getattr(ds, "health_check", None)
+            if checker is None:
+                continue
+            try:
+                result = checker()
+                if inspect.isawaitable(result):
+                    result = await result
+            except Exception as exc:
+                result = {"status": STATUS_DOWN, "error": str(exc)}
+            if not isinstance(result, dict):
+                result = {"status": STATUS_UP, "details": result}
+            if result.get("status") != STATUS_UP:
+                overall = STATUS_DEGRADED
+            out[name] = result
+        for name, svc in self.services.items():
+            checker = getattr(svc, "health_check", None)
+            if checker is None:
+                continue
+            try:
+                result = checker()
+                if inspect.isawaitable(result):
+                    result = await result
+            except Exception as exc:
+                result = {"status": STATUS_DOWN, "error": str(exc)}
+            if result.get("status") != STATUS_UP:
+                overall = STATUS_DEGRADED
+            out[f"{name}-service"] = result
+        out["status"] = overall
+        out["name"] = self.app_name
+        out["version"] = self.app_version
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+    async def close(self) -> None:
+        for _, ds in self._datasource_items():
+            closer = getattr(ds, "close", None)
+            if closer is None:
+                continue
+            try:
+                result = closer()
+                if inspect.isawaitable(result):
+                    await result
+            except Exception as exc:
+                self.logger.warnf("error closing datasource: %s", exc)
+        for svc in self.services.values():
+            closer = getattr(svc, "close", None)
+            if closer is not None:
+                try:
+                    result = closer()
+                    if inspect.isawaitable(result):
+                        await result
+                except Exception:
+                    pass
+
+
+def new_container(config: Config, logger: Logger | None = None) -> Container:
+    """Build a container from config, conditionally constructing datasources
+    whose configs are present (reference container.go:83-150)."""
+    c = Container(config, logger=logger)
+    c.register_framework_metrics()
+
+    # SQL: DB_DIALECT in {sqlite, mysql, postgres}; only sqlite is available
+    # in-image, others require network drivers and are constructed lazily.
+    dialect = config.get("DB_DIALECT")
+    if dialect:
+        from ..datasource.sql import new_sql
+
+        c.sql = new_sql(config, c.logger, c.metrics_manager)
+
+    if config.get("REDIS_HOST"):
+        from ..datasource.redis import Redis
+
+        c.redis = Redis(
+            host=config.get_or_default("REDIS_HOST", "localhost"),
+            port=int(config.get_or_default("REDIS_PORT", "6379")),
+            logger=c.logger,
+            metrics=c.metrics_manager,
+        )
+        try:
+            c.redis.connect()
+        except Exception as exc:
+            c.logger.errorf("could not connect to redis: %s", exc)
+
+    backend = (config.get("PUBSUB_BACKEND") or "").lower()
+    if backend:
+        from ..datasource.pubsub import new_pubsub
+
+        c.pubsub = new_pubsub(backend, config, c.logger, c.metrics_manager)
+
+    if config.get("KV_STORE_PATH"):
+        from ..datasource.kv import BadgerLikeKV
+
+        c.kv = BadgerLikeKV(config.get("KV_STORE_PATH"), logger=c.logger)
+        c.kv.connect()
+
+    return c

@@ -1,0 +1,155 @@
+"""Mock container for hermetic handler tests.
+
+Mirrors the reference's ``container.NewMockContainer`` (pkg/gofr/container/
+mock_container.go:46-151): returns a fully-wired container whose datasources
+are local fakes — an in-memory sqlite SQL (the reference itself uses pure-Go
+sqlite as a real-but-local dialect, SURVEY §4), a dict-backed Redis fake, the
+in-process pub/sub broker, an in-memory KV store — plus a ``Mocks`` handle for
+seeding and asserting on them. No sockets, no services, deterministic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from ..config import MapConfig
+from ..logging import Logger, Level
+from . import Container
+
+__all__ = ["new_mock_container", "Mocks", "FakeRedis"]
+
+
+class FakeRedis:
+    """Dict-backed Redis with the same convenience surface as the real client."""
+
+    def __init__(self) -> None:
+        self.store: dict[str, Any] = {}
+        self.hashes: dict[str, dict[str, str]] = {}
+        self.lists: dict[str, list] = {}
+
+    def connect(self) -> None:
+        pass
+
+    def ping(self) -> bool:
+        return True
+
+    def set(self, key: str, value: Any, ex: int | None = None) -> str:
+        self.store[key] = str(value)
+        return "OK"
+
+    def get(self, key: str) -> str | None:
+        return self.store.get(key)
+
+    def delete(self, *keys: str) -> int:
+        n = 0
+        for k in keys:
+            if self.store.pop(k, None) is not None:
+                n += 1
+        return n
+
+    def exists(self, *keys: str) -> int:
+        return sum(1 for k in keys if k in self.store)
+
+    def incr(self, key: str) -> int:
+        val = int(self.store.get(key, "0")) + 1
+        self.store[key] = str(val)
+        return val
+
+    def expire(self, key: str, seconds: int) -> int:
+        return 1 if key in self.store else 0
+
+    def hset(self, key: str, field: str, value: Any) -> int:
+        self.hashes.setdefault(key, {})[field] = str(value)
+        return 1
+
+    def hget(self, key: str, field: str) -> str | None:
+        return self.hashes.get(key, {}).get(field)
+
+    def hgetall(self, key: str) -> dict[str, str]:
+        return dict(self.hashes.get(key, {}))
+
+    def lpush(self, key: str, *values: Any) -> int:
+        lst = self.lists.setdefault(key, [])
+        for v in values:
+            lst.insert(0, str(v))
+        return len(lst)
+
+    def rpop(self, key: str) -> str | None:
+        lst = self.lists.get(key)
+        return lst.pop() if lst else None
+
+    def pipeline(self):
+        return _FakePipeline(self)
+
+    tx_pipeline = pipeline
+
+    def command(self, *args: Any) -> Any:
+        raise NotImplementedError(f"FakeRedis does not implement {args[0]}")
+
+    def health_check(self) -> dict:
+        return {"status": "UP", "details": {"backend": "fake"}}
+
+    def close(self) -> None:
+        pass
+
+
+class _FakePipeline:
+    def __init__(self, redis: FakeRedis) -> None:
+        self._redis = redis
+        self._ops: list = []
+
+    def set(self, key: str, value: Any):
+        self._ops.append(("set", key, value))
+        return self
+
+    def get(self, key: str):
+        self._ops.append(("get", key))
+        return self
+
+    def delete(self, *keys: str):
+        self._ops.append(("delete", *keys))
+        return self
+
+    def command(self, *args):
+        self._ops.append(args)
+        return self
+
+    def exec(self) -> list:
+        out = []
+        for op in self._ops:
+            name, *args = op
+            out.append(getattr(self._redis, name)(*args))
+        self._ops = []
+        return out
+
+    def discard(self) -> None:
+        self._ops = []
+
+
+@dataclass
+class Mocks:
+    sql: Any
+    redis: FakeRedis
+    kv: Any
+    pubsub: Any
+    ml: Any = None
+
+
+def new_mock_container(config: dict[str, str] | None = None) -> tuple[Container, Mocks]:
+    from ..datasource.kv import BadgerLikeKV
+    from ..datasource.pubsub import InProcessBroker
+    from ..datasource.sql import SQL
+
+    container = Container(MapConfig(config or {}), logger=Logger(Level.FATAL))
+    container.register_framework_metrics()
+    container.sql = SQL(":memory:", "sqlite")
+    container.redis = FakeRedis()
+    container.kv = BadgerLikeKV(None)
+    container.kv.connect()
+    container.pubsub = InProcessBroker(metrics=container.metrics_manager)
+    mocks = Mocks(
+        sql=container.sql, redis=container.redis, kv=container.kv,
+        pubsub=container.pubsub,
+    )
+    return container, mocks

@@ -1,0 +1,136 @@
+"""Per-request Context — the single argument every handler receives.
+
+Mirrors the reference's Context (pkg/gofr/context.go:17-35): it embeds the
+transport Request, the whole DI container, and a private responder; ``trace``
+opens child spans (context.go:59-69); ``get_auth_info`` surfaces middleware
+auth results (context.go:101-113); CLI contexts expose ``out`` for terminal
+output. Datasource handles (sql/redis/kv/ml/...) are reached as attributes,
+delegated to the container, so handlers read ``ctx.sql``, ``ctx.ml`` exactly
+like the reference's ``ctx.SQL`` / the new ``ctx.ML``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .container import Container
+from .tracing import Span
+
+__all__ = ["Context", "AuthInfo"]
+
+_DELEGATED = frozenset(
+    {
+        "sql", "redis", "kv", "file", "pubsub", "cassandra", "clickhouse",
+        "mongo", "dgraph", "solr", "opentsdb", "ml", "logger", "config",
+    }
+)
+
+
+class AuthInfo:
+    """Access to middleware-established identity (reference GetAuthInfo)."""
+
+    def __init__(self, method: str | None, identity: Any) -> None:
+        self._method = method
+        self._identity = identity
+
+    def get_username(self) -> str:
+        return self._identity if self._method == "basic" else ""
+
+    def get_api_key(self) -> str:
+        return self._identity if self._method == "apikey" else ""
+
+    def get_claims(self) -> dict:
+        return self._identity if self._method == "oauth" and isinstance(self._identity, dict) else {}
+
+    @property
+    def method(self) -> str | None:
+        return self._method
+
+
+class Context:
+    def __init__(
+        self,
+        request: Any,
+        container: Container,
+        *,
+        span: Span | None = None,
+        out: Any = None,
+    ) -> None:
+        self.request = request
+        self.container = container
+        self.span = span
+        self.out = out  # terminal writer in CLI mode
+
+    # -- delegation ----------------------------------------------------------
+    def __getattr__(self, name: str) -> Any:
+        if name in _DELEGATED:
+            return getattr(self.container, name)
+        raise AttributeError(f"Context has no attribute {name!r}")
+
+    def metrics(self):
+        return self.container.metrics_manager
+
+    def get_http_service(self, name: str) -> Any:
+        return self.container.get_http_service(name)
+
+    def get_datasource(self, name: str) -> Any:
+        return self.container.get_datasource(name)
+
+    # -- request passthrough ---------------------------------------------------
+    def param(self, key: str) -> str:
+        return self.request.param(key)
+
+    def params(self, key: str) -> list[str]:
+        return self.request.params(key)
+
+    def path_param(self, key: str) -> str:
+        return self.request.path_param(key)
+
+    async def bind(self, model: type | None = None) -> Any:
+        return await self.request.bind(model)
+
+    def host_name(self) -> str:
+        return self.request.host_name()
+
+    @property
+    def headers(self) -> Any:
+        return getattr(self.request, "headers", {})
+
+    # -- tracing ---------------------------------------------------------------
+    def trace(self, name: str) -> Span:
+        """Open a user child span; ``with ctx.trace("work"):`` (reference
+        Context.Trace)."""
+        tracer = self.container.tracer
+        if tracer is None:
+            from .tracing import NoopTracer
+
+            tracer = self.container.tracer = NoopTracer()
+        return tracer.start_span(name, parent=self.span)
+
+    # -- auth ------------------------------------------------------------------
+    def get_auth_info(self) -> AuthInfo:
+        raw = getattr(self.request, "raw", None)
+        auth = None
+        if raw is not None:
+            try:
+                auth = raw.get("gofr_auth")
+            except Exception:
+                auth = None
+        if auth is None:
+            return AuthInfo(None, None)
+        return AuthInfo(auth[0], auth[1])
+
+    # -- websocket -------------------------------------------------------------
+    async def write_message_to_socket(self, data: Any) -> None:
+        """Write to the current request's websocket (reference
+        context.go:78-88)."""
+        ws = getattr(self.request, "websocket", None)
+        if ws is None:
+            raise RuntimeError("no websocket on this request")
+        await ws.send_response(data)
+
+    async def write_message_to_service(self, service_name: str, data: Any) -> None:
+        conn = self.container.websocket_connections.get(service_name)
+        if conn is None:
+            raise RuntimeError(f"no websocket connection registered for {service_name}")
+        await conn.send_response(data)

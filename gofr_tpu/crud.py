@@ -1,0 +1,137 @@
+"""CRUD auto-handlers.
+
+Mirrors the reference's AddRESTHandlers (pkg/gofr/crud_handlers.go:66-330 +
+datasource/sql/query_builder.go:21-90): reflect an entity dataclass into
+metadata (first field is the primary key; field metadata ``sql="not_null"`` /
+``auto_increment`` honored), register POST/GET/GET-by-id/PUT/DELETE under
+``/{snake_case(entity)}``, generate dialect-aware SQL, and let the entity
+class override any verb by defining ``create/get_all/get/update/delete``
+methods itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any
+
+from .context import Context
+from .http.errors import EntityNotFound, InvalidInput
+
+__all__ = ["register_crud_handlers", "snake_case"]
+
+
+def snake_case(name: str) -> str:
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower()
+
+
+@dataclasses.dataclass
+class _EntityMeta:
+    name: str
+    table: str
+    fields: list[str]
+    primary_key: str
+    auto_increment: bool
+
+
+def scan_entity(entity: type) -> _EntityMeta:
+    if not dataclasses.is_dataclass(entity):
+        raise InvalidInput(f"entity {entity.__name__} must be a dataclass")
+    fields = dataclasses.fields(entity)
+    if not fields:
+        raise InvalidInput(f"entity {entity.__name__} has no fields")
+    pk = fields[0]
+    auto_inc = pk.metadata.get("sql", "") == "auto_increment"
+    return _EntityMeta(
+        name=entity.__name__,
+        table=snake_case(entity.__name__),
+        fields=[f.name for f in fields],
+        primary_key=pk.name,
+        auto_increment=auto_inc,
+    )
+
+
+def register_crud_handlers(app, entity: type) -> None:
+    meta = scan_entity(entity)
+    route = f"/{meta.table}"
+
+    def override(verb: str):
+        fn = getattr(entity, verb, None)
+        return fn if callable(fn) else None
+
+    app.post(route, override("create") or _create_handler(entity, meta))
+    app.get(route, override("get_all") or _get_all_handler(entity, meta))
+    app.get(route + "/{id}", override("get") or _get_handler(entity, meta))
+    app.put(route + "/{id}", override("update") or _update_handler(entity, meta))
+    app.delete(route + "/{id}", override("delete") or _delete_handler(entity, meta))
+
+
+def _create_handler(entity: type, meta: _EntityMeta):
+    async def create(ctx: Context) -> Any:
+        obj = await ctx.bind(entity)
+        fields = list(meta.fields)
+        if meta.auto_increment:
+            fields = fields[1:]
+        cols = ", ".join(fields)
+        ph = ", ".join("?" for _ in fields)
+        values = [getattr(obj, f) for f in fields]
+        new_id = ctx.sql.exec_last_id(
+            f"INSERT INTO {meta.table} ({cols}) VALUES ({ph})", *values
+        )
+        if meta.auto_increment:
+            return {"id": new_id, "message": f"{meta.name} successfully created with id: {new_id}"}
+        pk = getattr(obj, meta.primary_key)
+        return {"message": f"{meta.name} successfully created with id: {pk}"}
+
+    return create
+
+
+def _get_all_handler(entity: type, meta: _EntityMeta):
+    async def get_all(ctx: Context) -> Any:
+        return ctx.sql.select(entity, f"SELECT * FROM {meta.table}")
+
+    return get_all
+
+
+def _get_handler(entity: type, meta: _EntityMeta):
+    async def get(ctx: Context) -> Any:
+        entity_id = ctx.path_param("id")
+        rows = ctx.sql.select(
+            entity, f"SELECT * FROM {meta.table} WHERE {meta.primary_key} = ?", entity_id
+        )
+        if not rows:
+            raise EntityNotFound(meta.primary_key, entity_id)
+        return rows[0]
+
+    return get
+
+
+def _update_handler(entity: type, meta: _EntityMeta):
+    async def update(ctx: Context) -> Any:
+        entity_id = ctx.path_param("id")
+        obj = await ctx.bind(entity)
+        fields = [f for f in meta.fields if f != meta.primary_key]
+        sets = ", ".join(f"{f} = ?" for f in fields)
+        values = [getattr(obj, f) for f in fields]
+        n = ctx.sql.exec(
+            f"UPDATE {meta.table} SET {sets} WHERE {meta.primary_key} = ?",
+            *values, entity_id,
+        )
+        if n == 0:
+            raise EntityNotFound(meta.primary_key, entity_id)
+        return f"{meta.name} successfully updated with id: {entity_id}"
+
+    return update
+
+
+def _delete_handler(entity: type, meta: _EntityMeta):
+    async def delete(ctx: Context) -> Any:
+        entity_id = ctx.path_param("id")
+        n = ctx.sql.exec(
+            f"DELETE FROM {meta.table} WHERE {meta.primary_key} = ?", entity_id
+        )
+        if n == 0:
+            raise EntityNotFound(meta.primary_key, entity_id)
+        return f"{meta.name} successfully deleted with id: {entity_id}"
+
+    return delete

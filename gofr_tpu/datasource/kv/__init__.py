@@ -1,0 +1,144 @@
+"""Embedded key-value store.
+
+Fills the role of the reference's BadgerDB KV datasource
+(pkg/gofr/datasource/kv-store/badger, Get/Set/Delete over an embedded store):
+a from-scratch append-only log with an in-memory index, crash-safe recovery by
+log replay, and periodic compaction. No external dependency.
+
+Format: each record is ``<op:1><klen:4><vlen:4><key><value>`` little-endian.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+__all__ = ["BadgerLikeKV", "KeyNotFoundError"]
+
+_OP_SET = 1
+_OP_DEL = 2
+_HEADER = struct.Struct("<BII")
+
+
+class KeyNotFoundError(KeyError):
+    def __init__(self, key: str) -> None:
+        super().__init__(f"key {key!r} not found")
+
+
+class BadgerLikeKV:
+    """Embedded durable KV store (set/get/delete + health)."""
+
+    def __init__(self, path: str | None = None, logger=None,
+                 compact_threshold: int = 4096) -> None:
+        self._path = path
+        self._logger = logger
+        self._index: dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+        self._fh = None
+        self._dead_records = 0
+        self._compact_threshold = compact_threshold
+
+    # -- lifecycle -----------------------------------------------------------
+    def connect(self) -> None:
+        if self._path is None:
+            return  # pure in-memory mode
+        os.makedirs(os.path.dirname(os.path.abspath(self._path)), exist_ok=True)
+        if os.path.exists(self._path):
+            self._replay()
+        self._fh = open(self._path, "ab")
+        if self._logger is not None:
+            self._logger.infof("kv store open at %s (%d keys)", self._path, len(self._index))
+
+    def _replay(self) -> None:
+        with open(self._path, "rb") as fh:
+            data = fh.read()
+        off = 0
+        while off + _HEADER.size <= len(data):
+            op, klen, vlen = _HEADER.unpack_from(data, off)
+            off += _HEADER.size
+            if off + klen + vlen > len(data):
+                break  # truncated tail record: drop it (crash recovery)
+            key = data[off:off + klen]
+            off += klen
+            value = data[off:off + vlen]
+            off += vlen
+            if op == _OP_SET:
+                if key in self._index:
+                    self._dead_records += 1
+                self._index[key] = value
+            elif op == _OP_DEL:
+                self._index.pop(key, None)
+                self._dead_records += 1
+
+    def _append(self, op: int, key: bytes, value: bytes) -> None:
+        if self._fh is None:
+            return
+        self._fh.write(_HEADER.pack(op, len(key), len(value)) + key + value)
+        self._fh.flush()
+
+    def _maybe_compact(self) -> None:
+        if self._path is None or self._dead_records < self._compact_threshold:
+            return
+        tmp = self._path + ".compact"
+        with open(tmp, "wb") as fh:
+            for k, v in self._index.items():
+                fh.write(_HEADER.pack(_OP_SET, len(k), len(v)) + k + v)
+        self._fh.close()
+        os.replace(tmp, self._path)
+        self._fh = open(self._path, "ab")
+        self._dead_records = 0
+
+    # -- API -----------------------------------------------------------------
+    def set(self, key: str, value: str | bytes) -> None:
+        kb = key.encode()
+        vb = value.encode() if isinstance(value, str) else bytes(value)
+        with self._lock:
+            if kb in self._index:
+                self._dead_records += 1
+            self._index[kb] = vb
+            self._append(_OP_SET, kb, vb)
+            self._maybe_compact()
+
+    def get(self, key: str) -> str:
+        with self._lock:
+            vb = self._index.get(key.encode())
+        if vb is None:
+            raise KeyNotFoundError(key)
+        return vb.decode("utf-8", errors="replace")
+
+    def get_bytes(self, key: str) -> bytes:
+        with self._lock:
+            vb = self._index.get(key.encode())
+        if vb is None:
+            raise KeyNotFoundError(key)
+        return vb
+
+    def delete(self, key: str) -> None:
+        kb = key.encode()
+        with self._lock:
+            if kb in self._index:
+                del self._index[kb]
+                self._dead_records += 1
+                self._append(_OP_DEL, kb, b"")
+                self._maybe_compact()
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return [k.decode() for k in self._index]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def health_check(self) -> dict:
+        return {
+            "status": "UP",
+            "details": {"path": self._path or ":memory:", "keys": len(self)},
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

@@ -1,0 +1,262 @@
+"""Redis datasource: a from-scratch RESP2 client.
+
+The reference wraps go-redis with command logging + ``app_redis_stats``
+histogram + health PING (pkg/gofr/datasource/redis/redis.go:37-73, hook.go).
+No Python redis client ships in this image, so this module implements the
+RESP wire protocol directly over a socket pool — commands cover the surface
+the framework itself needs (strings, hashes, lists, expiry, ping, pipeline)
+plus a generic ``command`` escape hatch for everything else.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any
+
+__all__ = ["Redis", "RedisError"]
+
+
+class RedisError(Exception):
+    pass
+
+
+def _encode_command(args: tuple) -> bytes:
+    parts = [b"*%d\r\n" % len(args)]
+    for a in args:
+        if isinstance(a, bytes):
+            b = a
+        elif isinstance(a, str):
+            b = a.encode()
+        else:
+            b = str(a).encode()
+        parts.append(b"$%d\r\n%s\r\n" % (len(b), b))
+    return b"".join(parts)
+
+
+class _Conn:
+    def __init__(self, host: str, port: int, timeout: float) -> None:
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.buf = b""
+
+    def send(self, payload: bytes) -> None:
+        self.sock.sendall(payload)
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise RedisError("connection closed")
+            self.buf += chunk
+        line, _, self.buf = self.buf.partition(b"\r\n")
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n + 2:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise RedisError("connection closed")
+            self.buf += chunk
+        data, self.buf = self.buf[:n], self.buf[n + 2:]
+        return data
+
+    def read_reply(self) -> Any:
+        line = self._read_line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RedisError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            return self._read_exact(n)
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [self.read_reply() for _ in range(n)]
+        raise RedisError(f"unexpected RESP type {kind!r}")
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class Pipeline:
+    """Buffered commands flushed in one round trip (used by migrations)."""
+
+    def __init__(self, client: "Redis") -> None:
+        self._client = client
+        self._commands: list[tuple] = []
+
+    def command(self, *args: Any) -> "Pipeline":
+        self._commands.append(args)
+        return self
+
+    def set(self, key: str, value: Any) -> "Pipeline":
+        return self.command("SET", key, value)
+
+    def get(self, key: str) -> "Pipeline":
+        return self.command("GET", key)
+
+    def delete(self, *keys: str) -> "Pipeline":
+        return self.command("DEL", *keys)
+
+    def exec(self) -> list[Any]:
+        if not self._commands:
+            return []
+        out = self._client._pipeline(self._commands)
+        self._commands = []
+        return out
+
+    def discard(self) -> None:
+        self._commands = []
+
+
+class Redis:
+    """Socket-pool RESP client with per-command log + histogram."""
+
+    def __init__(self, host: str = "localhost", port: int = 6379,
+                 logger=None, metrics=None, timeout: float = 5.0,
+                 pool_size: int = 4) -> None:
+        self.host = host
+        self.port = port
+        self._logger = logger
+        self._metrics = metrics
+        self._timeout = timeout
+        self._pool: list[_Conn] = []
+        self._pool_lock = threading.Lock()
+        self._pool_size = pool_size
+        self._connected = False
+
+    # -- pool ----------------------------------------------------------------
+    def connect(self) -> None:
+        conn = _Conn(self.host, self.port, self._timeout)
+        with self._pool_lock:
+            self._pool.append(conn)
+        self._connected = True
+        if self._logger is not None:
+            self._logger.infof("connected to redis at %s:%d", self.host, self.port)
+
+    def _acquire(self) -> _Conn:
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+        return _Conn(self.host, self.port, self._timeout)
+
+    def _release(self, conn: _Conn) -> None:
+        with self._pool_lock:
+            if len(self._pool) < self._pool_size:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    # -- command execution ------------------------------------------------------
+    def command(self, *args: Any) -> Any:
+        start = time.perf_counter()
+        conn = self._acquire()
+        try:
+            conn.send(_encode_command(args))
+            reply = conn.read_reply()
+            self._release(conn)
+            return reply
+        except (OSError, RedisError):
+            conn.close()
+            raise
+        finally:
+            self._observe(str(args[0]), start)
+
+    def _pipeline(self, commands: list[tuple]) -> list[Any]:
+        start = time.perf_counter()
+        conn = self._acquire()
+        try:
+            conn.send(b"".join(_encode_command(c) for c in commands))
+            out = [conn.read_reply() for _ in commands]
+            self._release(conn)
+            return out
+        except (OSError, RedisError):
+            conn.close()
+            raise
+        finally:
+            self._observe("PIPELINE", start)
+
+    def _observe(self, cmd: str, start: float) -> None:
+        dur = time.perf_counter() - start
+        if self._logger is not None:
+            self._logger.debug({"redis": cmd.upper(), "duration": int(dur * 1e6)})
+        if self._metrics is not None:
+            try:
+                self._metrics.record_histogram("app_redis_stats", dur, type=cmd.lower())
+            except Exception:
+                pass
+
+    # -- convenience API ---------------------------------------------------------
+    def ping(self) -> bool:
+        return self.command("PING") == "PONG"
+
+    def set(self, key: str, value: Any, ex: int | None = None) -> Any:
+        if ex is not None:
+            return self.command("SET", key, value, "EX", ex)
+        return self.command("SET", key, value)
+
+    def get(self, key: str) -> str | None:
+        out = self.command("GET", key)
+        return out.decode() if isinstance(out, bytes) else out
+
+    def delete(self, *keys: str) -> int:
+        return self.command("DEL", *keys)
+
+    def exists(self, *keys: str) -> int:
+        return self.command("EXISTS", *keys)
+
+    def incr(self, key: str) -> int:
+        return self.command("INCR", key)
+
+    def expire(self, key: str, seconds: int) -> int:
+        return self.command("EXPIRE", key, seconds)
+
+    def hset(self, key: str, field: str, value: Any) -> int:
+        return self.command("HSET", key, field, value)
+
+    def hget(self, key: str, field: str) -> str | None:
+        out = self.command("HGET", key, field)
+        return out.decode() if isinstance(out, bytes) else out
+
+    def hgetall(self, key: str) -> dict[str, str]:
+        flat = self.command("HGETALL", key) or []
+        it = iter(flat)
+        return {k.decode(): v.decode() for k, v in zip(it, it)}
+
+    def lpush(self, key: str, *values: Any) -> int:
+        return self.command("LPUSH", key, *values)
+
+    def rpop(self, key: str) -> str | None:
+        out = self.command("RPOP", key)
+        return out.decode() if isinstance(out, bytes) else out
+
+    def pipeline(self) -> Pipeline:
+        return Pipeline(self)
+
+    tx_pipeline = pipeline
+
+    # -- health ------------------------------------------------------------------
+    def health_check(self) -> dict:
+        try:
+            if self.ping():
+                return {"status": "UP", "details": {"host": f"{self.host}:{self.port}"}}
+            return {"status": "DOWN", "error": "unexpected PING reply"}
+        except Exception as exc:
+            return {"status": "DOWN", "error": str(exc)}
+
+    def close(self) -> None:
+        with self._pool_lock:
+            for conn in self._pool:
+                conn.close()
+            self._pool.clear()

@@ -1,0 +1,189 @@
+"""gRPC server.
+
+Mirrors the reference's gRPC vertical (pkg/gofr/grpc.go:24-123 + grpc/log.go):
+an async gRPC server with recovery + logging interceptors (span per RPC,
+RPCLog with µs duration and status code), container injection into user
+service structs, and registration of either protoc-generated servicers or
+lightweight JSON-RPC method maps (no protoc needed — useful in this image
+where grpc_tools is absent).
+
+TPU-native addition: ``json_method_handlers`` is how the model-serving RPCs
+(Predict/Generate streams) are mounted without generated stubs.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import time
+import traceback
+from dataclasses import dataclass
+from typing import Any, Awaitable, Callable
+
+import grpc
+import grpc.aio
+
+__all__ = ["start_grpc_server", "JSONService", "RPCLog"]
+
+
+@dataclass
+class RPCLog:
+    """Structured RPC log entry (reference grpc/log.go RPCLog)."""
+
+    method: str
+    duration_us: int
+    status_code: int
+
+    def to_dict(self) -> dict:
+        return {"method": self.method, "duration": self.duration_us,
+                "status": self.status_code}
+
+    def pretty_print(self, writer) -> None:
+        writer.write(f"[38;5;5mGRPC[0m {self.duration_us:8d}μs "
+                     f"{self.status_code} {self.method} ")
+
+
+class _LoggingInterceptor(grpc.aio.ServerInterceptor):
+    """Span + RPCLog per call; panic recovery to INTERNAL (reference
+    grpc.go:26-30 interceptor chain)."""
+
+    def __init__(self, logger, tracer) -> None:
+        self._logger = logger
+        self._tracer = tracer
+
+    async def intercept_service(self, continuation, handler_call_details):
+        handler = await continuation(handler_call_details)
+        if handler is None:
+            return None
+        method = handler_call_details.method
+        logger = self._logger
+        tracer = self._tracer
+
+        def wrap_unary(behavior):
+            async def wrapped(request, context):
+                start = time.perf_counter()
+                span = None
+                if tracer is not None:
+                    span = tracer.start_span(f"grpc {method}", kind="SERVER")
+                code = 0
+                try:
+                    result = behavior(request, context)
+                    if inspect.isawaitable(result):
+                        result = await result
+                    return result
+                except Exception as exc:
+                    code = 13  # INTERNAL
+                    if span is not None:
+                        span.record_exception(exc)
+                    logger.error("grpc panic recovered", method=method,
+                                 error=str(exc), stack=traceback.format_exc())
+                    await context.abort(grpc.StatusCode.INTERNAL, "internal error")
+                finally:
+                    if span is not None:
+                        span.end()
+                    logger.info(RPCLog(
+                        method=method,
+                        duration_us=int((time.perf_counter() - start) * 1e6),
+                        status_code=code,
+                    ))
+
+            return wrapped
+
+        def wrap_stream(behavior):
+            async def wrapped(request, context):
+                start = time.perf_counter()
+                span = None
+                if tracer is not None:
+                    span = tracer.start_span(f"grpc {method}", kind="SERVER")
+                code = 0
+                try:
+                    async for item in behavior(request, context):
+                        yield item
+                except Exception as exc:
+                    code = 13
+                    if span is not None:
+                        span.record_exception(exc)
+                    logger.error("grpc stream panic recovered", method=method,
+                                 error=str(exc))
+                    await context.abort(grpc.StatusCode.INTERNAL, "internal error")
+                finally:
+                    if span is not None:
+                        span.end()
+                    logger.info(RPCLog(
+                        method=method,
+                        duration_us=int((time.perf_counter() - start) * 1e6),
+                        status_code=code,
+                    ))
+
+            return wrapped
+
+        if handler.unary_unary:
+            return grpc.unary_unary_rpc_method_handler(
+                wrap_unary(handler.unary_unary),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        if handler.unary_stream:
+            return grpc.unary_stream_rpc_method_handler(
+                wrap_stream(handler.unary_stream),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        return handler
+
+
+class JSONService:
+    """A proto-less gRPC service: methods exchange JSON-encoded dict payloads.
+
+    Usage::
+
+        svc = JSONService("ml.Inference")
+        svc.unary("Predict", predict_fn)        # async (dict, context) -> dict
+        svc.stream("Generate", generate_fn)     # async gen (dict, ctx) -> dict
+        app.register_service(svc, impl=None)
+    """
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._unary: dict[str, Callable] = {}
+        self._stream: dict[str, Callable] = {}
+
+    def unary(self, method: str, fn: Callable[..., Awaitable[Any]]) -> None:
+        self._unary[method] = fn
+
+    def stream(self, method: str, fn: Callable[..., Any]) -> None:
+        self._stream[method] = fn
+
+    def build_handler(self) -> grpc.GenericRpcHandler:
+        def serialize(obj: Any) -> bytes:
+            return json.dumps(obj).encode()
+
+        def deserialize(raw: bytes) -> Any:
+            return json.loads(raw) if raw else {}
+
+        handlers: dict[str, grpc.RpcMethodHandler] = {}
+        for method, fn in self._unary.items():
+            handlers[method] = grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=deserialize, response_serializer=serialize
+            )
+        for method, fn in self._stream.items():
+            handlers[method] = grpc.unary_stream_rpc_method_handler(
+                fn, request_deserializer=deserialize, response_serializer=serialize
+            )
+        return grpc.method_handlers_generic_handler(self.name, handlers)
+
+
+async def start_grpc_server(services, port: int, logger, tracer, container):
+    server = grpc.aio.server(interceptors=[_LoggingInterceptor(logger, tracer)])
+    for desc, impl in services:
+        if isinstance(desc, JSONService):
+            server.add_generic_rpc_handlers((desc.build_handler(),))
+        elif callable(desc):
+            # protoc-generated add_XServicer_to_server(impl, server); the
+            # container was injected onto impl at register time (grpc.go:81-123)
+            desc(impl, server)
+        else:
+            raise TypeError(f"unsupported gRPC service descriptor: {desc!r}")
+    server.add_insecure_port(f"[::]:{port}")
+    await server.start()
+    return server

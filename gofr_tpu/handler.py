@@ -1,0 +1,117 @@
+"""Handler engine: wraps a user handler into an aiohttp handler.
+
+Mirrors the reference's handler wrapper (pkg/gofr/handler.go:40-108): build a
+Context, run the user function with panic recovery, race completion against
+the configured request timeout (REQUEST_TIMEOUT -> 408), map (result, error)
+to the response via the responder, honor per-response custom headers. The
+reference runs each handler in its own goroutine; here sync handlers are
+dispatched to a worker thread so they never block the event loop, and async
+handlers run natively on it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import traceback
+from typing import Any, Awaitable, Callable
+
+from aiohttp import web
+
+from .container import Container
+from .context import Context
+from .http.errors import GofrError, PanicRecovery, RequestTimeout
+from .http.request import HTTPRequest
+from .http.responder import respond
+
+__all__ = ["wrap_handler", "HandlerFunc"]
+
+HandlerFunc = Callable[[Context], Any | Awaitable[Any]]
+
+
+async def invoke(func: HandlerFunc, ctx: Context) -> Any:
+    """Call a sync-or-async handler; sync goes to the default executor."""
+    if inspect.iscoroutinefunction(func):
+        return await func(ctx)
+    loop = asyncio.get_running_loop()
+    result = await loop.run_in_executor(None, func, ctx)
+    if inspect.isawaitable(result):
+        return await result
+    return result
+
+
+def wrap_handler(
+    func: HandlerFunc,
+    container: Container,
+    request_timeout: float | None = None,
+) -> Callable[[web.Request], Awaitable[web.StreamResponse]]:
+    async def aio_handler(request: web.Request) -> web.StreamResponse:
+        ctx = Context(HTTPRequest(request), container, span=request.get("gofr_span"))
+        result: Any = None
+        err: BaseException | None = None
+        try:
+            coro = invoke(func, ctx)
+            if request_timeout and request_timeout > 0:
+                result = await asyncio.wait_for(coro, timeout=request_timeout)
+            else:
+                result = await coro
+        except asyncio.TimeoutError:
+            err = RequestTimeout()
+        except asyncio.CancelledError:
+            raise
+        except GofrError as exc:
+            err = exc
+        except web.HTTPException:
+            raise
+        except Exception as exc:
+            # panic recovery (reference handler.go:77-97): log the stack,
+            # return an opaque 500 so internals never leak.
+            container.logger.error(
+                "handler panic",
+                error=str(exc),
+                type=type(exc).__name__,
+                stack=traceback.format_exc(),
+            )
+            err = PanicRecovery()
+        return respond(request.method, result, err)
+
+    return aio_handler
+
+
+def health_handler(container: Container):
+    """Aggregated readiness at /.well-known/health (reference handler.go:110)."""
+
+    async def handler(ctx: Context) -> Any:
+        return await ctx.container.health()
+
+    return handler
+
+
+async def alive_handler(_: Context) -> Any:
+    """Liveness at /.well-known/alive (reference handler.go:114-118)."""
+    return {"status": "UP"}
+
+
+async def catch_all_handler(ctx: Context) -> Any:
+    from .http.errors import GofrError, InvalidRoute
+
+    # distinguish 405 (path exists under another method) from 404: probe the
+    # router for sibling methods on the same path (the reference's mux does
+    # this natively; aiohttp's catch-all matches every method so we check)
+    raw = getattr(ctx.request, "raw", None)
+    if raw is not None:
+        allowed: set[str] = set()
+        for resource in raw.app.router.resources():
+            if getattr(resource, "canonical", "") == "/{tail}":
+                continue
+            try:
+                _, methods = await resource.resolve(raw)
+            except Exception:
+                continue
+            allowed |= methods
+        if allowed and raw.method not in allowed:
+            class MethodNotAllowed(GofrError):
+                status_code = 405
+
+            raise MethodNotAllowed("method not allowed")
+    raise InvalidRoute()

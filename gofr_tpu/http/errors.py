@@ -1,0 +1,123 @@
+"""Typed HTTP errors with status-code semantics.
+
+Mirrors the reference's error taxonomy (pkg/gofr/http/errors.go: EntityNotFound
+404, EntityAlreadyExists 409, InvalidParam/MissingParam 400, InvalidRoute 404,
+RequestTimeout 408, PanicRecovery 500) plus the ``StatusCode()`` protocol the
+responder honors (pkg/gofr/http/responder.go:55-84): any raised error exposing
+``status_code`` controls the HTTP status of the JSON error envelope.
+"""
+
+from __future__ import annotations
+
+from http import HTTPStatus
+
+__all__ = [
+    "GofrError",
+    "EntityNotFound",
+    "EntityAlreadyExists",
+    "InvalidParam",
+    "MissingParam",
+    "InvalidRoute",
+    "RequestTimeout",
+    "PanicRecovery",
+    "InvalidInput",
+    "ServiceUnavailable",
+    "Unauthorized",
+    "Forbidden",
+]
+
+
+class GofrError(Exception):
+    """Base class: carries an HTTP status code and a user-facing message."""
+
+    status_code: int = HTTPStatus.INTERNAL_SERVER_ERROR
+
+    def __init__(self, message: str | None = None) -> None:
+        super().__init__(message or self.default_message())
+
+    def default_message(self) -> str:
+        return HTTPStatus(self.status_code).phrase
+
+    @property
+    def message(self) -> str:
+        return str(self)
+
+
+class EntityNotFound(GofrError):
+    status_code = HTTPStatus.NOT_FOUND
+
+    def __init__(self, name: str = "", value: str = "") -> None:
+        if name:
+            super().__init__(f"No entity found with {name}: {value}")
+        else:
+            super().__init__("entity not found")
+
+
+class EntityAlreadyExists(GofrError):
+    status_code = HTTPStatus.CONFLICT
+
+    def __init__(self, message: str = "entity already exists") -> None:
+        super().__init__(message)
+
+
+class InvalidParam(GofrError):
+    status_code = HTTPStatus.BAD_REQUEST
+
+    def __init__(self, *params: str) -> None:
+        n = len(params)
+        super().__init__(f"'{n}' invalid parameter(s): {', '.join(params)}")
+        self.params = params
+
+
+class MissingParam(GofrError):
+    status_code = HTTPStatus.BAD_REQUEST
+
+    def __init__(self, *params: str) -> None:
+        n = len(params)
+        super().__init__(f"'{n}' missing parameter(s): {', '.join(params)}")
+        self.params = params
+
+
+class InvalidInput(GofrError):
+    status_code = HTTPStatus.BAD_REQUEST
+
+
+class InvalidRoute(GofrError):
+    status_code = HTTPStatus.NOT_FOUND
+
+    def __init__(self) -> None:
+        super().__init__("route not registered")
+
+
+class RequestTimeout(GofrError):
+    status_code = HTTPStatus.REQUEST_TIMEOUT
+
+    def __init__(self) -> None:
+        super().__init__("request timed out")
+
+
+class PanicRecovery(GofrError):
+    status_code = HTTPStatus.INTERNAL_SERVER_ERROR
+
+    def __init__(self) -> None:
+        super().__init__("some unexpected error has occurred")
+
+
+class ServiceUnavailable(GofrError):
+    status_code = HTTPStatus.SERVICE_UNAVAILABLE
+
+
+class Unauthorized(GofrError):
+    status_code = HTTPStatus.UNAUTHORIZED
+
+
+class Forbidden(GofrError):
+    status_code = HTTPStatus.FORBIDDEN
+
+
+def status_code_of(err: BaseException) -> int:
+    """Resolve the HTTP status for an arbitrary error (StatusCoder protocol)."""
+    code = getattr(err, "status_code", None)
+    if isinstance(code, int):
+        return code
+    return HTTPStatus.INTERNAL_SERVER_ERROR

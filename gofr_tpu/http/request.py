@@ -1,0 +1,145 @@
+"""Transport-agnostic Request abstraction (HTTP implementation).
+
+The reference defines an implicit Request interface — Context/Param/PathParam/
+Bind/HostName/Params (pkg/gofr/http/request.go:29-79) — implemented by HTTP,
+CLI, and pub/sub transports so one handler signature serves all three. This
+module provides the protocol plus the aiohttp-backed HTTP implementation with
+content-type-switched ``bind`` (JSON / form-urlencoded / multipart / raw
+bytes, reference pkg/gofr/http/request.go Bind + form_data_binder.go).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from typing import Any, Mapping, Protocol, runtime_checkable
+
+from .errors import InvalidInput
+
+__all__ = ["Request", "HTTPRequest"]
+
+
+@runtime_checkable
+class Request(Protocol):
+    def param(self, key: str) -> str: ...
+    def params(self, key: str) -> list[str]: ...
+    def path_param(self, key: str) -> str: ...
+    async def bind(self, model: type | None = None) -> Any: ...
+    def host_name(self) -> str: ...
+
+
+def _coerce(value: Any, annot: Any) -> Any:
+    """Best-effort coercion of a parsed value into an annotated field type."""
+    origin = typing.get_origin(annot)
+    if annot in (None, Any) or value is None:
+        return value
+    if origin is typing.Union or origin is getattr(typing, "UnionType", None):
+        args = [a for a in typing.get_args(annot) if a is not type(None)]
+        if len(args) == 1:
+            return _coerce(value, args[0])
+        return value
+    try:
+        if annot is bool and isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        if annot in (int, float, str, bool) and not isinstance(value, annot):
+            return annot(value)
+    except (TypeError, ValueError):
+        raise InvalidInput(f"cannot convert {value!r} to {annot}")
+    return value
+
+
+def bind_to_model(data: Mapping[str, Any], model: type) -> Any:
+    """Bind a dict into a dataclass (annotated-field coercion) or plain class."""
+    if dataclasses.is_dataclass(model):
+        hints = typing.get_type_hints(model)
+        kwargs = {}
+        for f in dataclasses.fields(model):
+            if f.name in data:
+                kwargs[f.name] = _coerce(data[f.name], hints.get(f.name))
+        try:
+            return model(**kwargs)
+        except TypeError as exc:
+            raise InvalidInput(str(exc))
+    obj = model()
+    for k, v in data.items():
+        if hasattr(obj, k) or not hasattr(obj, "__slots__"):
+            setattr(obj, k, v)
+    return obj
+
+
+class HTTPRequest:
+    """HTTP implementation of the Request contract over aiohttp."""
+
+    def __init__(self, raw: "Any") -> None:  # aiohttp.web.Request
+        self.raw = raw
+
+    # -- params --------------------------------------------------------------
+    def param(self, key: str) -> str:
+        return self.raw.query.get(key, "")
+
+    def params(self, key: str) -> list[str]:
+        # reference Params() splits comma-separated values too
+        out: list[str] = []
+        for v in self.raw.query.getall(key, []):
+            out.extend(v.split(",")) if "," in v else out.append(v)
+        return out
+
+    def path_param(self, key: str) -> str:
+        return self.raw.match_info.get(key, "")
+
+    def path_params(self) -> dict[str, str]:
+        return dict(self.raw.match_info)
+
+    def host_name(self) -> str:
+        scheme = "https" if self.raw.secure else "http"
+        return f"{scheme}://{self.raw.host}"
+
+    @property
+    def method(self) -> str:
+        return self.raw.method
+
+    @property
+    def path(self) -> str:
+        return self.raw.path
+
+    @property
+    def headers(self) -> Mapping[str, str]:
+        return self.raw.headers
+
+    def context(self) -> Any:
+        return self.raw
+
+    # -- binding --------------------------------------------------------------
+    async def body(self) -> bytes:
+        return await self.raw.read()
+
+    async def bind(self, model: type | None = None) -> Any:
+        ctype = (self.raw.content_type or "").lower()
+        if ctype in ("application/json", "") or ctype.endswith("+json"):
+            raw = await self.raw.read()
+            if not raw:
+                data: Any = {}
+            else:
+                try:
+                    data = json.loads(raw)
+                except json.JSONDecodeError as exc:
+                    raise InvalidInput(f"invalid JSON body: {exc}")
+        elif ctype in ("application/x-www-form-urlencoded", "multipart/form-data"):
+            post = await self.raw.post()
+            data = {}
+            for k, v in post.items():
+                # aiohttp FileField for uploaded files; keep bytes + filename
+                if hasattr(v, "file"):
+                    data[k] = v.file.read()
+                else:
+                    data[k] = v
+        elif ctype == "application/octet-stream":
+            data = await self.raw.read()
+        else:
+            data = await self.raw.read()
+        if model is None or isinstance(data, (bytes, bytearray)):
+            return data
+        if not isinstance(data, Mapping):
+            raise InvalidInput("request body must be a JSON object to bind a model")
+        return bind_to_model(data, model)

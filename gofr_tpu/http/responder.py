@@ -1,0 +1,114 @@
+"""Responder: maps (result, error) to an HTTP response.
+
+Implements the reference's status-code inference and JSON envelope
+(pkg/gofr/http/responder.go:24-113): success POST→201, DELETE→204, error with
+partial data→206, errors with a ``status_code`` attribute honored, unknown
+errors→500; bodies are enveloped as ``{"data": ...}`` /
+``{"error": {"message": ...}}``; ``Raw``/``File``/``Redirect``/``Response``
+bypass or extend the envelope.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from http import HTTPStatus
+from typing import Any
+
+from aiohttp import web
+
+from .errors import status_code_of
+from .response import File, Raw, Redirect, Response, Template
+
+__all__ = ["respond", "to_jsonable"]
+
+
+def to_jsonable(obj: Any) -> Any:
+    """Convert handler results (dataclasses, numpy/JAX arrays, sets) to JSON."""
+    if obj is None or isinstance(obj, (str, int, float, bool)):
+        return obj
+    if isinstance(obj, dict):
+        return {str(k): to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_jsonable(v) for v in obj]
+    if isinstance(obj, set):
+        return sorted(to_jsonable(v) for v in obj)
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {k: to_jsonable(v) for k, v in dataclasses.asdict(obj).items()}
+    if hasattr(obj, "tolist"):  # numpy / jax arrays
+        return obj.tolist()
+    if hasattr(obj, "item") and getattr(obj, "shape", None) == ():
+        return obj.item()
+    if hasattr(obj, "to_dict"):
+        return to_jsonable(obj.to_dict())
+    if isinstance(obj, bytes):
+        return obj.decode("utf-8", errors="replace")
+    return str(obj)
+
+
+def _status_code(method: str, data: Any, err: BaseException | None) -> int:
+    if err is not None:
+        if data is not None:
+            return HTTPStatus.PARTIAL_CONTENT
+        return status_code_of(err)
+    if method == "POST":
+        return HTTPStatus.CREATED
+    if method == "DELETE":
+        return HTTPStatus.NO_CONTENT
+    return HTTPStatus.OK
+
+
+def respond(method: str, result: Any, err: BaseException | None) -> web.StreamResponse:
+    """Build the aiohttp response for a handler's (result, error) pair."""
+    headers: dict[str, str] = {}
+    meta = None
+    if isinstance(result, Response):
+        headers = dict(result.headers)
+        meta = result.meta
+        result = result.data
+
+    if err is None:
+        if isinstance(result, web.StreamResponse):
+            return result
+        if isinstance(result, Redirect):
+            return web.Response(
+                status=result.status_code, headers={**headers, "Location": result.url}
+            )
+        if isinstance(result, File):
+            return web.Response(
+                body=result.content, content_type=result.content_type, headers=headers
+            )
+        if isinstance(result, Template):
+            return web.Response(
+                text=result.render(), content_type="text/html", headers=headers
+            )
+        if isinstance(result, Raw):
+            return web.Response(
+                body=json.dumps(to_jsonable(result.data)).encode(),
+                status=HTTPStatus.OK,
+                content_type="application/json",
+                headers=headers,
+            )
+
+    status = _status_code(method, result, err)
+    envelope: dict[str, Any] = {}
+    if err is not None:
+        error_obj: dict[str, Any] = {"message": str(err) or type(err).__name__}
+        extra = getattr(err, "response", None)
+        if isinstance(extra, dict):
+            error_obj.update(to_jsonable(extra))
+        envelope["error"] = error_obj
+        if result is not None:
+            envelope["data"] = to_jsonable(result)
+    else:
+        if status == HTTPStatus.NO_CONTENT:
+            return web.Response(status=status, headers=headers)
+        envelope["data"] = to_jsonable(result)
+        if meta is not None:
+            envelope["meta"] = to_jsonable(meta)
+    return web.Response(
+        body=json.dumps(envelope).encode(),
+        status=status,
+        content_type="application/json",
+        headers=headers,
+    )

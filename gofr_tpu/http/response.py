@@ -1,0 +1,70 @@
+"""Response value types handlers may return.
+
+Mirrors the reference's response package (pkg/gofr/http/response/): ``Raw``
+bypasses the ``{"data": ...}`` envelope, ``File`` streams bytes with a content
+type, ``Redirect`` issues a 302, ``Response`` carries data plus custom headers
+(honored by the handler engine, reference pkg/gofr/handler.go:99-104), and
+``Template`` renders a file with ``str.format``-style substitution.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+__all__ = ["Raw", "File", "Redirect", "Response", "Template"]
+
+
+@dataclass
+class Raw:
+    """Serialize ``data`` as-is (no envelope)."""
+
+    data: Any
+
+
+@dataclass
+class File:
+    """Binary payload with explicit content type."""
+
+    content: bytes
+    content_type: str = "application/octet-stream"
+
+    @classmethod
+    def from_path(cls, path: str, content_type: str | None = None) -> "File":
+        import mimetypes
+
+        with open(path, "rb") as fh:
+            content = fh.read()
+        if content_type is None:
+            content_type = mimetypes.guess_type(path)[0] or "application/octet-stream"
+        return cls(content, content_type)
+
+
+@dataclass
+class Redirect:
+    url: str
+    status_code: int = 302
+
+
+@dataclass
+class Response:
+    """Data plus extra response headers / metadata."""
+
+    data: Any
+    headers: Mapping[str, str] = field(default_factory=dict)
+    meta: Mapping[str, Any] | None = None
+
+
+@dataclass
+class Template:
+    """Render a template file from ``TEMPLATES_DIR`` (default ./templates)."""
+
+    name: str
+    data: Mapping[str, Any] = field(default_factory=dict)
+    directory: str | None = None
+
+    def render(self) -> str:
+        directory = self.directory or os.environ.get("TEMPLATES_DIR", "./templates")
+        with open(os.path.join(directory, self.name), "r", encoding="utf-8") as fh:
+            return fh.read().format(**self.data)

@@ -1,0 +1,215 @@
+"""Leveled, structured logging.
+
+Re-imagines the reference's logging layer (pkg/gofr/logging/logger.go:22-160):
+a small leveled logger that emits JSON lines when writing to a pipe/file and
+colored human-readable lines on a TTY, with a ``PrettyPrint`` protocol that
+lets structured payloads (request logs, SQL logs, RPC logs) control their own
+terminal rendering. Level names and ordering follow the reference's level enum
+(pkg/gofr/logging/level.go): DEBUG < INFO < NOTICE < WARN < ERROR < FATAL.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import threading
+import time
+from enum import IntEnum
+from typing import Any, Protocol, TextIO, runtime_checkable
+
+__all__ = [
+    "Level",
+    "Logger",
+    "PrettyPrint",
+    "new_logger",
+    "new_file_logger",
+    "get_level_from_string",
+]
+
+
+class Level(IntEnum):
+    DEBUG = 1
+    INFO = 2
+    NOTICE = 3
+    WARN = 4
+    ERROR = 5
+    FATAL = 6
+
+    @property
+    def color(self) -> int:
+        # ANSI 256 colors, mirroring the reference's scheme
+        return {
+            Level.DEBUG: 256,
+            Level.INFO: 6,
+            Level.NOTICE: 12,
+            Level.WARN: 3,
+            Level.ERROR: 160,
+            Level.FATAL: 160,
+        }[self]
+
+
+def get_level_from_string(level: str | None) -> Level:
+    if not level:
+        return Level.INFO
+    try:
+        return Level[level.strip().upper()]
+    except KeyError:
+        return Level.INFO
+
+
+@runtime_checkable
+class PrettyPrint(Protocol):
+    """Structured log payloads implement this to render on a terminal."""
+
+    def pretty_print(self, writer: TextIO) -> None:  # pragma: no cover
+        ...
+
+
+def _json_default(obj: Any) -> Any:
+    if hasattr(obj, "to_dict"):
+        return obj.to_dict()
+    if hasattr(obj, "__dict__"):
+        return {k: v for k, v in vars(obj).items() if not k.startswith("_")}
+    return str(obj)
+
+
+class Logger:
+    """Leveled logger writing JSON (non-TTY) or pretty colored lines (TTY).
+
+    Thread-safe; a single lock serializes writes so concurrent handlers never
+    interleave partial lines.
+    """
+
+    def __init__(
+        self,
+        level: Level = Level.INFO,
+        out: TextIO | None = None,
+        err: TextIO | None = None,
+        *,
+        is_terminal: bool | None = None,
+    ) -> None:
+        self.level = level
+        self._out = out if out is not None else sys.stdout
+        self._err = err if err is not None else sys.stderr
+        if is_terminal is None:
+            try:
+                is_terminal = self._out.isatty()
+            except (AttributeError, ValueError):
+                is_terminal = False
+        self._is_terminal = is_terminal
+        self._lock = threading.Lock()
+
+    # -- core ---------------------------------------------------------------
+    def _writer_for(self, level: Level) -> TextIO:
+        return self._err if level >= Level.ERROR else self._out
+
+    def log_at(self, level: Level, *args: Any, **fields: Any) -> None:
+        if level < self.level:
+            return
+        now = time.time()
+        writer = self._writer_for(level)
+        with self._lock:
+            try:
+                if self._is_terminal:
+                    self._pretty(writer, level, now, args, fields)
+                else:
+                    self._json(writer, level, now, args, fields)
+                writer.flush()
+            except ValueError:
+                # writer closed (interpreter teardown / redirected test pipe)
+                pass
+
+    def _json(self, w: TextIO, level: Level, now: float, args: tuple, fields: dict) -> None:
+        message: Any
+        if len(args) == 1:
+            message = args[0]
+            if isinstance(message, PrettyPrint) and hasattr(message, "to_dict"):
+                message = message.to_dict()
+        else:
+            message = " ".join(str(a) for a in args)
+        entry = {
+            "level": level.name,
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(now))
+            + f".{int((now % 1) * 1e6):06d}Z",
+            "message": message,
+        }
+        if fields:
+            entry.update(fields)
+        w.write(json.dumps(entry, default=_json_default) + "\n")
+
+    def _pretty(self, w: TextIO, level: Level, now: float, args: tuple, fields: dict) -> None:
+        ts = time.strftime("%H:%M:%S", time.localtime(now))
+        w.write(f"[38;5;{level.color}m{level.name:5s}[0m [{ts}] ")
+        for a in args:
+            if isinstance(a, PrettyPrint):
+                a.pretty_print(w)
+            else:
+                w.write(f"{a} ")
+        if fields:
+            w.write(json.dumps(fields, default=_json_default))
+        w.write("\n")
+
+    # -- leveled helpers ----------------------------------------------------
+    def debug(self, *args: Any, **fields: Any) -> None:
+        self.log_at(Level.DEBUG, *args, **fields)
+
+    def debugf(self, fmt: str, *args: Any) -> None:
+        self.log_at(Level.DEBUG, fmt % args if args else fmt)
+
+    def info(self, *args: Any, **fields: Any) -> None:
+        self.log_at(Level.INFO, *args, **fields)
+
+    def infof(self, fmt: str, *args: Any) -> None:
+        self.log_at(Level.INFO, fmt % args if args else fmt)
+
+    def notice(self, *args: Any, **fields: Any) -> None:
+        self.log_at(Level.NOTICE, *args, **fields)
+
+    def warn(self, *args: Any, **fields: Any) -> None:
+        self.log_at(Level.WARN, *args, **fields)
+
+    def warnf(self, fmt: str, *args: Any) -> None:
+        self.log_at(Level.WARN, fmt % args if args else fmt)
+
+    def error(self, *args: Any, **fields: Any) -> None:
+        self.log_at(Level.ERROR, *args, **fields)
+
+    def errorf(self, fmt: str, *args: Any) -> None:
+        self.log_at(Level.ERROR, fmt % args if args else fmt)
+
+    def fatal(self, *args: Any, **fields: Any) -> None:
+        self.log_at(Level.FATAL, *args, **fields)
+
+    def log(self, *args: Any, **fields: Any) -> None:
+        self.log_at(Level.INFO, *args, **fields)
+
+    def change_level(self, level: Level) -> None:
+        self.level = level
+
+
+class _NullLogger(Logger):
+    def __init__(self) -> None:
+        super().__init__(Level.FATAL, out=io.StringIO(), err=io.StringIO(), is_terminal=False)
+
+    def log_at(self, level: Level, *args: Any, **fields: Any) -> None:
+        pass
+
+
+NULL = _NullLogger()
+
+
+def new_logger(level: Level | str | None = None) -> Logger:
+    if isinstance(level, str) or level is None:
+        level = get_level_from_string(level if isinstance(level, str) else os.environ.get("LOG_LEVEL"))
+    return Logger(level)
+
+
+def new_file_logger(path: str, level: Level = Level.INFO) -> Logger:
+    """Logger writing JSON lines to a file (reference: logging.NewFileLogger,
+    used by the CLI mode so stdout stays clean for command output)."""
+    if not path:
+        return NULL
+    fh = open(path, "a", encoding="utf-8")
+    return Logger(level, out=fh, err=fh, is_terminal=False)

@@ -1,0 +1,114 @@
+"""Database migrations.
+
+Mirrors the reference's migration vertical (pkg/gofr/migration/): ``run``
+sorts the version map, ensures a ``gofr_migrations`` bookkeeping table
+(migration/sql.go:12-18 DDL), skips versions ≤ the last applied, and wraps
+each migration in a SQL transaction + Redis pipeline — commit bookkeeping on
+success, rollback and halt on failure (migration/migration.go:28-92). The
+``Datasource`` handed to user UP functions exposes the sql/redis/pubsub
+handles (migration/interface.go:13-64), and pub/sub migrations can create or
+delete topics. For the TPU build this doubles as the model/weight registry
+evolution tool.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+__all__ = ["Migrate", "Datasource", "run"]
+
+_CREATE_TABLE = """
+CREATE TABLE IF NOT EXISTS gofr_migrations (
+    version    INTEGER NOT NULL,
+    method     TEXT    NOT NULL,
+    start_time TEXT    NOT NULL,
+    duration   INTEGER,
+    PRIMARY KEY (version, method)
+)
+"""
+
+
+class Datasource:
+    """What a migration's UP function receives."""
+
+    def __init__(self, container) -> None:
+        self._container = container
+        self.sql = container.sql
+        self.redis = container.redis
+        self.kv = container.kv
+        self.pubsub = container.pubsub
+        self.logger = container.logger
+
+    def create_topic(self, name: str) -> None:
+        if self.pubsub is not None:
+            self.pubsub.create_topic(name)
+
+    def delete_topic(self, name: str) -> None:
+        if self.pubsub is not None:
+            self.pubsub.delete_topic(name)
+
+
+@dataclass
+class Migrate:
+    up: Callable[[Datasource], Any]
+
+
+def _last_version(sql) -> int:
+    row = sql.query_row("SELECT MAX(version) AS v FROM gofr_migrations")
+    return int(row["v"]) if row and row["v"] is not None else 0
+
+
+def run(migrations: dict[int, Migrate | Callable], container) -> None:
+    """Apply pending migrations in version order; halt on first failure."""
+    logger = container.logger
+    if not migrations:
+        return
+    invalid = [k for k in migrations if not isinstance(k, int) or k <= 0]
+    if invalid:
+        logger.errorf("invalid migration versions: %s", invalid)
+        return
+
+    sql = container.sql
+    if sql is not None:
+        sql.exec(_CREATE_TABLE)
+        last = _last_version(sql)
+    else:
+        last = 0
+
+    for version in sorted(migrations):
+        if version <= last:
+            continue
+        entry = migrations[version]
+        up = entry.up if isinstance(entry, Migrate) else entry
+        start = time.time()
+        tx = sql.begin() if sql is not None else None
+        redis_pipe = container.redis.pipeline() if container.redis is not None else None
+        ds = Datasource(container)
+        if tx is not None:
+            ds.sql = tx
+        if redis_pipe is not None:
+            ds.redis = redis_pipe
+        try:
+            up(ds)
+            duration_ms = int((time.time() - start) * 1e3)
+            if tx is not None:
+                tx.exec(
+                    "INSERT INTO gofr_migrations (version, method, start_time, duration)"
+                    " VALUES (?, ?, ?, ?)",
+                    version, "UP",
+                    time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(start)),
+                    duration_ms,
+                )
+                tx.commit()
+            if redis_pipe is not None:
+                redis_pipe.exec()
+            logger.infof("migration %d applied in %dms", version, duration_ms)
+        except Exception as exc:
+            if tx is not None:
+                tx.rollback()
+            if redis_pipe is not None:
+                redis_pipe.discard()
+            logger.errorf("migration %d failed: %s; halting", version, exc)
+            raise

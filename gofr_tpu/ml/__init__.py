@@ -1,0 +1,142 @@
+"""``ml`` — the TPU model-runtime datasource.
+
+The new first-class datasource BASELINE.json's north star demands: handlers
+reach models through ``ctx.ml`` exactly like ``ctx.sql`` reaches the
+database. It follows the container's datasource contract (health_check /
+close / metrics-injection — reference container/datasources.go) while its
+internals are pure TPU machinery: JAX engines (engine.py), dynamic request
+batching (batching.py), sharded multi-chip serving (gofr_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .engine import Engine, EngineConfig
+
+__all__ = ["MLDatasource", "Engine", "EngineConfig"]
+
+
+class MLDatasource:
+    """Registry of named model engines, exposed to handlers as ``ctx.ml``."""
+
+    def __init__(self, logger=None, metrics=None) -> None:
+        self._logger = logger
+        self._metrics = metrics
+        self._engines: dict[str, Engine] = {}
+        self._batchers: dict[str, Any] = {}
+
+    # -- registration ----------------------------------------------------------
+    def register(
+        self,
+        name: str,
+        model: Any = None,
+        *,
+        apply_fn=None,
+        params=None,
+        example_inputs: tuple | None = None,
+        config: EngineConfig | None = None,
+        batching: Any = None,
+    ) -> Engine:
+        """Mount a model. Accepts either an object with ``apply``/``params``
+        attributes (our model classes), a flax-style (apply_fn, params) pair,
+        or a ready Engine."""
+        if isinstance(model, Engine):
+            engine = model
+        else:
+            if model is not None and apply_fn is None:
+                apply_fn = getattr(model, "apply", None) or getattr(model, "__call__")
+                params = params if params is not None else getattr(model, "params", None)
+                if example_inputs is None:
+                    example_inputs = getattr(model, "example_inputs", None)
+            if apply_fn is None:
+                raise ValueError("register needs a model object or apply_fn")
+            engine = Engine(
+                name,
+                apply_fn,
+                params,
+                config=config,
+                logger=self._logger,
+                metrics=self._metrics,
+                example_inputs=example_inputs,
+            )
+        self._engines[name] = engine
+        if batching is not None:
+            from .batching import DynamicBatcher
+
+            if batching is True:
+                batching = DynamicBatcher(engine, metrics=self._metrics)
+            self._batchers[name] = batching
+        if self._logger is not None:
+            self._logger.infof("model %s registered on %s", name, str(engine.device))
+        return engine
+
+    def engine(self, name: str) -> Engine:
+        if name not in self._engines:
+            raise KeyError(
+                f"model {name!r} is not registered; available: {sorted(self._engines)}"
+            )
+        return self._engines[name]
+
+    def batcher(self, name: str):
+        return self._batchers.get(name)
+
+    # -- prediction ------------------------------------------------------------
+    async def predict(self, name: str, *inputs: Any) -> Any:
+        """Single prediction. Routed through the dynamic batcher when one is
+        mounted (requests coalesce into device-sized batches), else straight
+        to the engine."""
+        batcher = self._batchers.get(name)
+        if batcher is not None:
+            return await batcher.submit(*inputs)
+        return await self.engine(name).predict(*inputs)
+
+    def predict_sync(self, name: str, *inputs: Any) -> Any:
+        return self.engine(name).predict_sync(*inputs)
+
+    # -- datasource contract -----------------------------------------------------
+    def use_logger(self, logger) -> None:
+        self._logger = logger
+
+    def use_metrics(self, metrics) -> None:
+        self._metrics = metrics
+
+    def use_tracer(self, tracer) -> None:
+        pass
+
+    def connect(self) -> None:
+        pass
+
+    def refresh_device_metrics(self, metrics) -> None:
+        """Push HBM gauges per device (scraped by the metrics server)."""
+        import jax
+
+        for dev in jax.devices():
+            try:
+                stats = dev.memory_stats() or {}
+            except Exception:
+                continue
+            label = f"{dev.platform}:{dev.id}"
+            if "bytes_in_use" in stats:
+                metrics.set_gauge("app_tpu_hbm_bytes_in_use", stats["bytes_in_use"], device=label)
+            if "bytes_limit" in stats:
+                metrics.set_gauge("app_tpu_hbm_bytes_limit", stats["bytes_limit"], device=label)
+
+    def health_check(self) -> dict:
+        import jax
+
+        details: dict[str, Any] = {
+            "devices": [str(d) for d in jax.devices()],
+            "models": {},
+        }
+        for name, engine in self._engines.items():
+            details["models"][name] = {"steps": engine.steps, "device": str(engine.device)}
+        return {"status": "UP", "details": details}
+
+    def close(self) -> None:
+        for engine in self._engines.values():
+            engine.close()
+        for batcher in self._batchers.values():
+            closer = getattr(batcher, "close", None)
+            if closer is not None:
+                closer()

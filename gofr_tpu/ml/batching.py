@@ -1,0 +1,134 @@
+"""Dynamic request batching.
+
+The north-star middleware from BASELINE.json: coalesce concurrent single
+requests into one padded, bucket-shaped device batch so the MXU sees large
+matmuls instead of batch-1 dribble. The reference has no analogue (its
+closest pattern is Kafka writer batching, kafka.go:83-89); this is new
+TPU-first design:
+
+- requests enqueue (input arrays, future); a collector loop drains the queue
+  up to ``max_batch`` or until ``max_delay_s`` passes since the first request
+  (deadline policy bounds TTFT cost of batching).
+- the batch pads to the engine's next shape bucket (bounding XLA recompiles),
+  executes once on device, and each caller's future receives its row slice.
+- queue time and realized batch sizes flow into ``app_ml_queue_seconds`` and
+  ``app_ml_batch_size`` histograms.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any
+
+import numpy as np
+
+__all__ = ["DynamicBatcher"]
+
+
+class _Pending:
+    __slots__ = ("inputs", "future", "enqueued_at")
+
+    def __init__(self, inputs: tuple, future: asyncio.Future) -> None:
+        self.inputs = inputs
+        self.future = future
+        self.enqueued_at = time.perf_counter()
+
+
+class DynamicBatcher:
+    """Coalesces ``submit`` calls into padded engine batches.
+
+    Each submitted input is ONE example (no batch dim). The batcher stacks
+    examples along a new leading axis, pads the batch up to the engine's
+    bucket with zeros, executes, and slices row i back to caller i.
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        max_batch: int = 64,
+        max_delay_s: float = 0.005,
+        metrics=None,
+    ) -> None:
+        self._engine = engine
+        self._max_batch = max_batch
+        self._max_delay = max_delay_s
+        self._metrics = metrics
+        self._queue: asyncio.Queue[_Pending] = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+        self._closed = False
+
+    def _ensure_collector(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(
+                self._collect(), name=f"gofr-batcher-{self._engine.name}"
+            )
+
+    async def submit(self, *inputs: Any) -> Any:
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        self._ensure_collector()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put(_Pending(inputs, fut))
+        return await fut
+
+    async def _collect(self) -> None:
+        while not self._closed:
+            first = await self._queue.get()
+            batch = [first]
+            deadline = first.enqueued_at + self._max_delay
+            while len(batch) < self._max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(await asyncio.wait_for(self._queue.get(), remaining))
+                except asyncio.TimeoutError:
+                    break
+            await self._run_batch(batch)
+
+    async def _run_batch(self, batch: list[_Pending]) -> None:
+        n = len(batch)
+        bucket = self._engine.bucket_for(n)
+        now = time.perf_counter()
+        if self._metrics is not None:
+            try:
+                self._metrics.record_histogram("app_ml_batch_size", n, model=self._engine.name)
+                for p in batch:
+                    self._metrics.record_histogram(
+                        "app_ml_queue_seconds", now - p.enqueued_at, model=self._engine.name
+                    )
+            except Exception:
+                pass
+        try:
+            n_args = len(batch[0].inputs)
+            stacked = []
+            for j in range(n_args):
+                rows = [np.asarray(p.inputs[j]) for p in batch]
+                arr = np.stack(rows, axis=0)
+                if bucket > n:  # zero-pad to the shape bucket
+                    pad = np.zeros((bucket - n,) + arr.shape[1:], dtype=arr.dtype)
+                    arr = np.concatenate([arr, pad], axis=0)
+                stacked.append(arr)
+            out = await self._engine.predict(*stacked)
+        except Exception as exc:
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(exc)
+            return
+        for i, p in enumerate(batch):
+            if not p.future.done():
+                p.future.set_result(_slice_row(out, i))
+
+    def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+
+
+def _slice_row(out: Any, i: int):
+    """Row i of every array leaf in the batched output."""
+    import jax
+
+    return jax.tree.map(lambda a: a[i], out)

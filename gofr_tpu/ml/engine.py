@@ -1,0 +1,141 @@
+"""TPU model execution engine.
+
+The green-field core of the framework (BASELINE.json north star): execute
+JAX-compiled models behind GoFr-style handlers. The reference has no ML
+functionality; the closest structural analogue is a datasource driver —
+connect/health/metrics/logging (reference container/datasources.go provider
+protocol) — which is exactly how the engine presents itself to the container.
+
+Design (TPU-first):
+- the model is a pure ``apply(params, *inputs)`` function, jitted once per
+  input-shape bucket; weights live on device permanently (HBM-resident).
+- a single dedicated executor thread owns device dispatch, so the asyncio
+  event loop never blocks on compilation or synchronous transfers; results
+  come back through futures.
+- shape bucketing: inputs pad up to the nearest registered bucket to bound
+  the number of XLA compilations (dynamic shapes would silently retrace).
+- per-step metrics: ``app_tpu_step_seconds`` histogram + HBM gauges read
+  from device memory stats.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures as cf
+import queue
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Engine", "EngineConfig"]
+
+
+def _next_bucket(n: int, buckets: Sequence[int] | None) -> int:
+    if not buckets:
+        return n
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class EngineConfig:
+    def __init__(
+        self,
+        batch_buckets: Sequence[int] = (1, 2, 4, 8, 16, 32, 64),
+        donate_inputs: bool = False,
+        warmup: bool = True,
+    ) -> None:
+        self.batch_buckets = tuple(sorted(batch_buckets))
+        self.donate_inputs = donate_inputs
+        self.warmup = warmup
+
+
+class Engine:
+    """Owns one model: params on device, jitted apply, executor thread."""
+
+    def __init__(
+        self,
+        name: str,
+        apply_fn: Callable[..., Any],
+        params: Any,
+        *,
+        config: EngineConfig | None = None,
+        logger=None,
+        metrics=None,
+        example_inputs: tuple | None = None,
+        out_sharding=None,
+    ) -> None:
+        self.name = name
+        self.config = config or EngineConfig()
+        self._logger = logger
+        self._metrics = metrics
+        self._apply = jax.jit(apply_fn)
+        self._work: queue.Queue = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"gofr-ml-{name}"
+        )
+        self.steps = 0
+        self.device = jax.devices()[0]
+        self._params = jax.device_put(params)
+        self._thread.start()
+        if example_inputs is not None and self.config.warmup:
+            self.predict_sync(*example_inputs)  # compile before first request
+
+    # -- executor thread ------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            item = self._work.get()
+            if item is None:
+                return
+            fut, args = item
+            if fut.set_running_or_notify_cancel():
+                try:
+                    fut.set_result(self._execute(*args))
+                except BaseException as exc:  # noqa: BLE001 - relayed via future
+                    fut.set_exception(exc)
+
+    def _execute(self, *inputs: Any) -> Any:
+        start = time.perf_counter()
+        arrays = [jnp.asarray(x) for x in inputs]
+        out = self._apply(self._params, *arrays)
+        out = jax.tree.map(lambda a: np.asarray(a), out)  # blocks until done
+        self.steps += 1
+        dur = time.perf_counter() - start
+        if self._metrics is not None:
+            try:
+                self._metrics.record_histogram("app_tpu_step_seconds", dur, model=self.name)
+            except Exception:
+                pass
+        if self._logger is not None:
+            self._logger.debug(
+                {"ml_step": self.name, "duration_us": int(dur * 1e6)}
+            )
+        return out
+
+    # -- API -------------------------------------------------------------------
+    def predict_sync(self, *inputs: Any) -> Any:
+        fut: cf.Future = cf.Future()
+        self._work.put((fut, inputs))
+        return fut.result()
+
+    async def predict(self, *inputs: Any) -> Any:
+        fut: cf.Future = cf.Future()
+        self._work.put((fut, inputs))
+        return await asyncio.wrap_future(fut)
+
+    def bucket_for(self, n: int) -> int:
+        return _next_bucket(n, self.config.batch_buckets)
+
+    def memory_stats(self) -> dict | None:
+        try:
+            return self.device.memory_stats()
+        except Exception:
+            return None
+
+    def close(self) -> None:
+        self._work.put(None)

@@ -1,0 +1,56 @@
+"""MNIST MLP classifier — BASELINE.md config #2's model.
+
+Plain JAX (no flax dependency needed for a 2-layer MLP): params are a pytree,
+``apply`` is a pure function jitted by the engine. bfloat16 matmuls keep the
+MXU fed; logits return in float32 for stable softmax on host.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["MLP", "mnist_mlp"]
+
+
+def _init_linear(key, in_dim: int, out_dim: int) -> dict:
+    wkey, _ = jax.random.split(key)
+    scale = (2.0 / in_dim) ** 0.5
+    return {
+        "w": (jax.random.normal(wkey, (in_dim, out_dim), jnp.float32) * scale
+              ).astype(jnp.bfloat16),
+        "b": jnp.zeros((out_dim,), jnp.float32),
+    }
+
+
+class MLP:
+    """Feed-forward classifier: input -> hidden... -> logits."""
+
+    def __init__(self, sizes: tuple[int, ...] = (784, 512, 512, 10), seed: int = 0):
+        self.sizes = sizes
+        keys = jax.random.split(jax.random.PRNGKey(seed), len(sizes) - 1)
+        self.params = [
+            _init_linear(k, a, b) for k, a, b in zip(keys, sizes[:-1], sizes[1:])
+        ]
+        self.example_inputs = (np.zeros((1, sizes[0]), np.float32),)
+
+    @staticmethod
+    def apply(params: Any, x: jnp.ndarray) -> jnp.ndarray:
+        h = x.astype(jnp.bfloat16)
+        for layer in params[:-1]:
+            h = jnp.maximum(h @ layer["w"] + layer["b"].astype(jnp.bfloat16), 0)
+        last = params[-1]
+        return (h @ last["w"]).astype(jnp.float32) + last["b"]
+
+    @staticmethod
+    def loss(params: Any, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+        logits = MLP.apply(params, x)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
+def mnist_mlp(hidden: int = 512, seed: int = 0) -> MLP:
+    return MLP((784, hidden, hidden, 10), seed)

@@ -1,0 +1,66 @@
+"""Pub/Sub subscriber runtime.
+
+Mirrors the reference's SubscriptionManager (pkg/gofr/subscriber.go:13-78 and
+gofr.go:279-295): one task per subscribed topic looping
+subscribe → handle (fresh traced Context) → commit-on-success, with panic
+recovery so a bad message never kills the loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .container import Container
+from .context import Context
+from .handler import HandlerFunc, invoke
+from .tracing import Tracer
+
+__all__ = ["start_subscriber"]
+
+
+async def start_subscriber(
+    topic: str, handler: HandlerFunc, container: Container, tracer: Tracer | None = None
+) -> None:
+    logger = container.logger
+    pubsub = container.pubsub
+    if pubsub is None:
+        logger.errorf("no pubsub configured; subscriber for %s exiting", topic)
+        return
+    logger.infof("subscribed to topic %s", topic)
+    backoff = 0.1
+    while True:
+        try:
+            msg = await pubsub.subscribe(topic)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            logger.errorf("error reading from topic %s: %s; retrying", topic, exc)
+            await asyncio.sleep(min(backoff, 5.0))
+            backoff *= 2
+            continue
+        backoff = 0.1
+        span = None
+        if tracer is not None:
+            span = tracer.start_span(f"subscribe {topic}", kind="CONSUMER")
+        ctx = Context(msg, container, span=span)
+        try:
+            await invoke(handler, ctx)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            # handler failure: nack so the broker redelivers (at-least-once),
+            # never commit (reference subscriber.go:72-75 commits only on nil
+            # error; brokers without nack rely on uncommitted-offset replay)
+            logger.errorf("error in subscriber handler for %s: %s", topic, exc)
+            try:
+                msg.nack()
+            except Exception as nack_exc:
+                logger.errorf("nack failed for %s: %s", topic, nack_exc)
+            if span is not None:
+                span.record_exception(exc)
+                span.end()
+            await asyncio.sleep(0.05)  # brief backoff before redelivery
+            continue
+        msg.commit()
+        if span is not None:
+            span.end()

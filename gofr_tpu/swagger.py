@@ -1,0 +1,82 @@
+"""Swagger / OpenAPI serving.
+
+Mirrors the reference (pkg/gofr/swagger.go:22-55 + gofr.go:98-106): when
+``./static/openapi.json`` exists, serve it at /.well-known/openapi.json and
+render a Swagger-UI page at /.well-known/swagger. The reference embeds the
+Swagger-UI assets; we render a minimal self-contained HTML viewer (no CDN
+dependency — zero-egress environments still get a usable spec browser).
+"""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+__all__ = ["openapi_handler", "swagger_ui_handler"]
+
+_VIEWER_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8"/>
+<title>API Documentation</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; background: #fafafa; }
+ h1 { color: #1a1a2e; } h2 { margin-top: 2rem; }
+ .op { border: 1px solid #ddd; border-radius: 6px; margin: .5rem 0; padding: .7rem 1rem; background: #fff; }
+ .method { display: inline-block; min-width: 4.5rem; font-weight: 700; }
+ .GET { color: #0b7285; } .POST { color: #2b8a3e; } .PUT { color: #e67700; }
+ .DELETE { color: #c92a2a; } .PATCH { color: #5f3dc4; }
+ .path { font-family: ui-monospace, monospace; }
+ .summary { color: #555; margin-left: .75rem; }
+ pre { background: #f1f3f5; padding: 1rem; border-radius: 6px; overflow-x: auto; }
+</style>
+</head>
+<body>
+<h1 id="title">API Documentation</h1>
+<div id="ops"></div>
+<h2>Raw specification</h2>
+<pre id="raw"></pre>
+<script>
+fetch('/.well-known/openapi.json').then(r => r.json()).then(spec => {
+  document.getElementById('title').textContent =
+    (spec.info && spec.info.title) || 'API Documentation';
+  document.getElementById('raw').textContent = JSON.stringify(spec, null, 2);
+  const ops = document.getElementById('ops');
+  for (const [path, methods] of Object.entries(spec.paths || {})) {
+    for (const [method, op] of Object.entries(methods)) {
+      const div = document.createElement('div');
+      div.className = 'op';
+      const m = method.toUpperCase();
+      div.innerHTML = '<span class="method ' + m + '">' + m + '</span>' +
+        '<span class="path">' + path + '</span>' +
+        '<span class="summary">' + ((op && op.summary) || '') + '</span>';
+      ops.appendChild(div);
+    }
+  }
+});
+</script>
+</body>
+</html>
+"""
+
+
+def openapi_handler(spec_path: str):
+    async def handler(_: web.Request) -> web.Response:
+        try:
+            with open(spec_path, "r", encoding="utf-8") as fh:
+                spec = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            return web.json_response(
+                {"error": {"message": f"cannot read openapi spec: {exc}"}}, status=500
+            )
+        return web.json_response(spec)
+
+    return handler
+
+
+def swagger_ui_handler():
+    async def handler(_: web.Request) -> web.Response:
+        return web.Response(text=_VIEWER_HTML, content_type="text/html")
+
+    return handler

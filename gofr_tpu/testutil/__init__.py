@@ -1,0 +1,38 @@
+"""Test utilities.
+
+Mirrors the reference's testutil package (pkg/gofr/testutil/: capture stdout/
+stderr produced by a function) plus helpers this framework's own tests use:
+free-port allocation and an in-process app client that drives the aiohttp
+router without sockets (the reference's handler tests do the same through
+httptest, SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import socket
+import sys
+from typing import Callable
+
+__all__ = ["stdout_output_for_func", "stderr_output_for_func", "get_free_port"]
+
+
+def stdout_output_for_func(func: Callable[[], None]) -> str:
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        func()
+    return buf.getvalue()
+
+
+def stderr_output_for_func(func: Callable[[], None]) -> str:
+    buf = io.StringIO()
+    with contextlib.redirect_stderr(buf):
+        func()
+    return buf.getvalue()
+
+
+def get_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

@@ -1,0 +1,339 @@
+"""Tracing subsystem: spans, W3C propagation, batched exporters.
+
+The reference wires OpenTelemetry end-to-end (provider/sampler/exporter at
+pkg/gofr/gofr.go:395-431; exporters OTLP/Jaeger/Zipkin/custom at
+gofr.go:481-520 and exporter.go:48-130; user spans via Context.Trace at
+context.go:59-69). The OTel SDK is not available in this environment, so this
+is a from-scratch implementation of the same surface: a ratio-sampled tracer,
+spans carried through ``contextvars``, W3C ``traceparent`` inject/extract for
+cross-service propagation, and a background batch exporter that ships
+Zipkin-v2-format JSON spans to ``TRACER_URL`` (zipkin exposition is the lingua
+franca the reference also supports).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import queue
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+__all__ = [
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "NoopTracer",
+    "new_tracer",
+    "current_span",
+    "parse_traceparent",
+    "format_traceparent",
+]
+
+_current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "gofr_current_span", default=None
+)
+
+
+def _rand_trace_id() -> str:
+    return f"{random.getrandbits(128):032x}"
+
+
+def _rand_span_id() -> str:
+    return f"{random.getrandbits(64):016x}"
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    trace_id: str
+    span_id: str
+    sampled: bool = True
+
+
+def parse_traceparent(header: str | None) -> SpanContext | None:
+    """Parse a W3C ``traceparent`` header (00-<32x>-<16x>-<2x>)."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    try:
+        int(parts[1], 16), int(parts[2], 16)
+        flags = int(parts[3], 16)
+    except ValueError:
+        return None
+    if int(parts[1], 16) == 0 or int(parts[2], 16) == 0:
+        return None
+    return SpanContext(parts[1], parts[2], bool(flags & 1))
+
+
+def format_traceparent(ctx: SpanContext) -> str:
+    return f"00-{ctx.trace_id}-{ctx.span_id}-{'01' if ctx.sampled else '00'}"
+
+
+@dataclass
+class Span:
+    name: str
+    context: SpanContext
+    parent_span_id: str | None = None
+    kind: str = "INTERNAL"  # SERVER | CLIENT | INTERNAL | PRODUCER | CONSUMER
+    start_time: float = field(default_factory=time.time)
+    end_time: float | None = None
+    attributes: dict[str, Any] = field(default_factory=dict)
+    events: list[tuple[float, str, dict]] = field(default_factory=list)
+    status_code: str = "UNSET"  # OK | ERROR | UNSET
+    status_message: str = ""
+    _tracer: "Tracer | None" = None
+    _token: Any = None
+
+    # -- span API ------------------------------------------------------------
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def set_attributes(self, attrs: Mapping[str, Any]) -> None:
+        self.attributes.update(attrs)
+
+    def add_event(self, name: str, attrs: Mapping[str, Any] | None = None) -> None:
+        self.events.append((time.time(), name, dict(attrs or {})))
+
+    def set_status(self, code: str, message: str = "") -> None:
+        self.status_code = code
+        self.status_message = message
+
+    def record_exception(self, exc: BaseException) -> None:
+        self.add_event("exception", {"type": type(exc).__name__, "message": str(exc)})
+        self.set_status("ERROR", str(exc))
+
+    def end(self) -> None:
+        if self.end_time is not None:
+            return
+        self.end_time = time.time()
+        if self._token is not None:
+            try:
+                _current_span.reset(self._token)
+            except ValueError:
+                _current_span.set(None)
+            self._token = None
+        if self._tracer is not None:
+            self._tracer._on_end(self)
+
+    # -- context manager -----------------------------------------------------
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.record_exception(exc)
+        self.end()
+
+    @property
+    def trace_id(self) -> str:
+        return self.context.trace_id
+
+    @property
+    def span_id(self) -> str:
+        return self.context.span_id
+
+
+def current_span() -> Span | None:
+    return _current_span.get()
+
+
+class SpanExporter:
+    def export(self, spans: list[Span]) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class ConsoleExporter(SpanExporter):
+    def __init__(self, logger=None) -> None:
+        self._logger = logger
+
+    def export(self, spans: list[Span]) -> None:
+        for s in spans:
+            line = {
+                "trace_id": s.trace_id,
+                "span_id": s.span_id,
+                "name": s.name,
+                "duration_us": int(((s.end_time or s.start_time) - s.start_time) * 1e6),
+            }
+            if self._logger is not None:
+                self._logger.debug("span", **line)
+
+
+class ZipkinJSONExporter(SpanExporter):
+    """POSTs batches of Zipkin-v2 JSON spans to an HTTP collector."""
+
+    def __init__(self, url: str, service_name: str, logger=None, timeout: float = 5.0) -> None:
+        self.url = url
+        self.service_name = service_name
+        self._logger = logger
+        self._timeout = timeout
+
+    def _encode(self, s: Span) -> dict:
+        out: dict[str, Any] = {
+            "traceId": s.trace_id,
+            "id": s.span_id,
+            "name": s.name,
+            "kind": s.kind if s.kind in ("SERVER", "CLIENT", "PRODUCER", "CONSUMER") else None,
+            "timestamp": int(s.start_time * 1e6),
+            "duration": max(1, int(((s.end_time or s.start_time) - s.start_time) * 1e6)),
+            "localEndpoint": {"serviceName": self.service_name},
+            "tags": {str(k): str(v) for k, v in s.attributes.items()},
+        }
+        if s.parent_span_id:
+            out["parentId"] = s.parent_span_id
+        if s.status_code == "ERROR":
+            out["tags"]["error"] = s.status_message or "true"
+        return {k: v for k, v in out.items() if v is not None}
+
+    def export(self, spans: list[Span]) -> None:
+        import urllib.request
+
+        body = json.dumps([self._encode(s) for s in spans]).encode()
+        req = urllib.request.Request(
+            self.url, data=body, headers={"Content-Type": "application/json"}, method="POST"
+        )
+        try:
+            urllib.request.urlopen(req, timeout=self._timeout).close()
+        except Exception as exc:  # collector being down must never break serving
+            if self._logger is not None:
+                self._logger.debug(f"trace export failed: {exc}")
+
+
+class _BatchProcessor:
+    """Queue + background thread flushing spans to an exporter."""
+
+    def __init__(self, exporter: SpanExporter, max_batch: int = 256, interval: float = 2.0):
+        self._exporter = exporter
+        self._queue: queue.Queue[Span | None] = queue.Queue(maxsize=8192)
+        self._max_batch = max_batch
+        self._interval = interval
+        self._thread = threading.Thread(target=self._run, daemon=True, name="gofr-trace-export")
+        self._stopped = False
+        self._thread.start()
+
+    def submit(self, span: Span) -> None:
+        if self._stopped:
+            return
+        try:
+            self._queue.put_nowait(span)
+        except queue.Full:
+            pass
+
+    def _run(self) -> None:
+        buf: list[Span] = []
+        while True:
+            try:
+                item = self._queue.get(timeout=self._interval)
+            except queue.Empty:
+                item = False  # timeout marker
+            if item is None:
+                break
+            if item:
+                buf.append(item)
+            if buf and (len(buf) >= self._max_batch or item is False):
+                try:
+                    self._exporter.export(buf)
+                finally:
+                    buf = []
+        if buf:
+            try:
+                self._exporter.export(buf)
+            except Exception:
+                pass
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        self._queue.put(None)
+        self._thread.join(timeout=5)
+        self._exporter.shutdown()
+
+
+class Tracer:
+    """Creates spans; ratio-sampling decided at trace root (TRACER_RATIO)."""
+
+    def __init__(
+        self,
+        service_name: str = "gofr-app",
+        exporter: SpanExporter | None = None,
+        sample_ratio: float = 1.0,
+    ) -> None:
+        self.service_name = service_name
+        self.sample_ratio = sample_ratio
+        self._processor = _BatchProcessor(exporter) if exporter is not None else None
+
+    def start_span(
+        self,
+        name: str,
+        *,
+        parent: SpanContext | Span | None = None,
+        kind: str = "INTERNAL",
+        attributes: Mapping[str, Any] | None = None,
+        activate: bool = True,
+    ) -> Span:
+        if parent is None:
+            parent = current_span()
+        parent_ctx = parent.context if isinstance(parent, Span) else parent
+        if parent_ctx is not None:
+            ctx = SpanContext(parent_ctx.trace_id, _rand_span_id(), parent_ctx.sampled)
+            parent_id = parent_ctx.span_id
+        else:
+            sampled = random.random() < self.sample_ratio
+            ctx = SpanContext(_rand_trace_id(), _rand_span_id(), sampled)
+            parent_id = None
+        span = Span(
+            name=name,
+            context=ctx,
+            parent_span_id=parent_id,
+            kind=kind,
+            attributes=dict(attributes or {}),
+            _tracer=self,
+        )
+        if activate:
+            span._token = _current_span.set(span)
+        return span
+
+    def _on_end(self, span: Span) -> None:
+        if self._processor is not None and span.context.sampled:
+            self._processor.submit(span)
+
+    def inject(self, span: Span | None = None) -> dict[str, str]:
+        span = span or current_span()
+        if span is None:
+            return {}
+        return {"traceparent": format_traceparent(span.context)}
+
+    def shutdown(self) -> None:
+        if self._processor is not None:
+            self._processor.shutdown()
+
+
+class NoopTracer(Tracer):
+    def __init__(self) -> None:
+        super().__init__("noop", None, 0.0)
+
+
+def new_tracer(config, logger=None) -> Tracer:
+    """Build a tracer from config, mirroring reference env names
+    (TRACE_EXPORTER, TRACER_URL, TRACER_RATIO — pkg/gofr/gofr.go:433-520)."""
+    exporter_name = (config.get("TRACE_EXPORTER") or "").lower()
+    url = config.get("TRACER_URL")
+    try:
+        ratio = float(config.get_or_default("TRACER_RATIO", "1"))
+    except ValueError:
+        ratio = 1.0
+    service = config.get_or_default("APP_NAME", "gofr-app")
+    exporter: SpanExporter | None = None
+    if exporter_name in ("zipkin", "gofr", "otlp", "jaeger") and url:
+        exporter = ZipkinJSONExporter(url, service, logger)
+        if logger is not None:
+            logger.infof("exporting traces to %s at %s", exporter_name, url)
+    elif exporter_name == "console":
+        exporter = ConsoleExporter(logger)
+    return Tracer(service, exporter, ratio)

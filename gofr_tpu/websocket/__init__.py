@@ -1,0 +1,117 @@
+"""WebSocket support.
+
+Mirrors the reference's websocket vertical (pkg/gofr/websocket/ + gofr's
+websocket.go:23-66): ``App.websocket(route, handler)`` upgrades a GET request
+and enters a read loop that re-invokes the handler once per inbound message;
+the handler reads the frame via ``ctx.bind()`` and its return value is
+serialized back over the socket; connections register in the container's hub
+keyed by the websocket accept key so other handlers can target them
+(reference websocket/websocket.go:98-141 Manager).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from aiohttp import WSMsgType, web
+
+from ..context import Context
+from ..handler import HandlerFunc, invoke
+
+__all__ = ["Connection", "websocket_route_handler"]
+
+
+class Connection:
+    """A live websocket with typed send helpers."""
+
+    def __init__(self, ws: web.WebSocketResponse, key: str) -> None:
+        self.ws = ws
+        self.key = key
+        self._current_message: Any = None
+
+    async def send_response(self, data: Any) -> None:
+        if isinstance(data, (bytes, bytearray)):
+            await self.ws.send_bytes(bytes(data))
+        elif isinstance(data, str):
+            await self.ws.send_str(data)
+        else:
+            from ..http.responder import to_jsonable
+
+            await self.ws.send_str(json.dumps(to_jsonable(data)))
+
+    async def close(self) -> None:
+        await self.ws.close()
+
+
+class _WSRequest:
+    """Request adapter: ``bind`` yields the current frame."""
+
+    def __init__(self, raw: web.Request, conn: Connection) -> None:
+        self.raw = raw
+        self.websocket = conn
+
+    def param(self, key: str) -> str:
+        return self.raw.query.get(key, "")
+
+    def params(self, key: str) -> list[str]:
+        return list(self.raw.query.getall(key, []))
+
+    def path_param(self, key: str) -> str:
+        return self.raw.match_info.get(key, "")
+
+    async def bind(self, model: type | None = None) -> Any:
+        data = self.websocket._current_message
+        if isinstance(data, (bytes, str)) and model is None:
+            try:
+                return json.loads(data)
+            except (json.JSONDecodeError, TypeError):
+                return data
+        if model is not None and isinstance(data, (str, bytes)):
+            from ..http.request import bind_to_model
+
+            return bind_to_model(json.loads(data), model)
+        return data
+
+    def host_name(self) -> str:
+        return f"ws://{self.raw.host}"
+
+    def context(self) -> Any:
+        return self.raw
+
+    @property
+    def headers(self):
+        return self.raw.headers
+
+
+def websocket_route_handler(handler: HandlerFunc, container):
+    async def ws_handler(request: web.Request) -> web.StreamResponse:
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        key = request.headers.get("Sec-WebSocket-Key", str(id(ws)))
+        conn = Connection(ws, key)
+        container.websocket_connections[key] = conn
+        req = _WSRequest(request, conn)
+        ctx = Context(req, container, span=request.get("gofr_span"))
+        try:
+            async for msg in ws:
+                if msg.type == WSMsgType.TEXT:
+                    conn._current_message = msg.data
+                elif msg.type == WSMsgType.BINARY:
+                    conn._current_message = msg.data
+                elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
+                    break
+                else:
+                    continue
+                try:
+                    result = await invoke(handler, ctx)
+                except Exception as exc:
+                    container.logger.errorf("websocket handler error: %s", exc)
+                    continue
+                if result is not None:
+                    await conn.send_response(result)
+        finally:
+            container.websocket_connections.pop(key, None)
+        return ws
+
+    return ws_handler

@@ -1,0 +1,37 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere,
+so multi-chip sharding paths (tp/dp/sp over a Mesh) compile and execute
+hermetically without TPU hardware — the analogue of the reference's
+containerized-services CI split (SURVEY §4): unit tests never need real
+devices.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import asyncio  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def run():
+    """Run an async scenario to completion: ``run(scenario())``."""
+
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run
+
+
+@pytest.fixture
+def mock_container():
+    from gofr_tpu.container.mock import new_mock_container
+
+    container, mocks = new_mock_container()
+    yield container, mocks
+    asyncio.run(container.close())

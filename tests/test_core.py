@@ -1,0 +1,221 @@
+"""Core kernel tests: config, logging, metrics, tracing, cron parsing.
+
+Table-driven style mirrors the reference's test conventions (SURVEY §4).
+"""
+
+import io
+import json
+import time
+
+import pytest
+
+from gofr_tpu.config import EnvConfig, MapConfig, load_env_file, new_env_config
+from gofr_tpu.cron import InvalidCronError, parse_schedule
+from gofr_tpu.logging import Level, Logger, get_level_from_string
+from gofr_tpu.metrics import (
+    DuplicateMetricError,
+    Manager,
+    MetricNotFoundError,
+)
+from gofr_tpu.tracing import (
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+)
+
+
+# ---------------------------------------------------------------- config
+def test_env_file_parsing(tmp_path):
+    p = tmp_path / ".env"
+    p.write_text(
+        "# comment\n"
+        "APP_NAME=demo\n"
+        "export HTTP_PORT=8001\n"
+        'QUOTED="hello world"\n'
+        "WITH_COMMENT=value # trailing\n"
+        "EMPTY=\n"
+        "not-a-kv-line\n"
+    )
+    values = load_env_file(str(p))
+    assert values == {
+        "APP_NAME": "demo",
+        "HTTP_PORT": "8001",
+        "QUOTED": "hello world",
+        "WITH_COMMENT": "value",
+        "EMPTY": "",
+    }
+
+
+def test_env_overlay_precedence(tmp_path, monkeypatch):
+    configs = tmp_path / "configs"
+    configs.mkdir()
+    (configs / ".env").write_text("A=base\nB=base\nAPP_ENV=staging\n")
+    (configs / ".staging.env").write_text("B=overlay\n")
+    monkeypatch.delenv("APP_ENV", raising=False)
+    cfg = new_env_config(str(configs))
+    assert cfg.get("A") == "base"
+    assert cfg.get("B") == "overlay"
+    # process env wins last
+    monkeypatch.setenv("B", "process")
+    assert cfg.get("B") == "process"
+    assert cfg.get_or_default("MISSING", "fallback") == "fallback"
+
+
+def test_map_config():
+    cfg = MapConfig({"K": "V"})
+    assert cfg.get("K") == "V"
+    assert cfg.get("X") is None
+    assert cfg.get_or_default("X", "d") == "d"
+
+
+# ---------------------------------------------------------------- logging
+@pytest.mark.parametrize(
+    "name,expected",
+    [
+        ("DEBUG", Level.DEBUG),
+        ("info", Level.INFO),
+        ("WARN", Level.WARN),
+        ("bogus", Level.INFO),
+        (None, Level.INFO),
+    ],
+)
+def test_level_from_string(name, expected):
+    assert get_level_from_string(name) == expected
+
+
+def test_json_log_format_and_level_filter():
+    out = io.StringIO()
+    logger = Logger(Level.INFO, out=out, err=out, is_terminal=False)
+    logger.debug("hidden")
+    logger.info("shown", request_id="abc")
+    logger.errorf("bad %s", "thing")
+    lines = [json.loads(line) for line in out.getvalue().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["level"] == "INFO"
+    assert lines[0]["message"] == "shown"
+    assert lines[0]["request_id"] == "abc"
+    assert lines[1]["level"] == "ERROR"
+    assert lines[1]["message"] == "bad thing"
+
+
+def test_change_level():
+    out = io.StringIO()
+    logger = Logger(Level.ERROR, out=out, err=out, is_terminal=False)
+    logger.info("nope")
+    logger.change_level(Level.DEBUG)
+    logger.debug("yes")
+    assert "yes" in out.getvalue()
+    assert "nope" not in out.getvalue()
+
+
+# ---------------------------------------------------------------- metrics
+def test_metrics_counter_gauge_histogram():
+    m = Manager()
+    m.new_counter("hits", "hit count")
+    m.new_gauge("temp", "temperature")
+    m.new_histogram("lat", "latency", buckets=(0.1, 1, 10))
+    m.increment_counter("hits", path="/a")
+    m.increment_counter("hits", path="/a")
+    m.set_gauge("temp", 42.5)
+    m.record_histogram("lat", 0.05)
+    m.record_histogram("lat", 5)
+    text = m.expose_text()
+    assert 'hits{path="/a"} 2' in text
+    assert "temp 42.5" in text
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="10"} 2' in text
+    assert "lat_count 2" in text
+
+
+def test_metrics_errors():
+    m = Manager()
+    m.new_counter("c")
+    with pytest.raises(DuplicateMetricError):
+        m.new_counter("c")
+    with pytest.raises(MetricNotFoundError):
+        m.increment_counter("missing")
+    with pytest.raises(MetricNotFoundError):
+        m.set_gauge("c", 1)  # wrong type
+
+
+def test_histogram_percentile():
+    m = Manager()
+    m.new_histogram("h", buckets=(1, 2, 4, 8))
+    for v in (0.5, 1.5, 3, 7):
+        m.record_histogram("h", v)
+    assert m.percentile("h", 0.5) == 2
+
+
+# ---------------------------------------------------------------- tracing
+def test_traceparent_roundtrip():
+    ctx = parse_traceparent("00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01")
+    assert ctx is not None
+    assert ctx.trace_id == "0af7651916cd43dd8448eb211c80319c"
+    assert ctx.sampled is True
+    assert (
+        format_traceparent(ctx)
+        == "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+    )
+
+
+@pytest.mark.parametrize(
+    "header",
+    [None, "", "garbage", "00-zz-aa-01", "00-" + "0" * 32 + "-" + "0" * 16 + "-01"],
+)
+def test_traceparent_rejects_invalid(header):
+    assert parse_traceparent(header) is None
+
+
+def test_span_parenting_and_context_propagation():
+    tracer = Tracer("test")
+    with tracer.start_span("parent") as parent:
+        child = tracer.start_span("child")
+        assert child.trace_id == parent.trace_id
+        assert child.parent_span_id == parent.span_id
+        child.end()
+    assert parent.end_time is not None
+
+
+def test_span_exception_recording():
+    tracer = Tracer("test")
+    with pytest.raises(ValueError):
+        with tracer.start_span("boom") as span:
+            raise ValueError("bad")
+    assert span.status_code == "ERROR"
+    assert span.events and span.events[0][1] == "exception"
+
+
+# ---------------------------------------------------------------- cron
+@pytest.mark.parametrize(
+    "expr,t,expected",
+    [
+        ("* * * * *", (2026, 1, 5, 10, 30, 0), True),
+        ("* * * * *", (2026, 1, 5, 10, 30, 5), False),  # 5-field ⇒ second 0
+        ("*/10 * * * * *", (2026, 1, 5, 10, 30, 20), True),
+        ("*/10 * * * * *", (2026, 1, 5, 10, 30, 25), False),
+        ("0 30 10 * * *", (2026, 1, 5, 10, 30, 0), True),
+        ("0 0-15 * * * *", (2026, 1, 5, 10, 10, 0), True),
+        ("0 0-15 * * * *", (2026, 1, 5, 10, 20, 0), False),
+        ("0 0,30 * * * *", (2026, 1, 5, 10, 30, 0), True),
+        # day-of-week: 2026-01-05 is a Monday (cron dow 1)
+        ("0 * * * * 1", (2026, 1, 5, 10, 30, 0), True),
+        ("0 * * * * 2", (2026, 1, 5, 10, 30, 0), False),
+        # both dom and dow restricted → OR semantics
+        ("0 * * 5 * 2", (2026, 1, 5, 10, 30, 0), True),
+    ],
+)
+def test_cron_matching(expr, t, expected):
+    schedule = parse_schedule(expr)
+    st = time.struct_time(t + (0, 0, -1))
+    # struct_time needs correct tm_wday; rebuild via mktime round trip
+    st = time.localtime(time.mktime(st))
+    assert schedule.matches(st) is expected
+
+
+@pytest.mark.parametrize(
+    "expr",
+    ["", "* * *", "61 * * * * *", "* 24 * * *extra", "a b c d e", "*/0 * * * *"],
+)
+def test_cron_rejects_invalid(expr):
+    with pytest.raises(InvalidCronError):
+        parse_schedule(expr)

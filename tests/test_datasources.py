@@ -1,0 +1,232 @@
+"""Datasource tests: SQL, KV store, pub/sub, file store, migrations, mocks."""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+from gofr_tpu.container.mock import new_mock_container
+from gofr_tpu.datasource.file import LocalFileSystem
+from gofr_tpu.datasource.kv import BadgerLikeKV, KeyNotFoundError
+from gofr_tpu.datasource.pubsub import InProcessBroker
+from gofr_tpu.datasource.sql import SQL
+from gofr_tpu.migration import Migrate, run as run_migrations
+
+
+@dataclasses.dataclass
+class Person:
+    id: int
+    name: str
+    active: bool
+
+
+# ------------------------------------------------------------------- SQL
+def test_sql_exec_query_select():
+    db = SQL(":memory:")
+    db.exec("CREATE TABLE person (id INTEGER PRIMARY KEY, name TEXT, active INTEGER)")
+    db.exec("INSERT INTO person (name, active) VALUES (?, ?)", "ada", 1)
+    new_id = db.exec_last_id("INSERT INTO person (name, active) VALUES (?, ?)", "bob", 0)
+    assert new_id == 2
+    rows = db.query("SELECT * FROM person ORDER BY id")
+    assert rows[0]["name"] == "ada"
+    people = db.select(Person, "SELECT * FROM person ORDER BY id")
+    assert people[1] == Person(id=2, name="bob", active=False)
+    assert db.query_row("SELECT COUNT(*) AS n FROM person")["n"] == 2
+    assert db.health_check()["status"] == "UP"
+    db.close()
+
+
+def test_sql_transaction_rollback():
+    db = SQL(":memory:")
+    db.exec("CREATE TABLE t (v TEXT)")
+    with pytest.raises(RuntimeError):
+        with db.begin() as tx:
+            tx.exec("INSERT INTO t (v) VALUES (?)", "x")
+            raise RuntimeError("abort")
+    assert db.query("SELECT * FROM t") == []
+    with db.begin() as tx:
+        tx.exec("INSERT INTO t (v) VALUES (?)", "y")
+    assert db.query("SELECT * FROM t") == [{"v": "y"}]
+    db.close()
+
+
+# ------------------------------------------------------------------- KV
+def test_kv_set_get_delete_persistence(tmp_path):
+    path = str(tmp_path / "store" / "data.kv")
+    kv = BadgerLikeKV(path)
+    kv.connect()
+    kv.set("a", "1")
+    kv.set("b", "2")
+    kv.set("a", "3")  # overwrite
+    kv.delete("b")
+    assert kv.get("a") == "3"
+    with pytest.raises(KeyNotFoundError):
+        kv.get("b")
+    kv.close()
+    # replay from disk
+    kv2 = BadgerLikeKV(path)
+    kv2.connect()
+    assert kv2.get("a") == "3"
+    assert len(kv2) == 1
+    assert kv2.health_check()["status"] == "UP"
+    kv2.close()
+
+
+def test_kv_compaction(tmp_path):
+    path = str(tmp_path / "c.kv")
+    kv = BadgerLikeKV(path, compact_threshold=10)
+    kv.connect()
+    for i in range(50):
+        kv.set("key", f"v{i}")
+    kv.close()
+    import os
+
+    # after compaction the log holds ~1 live record, not 50
+    assert os.path.getsize(path) < 50 * 20
+    kv2 = BadgerLikeKV(path)
+    kv2.connect()
+    assert kv2.get("key") == "v49"
+    kv2.close()
+
+
+# ------------------------------------------------------------------- pubsub
+def test_inproc_pubsub_roundtrip(run):
+    async def scenario():
+        broker = InProcessBroker()
+        await broker.publish("orders", b'{"id": 7}')
+        msg = await broker.subscribe("orders")
+        data = await msg.bind()
+        assert data == {"id": 7}
+        msg.commit()
+        assert msg.committed
+        assert broker.health_check()["status"] == "UP"
+
+    run(scenario())
+
+
+def test_subscriber_loop_commits_on_success(run):
+    from gofr_tpu.subscriber import start_subscriber
+
+    async def scenario():
+        container, mocks = new_mock_container()
+        seen = []
+
+        async def handler(ctx):
+            seen.append(await ctx.bind())
+            if len(seen) >= 2:
+                task.cancel()
+
+        await mocks.pubsub.publish("t", b'{"n": 1}')
+        await mocks.pubsub.publish("t", b'{"n": 2}')
+        task = asyncio.ensure_future(start_subscriber("t", handler, container))
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        assert seen == [{"n": 1}, {"n": 2}]
+
+    run(scenario())
+
+
+def test_subscriber_handler_error_no_commit(run):
+    from gofr_tpu.subscriber import start_subscriber
+
+    async def scenario():
+        container, mocks = new_mock_container()
+        calls = []
+
+        async def handler(ctx):
+            calls.append(1)
+            task.cancel()
+            raise ValueError("boom")
+
+        await mocks.pubsub.publish("t", b"{}")
+        task = asyncio.ensure_future(start_subscriber("t", handler, container))
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        assert calls == [1]
+        m = container.metrics_manager.expose_text()
+        # received (the loop may re-poll once before the cancel lands) but
+        # never marked success: commit was skipped on handler failure
+        assert 'app_pubsub_subscribe_total_count{topic="t"}' in m
+        assert 'app_pubsub_subscribe_success_count{topic="t"}' not in m
+
+    run(scenario())
+
+
+# ------------------------------------------------------------------- file
+def test_local_file_row_reader(tmp_path):
+    fs = LocalFileSystem()
+    jf = tmp_path / "rows.json"
+    jf.write_text('[{"a": 1}, {"a": 2}]')
+    rows = list(fs.open(str(jf)).read_all())
+    assert rows == [{"a": 1}, {"a": 2}]
+    cf = tmp_path / "rows.csv"
+    cf.write_text("x,y\n1,2\n")
+    rows = list(fs.open(str(cf)).read_all())
+    assert rows == [["x", "y"], ["1", "2"]]
+    tf = tmp_path / "rows.txt"
+    tf.write_text("one\ntwo\n")
+    rows = list(fs.open(str(tf)).read_all())
+    assert rows == ["one", "two"]
+    fs.mkdir_all(str(tmp_path / "d1" / "d2"))
+    assert "d1" in fs.read_dir(str(tmp_path))
+
+
+# ------------------------------------------------------------------- migration
+def test_migrations_apply_in_order_and_skip_applied():
+    container, mocks = new_mock_container()
+    order = []
+
+    def m1(ds):
+        ds.sql.exec("CREATE TABLE t1 (v TEXT)")
+        order.append(1)
+
+    def m2(ds):
+        ds.sql.exec("CREATE TABLE t2 (v TEXT)")
+        ds.redis.set("migrated", "yes")
+        order.append(2)
+
+    run_migrations({2: Migrate(up=m2), 1: Migrate(up=m1)}, container)
+    assert order == [1, 2]
+    # bookkeeping recorded; re-run is a no-op
+    run_migrations({1: Migrate(up=m1), 2: Migrate(up=m2)}, container)
+    assert order == [1, 2]
+    rows = mocks.sql.query("SELECT version FROM gofr_migrations ORDER BY version")
+    assert [r["version"] for r in rows] == [1, 2]
+    assert mocks.redis.get("migrated") == "yes"
+
+
+def test_migration_failure_rolls_back_and_halts():
+    container, mocks = new_mock_container()
+
+    def bad(ds):
+        ds.sql.exec("CREATE TABLE will_rollback (v TEXT)")
+        raise RuntimeError("broken migration")
+
+    with pytest.raises(RuntimeError):
+        run_migrations({1: Migrate(up=bad)}, container)
+    # nothing recorded, table rolled back
+    rows = mocks.sql.query("SELECT * FROM gofr_migrations")
+    assert rows == []
+    with pytest.raises(Exception):
+        mocks.sql.query("SELECT * FROM will_rollback")
+
+
+# ------------------------------------------------------------------- container
+def test_container_health_aggregation(run):
+    async def scenario():
+        container, mocks = new_mock_container()
+        health = await container.health()
+        assert health["status"] == "UP"
+        assert health["sql"]["status"] == "UP"
+        assert health["redis"]["status"] == "UP"
+
+        class Down:
+            def health_check(self):
+                return {"status": "DOWN", "error": "nope"}
+
+        container._extra_datasources["broken"] = Down()
+        health = await container.health()
+        assert health["status"] == "DEGRADED"
+        assert health["broken"]["status"] == "DOWN"
+
+    run(scenario())

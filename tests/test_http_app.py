@@ -1,0 +1,350 @@
+"""End-to-end HTTP tests: envelope rules, errors, auth, CRUD, health, CORS,
+websockets — driven through aiohttp's in-process test client, the analogue of
+the reference's router.ServeHTTP recorder tests (SURVEY §4).
+"""
+
+import dataclasses
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from gofr_tpu import errors
+from gofr_tpu.app import App
+from gofr_tpu.config import MapConfig
+from gofr_tpu.container.mock import new_mock_container
+from gofr_tpu.http.response import Raw, Redirect, Response
+
+
+def make_app(**config) -> App:
+    app = App(config=MapConfig({"APP_NAME": "test-app", **config}))
+    # swap in hermetic datasources
+    container, _ = new_mock_container()
+    container.tracer = app.tracer
+    app.container = container
+    return app
+
+
+async def client_for(app: App) -> TestClient:
+    server = TestServer(app._build_http_app())
+    client = TestClient(server)
+    await client.start_server()
+    return client
+
+
+# ------------------------------------------------------------- envelope rules
+def test_envelope_and_status_codes(run):
+    async def scenario():
+        app = make_app()
+
+        async def greet(ctx):
+            return "Hello World!"
+
+        async def create(ctx):
+            body = await ctx.bind()
+            return {"created": body["name"]}
+
+        async def remove(ctx):
+            return None
+
+        async def missing(ctx):
+            raise errors.EntityNotFound("id", ctx.path_param("id"))
+
+        app.get("/greet", greet)
+        app.post("/things", create)
+        app.delete("/things/{id}", remove)
+        app.get("/things/{id}", missing)
+        client = await client_for(app)
+        try:
+            r = await client.get("/greet")
+            assert r.status == 200
+            assert await r.json() == {"data": "Hello World!"}
+
+            r = await client.post("/things", json={"name": "x"})
+            assert r.status == 201
+            assert (await r.json())["data"] == {"created": "x"}
+
+            r = await client.delete("/things/9")
+            assert r.status == 204
+
+            r = await client.get("/things/42")
+            assert r.status == 404
+            assert (await r.json())["error"]["message"] == "No entity found with id: 42"
+
+            # unregistered route → catch-all 404 envelope
+            r = await client.get("/nope")
+            assert r.status == 404
+            assert (await r.json())["error"]["message"] == "route not registered"
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+def test_raw_redirect_response_types(run):
+    async def scenario():
+        app = make_app()
+
+        async def raw(ctx):
+            return Raw([1, 2, 3])
+
+        async def redirect(ctx):
+            return Redirect("https://example.com")
+
+        async def custom(ctx):
+            return Response({"k": "v"}, headers={"X-Custom": "yes"})
+
+        app.get("/raw", raw)
+        app.get("/redir", redirect)
+        app.get("/custom", custom)
+        client = await client_for(app)
+        try:
+            r = await client.get("/raw")
+            assert await r.json() == [1, 2, 3]
+
+            r = await client.get("/redir", allow_redirects=False)
+            assert r.status == 302
+            assert r.headers["Location"] == "https://example.com"
+
+            r = await client.get("/custom")
+            assert r.headers["X-Custom"] == "yes"
+            assert (await r.json())["data"] == {"k": "v"}
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+def test_panic_recovery_and_timeout(run):
+    async def scenario():
+        app = make_app(REQUEST_TIMEOUT="0.2")
+
+        async def boom(ctx):
+            raise RuntimeError("internal secret detail")
+
+        async def slow(ctx):
+            import asyncio
+
+            await asyncio.sleep(5)
+
+        app.get("/boom", boom)
+        app.get("/slow", slow)
+        client = await client_for(app)
+        try:
+            r = await client.get("/boom")
+            assert r.status == 500
+            body = await r.json()
+            assert body["error"]["message"] == "some unexpected error has occurred"
+            assert "secret" not in json.dumps(body)
+
+            r = await client.get("/slow")
+            assert r.status == 408
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------- well-known
+def test_health_and_alive(run):
+    async def scenario():
+        app = make_app()
+        client = await client_for(app)
+        try:
+            r = await client.get("/.well-known/alive")
+            assert r.status == 200
+            assert (await r.json())["data"] == {"status": "UP"}
+
+            r = await client.get("/.well-known/health")
+            body = (await r.json())["data"]
+            assert body["status"] == "UP"
+            assert body["sql"]["status"] == "UP"
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+def test_cors_headers_and_options(run):
+    async def scenario():
+        app = make_app(ACCESS_CONTROL_ALLOW_ORIGIN="https://ui.example.com")
+
+        async def h(ctx):
+            return "ok"
+
+        app.get("/x", h)
+        client = await client_for(app)
+        try:
+            r = await client.get("/x")
+            assert r.headers["Access-Control-Allow-Origin"] == "https://ui.example.com"
+            r = await client.options("/x")
+            assert r.status == 200
+            assert "GET" in r.headers["Access-Control-Allow-Methods"]
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------- auth
+def test_basic_auth(run):
+    async def scenario():
+        import base64
+
+        app = make_app()
+        app.enable_basic_auth("admin", "secret")
+
+        async def h(ctx):
+            return ctx.get_auth_info().get_username()
+
+        app.get("/me", h)
+        client = await client_for(app)
+        try:
+            r = await client.get("/me")
+            assert r.status == 401
+
+            token = base64.b64encode(b"admin:wrong").decode()
+            r = await client.get("/me", headers={"Authorization": f"Basic {token}"})
+            assert r.status == 401
+
+            token = base64.b64encode(b"admin:secret").decode()
+            r = await client.get("/me", headers={"Authorization": f"Basic {token}"})
+            assert r.status == 200
+            assert (await r.json())["data"] == "admin"
+
+            # well-known bypasses auth
+            r = await client.get("/.well-known/alive")
+            assert r.status == 200
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+def test_api_key_auth(run):
+    async def scenario():
+        app = make_app()
+        app.enable_api_key_auth("k1", "k2")
+
+        async def h(ctx):
+            return "in"
+
+        app.get("/x", h)
+        client = await client_for(app)
+        try:
+            assert (await client.get("/x")).status == 401
+            assert (await client.get("/x", headers={"X-Api-Key": "bad"})).status == 401
+            assert (await client.get("/x", headers={"X-Api-Key": "k2"})).status == 200
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------- CRUD
+@dataclasses.dataclass
+class Book:
+    id: int = dataclasses.field(default=0, metadata={"sql": "auto_increment"})
+    title: str = ""
+    pages: int = 0
+
+
+def test_crud_handlers(run):
+    async def scenario():
+        app = make_app()
+        app.container.sql.exec(
+            "CREATE TABLE book (id INTEGER PRIMARY KEY AUTOINCREMENT,"
+            " title TEXT, pages INTEGER)"
+        )
+        app.add_rest_handlers(Book)
+        client = await client_for(app)
+        try:
+            r = await client.post("/book", json={"title": "Dune", "pages": 412})
+            assert r.status == 201
+            created = (await r.json())["data"]
+            assert created["id"] == 1
+
+            r = await client.get("/book")
+            assert [b["title"] for b in (await r.json())["data"]] == ["Dune"]
+
+            r = await client.get("/book/1")
+            assert (await r.json())["data"]["pages"] == 412
+
+            r = await client.put("/book/1", json={"title": "Dune", "pages": 500})
+            assert r.status == 200
+            r = await client.get("/book/1")
+            assert (await r.json())["data"]["pages"] == 500
+
+            r = await client.delete("/book/1")
+            assert r.status == 204
+            r = await client.get("/book/1")
+            assert r.status == 404
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+# ----------------------------------------------------------------- websocket
+def test_websocket_echo(run):
+    async def scenario():
+        app = make_app()
+
+        async def ws_handler(ctx):
+            msg = await ctx.bind()
+            return {"echo": msg}
+
+        app.websocket("/ws", ws_handler)
+        client = await client_for(app)
+        try:
+            ws = await client.ws_connect("/ws")
+            await ws.send_str(json.dumps({"hello": "tpu"}))
+            reply = json.loads((await ws.receive()).data)
+            assert reply == {"echo": {"hello": "tpu"}}
+            await ws.close()
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+# ------------------------------------------------------------------- metrics
+def test_http_metrics_recorded(run):
+    async def scenario():
+        app = make_app()
+
+        async def h(ctx):
+            return "ok"
+
+        app.get("/m/{id}", h)
+        client = await client_for(app)
+        try:
+            await client.get("/m/1")
+            await client.get("/m/2")
+        finally:
+            await client.close()
+        text = app.container.metrics_manager.expose_text()
+        # route template (not raw path) labels the histogram
+        assert 'path="/m/{id}"' in text
+        assert 'method="GET"' in text
+
+    run(scenario())
+
+
+def test_method_not_allowed(run):
+    async def scenario():
+        app = make_app()
+
+        async def h(ctx):
+            return "ok"
+
+        app.get("/only-get", h)
+        client = await client_for(app)
+        try:
+            r = await client.post("/only-get")
+            assert r.status == 405
+            r = await client.get("/truly/unknown")
+            assert r.status == 404
+        finally:
+            await client.close()
+
+    run(scenario())

@@ -1,0 +1,122 @@
+"""ML datasource tests: engine, registry, dynamic batching, /predict route."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from gofr_tpu.ml import EngineConfig, MLDatasource
+from gofr_tpu.models.mlp import mnist_mlp
+
+
+@pytest.fixture(scope="module")
+def ml():
+    ds = MLDatasource()
+    ds.register("mnist", mnist_mlp(hidden=64), batching=True)
+    yield ds
+    ds.close()
+
+
+def test_engine_predict_sync(ml):
+    x = np.random.default_rng(0).normal(size=(4, 784)).astype(np.float32)
+    logits = ml.predict_sync("mnist", x)
+    assert logits.shape == (4, 10)
+    assert np.isfinite(logits).all()
+
+
+def test_engine_async_predict(ml, run):
+    async def scenario():
+        x = np.zeros((2, 784), np.float32)
+        out = await ml.engine("mnist").predict(x)
+        assert out.shape == (2, 10)
+
+    run(scenario())
+
+
+def test_unknown_model_raises(ml):
+    with pytest.raises(KeyError):
+        ml.engine("nope")
+
+
+def test_dynamic_batcher_coalesces(run):
+    calls = []
+
+    class FakeEngine:
+        name = "fake"
+
+        def bucket_for(self, n):
+            return 8  # always pad to 8
+
+        async def predict(self, x):
+            calls.append(x.shape[0])
+            return x * 2
+
+    from gofr_tpu.ml.batching import DynamicBatcher
+
+    async def scenario():
+        batcher = DynamicBatcher(FakeEngine(), max_batch=8, max_delay_s=0.02)
+        inputs = [np.full((3,), i, np.float32) for i in range(5)]
+        outs = await asyncio.gather(*(batcher.submit(x) for x in inputs))
+        for i, out in enumerate(outs):
+            assert np.allclose(out, inputs[i] * 2)
+        batcher.close()
+
+    run(scenario())
+    # all 5 concurrent requests coalesced into one padded batch of 8
+    assert calls == [8]
+
+
+def test_batcher_error_propagates(run):
+    class BadEngine:
+        name = "bad"
+
+        def bucket_for(self, n):
+            return n
+
+        async def predict(self, x):
+            raise RuntimeError("device on fire")
+
+    from gofr_tpu.ml.batching import DynamicBatcher
+
+    async def scenario():
+        batcher = DynamicBatcher(BadEngine(), max_delay_s=0.001)
+        with pytest.raises(RuntimeError, match="device on fire"):
+            await batcher.submit(np.zeros(3, np.float32))
+        batcher.close()
+
+    run(scenario())
+
+
+def test_predict_routes_through_batcher(ml, run):
+    async def scenario():
+        # datasource-level predict on a batching model takes ONE example
+        x = np.zeros((784,), np.float32)
+        out = await ml.predict("mnist", x)
+        assert out.shape == (10,)
+
+    run(scenario())
+
+
+def test_ml_health_and_hbm_metrics(ml):
+    health = ml.health_check()
+    assert health["status"] == "UP"
+    assert "mnist" in health["details"]["models"]
+
+    from gofr_tpu.metrics import Manager
+
+    m = Manager()
+    m.new_gauge("app_tpu_hbm_bytes_in_use")
+    m.new_gauge("app_tpu_hbm_bytes_limit")
+    ml.refresh_device_metrics(m)  # must not raise on CPU devices
+
+
+def test_register_model_on_app(run):
+    from gofr_tpu.app import App
+    from gofr_tpu.config import MapConfig
+
+    app = App(config=MapConfig({}))
+    app.register_model("mnist", mnist_mlp(hidden=32))
+    assert app.container.ml is not None
+    x = np.zeros((1, 784), np.float32)
+    assert app.container.ml.predict_sync("mnist", x).shape == (1, 10)
+    app.container.ml.close()
