@@ -1,0 +1,77 @@
+"""Benchmark: continuous-batching decode throughput on the local accelerator.
+
+Prints ONE JSON line. The workload is the per-chip share of BASELINE.md
+config #4 (Llama-3-8B, TP=8, >= 2000 tok/s aggregate): one chip running a
+1B-param decoder (== 8B sharded 8 ways) with 8 continuous-batching slots.
+``vs_baseline`` is therefore value / 2000 — each chip of the TP=8 system
+must sustain the full aggregate token rate on its 1/8 model shard.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def main() -> None:
+    from gofr_tpu.ml.generate import Generator
+    from gofr_tpu.models import llama
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = llama.LlamaConfig(
+            vocab_size=32_128, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+            ffn_dim=8192, max_seq_len=2048,
+        )
+        slots, chunk, n_chunks, prompt_len, max_seq = 8, 16, 16, 128, 1024
+    else:  # CPU smoke fallback so the bench never hard-fails
+        cfg = llama.tiny_llama(use_flash=False)
+        slots, chunk, n_chunks, prompt_len, max_seq = 4, 4, 4, 8, 64
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    gen = Generator(params, cfg, batch_slots=slots, max_seq=max_seq,
+                    prefill_buckets=(prompt_len,), chunk=chunk)
+
+    rng = np.random.default_rng(0)
+    t_prefill = time.perf_counter()
+    for _ in range(slots):
+        gen.add_request(
+            rng.integers(1, cfg.vocab_size, (prompt_len,)).astype(np.int32),
+            max_new_tokens=10**9,
+        )
+    prefill_s = time.perf_counter() - t_prefill
+
+    gen.step()  # decode compile + warmup
+    jax.block_until_ready(gen.cache["k"])
+
+    start = time.perf_counter()
+    for _ in range(n_chunks):
+        gen.step()
+    jax.block_until_ready(gen.cache["k"])
+    elapsed = time.perf_counter() - start
+
+    steps = chunk * n_chunks
+    tok_per_s = slots * steps / elapsed
+    print(json.dumps({
+        "metric": "decode_tok_per_s_per_chip_1b_proxy",
+        "value": round(tok_per_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_per_s / 2000.0, 3),
+        "detail": {
+            "backend": jax.default_backend(),
+            "slots": slots,
+            "decode_steps": steps,
+            "step_ms": round(1000 * elapsed / steps, 2),
+            "prefill_total_s": round(prefill_s, 2),
+            "params_m": round(sum(
+                int(np.prod(p.shape)) for p in jax.tree.leaves(params)
+            ) / 1e6),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,282 @@
+"""Continuous-batching token generation.
+
+The serving heart of BASELINE.md config #4 (Llama streaming, TP=8):
+a decode loop that keeps the MXU busy with a fixed-shape batch while
+requests of different lengths join and leave — the TPU-native analogue of
+the reference's per-request goroutine model (handler.go:77-97), redesigned
+because SPMD compute wants ONE static-shaped program, not one thread per
+request.
+
+Design:
+- ``Generator`` holds a fixed batch of slots; the jitted step always runs
+  the full batch — free slots decode garbage that is simply ignored (a
+  slot's share of one matmul is cheaper than a recompile).
+- the decode loop is DEVICE-RESIDENT: sampling is fused into the jitted
+  step, the KV cache is donated (no copy per step), ``chunk`` tokens are
+  produced per dispatch via ``lax.scan``, and sampled tokens come back to
+  the host through an async-copy pipeline one dispatch deep — host-side
+  bookkeeping (callbacks, EOS, slot lifecycle) lags one chunk behind the
+  device and never stalls it. Measured here: device→host sync costs ~40 ms
+  through the PJRT tunnel; a naive per-step fetch caps throughput at ~25
+  tok/s/slot regardless of chip speed.
+- prefill runs per-request on padded shape buckets, then the sequence's
+  KV rows are scattered into its slot.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Sampler", "sample_logits", "greedy", "Generator"]
+
+
+class Sampler:
+    """Static sampling config (hashable: safe as a jit static arg)."""
+
+    def __init__(self, temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0) -> None:
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+
+    def __hash__(self) -> int:
+        return hash((self.temperature, self.top_k, self.top_p))
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Sampler)
+                and (self.temperature, self.top_k, self.top_p)
+                == (other.temperature, other.top_k, other.top_p))
+
+
+def greedy() -> Sampler:
+    return Sampler()
+
+
+def _sample_impl(logits: jnp.ndarray, key, sampler: Sampler) -> jnp.ndarray:
+    """logits [B, V] -> token ids [B]. Traced inside the decode step."""
+    if sampler.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / sampler.temperature
+    if sampler.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -sampler.top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if sampler.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set of tokens whose mass exceeds top_p
+        cutoff_idx = jnp.sum(cum < sampler.top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("sampler",))
+def sample_logits(logits: jnp.ndarray, key, sampler: Sampler) -> jnp.ndarray:
+    return _sample_impl(logits, key, sampler)
+
+
+class _Slot:
+    __slots__ = ("live", "tokens", "max_new", "produced", "prompt_len",
+                 "eos_hit", "callback")
+
+    def __init__(self) -> None:
+        self.live = False
+        self.tokens: list[int] = []
+        self.max_new = 0
+        self.produced = 0
+        self.prompt_len = 0
+        self.eos_hit = False
+        self.callback = None
+
+
+class Generator:
+    """Continuous-batching decode loop over a fixed slot batch.
+
+    Synchronous core (the asyncio serving layer drives it from a thread via
+    the Engine pattern). Usage:
+
+        gen = Generator(params, cfg, batch_slots=8, max_seq=2048)
+        out = gen.generate(prompt_ids, max_new_tokens=64)   # single request
+        # or: slot = gen.add_request(ids, n, cb); gen.step() in a loop
+    """
+
+    def __init__(self, params: Any, cfg, *, batch_slots: int = 8,
+                 max_seq: int = 2048, sampler: Sampler | None = None,
+                 eos_id: int | None = None, prefill_buckets=(128, 512, 2048),
+                 seed: int = 0, mesh=None, chunk: int = 1) -> None:
+        import contextlib
+
+        from ..models import llama
+
+        self._m = llama
+        self._mesh_ctx = (lambda: mesh) if mesh is not None else contextlib.nullcontext
+        self.params = params
+        self.cfg = cfg
+        self.batch_slots = batch_slots
+        self.max_seq = max_seq
+        self.sampler = sampler or greedy()
+        self.eos_id = eos_id
+        self.chunk = chunk
+        self.prefill_buckets = tuple(
+            b for b in sorted(prefill_buckets) if b <= max_seq
+        ) or (max_seq,)
+        self.cache = llama.init_cache(cfg, batch_slots, max_seq)
+        self.slots = [_Slot() for _ in range(batch_slots)]
+        # two independent streams: decode keys fold the step counter,
+        # prefill keys fold a request counter — no collisions between the
+        # two or between back-to-back add_request calls.
+        root = jax.random.PRNGKey(seed)
+        self._base_key = jax.random.fold_in(root, 0)
+        self._prefill_key = jax.random.fold_in(root, 1)
+        self._n_requests = 0
+        self._tok_dev = jnp.zeros((batch_slots,), jnp.int32)  # device-resident
+        self._inflight: collections.deque = collections.deque()  # [chunk, B] arrays
+        self.steps = 0
+
+        sampler_cfg = self.sampler
+        n_chunk = self.chunk
+
+        def chunk_fn(params, tok, cache, step0, base_key):
+            """``chunk`` fused decode+sample steps; returns all sampled
+            tokens [chunk, B] plus the final carry."""
+
+            def body(carry, j):
+                tok, cache = carry
+                logits, cache = llama.decode_step(params, tok, cache, cfg)
+                key = jax.random.fold_in(base_key, step0 + j)
+                nxt = _sample_impl(logits, key, sampler_cfg)
+                return (nxt, cache), nxt
+
+            (tok, cache), toks = jax.lax.scan(
+                body, (tok, cache), jnp.arange(n_chunk)
+            )
+            return toks, tok, cache
+
+        # donate the cache: in-place KV update on device, no copy per step
+        self._chunk_fn = jax.jit(chunk_fn, donate_argnums=(2,))
+        self._prefill = jax.jit(
+            lambda p, t, l, c: llama.prefill(p, t, l, cfg, c)
+        )
+
+    # -- request management ---------------------------------------------------
+    def free_slot(self) -> int | None:
+        for i, s in enumerate(self.slots):
+            if not s.live:
+                return i
+        return None
+
+    def add_request(self, prompt_ids, max_new_tokens: int,
+                    callback=None) -> int:
+        """Prefill the prompt into a free slot; returns the slot index."""
+        self.drain()  # settle bookkeeping before reusing a slot
+        i = self.free_slot()
+        if i is None:
+            raise RuntimeError("no free generation slot")
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        n = len(ids)
+        if n == 0 or n >= self.max_seq:
+            raise ValueError(f"prompt length {n} out of range (1..{self.max_seq - 1})")
+        bucket = next((b for b in self.prefill_buckets if n <= b), self.max_seq)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = ids
+        tmp_cache = self._m.init_cache(self.cfg, 1, self.max_seq)
+        with self._mesh_ctx():
+            logits, filled = self._prefill(
+                self.params, jnp.asarray(padded), jnp.asarray([n], np.int32),
+                tmp_cache,
+            )
+        # scatter the prefilled row into slot i of the shared cache
+        self.cache = {
+            "k": self.cache["k"].at[:, i].set(filled["k"][:, 0]),
+            "v": self.cache["v"].at[:, i].set(filled["v"][:, 0]),
+            "len": self.cache["len"].at[i].set(n),
+        }
+        key = jax.random.fold_in(self._prefill_key, self._n_requests)
+        self._n_requests += 1
+        first = int(sample_logits(logits, key, self.sampler)[0])
+        self._tok_dev = self._tok_dev.at[i].set(first)
+        s = _Slot()
+        s.live = True
+        s.tokens = [first]
+        s.max_new = max_new_tokens
+        s.produced = 1
+        s.prompt_len = n
+        s.eos_hit = self.eos_id is not None and first == self.eos_id
+        s.callback = callback
+        self.slots[i] = s
+        if callback is not None:
+            callback(i, first)
+        self._maybe_finish(i)
+        return i
+
+    def _maybe_finish(self, i: int) -> None:
+        s = self.slots[i]
+        if s.live and (
+            s.produced >= s.max_new
+            or s.eos_hit
+            or s.prompt_len + s.produced >= self.max_seq
+        ):
+            s.live = False
+
+    @property
+    def n_live(self) -> int:
+        return sum(s.live for s in self.slots)
+
+    # -- decode ---------------------------------------------------------------
+    def step(self) -> None:
+        """Dispatch one ``chunk`` of decode steps; process the previous
+        chunk's tokens (host bookkeeping lags one dispatch — the device
+        never waits for the ~40 ms tunnel round-trip)."""
+        if self.n_live == 0:
+            self.drain()
+            return
+        with self._mesh_ctx():
+            toks, self._tok_dev, self.cache = self._chunk_fn(
+                self.params, self._tok_dev, self.cache,
+                jnp.int32(self.steps), self._base_key,
+            )
+        self.steps += self.chunk
+        try:
+            toks.copy_to_host_async()
+        except Exception:
+            pass
+        self._inflight.append(toks)
+        while len(self._inflight) > 1:
+            self._process(np.asarray(self._inflight.popleft()))
+
+    def drain(self) -> None:
+        """Flush pending token chunks into host bookkeeping."""
+        while self._inflight:
+            self._process(np.asarray(self._inflight.popleft()))
+
+    def _process(self, toks: np.ndarray) -> None:
+        """Apply one [chunk, B] token block to slot state, in step order."""
+        for row in toks:
+            for i, s in enumerate(self.slots):
+                if not s.live:
+                    continue
+                t = int(row[i])
+                s.tokens.append(t)
+                s.produced += 1
+                if self.eos_id is not None and t == self.eos_id:
+                    s.eos_hit = True
+                if s.callback is not None:
+                    s.callback(i, t)
+                self._maybe_finish(i)
+
+    def generate(self, prompt_ids, max_new_tokens: int = 32) -> list[int]:
+        """Blocking single-request convenience: returns generated ids."""
+        i = self.add_request(prompt_ids, max_new_tokens)
+        while self.slots[i].live:
+            self.step()
+        self.drain()
+        out = self.slots[i].tokens[:max_new_tokens]
+        self.slots[i] = _Slot()
+        return out

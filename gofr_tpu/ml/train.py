@@ -1,0 +1,90 @@
+"""Sharded training step.
+
+The reference has no training of any kind (SURVEY §2.10); this is the
+TPU-native subsystem that lets the framework fine-tune the models it
+serves. One jitted SPMD step:
+
+- params and optimizer state live sharded on the mesh (TP rules from the
+  model + optional fsdp on the dp axis via optax's pytree states, which
+  inherit the params' shardings);
+- the batch arrives sharded on ``dp``; the gradient all-reduce over dp and
+  the TP psums are both inserted by GSPMD from the shardings — no explicit
+  collectives here;
+- bf16 compute with f32 Adam moments (``mu_dtype``/``nu`` kept f32 so
+  second-moment accumulation doesn't underflow at bf16);
+- activation rematerialization is the model's concern: LlamaConfig(remat=
+  True) wraps the layer-scan body in ``jax.checkpoint`` so long sequences
+  trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..parallel import Mesh, NamedSharding, P, shard_params
+
+__all__ = ["Trainer", "make_train_step"]
+
+
+def make_train_step(loss_fn: Callable, optimizer) -> Callable:
+    """Build ``step(params, opt_state, *batch) -> (params, opt_state, loss)``.
+
+    ``loss_fn(params, *batch) -> scalar``. The returned step is pure and
+    jittable; shardings flow in through the arguments.
+    """
+
+    def step(params, opt_state, *batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+class Trainer:
+    """Owns sharded params + optimizer state and a compiled SPMD step."""
+
+    def __init__(
+        self,
+        loss_fn: Callable,
+        params: Any,
+        *,
+        mesh: Mesh | None = None,
+        param_specs: Any = None,
+        batch_spec: P = P("dp"),
+        optimizer=None,
+        learning_rate: float = 3e-4,
+    ) -> None:
+        self.mesh = mesh
+        # mu_dtype=f32: bf16 params must not drag the Adam moments down to
+        # bf16, or second-moment accumulation underflows.
+        self.optimizer = optimizer or optax.adamw(learning_rate, mu_dtype=jnp.float32)
+        if mesh is not None and param_specs is not None:
+            params = shard_params(params, param_specs, mesh)
+        self.params = params
+        self.opt_state = self.optimizer.init(params)
+        self._batch_spec = batch_spec
+        self._step_fn = jax.jit(make_train_step(loss_fn, self.optimizer),
+                                donate_argnums=(0, 1))
+        self.step_count = 0
+
+    def step(self, *batch) -> float:
+        if self.mesh is not None:
+            sharding = NamedSharding(self.mesh, self._batch_spec)
+            batch = tuple(jax.device_put(b, sharding) for b in batch)
+            ctx = self.mesh
+        else:
+            import contextlib
+
+            ctx = contextlib.nullcontext()
+        with ctx:
+            self.params, self.opt_state, loss = self._step_fn(
+                self.params, self.opt_state, *batch
+            )
+        self.step_count += 1
+        return float(loss)

@@ -1,0 +1,303 @@
+"""Llama-3-family decoder — the flagship serving model (BASELINE.md #4).
+
+Green-field for this framework (the reference nidhey27/gofr has no ML at
+all, SURVEY §2.10); designed TPU-first rather than ported:
+
+- layers are STACKED (leading [n_layers] axis on every weight) and the
+  forward pass is one ``lax.scan`` — one XLA layer body compiled once, not
+  n_layers inlined copies (compile time and code size stay flat as the
+  model deepens).
+- weights are bf16 and land on the mesh via declarative regex sharding
+  rules (gofr_tpu.parallel.specs_from_rules): Megatron-style TP — qkv/gate/up
+  column-sharded on ``tp``, wo/down row-sharded — so each layer needs one
+  psum, inserted by GSPMD, riding ICI.
+- activations carry ``P("dp", "sp", None)``: batch on data-parallel, sequence
+  on sequence-parallel. Attention itself sees the full sequence (XLA
+  all-gathers around it); ring attention over ``sp`` lives in
+  gofr_tpu.parallel.ring for the long-context path.
+- KV cache is a padded [L, B, S_max, KV, D] ring per layer with per-row
+  valid lengths, written with batched ``.at[rows, pos]`` scatters so
+  continuous batching can decode rows at different positions in one step.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import (
+    apply_rope,
+    attention,
+    decode_attention,
+    flash_attention,
+    repeat_kv,
+    rms_norm,
+    rope_table,
+    swiglu,
+)
+from ..parallel import P, constrain
+
+__all__ = ["LlamaConfig", "Llama", "llama3_8b", "tiny_llama"]
+
+
+class LlamaConfig:
+    def __init__(
+        self,
+        vocab_size: int = 128_256,
+        dim: int = 4096,
+        n_layers: int = 32,
+        n_heads: int = 32,
+        n_kv_heads: int = 8,
+        ffn_dim: int = 14_336,
+        max_seq_len: int = 8192,
+        rope_theta: float = 500_000.0,
+        norm_eps: float = 1e-5,
+        dtype: Any = jnp.bfloat16,
+        use_flash: bool = True,
+        remat: bool = False,
+    ) -> None:
+        self.vocab_size = vocab_size
+        self.dim = dim
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.n_kv_heads = n_kv_heads
+        self.head_dim = dim // n_heads
+        self.ffn_dim = ffn_dim
+        self.max_seq_len = max_seq_len
+        self.rope_theta = rope_theta
+        self.norm_eps = norm_eps
+        self.dtype = dtype
+        self.use_flash = use_flash
+        self.remat = remat
+
+    @property
+    def n_rep(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+
+def llama3_8b(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def tiny_llama(**kw) -> LlamaConfig:
+    """Test-scale config: same topology, toy widths (divisible by tp=4)."""
+    defaults = dict(
+        vocab_size=512, dim=128, n_layers=2, n_heads=8, n_kv_heads=4,
+        ffn_dim=256, max_seq_len=128, rope_theta=10_000.0,
+    )
+    defaults.update(kw)
+    return LlamaConfig(**defaults)
+
+
+# Megatron-style TP over the canonical mesh. Leading axis of every layer
+# weight is the stacked n_layers axis (never sharded).
+SHARDING_RULES = (
+    (r"layers/(wq|wk|wv|w_gate|w_up)", P(None, None, "tp")),  # column parallel
+    (r"layers/(wo|w_down)", P(None, "tp", None)),             # row parallel
+    (r"layers/(attn_norm|mlp_norm)", P(None)),
+    (r"embed", P(None, None)),
+    (r"lm_head", P(None, "tp")),                              # vocab sharded
+    (r"final_norm", P(None)),
+)
+
+# KV cache [L, B, S, KV, D]: batch on dp, kv heads on tp.
+CACHE_SPEC = P(None, "dp", None, "tp", None)
+
+
+def init_params(cfg: LlamaConfig, key) -> dict:
+    """bf16 weights, truncated-normal-ish scaled init; stacked layer axis."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    L, D, H, KV, hd, F = (cfg.n_layers, cfg.dim, cfg.n_heads,
+                          cfg.n_kv_heads, cfg.head_dim, cfg.ffn_dim)
+
+    def dense(key, *shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)
+                ).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    return {
+        "embed": dense(k_embed, cfg.vocab_size, D, fan_in=D),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), jnp.float32),
+            "mlp_norm": jnp.ones((L, D), jnp.float32),
+            "wq": dense(ks[0], L, D, H * hd, fan_in=D),
+            "wk": dense(ks[1], L, D, KV * hd, fan_in=D),
+            "wv": dense(ks[2], L, D, KV * hd, fan_in=D),
+            "wo": dense(ks[3], L, H * hd, D, fan_in=H * hd),
+            "w_gate": dense(ks[4], L, D, F, fan_in=D),
+            "w_up": dense(ks[5], L, D, F, fan_in=D),
+            "w_down": dense(ks[6], L, F, D, fan_in=F),
+        },
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "lm_head": dense(k_head, D, cfg.vocab_size, fan_in=D),
+    }
+
+
+def _layer(cfg: LlamaConfig, x, lp, cos, sin, *, kv_len=None, k_cache=None,
+           v_cache=None, pos=None, full_seq: bool):
+    """One decoder block. Returns (x, k_proj, v_proj[, caches])."""
+    b, s, _ = x.shape
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(b, s, H, hd)
+    k = (h @ lp["wk"]).reshape(b, s, KV, hd)
+    v = (h @ lp["wv"]).reshape(b, s, KV, hd)
+    q = constrain(q, P("dp", None, "tp", None))
+    k = constrain(k, P("dp", None, "tp", None))
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if full_seq:
+        kf, vf = repeat_kv(k, cfg.n_rep), repeat_kv(v, cfg.n_rep)
+        if cfg.use_flash:
+            o = flash_attention(q, kf, vf, causal=True, kv_len=kv_len)
+        else:
+            o = attention(q, kf, vf, causal=True, kv_len=kv_len)
+        new_k, new_v = k, v
+    else:
+        # decode: write this token into the cache at each row's position
+        rows = jnp.arange(b)
+        new_k = k_cache.at[rows, pos].set(k[:, 0])
+        new_v = v_cache.at[rows, pos].set(v[:, 0])
+        kf = repeat_kv(new_k, cfg.n_rep)
+        vf = repeat_kv(new_v, cfg.n_rep)
+        o = decode_attention(q, kf, vf, kv_len=pos + 1)
+
+    o = o.reshape(b, s, H * hd)
+    x = x + constrain(o @ lp["wo"], P("dp", "sp", None))
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    x = x + constrain(
+        swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]), P("dp", "sp", None)
+    )
+    return x, new_k, new_v
+
+
+def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
+            *, seq_lens: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Full-sequence forward: tokens [B, S] -> f32 logits [B, S, V].
+
+    Used for training and for prefill-without-cache; ``seq_lens`` masks
+    padded tail positions out of attention.
+    """
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = constrain(x, P("dp", "sp", None))
+    positions = jnp.arange(tokens.shape[1])[None, :]
+    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(x, lp):
+        x, _, _ = _layer(cfg, x, lp, cos, sin, kv_len=seq_lens, full_seq=True)
+        return x, None
+
+    if cfg.remat:
+        # recompute layer activations in the backward pass: HBM footprint
+        # stays O(1) in depth for long-sequence training
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return constrain(logits, P("dp", "sp", None))
+
+
+# -- KV-cache serving path ----------------------------------------------------
+
+def init_cache(cfg: LlamaConfig, batch: int, max_seq: int | None = None) -> dict:
+    S = max_seq or cfg.max_seq_len
+    shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "len": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def prefill(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
+            cfg: LlamaConfig, cache: dict) -> tuple[jnp.ndarray, dict]:
+    """Run the prompt [B, S_pad] through the model, filling the cache.
+
+    Returns (last-token logits [B, V], cache). S_pad is a shape bucket;
+    ``seq_lens`` gives each row's true prompt length.
+    """
+    b, s = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = constrain(x, P("dp", "sp", None))
+    positions = jnp.arange(s)[None, :]
+    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(x, lp):
+        x, k, v = _layer(cfg, x, lp, cos, sin, kv_len=seq_lens, full_seq=True)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    # gather each row's last valid position, then project only that row
+    rows = jnp.arange(b)
+    last = x[rows, seq_lens - 1]  # [B, D]
+    logits = (last @ params["lm_head"]).astype(jnp.float32)
+
+    S_max = cache["k"].shape[2]
+    pad = S_max - s
+    if pad < 0:
+        raise ValueError(f"prompt bucket {s} exceeds cache length {S_max}")
+    widen = lambda a: jnp.pad(a, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+    cache = {"k": widen(ks), "v": widen(vs), "len": seq_lens.astype(jnp.int32)}
+    return logits, cache
+
+
+def decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
+                cfg: LlamaConfig) -> tuple[jnp.ndarray, dict]:
+    """One token per row: tokens [B] -> (logits [B, V], updated cache).
+
+    Rows may sit at different positions (continuous batching); each row
+    writes its cache slot at its own ``len`` and attends to len+1 keys.
+    """
+    b = tokens.shape[0]
+    pos = cache["len"]  # [B]
+    x = params["embed"][tokens][:, None, :].astype(cfg.dtype)
+    cos, sin = rope_table(pos[:, None], cfg.head_dim, cfg.rope_theta)
+
+    def body(x, xs):
+        lp, kc, vc = xs
+        x, nk, nv = _layer(cfg, x, lp, cos, sin, k_cache=kc, v_cache=vc,
+                           pos=pos, full_seq=False)
+        return x, (nk, nv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    # cap len at capacity: rows past the end keep decoding garbage (their
+    # cache writes are dropped as out-of-bounds) but never index OOB.
+    S_max = cache["k"].shape[2]
+    new_len = jnp.minimum(pos + 1, S_max)
+    return logits, {"k": ks, "v": vs, "len": new_len}
+
+
+def loss_fn(params: dict, tokens: jnp.ndarray, targets: jnp.ndarray,
+            mask: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
+    """Masked next-token cross-entropy (f32 logits)."""
+    logits = forward(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    maskf = mask.astype(jnp.float32)
+    return -(ll * maskf).sum() / jnp.maximum(maskf.sum(), 1.0)
+
+
+class Llama:
+    """Engine-facing wrapper: holds params, exposes ``apply`` for ctx.ml."""
+
+    def __init__(self, cfg: LlamaConfig | None = None, seed: int = 0) -> None:
+        self.cfg = cfg or llama3_8b()
+        self.params = init_params(self.cfg, jax.random.PRNGKey(seed))
+        self.example_inputs = (np.zeros((1, 16), np.int32),)
+
+    def apply(self, params, tokens):
+        return forward(params, tokens, self.cfg)
+
+    def sharding_specs(self):
+        from ..parallel import specs_from_rules
+
+        return specs_from_rules(self.params, SHARDING_RULES)

@@ -1,0 +1,156 @@
+"""Core tensor ops for the model zoo.
+
+The reference has no compute ops at all (it is a Go microservice framework,
+SURVEY §2.10); these are the TPU-native primitives its "datasource driver"
+slot maps onto for the ``ml`` runtime. Two tiers:
+
+- pure-jnp reference implementations (this file): always correct, run on any
+  backend, and are what XLA fuses on CPU test meshes;
+- Pallas TPU kernels (``flash_attention.py``): the hot-path attention used
+  on real chips, selected by ``use_flash`` / backend detection.
+
+Everything is shaped [batch, seq, heads, head_dim] ("BSHD") so sequence and
+head axes line up with the mesh's ``sp``/``tp`` axes without transposes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "rms_norm",
+    "rope_table",
+    "apply_rope",
+    "repeat_kv",
+    "attention",
+    "decode_attention",
+    "swiglu",
+    "flash_attention",
+]
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm in float32 accumulation (bf16 inputs lose too much in the
+    mean-of-squares), cast back to the input dtype for the next matmul."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32)).astype(dtype)
+
+
+def rope_table(positions: jnp.ndarray, head_dim: int, theta: float = 500_000.0):
+    """cos/sin tables for rotary embeddings at the given positions.
+
+    positions: int array [...]; returns (cos, sin) of shape [..., head_dim//2]
+    in float32 — rotation is numerically sensitive, done in f32 then cast.
+    """
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate pairs (x[..., :half], x[..., half:]) — the "rotate_half"
+    convention. x: [..., seq, heads, head_dim]; cos/sin: [..., seq, half]."""
+    dtype = x.dtype
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    c = cos[..., None, :]  # broadcast over heads
+    s = sin[..., None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(dtype)
+
+
+def repeat_kv(kv: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """GQA: expand [B, S, n_kv, D] -> [B, S, n_kv*n_rep, D]."""
+    if n_rep == 1:
+        return kv
+    b, s, h, d = kv.shape
+    return jnp.broadcast_to(kv[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d
+    )
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    q_offset: int | jnp.ndarray = 0,
+    kv_len: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Reference softmax attention, BSHD layout, f32 logits.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D] (call repeat_kv first for GQA).
+    ``q_offset`` is the absolute position of q[0] (cache decoding);
+    ``kv_len`` masks out cache slots beyond the valid length, per batch row.
+    """
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    logits *= scale
+    tq, tk = q.shape[1], k.shape[1]
+    mask = None
+    if causal:
+        qpos = jnp.arange(tq) + q_offset
+        kpos = jnp.arange(tk)
+        mask = kpos[None, :] <= qpos[:, None]  # [Tq, Tk]
+        mask = mask[None, None]
+    if kv_len is not None:
+        valid = jnp.arange(tk)[None, :] < kv_len[:, None]  # [B, Tk]
+        valid = valid[:, None, None, :]
+        mask = valid if mask is None else jnp.logical_and(mask, valid)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def decode_attention(
+    q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray, kv_len: jnp.ndarray
+) -> jnp.ndarray:
+    """Single-token decode attention over a padded KV cache.
+
+    q: [B, 1, H, D]; caches: [B, S_max, H, D]; kv_len: [B] valid lengths
+    (the new token's slot already written). Bandwidth-bound: a plain einsum
+    lets XLA fuse the mask+softmax into the cache sweep.
+    """
+    return attention(q, k_cache, v_cache, causal=False, kv_len=kv_len)
+
+
+def swiglu(x: jnp.ndarray, w_gate: jnp.ndarray, w_up: jnp.ndarray,
+           w_down: jnp.ndarray) -> jnp.ndarray:
+    """SwiGLU MLP: silu(x @ w_gate) * (x @ w_up) @ w_down."""
+    g = jax.nn.silu(x @ w_gate)
+    return (g * (x @ w_up)) @ w_down
+
+
+@functools.cache
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def flash_attention(q, k, v, *, causal: bool = True, q_offset=0, kv_len=None,
+                    block_q: int = 256, block_k: int = 256):
+    """Fused attention: Pallas kernel on TPU, reference path elsewhere.
+
+    The kernel (ops/flash_attention.py) streams K/V blocks through VMEM with
+    an online softmax so the [Tq, Tk] logits matrix never materializes in
+    HBM — the standard memory-bound win for long sequences.
+    """
+    tq, tk = q.shape[1], k.shape[1]
+    bq, bk = min(block_q, tq), min(block_k, tk)
+    if _on_tpu() and tq >= 128 and tq % bq == 0 and tk % bk == 0:
+        from .flash_attention import flash_attention_tpu
+
+        return flash_attention_tpu(
+            q, k, v, kv_len, causal=causal, q_offset=q_offset,
+            block_q=block_q, block_k=block_k,
+        )
+    return attention(q, k, v, causal=causal, q_offset=q_offset, kv_len=kv_len)

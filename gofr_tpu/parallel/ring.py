@@ -1,0 +1,87 @@
+"""Ring attention: sequence-parallel exact attention over the ``sp`` axis.
+
+Long-context machinery the reference lacks entirely (SURVEY §5 "long-context
+/ sequence parallelism: absent"). Sequences longer than one chip's HBM are
+sharded along ``sp``; each device holds a [B, T/sp, H, D] slice of q/k/v and
+K/V blocks rotate around the ring via ``ppermute`` (one ICI hop per step)
+while a running online-softmax accumulator makes the result EXACT — the
+block-wise math is the same online update as the Pallas flash kernel
+(ops/flash_attention.py), lifted one level up: blocks across chips instead
+of blocks across VMEM tiles.
+
+Cost model: sp steps, each overlapping a [T/sp x T/sp] attention block with
+one neighbor-to-neighbor K/V transfer; compute hides the transfer when
+T/sp * H * D is large enough (the usual long-context regime).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import P
+
+__all__ = ["ring_attention_local", "ring_attention"]
+
+
+def ring_attention_local(q, k, v, *, axis_name: str = "sp",
+                         causal: bool = True) -> jnp.ndarray:
+    """Per-shard body: q/k/v are this device's [B, T_loc, H, D] slices along
+    the sequence; must run inside shard_map/vmap with ``axis_name`` bound.
+
+    Device i starts with K/V block i and passes its current block to device
+    i+1 each step (receiving from i-1), so after j steps it holds block
+    (i - j) mod n. Online softmax in f32 accumulates across blocks.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, t_loc, h, d = q.shape
+    scale = d ** -0.5
+    qf = q.astype(jnp.float32) * scale
+    q_pos = idx * t_loc + jnp.arange(t_loc)  # global positions of local q
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(j, carry):
+        acc, m, l, kc, vc = carry
+        src = (idx - j) % n  # which global block we currently hold
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32))
+        if causal:
+            k_pos = src * t_loc + jnp.arange(t_loc)
+            mask = k_pos[None, :] <= q_pos[:, None]  # [t_loc, t_loc]
+            logits = jnp.where(mask[None, None], logits, -1e30)
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)  # [b,h,q,1]
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32)
+        )
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return acc_new, m_new, l_new, kc, vc
+
+    acc0 = jnp.zeros((b, h, t_loc, d), jnp.float32)
+    m0 = jnp.full((b, h, t_loc, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, t_loc, 1), jnp.float32)
+    acc, _, l, _, _ = jax.lax.fori_loop(0, n, body, (acc0, m0, l0, k, v))
+    out = acc / jnp.maximum(l, 1e-30)  # fully-masked rows (padding) -> 0
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # back to BSHD
+
+
+def ring_attention(q, k, v, mesh, *, causal: bool = True,
+                   batch_axis: str = "dp", seq_axis: str = "sp",
+                   head_axis: str = "tp") -> jnp.ndarray:
+    """shard_map wrapper: q/k/v are full [B, S, H, D] arrays; batch rides
+    ``dp``, sequence ``sp``, heads ``tp`` (GQA must be expanded first so q
+    and k/v shard identically along heads)."""
+    spec = P(batch_axis, seq_axis, head_axis, None)
+    fn = functools.partial(ring_attention_local, axis_name=seq_axis,
+                           causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -13,6 +13,14 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+import jax  # noqa: E402
+
+# The environment's TPU plugin (sitecustomize) force-registers itself and
+# overrides JAX_PLATFORMS from the env, so pin the platform after import —
+# this wins over the plugin and gives the hermetic 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu" and len(jax.devices()) == 8
+
 import asyncio  # noqa: E402
 
 import pytest  # noqa: E402

@@ -1,0 +1,122 @@
+"""Ops: reference attention invariants + pallas kernel parity (interpret).
+
+Mirrors the reference's table-driven colocated unit tests (SURVEY §4) —
+hermetic, no hardware: the Pallas kernel runs in interpreter mode on CPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.ops import (
+    apply_rope,
+    attention,
+    decode_attention,
+    repeat_kv,
+    rms_norm,
+    rope_table,
+)
+from gofr_tpu.ops.flash_attention import flash_attention_tpu
+
+
+def test_rms_norm_unit_scale():
+    x = jnp.ones((2, 4, 8), jnp.bfloat16) * 3.0
+    out = rms_norm(x, jnp.ones((8,)))
+    np.testing.assert_allclose(np.asarray(out, np.float32), 1.0, atol=1e-2)
+
+
+def test_rope_preserves_norm_and_zero_position_identity():
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 4, 2, 16))
+    cos, sin = rope_table(jnp.arange(4)[None, :], 16, theta=10_000.0)
+    rq = apply_rope(q, cos, sin)
+    # rotation preserves per-head norms
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(q), axis=-1),
+        np.linalg.norm(np.asarray(rq), axis=-1),
+        rtol=1e-5,
+    )
+    # position 0 has angle 0 -> identity
+    np.testing.assert_allclose(np.asarray(q[:, 0]), np.asarray(rq[:, 0]), atol=1e-6)
+
+
+def test_repeat_kv_expands_heads():
+    kv = jnp.arange(2 * 3 * 2 * 4).reshape(2, 3, 2, 4).astype(jnp.float32)
+    out = repeat_kv(kv, 3)
+    assert out.shape == (2, 3, 6, 4)
+    np.testing.assert_array_equal(np.asarray(out[:, :, 0]), np.asarray(out[:, :, 2]))
+
+
+def test_attention_causal_ignores_future():
+    """Changing a future token must not change earlier outputs."""
+    key = jax.random.PRNGKey(1)
+    q, k, v = (jax.random.normal(kk, (1, 8, 2, 16)) for kk in jax.random.split(key, 3))
+    out1 = attention(q, k, v, causal=True)
+    k2 = k.at[:, -1].set(99.0)
+    v2 = v.at[:, -1].set(99.0)
+    out2 = attention(q, k2, v2, causal=True)
+    np.testing.assert_allclose(np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), rtol=1e-5)
+    assert not np.allclose(np.asarray(out1[:, -1]), np.asarray(out2[:, -1]))
+
+
+def test_attention_kv_len_masks_padding():
+    key = jax.random.PRNGKey(2)
+    q, k, v = (jax.random.normal(kk, (2, 6, 2, 8)) for kk in jax.random.split(key, 3))
+    out_full = attention(q[:, :4], k[:, :4], v[:, :4], causal=True)
+    # same, but with 2 garbage padded positions masked by kv_len
+    k_pad = k.at[:, 4:].set(7.0)
+    v_pad = v.at[:, 4:].set(7.0)
+    out_pad = attention(q[:, :4], k_pad, v_pad, causal=True,
+                        kv_len=jnp.array([4, 4]))
+    np.testing.assert_allclose(np.asarray(out_full), np.asarray(out_pad), rtol=1e-5)
+
+
+def test_decode_attention_matches_full():
+    key = jax.random.PRNGKey(3)
+    q, k, v = (jax.random.normal(kk, (1, 5, 2, 8)) for kk in jax.random.split(key, 3))
+    full = attention(q, k, v, causal=True)
+    # last token via decode path over a padded cache
+    pad = jnp.zeros((1, 3, 2, 8))
+    kc = jnp.concatenate([k, pad], axis=1)
+    vc = jnp.concatenate([v, pad], axis=1)
+    dec = decode_attention(q[:, 4:5], kc, vc, kv_len=jnp.array([5]))
+    np.testing.assert_allclose(np.asarray(full[:, 4]), np.asarray(dec[:, 0]), rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_kernel_matches_reference(causal):
+    key = jax.random.PRNGKey(4)
+    q, k, v = (jax.random.normal(kk, (2, 256, 2, 64), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    ref = attention(q, k, v, causal=causal)
+    out = flash_attention_tpu(q, k, v, causal=causal, block_q=128, block_k=128,
+                              interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-2, rtol=2e-2)
+
+
+def test_flash_kernel_bf16():
+    key = jax.random.PRNGKey(5)
+    q, k, v = (jax.random.normal(kk, (1, 256, 2, 64), jnp.float32).astype(jnp.bfloat16)
+               for kk in jax.random.split(key, 3))
+    ref = attention(q, k, v, causal=True)
+    out = flash_attention_tpu(q, k, v, causal=True, block_q=128, block_k=128,
+                              interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(ref, np.float32), np.asarray(out, np.float32), atol=5e-2, rtol=5e-2
+    )
+
+
+def test_flash_kernel_kv_len_masks_padding():
+    """Kernel kv_len masking == reference kv_len masking (serving prefill)."""
+    key = jax.random.PRNGKey(6)
+    q, k, v = (jax.random.normal(kk, (2, 256, 2, 64), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    kv_len = jnp.array([100, 256], jnp.int32)
+    ref = attention(q, k, v, causal=True, kv_len=kv_len)
+    out = flash_attention_tpu(q, k, v, kv_len, causal=True, block_q=128,
+                              block_k=128, interpret=True)
+    # rows past a sequence's kv_len see only masked keys -> compare valid area
+    np.testing.assert_allclose(np.asarray(ref[0, :100]), np.asarray(out[0, :100]),
+                               atol=2e-2, rtol=2e-2)
+    np.testing.assert_allclose(np.asarray(ref[1]), np.asarray(out[1]),
+                               atol=2e-2, rtol=2e-2)
