@@ -1,0 +1,60 @@
+"""BERT embeddings service — BASELINE.md config #3 (gRPC unary, batch=32).
+
+``ml.Embeddings/Embed`` gRPC method + ``POST /embed`` HTTP route over one
+engine with dynamic batching: concurrent unary calls coalesce into padded
+device batches (the batcher supplies per-row seq_lens so padding is exact).
+"""
+
+import os
+
+import numpy as np
+
+import gofr_tpu
+from gofr_tpu.grpc import JSONService
+from gofr_tpu.models import bert
+
+MAX_LEN = 128
+
+
+def _prep(ids):
+    ids = list(ids)[:MAX_LEN]
+    n = len(ids)
+    padded = np.zeros((MAX_LEN,), np.int32)
+    padded[:n] = ids
+    return padded, np.int32(n)
+
+
+async def embed(ctx: gofr_tpu.Context):
+    body = await ctx.bind()
+    ids = body.get("token_ids")
+    if not ids:
+        raise gofr_tpu.errors.MissingParam("token_ids")
+    padded, n = _prep(ids)
+    vec = await ctx.ml.predict("bert", padded, n)
+    return {"embedding": [round(float(v), 6) for v in vec]}
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    preset = os.environ.get("BERT_PRESET", "tiny")
+    model = bert.Bert(bert.tiny_bert() if preset == "tiny" else bert.bert_base())
+    model.example_inputs = (
+        np.zeros((1, MAX_LEN), np.int32), np.full((1,), 1, np.int32),
+    )
+    app.register_model("bert", model, batching=True)
+    app.post("/embed", embed)
+
+    svc = JSONService("ml.Embeddings")
+
+    async def grpc_embed(request, context):
+        padded, n = _prep(request["token_ids"])
+        vec = await app.container.ml.predict("bert", padded, n)
+        return {"embedding": [float(v) for v in vec]}
+
+    svc.unary("Embed", grpc_embed)
+    app.register_service(svc, impl=None)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -1,0 +1,97 @@
+"""Llama chat serving — BASELINE.md config #4's serving surface.
+
+One continuous-batching Generator behind three transports, the same
+handler-per-transport shape as the reference (handler.go:27-38):
+
+- ``POST /generate``            -> full completion (JSON)
+- ``WS   /stream``              -> token-at-a-time frames to browsers
+- gRPC ``llm.Chat/Generate``    -> server-streaming JSON frames on :9000
+
+Model size comes from env (LLAMA_PRESET=tiny|1b|8b) so the same example runs
+on CPU tests and on real chips.
+"""
+
+import os
+
+import jax
+
+import gofr_tpu
+from gofr_tpu.grpc import JSONService
+from gofr_tpu.ml.generate import Sampler
+from gofr_tpu.models import llama
+from gofr_tpu.native.tokenizer import BPETokenizer
+
+# byte-level fallback vocabulary; mount a trained one for real deployments
+TOKENIZER = BPETokenizer.byte_level(specials=["<eos>"])
+
+PRESETS = {
+    # tiny: model vocab == tokenizer vocab so decoded text is always valid
+    "tiny": lambda: llama.tiny_llama(vocab_size=TOKENIZER.vocab_size),
+    "1b": lambda: llama.LlamaConfig(
+        vocab_size=32_128, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        ffn_dim=8192, max_seq_len=2048,
+    ),
+    "8b": llama.llama3_8b,
+}
+
+
+def _prompt_ids(body) -> list[int]:
+    if body.get("prompt_ids"):
+        return body["prompt_ids"]
+    if body.get("prompt"):
+        return TOKENIZER.encode(body["prompt"])
+    raise gofr_tpu.errors.MissingParam("prompt or prompt_ids")
+
+
+async def generate(ctx: gofr_tpu.Context):
+    body = await ctx.bind()
+    ids = _prompt_ids(body)
+    max_new = int(body.get("max_new_tokens", 64))
+    tokens = await ctx.ml.llm("chat").generate(ids, max_new)
+    out = {"tokens": tokens}
+    if body.get("prompt"):  # text in -> text out
+        out["text"] = TOKENIZER.decode(tokens)
+    return out
+
+
+async def stream_ws(ctx: gofr_tpu.Context):
+    body = await ctx.bind()
+    ids = _prompt_ids(body)
+    async for tok in ctx.ml.llm("chat").stream(ids, int(body.get("max_new_tokens", 64))):
+        await ctx.write_message_to_socket({"token": tok})
+    return {"done": True}
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    preset = os.environ.get("LLAMA_PRESET", "tiny")
+    cfg = PRESETS[preset]()
+    if preset == "tiny":
+        cfg.use_flash = False
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    app.register_llm(
+        "chat", params, cfg,
+        batch_slots=int(os.environ.get("LLM_SLOTS", "4")),
+        max_seq=min(cfg.max_seq_len, 1024),
+        chunk=int(os.environ.get("LLM_CHUNK", "4")),
+        sampler=Sampler(temperature=float(os.environ.get("LLM_TEMPERATURE", "0"))),
+    )
+
+    app.post("/generate", generate)
+    app.websocket("/stream", stream_ws)
+
+    svc = JSONService("llm.Chat")
+
+    async def grpc_generate(request, context):
+        llm = app.container.ml.llm("chat")
+        async for tok in llm.stream(request["prompt_ids"],
+                                    int(request.get("max_new_tokens", 64))):
+            yield {"token": tok}
+
+    svc.stream("Generate", grpc_generate)
+    app.register_service(svc, impl=None)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -174,6 +174,15 @@ class App:
 
         register_crud_handlers(self, entity)
 
+    def register_llm(self, name: str, params: Any, cfg: Any, **kwargs: Any) -> None:
+        """Mount a continuous-batching LLM (ml/llm.py): handlers stream
+        tokens via ``ctx.ml.llm(name)`` (TPU-native; green-field)."""
+        from .ml import MLDatasource
+
+        if self.container.ml is None:
+            self.container.ml = MLDatasource(self.logger, self.container.metrics_manager)
+        self.container.ml.register_llm(name, params, cfg, **kwargs)
+
     def register_model(self, name: str, model: Any, **kwargs: Any) -> None:
         """Mount a JAX model into the ml datasource (TPU-native; green-field)."""
         from .ml import MLDatasource

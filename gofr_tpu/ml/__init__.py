@@ -25,6 +25,7 @@ class MLDatasource:
         self._metrics = metrics
         self._engines: dict[str, Engine] = {}
         self._batchers: dict[str, Any] = {}
+        self._llms: dict[str, Any] = {}
 
     # -- registration ----------------------------------------------------------
     def register(
@@ -70,6 +71,31 @@ class MLDatasource:
         if self._logger is not None:
             self._logger.infof("model %s registered on %s", name, str(engine.device))
         return engine
+
+    def register_llm(self, name: str, params: Any, cfg: Any, *,
+                     generator: Any = None, **gen_kwargs):
+        """Mount a continuous-batching LLM: ``ctx.ml.llm(name)`` gives the
+        async generate/stream API (llm.py); pass a ready Generator or the
+        (params, cfg) to build one."""
+        from .generate import Generator
+        from .llm import LLMServer
+
+        if generator is None:
+            generator = Generator(params, cfg, **gen_kwargs)
+        server = LLMServer(generator, name=name, logger=self._logger,
+                           metrics=self._metrics)
+        self._llms[name] = server
+        if self._logger is not None:
+            self._logger.infof("llm %s registered (%d slots)", name,
+                               generator.batch_slots)
+        return server
+
+    def llm(self, name: str):
+        if name not in self._llms:
+            raise KeyError(
+                f"llm {name!r} is not registered; available: {sorted(self._llms)}"
+            )
+        return self._llms[name]
 
     def engine(self, name: str) -> Engine:
         if name not in self._engines:
@@ -131,7 +157,15 @@ class MLDatasource:
         }
         for name, engine in self._engines.items():
             details["models"][name] = {"steps": engine.steps, "device": str(engine.device)}
-        return {"status": "UP", "details": details}
+        status = "UP"
+        if self._llms:
+            details["llms"] = {}
+            for name, server in self._llms.items():
+                h = server.health_check()
+                details["llms"][name] = h["details"]
+                if h["status"] != "UP":
+                    status = "DEGRADED"
+        return {"status": status, "details": details}
 
     def close(self) -> None:
         for engine in self._engines.values():
@@ -140,3 +174,5 @@ class MLDatasource:
             closer = getattr(batcher, "close", None)
             if closer is not None:
                 closer()
+        for server in self._llms.values():
+            server.close()

@@ -271,6 +271,12 @@ class Generator:
                     s.callback(i, t)
                 self._maybe_finish(i)
 
+    def release(self, i: int) -> None:
+        """Return a finished slot to the free pool (its tokens are consumed)."""
+        if self.slots[i].live:
+            raise RuntimeError(f"slot {i} still decoding")
+        self.slots[i] = _Slot()
+
     def generate(self, prompt_ids, max_new_tokens: int = 32) -> list[int]:
         """Blocking single-request convenience: returns generated ids."""
         i = self.add_request(prompt_ids, max_new_tokens)
@@ -278,5 +284,5 @@ class Generator:
             self.step()
         self.drain()
         out = self.slots[i].tokens[:max_new_tokens]
-        self.slots[i] = _Slot()
+        self.release(i)
         return out

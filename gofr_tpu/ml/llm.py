@@ -1,0 +1,180 @@
+"""Async LLM serving: the bridge from the request plane to the decode loop.
+
+The reference's request plane is goroutine-per-request (handler.go:77-97);
+here many concurrent asyncio handlers feed ONE device-resident
+continuous-batching Generator (generate.py) owned by a dedicated thread —
+the same thread-confinement pattern as Engine (engine.py): the asyncio
+event loop never blocks on device work, and all device dispatch happens
+from one thread.
+
+Flow per request: handler awaits ``stream()``/``generate()`` → request goes
+on a thread-safe queue → the serving thread admits it into a free slot
+(prefill) or parks it until one frees → each sampled token is pushed back
+to the handler's asyncio queue via ``call_soon_threadsafe`` → slot release
+on completion. Metrics: queue wait, TTFT, tokens out.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue as _queue
+import threading
+import time
+from typing import Any, AsyncIterator
+
+__all__ = ["LLMServer"]
+
+_DONE = object()
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new", "out_q", "loop", "enqueued_at", "slot",
+                 "first_token_at")
+
+    def __init__(self, prompt, max_new, out_q, loop) -> None:
+        self.prompt = prompt
+        self.max_new = max_new
+        self.out_q = out_q
+        self.loop = loop
+        self.enqueued_at = time.perf_counter()
+        self.slot = None
+        self.first_token_at = None
+
+
+class LLMServer:
+    """Owns a Generator on a serving thread; async API for handlers.
+
+    Register through MLDatasource (``ml.register_llm``) so health/metrics
+    flow like every other datasource, or standalone in tests.
+    """
+
+    def __init__(self, generator, *, name: str = "llm", logger=None,
+                 metrics=None, idle_wait_s: float = 0.002) -> None:
+        self.gen = generator
+        self.name = name
+        self._logger = logger
+        self._metrics = metrics
+        self._idle_wait = idle_wait_s
+        self._requests: _queue.Queue[_Request | None] = _queue.Queue()
+        self._waiting: list[_Request] = []
+        self._active: dict[int, _Request] = {}
+        self._closed = False
+        self.served = 0
+        self._thread = threading.Thread(
+            target=self._serve_loop, daemon=True, name=f"gofr-llm-{name}"
+        )
+        self._thread.start()
+
+    # -- serving thread -------------------------------------------------------
+    def _serve_loop(self) -> None:
+        while not self._closed:
+            self._admit_waiting()
+            if self.gen.n_live:
+                self.gen.step()
+                self._finish_dead_slots()
+            else:
+                self.gen.drain()
+                self._finish_dead_slots()
+                try:  # idle: block briefly for the next request
+                    req = self._requests.get(timeout=self._idle_wait)
+                except _queue.Empty:
+                    continue
+                if req is None:
+                    return
+                self._waiting.append(req)
+
+    def _admit_waiting(self) -> None:
+        # pull everything queued, then admit as long as slots are free
+        while True:
+            try:
+                req = self._requests.get_nowait()
+            except _queue.Empty:
+                break
+            if req is None:
+                self._closed = True
+                return
+            self._waiting.append(req)
+        while self._waiting and self.gen.free_slot() is not None:
+            req = self._waiting.pop(0)
+            try:
+                slot = self.gen.add_request(
+                    req.prompt, req.max_new,
+                    callback=lambda i, t, r=req: self._emit(r, t),
+                )
+            except Exception as exc:  # bad prompt etc. -> relay to caller
+                req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
+                req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
+                continue
+            req.slot = slot
+            self._active[slot] = req
+            if self._metrics is not None:
+                try:
+                    self._metrics.record_histogram(
+                        "app_llm_queue_seconds",
+                        time.perf_counter() - req.enqueued_at, model=self.name,
+                    )
+                except Exception:
+                    pass
+
+    def _emit(self, req: _Request, token: int) -> None:
+        if req.first_token_at is None:
+            req.first_token_at = time.perf_counter()
+            if self._metrics is not None:
+                try:
+                    self._metrics.record_histogram(
+                        "app_llm_ttft_seconds",
+                        req.first_token_at - req.enqueued_at, model=self.name,
+                    )
+                except Exception:
+                    pass
+        req.loop.call_soon_threadsafe(req.out_q.put_nowait, token)
+
+    def _finish_dead_slots(self) -> None:
+        for slot, req in list(self._active.items()):
+            if not self.gen.slots[slot].live:
+                # all of the slot's tokens were streamed via the callback
+                self.gen.release(slot)
+                del self._active[slot]
+                self.served += 1
+                req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
+
+    # -- async API ------------------------------------------------------------
+    async def stream(self, prompt_ids, max_new_tokens: int = 64
+                     ) -> AsyncIterator[int]:
+        """Yield tokens as the device produces them."""
+        if self._closed:
+            raise RuntimeError("llm server is closed")
+        loop = asyncio.get_running_loop()
+        out_q: asyncio.Queue = asyncio.Queue()
+        self._requests.put(_Request(prompt_ids, max_new_tokens, out_q, loop))
+        while True:
+            item = await out_q.get()
+            if item is _DONE:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+
+    async def generate(self, prompt_ids, max_new_tokens: int = 64) -> list[int]:
+        """Collect the full completion."""
+        return [t async for t in self.stream(prompt_ids, max_new_tokens)]
+
+    # -- datasource contract --------------------------------------------------
+    def health_check(self) -> dict:
+        return {
+            "status": "UP" if self._thread.is_alive() and not self._closed else "DOWN",
+            "details": {
+                "model": self.name,
+                "slots": self.gen.batch_slots,
+                "live": self.gen.n_live,
+                "queued": len(self._waiting) + self._requests.qsize(),
+                "served": self.served,
+                "decode_steps": self.gen.steps,
+            },
+        }
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._requests.put(None)
+            self._thread.join(timeout=5)

@@ -1,0 +1,173 @@
+"""BERT-family encoder — BASELINE.md config #3 (embeddings, gRPC, batch=32).
+
+Green-field (the reference nidhey27/gofr has no ML; SURVEY §2.10). Same
+TPU-first construction as the Llama decoder (llama.py): stacked layer
+weights + one ``lax.scan`` body, bf16 matmuls with f32 norms, Megatron TP
+sharding rules over the canonical mesh, bidirectional attention with
+per-row valid lengths so padded batches from the dynamic batcher are exact.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import attention, layer_norm
+from ..parallel import P, constrain
+
+__all__ = ["BertConfig", "Bert", "bert_base", "tiny_bert"]
+
+
+class BertConfig:
+    def __init__(
+        self,
+        vocab_size: int = 30_522,
+        dim: int = 768,
+        n_layers: int = 12,
+        n_heads: int = 12,
+        ffn_dim: int = 3072,
+        max_pos: int = 512,
+        n_types: int = 2,
+        norm_eps: float = 1e-12,
+        dtype: Any = jnp.bfloat16,
+    ) -> None:
+        self.vocab_size = vocab_size
+        self.dim = dim
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.head_dim = dim // n_heads
+        self.ffn_dim = ffn_dim
+        self.max_pos = max_pos
+        self.n_types = n_types
+        self.norm_eps = norm_eps
+        self.dtype = dtype
+
+
+def bert_base(**kw) -> BertConfig:
+    return BertConfig(**kw)
+
+
+def tiny_bert(**kw) -> BertConfig:
+    defaults = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                    ffn_dim=128, max_pos=64)
+    defaults.update(kw)
+    return BertConfig(**defaults)
+
+
+SHARDING_RULES = (
+    (r"layers/(wq|wk|wv|w_in)", P(None, None, "tp")),   # column parallel
+    (r"layers/(wo|w_out)", P(None, "tp", None)),        # row parallel
+    (r"layers/", P(None)),                              # biases/norms replicate
+    (r"pooler/w", P(None, "tp")),
+    (r".*", P()),
+)
+
+
+def init_params(cfg: BertConfig, key) -> dict:
+    L, D, F = cfg.n_layers, cfg.dim, cfg.ffn_dim
+    ks = jax.random.split(key, 10)
+
+    def dense(key, *shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)
+                ).astype(cfg.dtype)
+
+    return {
+        "tok_embed": dense(ks[0], cfg.vocab_size, D, fan_in=D),
+        "pos_embed": dense(ks[1], cfg.max_pos, D, fan_in=D),
+        "type_embed": dense(ks[2], cfg.n_types, D, fan_in=D),
+        "embed_norm_scale": jnp.ones((D,), jnp.float32),
+        "embed_norm_bias": jnp.zeros((D,), jnp.float32),
+        "layers": {
+            "wq": dense(ks[3], L, D, D, fan_in=D),
+            "wk": dense(ks[4], L, D, D, fan_in=D),
+            "wv": dense(ks[5], L, D, D, fan_in=D),
+            "wo": dense(ks[6], L, D, D, fan_in=D),
+            "bq": jnp.zeros((L, D), jnp.float32),
+            "bk": jnp.zeros((L, D), jnp.float32),
+            "bv": jnp.zeros((L, D), jnp.float32),
+            "bo": jnp.zeros((L, D), jnp.float32),
+            "attn_norm_scale": jnp.ones((L, D), jnp.float32),
+            "attn_norm_bias": jnp.zeros((L, D), jnp.float32),
+            "w_in": dense(ks[7], L, D, F, fan_in=D),
+            "b_in": jnp.zeros((L, F), jnp.float32),
+            "w_out": dense(ks[8], L, F, D, fan_in=F),
+            "b_out": jnp.zeros((L, D), jnp.float32),
+            "mlp_norm_scale": jnp.ones((L, D), jnp.float32),
+            "mlp_norm_bias": jnp.zeros((L, D), jnp.float32),
+        },
+        "pooler": {"w": dense(ks[9], D, D, fan_in=D), "b": jnp.zeros((D,), jnp.float32)},
+    }
+
+
+def forward(params: dict, tokens: jnp.ndarray, cfg: BertConfig,
+            *, seq_lens: jnp.ndarray | None = None,
+            token_types: jnp.ndarray | None = None) -> dict:
+    """tokens [B, S] (+ optional [B] valid lengths) ->
+    {"hidden": [B,S,D], "pooled": [B,D], "mean": [B,D]} — pooled is the
+    tanh-projected [CLS] (BERT convention), mean is masked mean-pooling
+    (the usual sentence-embedding choice)."""
+    b, s = tokens.shape
+    H, hd = cfg.n_heads, cfg.head_dim
+    x = params["tok_embed"][tokens]
+    x = x + params["pos_embed"][jnp.arange(s)][None, :, :]
+    types = token_types if token_types is not None else jnp.zeros_like(tokens)
+    x = x + params["type_embed"][types]
+    x = layer_norm(x.astype(cfg.dtype), params["embed_norm_scale"],
+                   params["embed_norm_bias"], cfg.norm_eps)
+    x = constrain(x, P("dp", "sp", None))
+
+    dt = cfg.dtype
+
+    def body(x, lp):
+        q = (x @ lp["wq"] + lp["bq"].astype(dt)).reshape(b, s, H, hd)
+        k = (x @ lp["wk"] + lp["bk"].astype(dt)).reshape(b, s, H, hd)
+        v = (x @ lp["wv"] + lp["bv"].astype(dt)).reshape(b, s, H, hd)
+        q = constrain(q, P("dp", None, "tp", None))
+        o = attention(q, k, v, causal=False, kv_len=seq_lens)
+        o = o.reshape(b, s, H * hd) @ lp["wo"] + lp["bo"].astype(dt)
+        x = layer_norm(x + o, lp["attn_norm_scale"], lp["attn_norm_bias"],
+                       cfg.norm_eps)
+        h = jax.nn.gelu(x @ lp["w_in"] + lp["b_in"].astype(dt))
+        h = h @ lp["w_out"] + lp["b_out"].astype(dt)
+        x = layer_norm(x + h, lp["mlp_norm_scale"], lp["mlp_norm_bias"],
+                       cfg.norm_eps)
+        return constrain(x, P("dp", "sp", None)), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    pooled = jnp.tanh(
+        (x[:, 0].astype(jnp.float32) @ params["pooler"]["w"].astype(jnp.float32))
+        + params["pooler"]["b"]
+    )
+    if seq_lens is not None:
+        mask = (jnp.arange(s)[None, :] < seq_lens[:, None]).astype(jnp.float32)
+    else:
+        mask = jnp.ones((b, s), jnp.float32)
+    mean = (x.astype(jnp.float32) * mask[..., None]).sum(1) / jnp.maximum(
+        mask.sum(1, keepdims=True), 1.0
+    )
+    return {"hidden": x, "pooled": pooled, "mean": mean}
+
+
+class Bert:
+    """Engine-facing wrapper: ``apply(params, tokens, seq_lens)`` returns the
+    masked-mean sentence embedding (the gRPC Embed payload)."""
+
+    def __init__(self, cfg: BertConfig | None = None, seed: int = 0) -> None:
+        self.cfg = cfg or bert_base()
+        self.params = init_params(self.cfg, jax.random.PRNGKey(seed))
+        self.example_inputs = (
+            np.zeros((1, 16), np.int32),
+            np.full((1,), 16, np.int32),
+        )
+
+    def apply(self, params, tokens, seq_lens):
+        return forward(params, tokens, self.cfg, seq_lens=seq_lens)["mean"]
+
+    def sharding_specs(self):
+        from ..parallel import specs_from_rules
+
+        return specs_from_rules(self.params, SHARDING_RULES)

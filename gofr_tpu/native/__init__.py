@@ -1,0 +1,51 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference is pure Go (SURVEY: no cgo/native code anywhere); this
+package holds the TPU build's native pieces — currently the byte-level BPE
+tokenizer (bpe.cpp) that keeps request-plane tokenization off the Python
+interpreter while the device decodes.
+
+Build strategy: compile-on-first-use with g++ into the package directory
+(cached by source hash); every native component has a pure-Python fallback
+with identical semantics so the framework never hard-requires a toolchain.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+
+_BUILD_LOCK = threading.Lock()
+_LIBS: dict[str, object] = {}
+
+
+def build_and_load(source_name: str, lib_stem: str):
+    """Compile ``<pkg>/<source_name>`` to a cached .so and ctypes-load it.
+    Returns None when no toolchain is available (callers fall back)."""
+    import ctypes
+
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(pkg_dir, source_name)
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(pkg_dir, f"{lib_stem}-{digest}.so")
+
+    with _BUILD_LOCK:
+        if so_path in _LIBS:
+            return _LIBS[so_path]
+        if not os.path.exists(so_path):
+            cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src,
+                   "-o", so_path + ".tmp"]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+                os.replace(so_path + ".tmp", so_path)  # atomic publish
+            except (OSError, subprocess.SubprocessError):
+                return None
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError:
+            return None
+        _LIBS[so_path] = lib
+        return lib

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 __all__ = [
     "rms_norm",
+    "layer_norm",
     "rope_table",
     "apply_rope",
     "repeat_kv",
@@ -40,6 +41,17 @@ def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarr
     var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
     out = xf * jax.lax.rsqrt(var + eps)
     return (out * scale.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
+               eps: float = 1e-12) -> jnp.ndarray:
+    """LayerNorm with f32 statistics (BERT-family encoders)."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
 
 
 def rope_table(positions: jnp.ndarray, head_dim: int, theta: float = 500_000.0):

@@ -1,0 +1,153 @@
+"""LLM serving layer: async stream/generate over the continuous-batching
+Generator, slot queueing, and the HTTP + WS transports end-to-end.
+"""
+
+import asyncio
+
+import jax
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gofr_tpu.app import App
+from gofr_tpu.config import MapConfig
+from gofr_tpu.ml.generate import Generator
+from gofr_tpu.ml.llm import LLMServer
+from gofr_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.tiny_llama(use_flash=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _expected(params, cfg, prompt, n):
+    gen = Generator(params, cfg, batch_slots=1, max_seq=64, prefill_buckets=(8,))
+    return gen.generate(prompt, n)
+
+
+def test_generate_and_stream_agree(model, run):
+    cfg, params = model
+    expect = _expected(params, cfg, [3, 1, 4], 6)
+
+    async def scenario():
+        server = LLMServer(Generator(params, cfg, batch_slots=2, max_seq=64,
+                                     prefill_buckets=(8,)))
+        try:
+            full = await server.generate([3, 1, 4], 6)
+            streamed = [t async for t in server.stream([3, 1, 4], 6)]
+            return full, streamed
+        finally:
+            server.close()
+
+    full, streamed = run(scenario())
+    assert full == expect
+    assert streamed == expect
+
+
+def test_concurrent_requests_beyond_slots(model, run):
+    """6 concurrent requests over 2 slots: all finish, each correct."""
+    cfg, params = model
+    prompts = [[i + 1, i + 2] for i in range(6)]
+    expects = [_expected(params, cfg, p, 4) for p in prompts]
+
+    async def scenario():
+        server = LLMServer(Generator(params, cfg, batch_slots=2, max_seq=64,
+                                     prefill_buckets=(8,)))
+        try:
+            return await asyncio.gather(
+                *(server.generate(p, 4) for p in prompts)
+            )
+        finally:
+            server.close()
+
+    results = run(scenario())
+    assert results == expects
+
+
+def test_bad_prompt_raises_not_hangs(model, run):
+    cfg, params = model
+
+    async def scenario():
+        server = LLMServer(Generator(params, cfg, batch_slots=1, max_seq=64,
+                                     prefill_buckets=(8,)))
+        try:
+            with pytest.raises(ValueError):
+                await server.generate([], 4)
+            # server still serves after the failure
+            return await server.generate([5], 2)
+        finally:
+            server.close()
+
+    assert len(run(scenario())) == 2
+
+
+def test_health_reports_slots(model, run):
+    cfg, params = model
+
+    async def scenario():
+        server = LLMServer(Generator(params, cfg, batch_slots=3, max_seq=64,
+                                     prefill_buckets=(8,)))
+        try:
+            await server.generate([1, 2], 2)
+            return server.health_check()
+        finally:
+            server.close()
+
+    h = run(scenario())
+    assert h["status"] == "UP"
+    assert h["details"]["slots"] == 3
+    assert h["details"]["served"] == 1
+
+
+def test_http_and_ws_transports(model, run):
+    """The llama_server example wiring: POST /generate + WS /stream."""
+    cfg, params = model
+    expect = _expected(params, cfg, [2, 7, 1], 5)
+
+    async def scenario():
+        app = App(config=MapConfig({"APP_NAME": "llm-test"}))
+        app.register_llm("chat", params, cfg, batch_slots=2, max_seq=64,
+                         prefill_buckets=(8,))
+
+        async def generate(ctx):
+            body = await ctx.bind()
+            toks = await ctx.ml.llm("chat").generate(
+                body["prompt_ids"], int(body.get("max_new_tokens", 8)))
+            return {"tokens": toks}
+
+        async def stream_ws(ctx):
+            body = await ctx.bind()
+            async for tok in ctx.ml.llm("chat").stream(
+                    body["prompt_ids"], int(body.get("max_new_tokens", 8))):
+                await ctx.write_message_to_socket({"token": tok})
+            return {"done": True}
+
+        app.post("/generate", generate)
+        app.websocket("/stream", stream_ws)
+
+        client = TestClient(TestServer(app._build_http_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/generate", json={
+                "prompt_ids": [2, 7, 1], "max_new_tokens": 5})
+            assert r.status == 201  # responder rule: POST with data -> 201
+            body = await r.json()
+
+            ws = await client.ws_connect("/stream")
+            await ws.send_json({"prompt_ids": [2, 7, 1], "max_new_tokens": 5})
+            ws_tokens = []
+            while len(ws_tokens) < 5:
+                frame = await ws.receive_json()
+                if "token" in frame:
+                    ws_tokens.append(frame["token"])
+            await ws.close()
+            return body["data"]["tokens"], ws_tokens
+        finally:
+            await client.close()
+            await app.container.close()
+
+    http_tokens, ws_tokens = run(scenario())
+    assert http_tokens == expect
+    assert ws_tokens == expect

@@ -1,0 +1,62 @@
+"""Native BPE tokenizer: C++/Python parity, roundtrips, training."""
+
+import numpy as np
+import pytest
+
+from gofr_tpu.native.tokenizer import BPETokenizer, train_bpe
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "pack my box with five dozen liquor jugs",
+    "the five boxing wizards jump quickly",
+    "how vexingly quick daft zebras jump",
+] * 4
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return train_bpe(CORPUS, vocab_size=300, specials=["<eos>"])
+
+
+def test_native_library_builds(tok):
+    assert tok.native, "C++ tokenizer failed to build — g++ is baked in"
+
+
+def test_roundtrip(tok):
+    for text in CORPUS[:4] + ["unseen words épée 漢字 🙂"]:
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_merges_compress(tok):
+    text = "the quick brown fox"
+    ids = tok.encode(text)
+    assert len(ids) < len(text.encode())  # trained merges actually apply
+
+
+def test_native_matches_python_reference(tok):
+    """C++ heap merger must be bit-identical to the Python oracle."""
+    py = BPETokenizer(tok.vocab, tok.merges, tok.byte_map, use_native=False)
+    assert not py.native
+    rng = np.random.default_rng(0)
+    for text in CORPUS + ["zzz", " ", "ab" * 500]:
+        assert tok.encode(text) == py.encode(text)
+    # random byte strings too (never seen in training)
+    for _ in range(20):
+        blob = bytes(rng.integers(0, 256, rng.integers(1, 200)).tolist())
+        assert tok.encode(blob) == py.encode(blob)
+        assert tok.decode_bytes(tok.encode(blob)) == blob
+
+
+def test_byte_level_fallback_tokenizer():
+    tok = BPETokenizer.byte_level(specials=["<eos>"])
+    ids = tok.encode("hi")
+    assert ids == [104, 105]
+    assert tok.specials["<eos>"] == 256
+    assert tok.decode(ids) == "hi"
+
+
+def test_empty_and_edge_cases(tok):
+    assert tok.encode("") == []
+    assert tok.decode([]) == ""
+    one = tok.encode("a")
+    assert len(one) == 1
