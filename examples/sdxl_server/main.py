@@ -1,0 +1,106 @@
+"""Text-to-image serving — BASELINE.md config #5 (HTTP, multi-host DP).
+
+Pipeline per request: prompt -> native BPE tokenizer -> BERT text encoder
+-> DiT DDIM sampler (whole sampler is ONE device program) -> linear
+latent->RGB map -> PNG. Both models ride the ``ml`` engine so device work
+never blocks the event loop; scale-out is data-parallel: each host serves
+its own HTTP port and the mesh's dp axis carries the batch.
+
+``GET /image?prompt=...`` returns image/png (sampler steps via DIT_STEPS env).
+"""
+
+import io
+import os
+import struct
+import zlib
+
+import jax
+import numpy as np
+
+import gofr_tpu
+from gofr_tpu.models import bert, diffusion
+from gofr_tpu.native.tokenizer import BPETokenizer
+
+TOKENIZER = BPETokenizer.byte_level()
+MAX_CTX = 32
+
+
+def _png(rgb: np.ndarray) -> bytes:
+    """Minimal PNG writer (no imaging libs in the base image)."""
+    h, w, _ = rgb.shape
+    raw = b"".join(b"\x00" + rgb[i].astype(np.uint8).tobytes() for i in range(h))
+
+    def chunk(tag: bytes, data: bytes) -> bytes:
+        return (struct.pack(">I", len(data)) + tag + data
+                + struct.pack(">I", zlib.crc32(tag + data) & 0xFFFFFFFF))
+
+    return (b"\x89PNG\r\n\x1a\n"
+            + chunk(b"IHDR", struct.pack(">IIBBBBB", w, h, 8, 2, 0, 0, 0))
+            + chunk(b"IDAT", zlib.compress(raw, 6))
+            + chunk(b"IEND", b""))
+
+
+def _latent_to_rgb(latents: np.ndarray) -> np.ndarray:
+    """Fixed linear latent->RGB map (VAE stand-in; pluggable)."""
+    mix = np.array([[0.6, 0.2, 0.1, 0.1],
+                    [0.1, 0.6, 0.2, 0.1],
+                    [0.1, 0.1, 0.2, 0.6]], np.float32)
+    img = latents @ mix.T
+    img = (img - img.min()) / max(float(np.ptp(img)), 1e-6)
+    return (img * 255).astype(np.uint8)
+
+
+async def image(ctx: gofr_tpu.Context):
+    prompt = ctx.param("prompt") or "a photo"
+    ids = TOKENIZER.encode(prompt)[:MAX_CTX]
+    padded = np.zeros((MAX_CTX,), np.int32)
+    padded[: len(ids)] = ids
+
+    emb = await ctx.ml.predict(
+        "text_encoder", padded[None], np.array([max(len(ids), 1)], np.int32))
+    context = np.asarray(emb)  # [1, S, ctx_dim] hidden states
+
+    latents = await ctx.ml.predict("dit", context)
+    rgb = _latent_to_rgb(np.asarray(latents)[0])
+    return gofr_tpu.File(_png(rgb), content_type="image/png")
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    preset = os.environ.get("DIT_PRESET", "tiny")
+
+    enc_cfg = bert.tiny_bert(vocab_size=max(257, TOKENIZER.vocab_size)) \
+        if preset == "tiny" else bert.bert_base()
+    encoder = bert.Bert(enc_cfg)
+    dit_cfg = diffusion.tiny_dit(ctx_dim=enc_cfg.dim) if preset == "tiny" \
+        else diffusion.dit_xl(ctx_dim=enc_cfg.dim)
+    dit = diffusion.DiT(dit_cfg)
+
+    # text encoder returns per-token hidden states (cross-attn context)
+    app.register_model(
+        "text_encoder", encoder,
+        apply_fn=lambda p, toks, n: bert.forward(
+            p, toks, enc_cfg, seq_lens=n)["hidden"],
+        params=encoder.params,
+        example_inputs=(np.zeros((1, MAX_CTX), np.int32),
+                        np.full((1,), 1, np.int32)),
+    )
+
+    # the sampler is the engine's apply: one program per image batch
+    # (step count is baked into the compiled program; set via DIT_STEPS)
+    def sample(params, context):
+        return diffusion.ddim_sample(
+            params, context, dit_cfg, jax.random.PRNGKey(0),
+            steps=int(os.environ.get("DIT_STEPS", "8")), guidance=5.0,
+        )
+
+    app.register_model(
+        "dit", dit, apply_fn=sample, params=dit.params,
+        example_inputs=(np.zeros((1, MAX_CTX, enc_cfg.dim), np.float32),),
+    )
+    app.get("/image", image)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -1,0 +1,262 @@
+"""Latent diffusion transformer (DiT) — BASELINE.md config #5's model family.
+
+Green-field (the reference nidhey27/gofr has no ML; SURVEY §2.10). A
+text-to-image latent diffusion stack built transformer-first because the
+MXU wants matmuls, not small convs:
+
+- patchified latents -> DiT blocks (self-attention + text cross-attention +
+  MLP), each modulated adaLN-zero style by the timestep embedding;
+- stacked-layer weights + one ``lax.scan`` body (same construction as
+  llama.py/bert.py — compile time flat in depth);
+- the FULL DDIM sampler runs on device in one jit (scan over timesteps,
+  classifier-free guidance by batch doubling): the host dispatches one
+  program per image batch, not one per step — the same host-latency lesson
+  as the decode loop in ml/generate.py;
+- Megatron TP sharding rules over the canonical mesh; batch rides dp for
+  the multi-host images/min config.
+
+The text encoder is any model producing [B, S_ctx, ctx_dim] (examples use
+gofr_tpu.models.bert); the latent->RGB decoder is pluggable (a VAE in real
+deployments; a fixed linear map in the example server).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import attention, layer_norm
+from ..parallel import P, constrain
+
+__all__ = ["DiTConfig", "DiT", "dit_xl", "tiny_dit", "ddim_sample"]
+
+
+class DiTConfig:
+    def __init__(
+        self,
+        latent_size: int = 32,     # latent grid (SDXL: 128 for 1024px; 32 ~ 256px)
+        latent_channels: int = 4,
+        patch: int = 2,
+        dim: int = 1152,
+        n_layers: int = 28,
+        n_heads: int = 16,
+        ffn_dim: int = 4608,
+        ctx_dim: int = 768,        # text-encoder hidden size
+        norm_eps: float = 1e-6,
+        dtype: Any = jnp.bfloat16,
+    ) -> None:
+        self.latent_size = latent_size
+        self.latent_channels = latent_channels
+        self.patch = patch
+        self.dim = dim
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.head_dim = dim // n_heads
+        self.ffn_dim = ffn_dim
+        self.ctx_dim = ctx_dim
+        self.norm_eps = norm_eps
+        self.dtype = dtype
+        self.n_patches = (latent_size // patch) ** 2
+        self.patch_dim = latent_channels * patch * patch
+
+
+def dit_xl(**kw) -> DiTConfig:
+    return DiTConfig(**kw)
+
+
+def tiny_dit(**kw) -> DiTConfig:
+    defaults = dict(latent_size=8, patch=2, dim=64, n_layers=2, n_heads=4,
+                    ffn_dim=128, ctx_dim=32)
+    defaults.update(kw)
+    return DiTConfig(**defaults)
+
+
+SHARDING_RULES = (
+    (r"layers/(wq|wk|wv|xq|xk|xv|w_in)", P(None, None, "tp")),
+    (r"layers/(wo|xo|w_out)", P(None, "tp", None)),
+    (r"layers/", P(None)),
+    (r".*", P()),
+)
+
+
+def _timestep_embedding(t: jnp.ndarray, dim: int) -> jnp.ndarray:
+    """Sinusoidal embedding of diffusion time t in [0, 1000): [B] -> [B, dim]."""
+    half = dim // 2
+    freqs = jnp.exp(-np.log(10_000.0) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def init_params(cfg: DiTConfig, key) -> dict:
+    L, D, F, C = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.ctx_dim
+    ks = jax.random.split(key, 16)
+
+    def dense(key, *shape, fan_in, scale=1.0):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * scale * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    return {
+        "patch_in": dense(ks[0], cfg.patch_dim, D, fan_in=cfg.patch_dim),
+        "pos_embed": dense(ks[1], cfg.n_patches, D, fan_in=D),
+        "t_mlp1": dense(ks[2], D, D, fan_in=D),
+        "t_mlp2": dense(ks[3], D, D, fan_in=D),
+        "ctx_proj": dense(ks[4], C, D, fan_in=C),
+        "layers": {
+            # self-attention
+            "wq": dense(ks[5], L, D, D, fan_in=D),
+            "wk": dense(ks[6], L, D, D, fan_in=D),
+            "wv": dense(ks[7], L, D, D, fan_in=D),
+            "wo": dense(ks[8], L, D, D, fan_in=D),
+            # cross-attention over text context
+            "xq": dense(ks[9], L, D, D, fan_in=D),
+            "xk": dense(ks[10], L, D, D, fan_in=D),
+            "xv": dense(ks[11], L, D, D, fan_in=D),
+            "xo": dense(ks[12], L, D, D, fan_in=D),
+            # mlp
+            "w_in": dense(ks[13], L, D, F, fan_in=D),
+            "w_out": dense(ks[14], L, F, D, fan_in=F),
+            # adaLN-zero: 9 modulation vectors (shift/scale/gate x 3 branches)
+            # from the timestep embedding; zero-init so blocks start as identity
+            "ada_w": jnp.zeros((L, D, 9 * D), cfg.dtype),
+            "ada_b": jnp.zeros((L, 9 * D), jnp.float32),
+        },
+        "final_norm_scale": jnp.ones((D,), jnp.float32),
+        "final_norm_bias": jnp.zeros((D,), jnp.float32),
+        "patch_out": jnp.zeros((D, cfg.patch_dim), cfg.dtype),  # zero-init
+    }
+
+
+def patchify(x: jnp.ndarray, cfg: DiTConfig) -> jnp.ndarray:
+    """[B, H, W, C] latents -> [B, n_patches, patch_dim]."""
+    b, h, w, c = x.shape
+    p = cfg.patch
+    x = x.reshape(b, h // p, p, w // p, p, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, (h // p) * (w // p), p * p * c)
+
+
+def unpatchify(x: jnp.ndarray, cfg: DiTConfig) -> jnp.ndarray:
+    b = x.shape[0]
+    p, c = cfg.patch, cfg.latent_channels
+    g = cfg.latent_size // p
+    x = x.reshape(b, g, g, p, p, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, g * p, g * p, c)
+
+
+def forward(params: dict, latents: jnp.ndarray, t: jnp.ndarray,
+            context: jnp.ndarray, cfg: DiTConfig) -> jnp.ndarray:
+    """Predict noise: latents [B,H,W,C], t [B], context [B,S,ctx_dim]
+    -> eps [B,H,W,C]."""
+    b = latents.shape[0]
+    H, hd = cfg.n_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    x = patchify(latents.astype(dt), cfg) @ params["patch_in"]
+    x = x + params["pos_embed"][None]
+    x = constrain(x, P("dp", None, None))
+    s = x.shape[1]
+
+    temb = _timestep_embedding(t, cfg.dim).astype(dt)
+    temb = jax.nn.silu(temb @ params["t_mlp1"]) @ params["t_mlp2"]  # [B, D]
+    ctx = (context.astype(dt) @ params["ctx_proj"])
+    sc = ctx.shape[1]
+
+    def body(x, lp):
+        mod = jax.nn.silu(temb) @ lp["ada_w"] + lp["ada_b"].astype(dt)
+        (sa_shift, sa_scale, sa_gate, xa_shift, xa_scale, xa_gate,
+         mlp_shift, mlp_scale, mlp_gate) = jnp.split(mod[:, None, :], 9, axis=-1)
+
+        def ln(h):  # parameter-free LN; adaLN supplies shift/scale
+            hf = h.astype(jnp.float32)
+            mean = hf.mean(-1, keepdims=True)
+            var = ((hf - mean) ** 2).mean(-1, keepdims=True)
+            return ((hf - mean) * jax.lax.rsqrt(var + cfg.norm_eps)).astype(dt)
+
+        # self-attention (bidirectional over patches)
+        h = ln(x) * (1 + sa_scale) + sa_shift
+        q = (h @ lp["wq"]).reshape(b, s, H, hd)
+        k = (h @ lp["wk"]).reshape(b, s, H, hd)
+        v = (h @ lp["wv"]).reshape(b, s, H, hd)
+        q = constrain(q, P("dp", None, "tp", None))
+        o = attention(q, k, v, causal=False).reshape(b, s, H * hd)
+        x = x + sa_gate * (o @ lp["wo"])
+
+        # cross-attention over text tokens
+        h = ln(x) * (1 + xa_scale) + xa_shift
+        q = (h @ lp["xq"]).reshape(b, s, H, hd)
+        k = (ctx @ lp["xk"]).reshape(b, sc, H, hd)
+        v = (ctx @ lp["xv"]).reshape(b, sc, H, hd)
+        q = constrain(q, P("dp", None, "tp", None))
+        o = attention(q, k, v, causal=False).reshape(b, s, H * hd)
+        x = x + xa_gate * (o @ lp["xo"])
+
+        # mlp
+        h = ln(x) * (1 + mlp_scale) + mlp_shift
+        h = jax.nn.gelu(h @ lp["w_in"]) @ lp["w_out"]
+        x = x + mlp_gate * h
+        return constrain(x, P("dp", None, None)), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = layer_norm(x, params["final_norm_scale"], params["final_norm_bias"],
+                   cfg.norm_eps)
+    eps = x @ params["patch_out"]
+    return unpatchify(eps.astype(jnp.float32), cfg)
+
+
+def ddim_sample(params: dict, context: jnp.ndarray, cfg: DiTConfig, key,
+                *, steps: int = 20, guidance: float = 5.0,
+                uncond_context: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Full DDIM sampler in one jittable program: [B,S,ctx] -> latents.
+
+    Classifier-free guidance doubles the batch (cond + uncond) per step so
+    both passes share one matmul stream. Linear-beta DDPM schedule, eta=0.
+    """
+    b = context.shape[0]
+    shape = (b, cfg.latent_size, cfg.latent_size, cfg.latent_channels)
+    x = jax.random.normal(key, shape, jnp.float32)
+    if uncond_context is None:
+        uncond_context = jnp.zeros_like(context)
+    ctx2 = jnp.concatenate([context, uncond_context], axis=0)
+
+    n_train = 1000
+    betas = jnp.linspace(1e-4, 0.02, n_train, dtype=jnp.float32)
+    alphas_bar = jnp.cumprod(1.0 - betas)
+    ts = jnp.linspace(n_train - 1, 0, steps).astype(jnp.int32)  # descending
+
+    def step_fn(x, i):
+        t = ts[i]
+        t_next = jnp.where(i + 1 < steps, ts[jnp.minimum(i + 1, steps - 1)], -1)
+        a_t = alphas_bar[t]
+        a_next = jnp.where(t_next >= 0, alphas_bar[jnp.maximum(t_next, 0)], 1.0)
+
+        x2 = jnp.concatenate([x, x], axis=0)
+        t2 = jnp.full((2 * b,), t, jnp.int32)
+        eps2 = forward(params, x2, t2, ctx2, cfg)
+        eps_c, eps_u = eps2[:b], eps2[b:]
+        eps = eps_u + guidance * (eps_c - eps_u)
+
+        x0 = (x - jnp.sqrt(1.0 - a_t) * eps) * jax.lax.rsqrt(a_t)
+        x0 = jnp.clip(x0, -4.0, 4.0)
+        x_next = jnp.sqrt(a_next) * x0 + jnp.sqrt(1.0 - a_next) * eps
+        return x_next, None
+
+    x, _ = jax.lax.scan(step_fn, x, jnp.arange(steps))
+    return x
+
+
+class DiT:
+    """Engine-facing wrapper; ``sample`` is the serving entry."""
+
+    def __init__(self, cfg: DiTConfig | None = None, seed: int = 0) -> None:
+        self.cfg = cfg or dit_xl()
+        self.params = init_params(self.cfg, jax.random.PRNGKey(seed))
+
+    def apply(self, params, latents, t, context):
+        return forward(params, latents, t, context, self.cfg)
+
+    def sharding_specs(self):
+        from ..parallel import specs_from_rules
+
+        return specs_from_rules(self.params, SHARDING_RULES)

@@ -1,0 +1,98 @@
+"""DiT diffusion: patchify inverses, conditioning, sampler, sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu import parallel as par
+from gofr_tpu.models import diffusion
+from gofr_tpu.parallel import P
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = diffusion.tiny_dit()
+    params = diffusion.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_patchify_roundtrip(model):
+    cfg, _ = model
+    x = jnp.arange(2 * 8 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 8, 4)
+    patches = diffusion.patchify(x, cfg)
+    assert patches.shape == (2, cfg.n_patches, cfg.patch_dim)
+    np.testing.assert_array_equal(
+        np.asarray(diffusion.unpatchify(patches, cfg)), np.asarray(x)
+    )
+
+
+def test_forward_shapes(model):
+    cfg, params = model
+    lat = jnp.zeros((2, 8, 8, 4))
+    ctx = jnp.zeros((2, 5, cfg.ctx_dim))
+    eps = diffusion.forward(params, lat, jnp.array([10, 500]), ctx, cfg)
+    assert eps.shape == lat.shape
+    assert eps.dtype == jnp.float32
+
+
+def test_conditioning_changes_output(model):
+    """Different text context must steer the predicted noise; zero-init
+    patch_out means we must first check the trunk, so perturb patch_out."""
+    cfg, params = model
+    params = dict(params)
+    # adaLN-zero + zero patch_out start as identity (by design); perturb
+    # them so the conditioning pathway is actually exercised
+    params["patch_out"] = (
+        jax.random.normal(jax.random.PRNGKey(1),
+                          params["patch_out"].shape) * 0.02
+    ).astype(cfg.dtype)
+    layers = dict(params["layers"])
+    layers["ada_w"] = (
+        jax.random.normal(jax.random.PRNGKey(11),
+                          layers["ada_w"].shape) * 0.02
+    ).astype(cfg.dtype)
+    params["layers"] = layers
+    lat = jax.random.normal(jax.random.PRNGKey(2), (1, 8, 8, 4))
+    t = jnp.array([300])
+    c1 = jax.random.normal(jax.random.PRNGKey(3), (1, 5, cfg.ctx_dim))
+    c2 = jax.random.normal(jax.random.PRNGKey(4), (1, 5, cfg.ctx_dim))
+    e1 = diffusion.forward(params, lat, t, c1, cfg)
+    e2 = diffusion.forward(params, lat, t, c2, cfg)
+    assert not np.allclose(np.asarray(e1), np.asarray(e2), atol=1e-5)
+    # timestep also conditions
+    e3 = diffusion.forward(params, lat, jnp.array([900]), c1, cfg)
+    assert not np.allclose(np.asarray(e1), np.asarray(e3), atol=1e-5)
+
+
+def test_ddim_sampler_runs_and_is_deterministic(model):
+    cfg, params = model
+    ctx = jax.random.normal(jax.random.PRNGKey(5), (2, 4, cfg.ctx_dim))
+    sample = jax.jit(
+        lambda p, c, k: diffusion.ddim_sample(p, c, cfg, k, steps=4, guidance=2.0)
+    )
+    out1 = sample(params, ctx, jax.random.PRNGKey(7))
+    out2 = sample(params, ctx, jax.random.PRNGKey(7))
+    assert out1.shape == (2, 8, 8, 4)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert np.isfinite(np.asarray(out1)).all()
+    # different key -> different image
+    out3 = sample(params, ctx, jax.random.PRNGKey(8))
+    assert not np.allclose(np.asarray(out1), np.asarray(out3))
+
+
+def test_sharded_forward_matches(model):
+    cfg, params = model
+    mesh = par.make_mesh(par.MeshConfig(dp=2, tp=4))
+    specs = par.specs_from_rules(params, diffusion.SHARDING_RULES)
+    sharded = par.shard_params(params, specs, mesh)
+    lat = jax.random.normal(jax.random.PRNGKey(9), (4, 8, 8, 4))
+    ctx = jax.random.normal(jax.random.PRNGKey(10), (4, 5, cfg.ctx_dim))
+    t = jnp.array([10, 200, 500, 900])
+    expect = diffusion.forward(params, lat, t, ctx, cfg)
+    with mesh:
+        got = jax.jit(
+            lambda p, l, tt, c: diffusion.forward(p, l, tt, c, cfg)
+        )(sharded, par.shard_like(lat, P("dp"), mesh), t,
+          par.shard_like(ctx, P("dp"), mesh))
+    np.testing.assert_allclose(np.asarray(expect), np.asarray(got), atol=5e-2)
