@@ -103,6 +103,18 @@ SHARDING_RULES = (
     (r"final_norm", P(None)),
 )
 
+# FSDP variant: weights additionally sharded over the fsdp axis (ZeRO-3
+# style — GSPMD all-gathers each layer's weights just-in-time inside the
+# scan and reduce-scatters its grads). Combine with tp for 2D sharding.
+SHARDING_RULES_FSDP = (
+    (r"layers/(wq|wk|wv|w_gate|w_up)", P(None, "fsdp", "tp")),
+    (r"layers/(wo|w_down)", P(None, "tp", "fsdp")),
+    (r"layers/(attn_norm|mlp_norm)", P(None)),
+    (r"embed", P("fsdp", None)),
+    (r"lm_head", P("fsdp", "tp")),
+    (r"final_norm", P(None)),
+)
+
 # KV cache [L, B, S, KV, D]: batch on dp, kv heads on tp.
 CACHE_SPEC = P(None, "dp", None, "tp", None)
 

@@ -46,39 +46,46 @@ __all__ = [
     "pad_to_multiple",
 ]
 
-AXES = ("dp", "fsdp", "tp", "sp")
+AXES = ("dp", "fsdp", "pp", "ep", "tp", "sp")
 
 
 class MeshConfig:
-    """Mesh axis sizes for the canonical 4-axis mesh."""
+    """Mesh axis sizes for the canonical 6-axis mesh: data, fully-sharded
+    data, pipeline, expert, tensor, and sequence parallelism. Size-1 axes
+    cost nothing, so every program shares one PartitionSpec vocabulary."""
 
-    def __init__(self, dp: int = 1, fsdp: int = 1, tp: int = 1, sp: int = 1) -> None:
-        self.dp, self.fsdp, self.tp, self.sp = dp, fsdp, tp, sp
+    def __init__(self, dp: int = 1, fsdp: int = 1, tp: int = 1, sp: int = 1,
+                 pp: int = 1, ep: int = 1) -> None:
+        self.dp, self.fsdp, self.pp, self.ep = dp, fsdp, pp, ep
+        self.tp, self.sp = tp, sp
 
-    def sizes(self) -> tuple[int, int, int, int]:
-        return (self.dp, self.fsdp, self.tp, self.sp)
+    def sizes(self) -> tuple[int, int, int, int, int, int]:
+        return (self.dp, self.fsdp, self.pp, self.ep, self.tp, self.sp)
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid
-        return f"MeshConfig(dp={self.dp}, fsdp={self.fsdp}, tp={self.tp}, sp={self.sp})"
+        return (f"MeshConfig(dp={self.dp}, fsdp={self.fsdp}, pp={self.pp}, "
+                f"ep={self.ep}, tp={self.tp}, sp={self.sp})")
 
 
 def mesh_shape_for(n_devices: int, *, tp: int | None = None, sp: int = 1,
-                   fsdp: int = 1) -> MeshConfig:
+                   fsdp: int = 1, pp: int = 1, ep: int = 1) -> MeshConfig:
     """Sensible default layout: give TP as many chips as divide evenly
-    (it needs the fastest links), sequence/fsdp as requested, and let DP
+    (it needs the fastest links), the other axes as requested, and let DP
     absorb the rest."""
+    fixed = sp * fsdp * pp * ep
     if tp is None:
         tp = 1
         for cand in (8, 4, 2):
-            if n_devices % (cand * sp * fsdp) == 0:
+            if n_devices % (cand * fixed) == 0:
                 tp = cand
                 break
-    dp = n_devices // (tp * sp * fsdp)
-    if dp * tp * sp * fsdp != n_devices:
+    dp = n_devices // (tp * fixed)
+    if dp * tp * fixed != n_devices:
         raise ValueError(
-            f"mesh {dp}x{fsdp}x{tp}x{sp} does not cover {n_devices} devices"
+            f"mesh dp={dp} fsdp={fsdp} pp={pp} ep={ep} tp={tp} sp={sp} "
+            f"does not cover {n_devices} devices"
         )
-    return MeshConfig(dp=dp, fsdp=fsdp, tp=tp, sp=sp)
+    return MeshConfig(dp=dp, fsdp=fsdp, pp=pp, ep=ep, tp=tp, sp=sp)
 
 
 def make_mesh(config: MeshConfig | None = None, *, devices: Sequence | None = None) -> Mesh:

@@ -1,0 +1,133 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe schedule).
+
+Completes the parallelism matrix (SURVEY §2.10: absent in the reference).
+Layers are split into pp contiguous stages; microbatches flow through the
+stage ring via ``ppermute`` — one neighbor hop per tick, the classic
+bubble of (pp - 1) ticks at fill and drain:
+
+    tick:      0    1    2    3   ...
+    stage 0:  mb0  mb1  mb2  mb3
+    stage 1:   -   mb0  mb1  mb2
+    stage 2:   -    -   mb0  mb1
+
+Implementation: one ``shard_map`` body per pipeline run. Each device holds
+its stage's parameter shard ([1, ...] slice of the stage-stacked pytree)
+and a rolling activation; a ``fori_loop`` drives ticks. Stage 0 injects
+microbatch t from its local input buffer; the last stage banks its result
+into the output buffer at tick t - (pp - 1). All control flow is static —
+XLA sees one compiled loop, no per-tick dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from . import P
+
+__all__ = ["pipeline_apply", "pipeline_layers", "stack_stages"]
+
+
+def stack_stages(layer_params: Any, n_stages: int) -> Any:
+    """Regroup a layer-stacked pytree [L, ...] into [n_stages, L/pp, ...]."""
+
+    def regroup(leaf):
+        l = leaf.shape[0]
+        if l % n_stages:
+            raise ValueError(f"{l} layers not divisible by {n_stages} stages")
+        return leaf.reshape(n_stages, l // n_stages, *leaf.shape[1:])
+
+    return jax.tree.map(regroup, layer_params)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jnp.ndarray,
+                   mesh, *, axis_name: str = "pp",
+                   data_spec: P = P("dp")) -> jnp.ndarray:
+    """Run ``x`` through the staged network on the mesh's pp ring.
+
+    stage_fn(params_one_stage, activation [B_m, ...]) -> activation;
+    stage_params: pytree with leading [pp, ...] stage axis;
+    x: [n_micro, B_m, ...] microbatches (n_micro >= 1).
+    Returns [n_micro, B_m, ...] outputs (the last stage's results,
+    broadcast back to every stage so downstream specs stay simple).
+    """
+    n_stages = mesh.shape[axis_name]
+    n_micro = x.shape[0]
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+    x_spec = P(None, *data_spec)  # microbatch axis replicated across pp
+
+    def body(params_local, x_local):
+        # params_local leaves: [1, ...] (this stage); x_local: [n_micro, ...]
+        params_me = jax.tree.map(lambda a: a[0], params_local)
+        stage = jax.lax.axis_index(axis_name)
+        n_ticks = n_micro + n_stages - 1
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        carry0 = jnp.zeros_like(x_local[0])
+        out0 = jnp.zeros_like(x_local)
+
+        def tick(t, state):
+            carry, outs = state
+            # stage 0 injects microbatch t (clamped; masked off when t >= n_micro)
+            inject = x_local[jnp.minimum(t, n_micro - 1)]
+            a_in = jnp.where(stage == 0, inject, carry)
+            a_out = stage_fn(params_me, a_in)
+            # valid iff this stage is currently working on a real microbatch
+            mb = t - stage
+            valid = (mb >= 0) & (mb < n_micro)
+            a_out = jnp.where(valid, a_out, jnp.zeros_like(a_out))
+            # last stage banks its finished microbatch
+            bank = (stage == n_stages - 1) & valid
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs,
+                jnp.where(bank, a_out, outs[jnp.maximum(mb, 0)]),
+                jnp.maximum(mb, 0), axis=0,
+            )
+            # everyone passes activations one hop around the ring
+            carry = jax.lax.ppermute(a_out, axis_name, fwd_perm)
+            return carry, outs
+
+        _, outs = jax.lax.fori_loop(0, n_ticks, tick, (carry0, out0))
+        # results live on the last stage; share them with the whole ring
+        outs = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)),
+            axis_name,
+        )
+        return outs
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_specs, x_spec), out_specs=x_spec,
+        check_vma=False,
+    )(stage_params, x)
+
+
+def pipeline_layers(layer_fn: Callable, layer_params: Any, x: jnp.ndarray,
+                    mesh, *, n_micro: int | None = None,
+                    axis_name: str = "pp") -> jnp.ndarray:
+    """Convenience: run a layer-stacked [L, ...] pytree as a pipeline.
+
+    Splits layers into mesh.shape[pp] stages (scan inside each stage) and
+    the batch into ``n_micro`` microbatches (default: pp, the minimum that
+    keeps every stage busy at steady state).
+    """
+    n_stages = mesh.shape[axis_name]
+    n_micro = n_micro or n_stages
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible into {n_micro} microbatches")
+    staged = stack_stages(layer_params, n_stages)
+    xm = x.reshape(n_micro, b // n_micro, *x.shape[1:])
+
+    def stage_fn(params_stage, a):
+        def one(a, lp):
+            return layer_fn(lp, a), None
+
+        a, _ = jax.lax.scan(one, a, params_stage)
+        return a
+
+    out = pipeline_apply(stage_fn, staged, xm, mesh, axis_name=axis_name)
+    return out.reshape(b, *x.shape[1:])

@@ -1,0 +1,57 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: all-to-all attention.
+
+The second long-context strategy next to ring attention (ring.py). Where
+the ring rotates K/V blocks and keeps attention local, Ulysses re-shards:
+two ``all_to_all`` collectives swap the sequence sharding for a HEAD
+sharding around the attention op, so each device computes exact attention
+over the FULL sequence for n_heads/sp of the heads — no online-softmax
+bookkeeping, two big ICI transfers instead of sp small ones.
+
+Trade-off vs ring (why both exist): Ulysses needs n_heads % sp == 0 and
+moves q,k,v,o once each (4 x all_to_all total); the ring moves k,v sp-1
+times but has no head-count constraint and overlaps transfer with compute.
+Ulysses usually wins at moderate sp on fat ICI; the ring wins at extreme
+sequence lengths or when heads are scarce (GQA-expanded kv).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from . import P
+
+__all__ = ["ulysses_attention_local", "ulysses_attention"]
+
+
+def ulysses_attention_local(q, k, v, *, axis_name: str = "sp",
+                            causal: bool = True):
+    """Per-shard body under shard_map: q/k/v are [B, T/sp, H, D] sequence
+    shards; returns the same shape. Heads must divide the axis size."""
+    from ..ops import attention
+
+    n = jax.lax.psum(1, axis_name)
+    if q.shape[2] % n:
+        raise ValueError(f"n_heads {q.shape[2]} must divide {axis_name}={n}")
+    # seq-sharded -> head-sharded: split heads across the axis, gather seq
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            split_axis=2, concat_axis=1, tiled=True)
+    qh, kh, vh = a2a(q), a2a(k), a2a(v)      # [B, T, H/sp, D]
+    o = attention(qh, kh, vh, causal=causal)
+    # head-sharded -> seq-sharded
+    return jax.lax.all_to_all(o, axis_name=axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh, *, causal: bool = True,
+                      batch_axis: str = "dp", seq_axis: str = "sp",
+                      head_axis: str = "tp"):
+    """shard_map wrapper over full [B, S, H, D] arrays (GQA expanded)."""
+    spec = P(batch_axis, seq_axis, head_axis, None)
+    fn = functools.partial(ulysses_attention_local, axis_name=seq_axis,
+                           causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

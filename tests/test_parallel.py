@@ -11,18 +11,20 @@ from gofr_tpu.parallel import P
 
 def test_mesh_shape_inference():
     cfg = par.mesh_shape_for(8)
-    assert cfg.sizes() == (1, 1, 8, 1)
+    assert cfg.sizes() == (1, 1, 1, 1, 8, 1)
     cfg = par.mesh_shape_for(8, tp=4)
-    assert cfg.sizes() == (2, 1, 4, 1)
+    assert cfg.sizes() == (2, 1, 1, 1, 4, 1)
     cfg = par.mesh_shape_for(8, tp=2, sp=2)
-    assert cfg.sizes() == (2, 1, 2, 2)
+    assert cfg.sizes() == (2, 1, 1, 1, 2, 2)
+    cfg = par.mesh_shape_for(8, tp=2, ep=2, pp=2)
+    assert cfg.sizes() == (1, 1, 2, 2, 2, 1)
     with pytest.raises(ValueError):
         par.mesh_shape_for(8, tp=3)
 
 
 def test_make_mesh_axes():
     mesh = par.make_mesh(par.MeshConfig(dp=2, tp=4))
-    assert mesh.axis_names == ("dp", "fsdp", "tp", "sp")
+    assert mesh.axis_names == ("dp", "fsdp", "pp", "ep", "tp", "sp")
     assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 4
 
 
