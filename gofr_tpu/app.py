@@ -174,6 +174,33 @@ class App:
 
         register_crud_handlers(self, entity)
 
+    # externally-injected datasources (reference external_db.go:10-146):
+    # observability is injected, connect() runs, and the handle lands on the
+    # container under its conventional name for ctx.<name> access.
+    def add_cassandra(self, db: Any) -> None:
+        self.container.add_datasource("cassandra", db)
+
+    def add_mongo(self, db: Any) -> None:
+        self.container.add_datasource("mongo", db)
+
+    def add_clickhouse(self, db: Any) -> None:
+        self.container.add_datasource("clickhouse", db)
+
+    def add_solr(self, db: Any) -> None:
+        self.container.add_datasource("solr", db)
+
+    def add_opentsdb(self, db: Any) -> None:
+        self.container.add_datasource("opentsdb", db)
+
+    def add_dgraph(self, db: Any) -> None:
+        self.container.add_datasource("dgraph", db)
+
+    def add_kv_store(self, db: Any) -> None:
+        self.container.add_datasource("kv", db)
+
+    def add_file_store(self, fs: Any) -> None:
+        self.container.add_datasource("file", fs)
+
     def register_llm(self, name: str, params: Any, cfg: Any, **kwargs: Any) -> None:
         """Mount a continuous-batching LLM (ml/llm.py): handlers stream
         tokens via ``ctx.ml.llm(name)`` (TPU-native; green-field)."""
@@ -404,6 +431,16 @@ class App:
             self._background_tasks.append(
                 asyncio.create_task(self._cron.run(), name="cron")
             )
+        # live log-level updates (reference remotelogger poller)
+        remote_url = self.config.get("REMOTE_LOG_URL")
+        if remote_url:
+            from .logging.remote import RemoteLevelUpdater
+
+            self._remote_level = RemoteLevelUpdater(
+                self.logger, remote_url,
+                float(self.config.get_or_default("REMOTE_LOG_FETCH_INTERVAL", "15")),
+            )
+            self._remote_level.start()
         self.logger.infof("startup complete in %.0fms", (time.perf_counter() - t0) * 1e3)
 
     async def shutdown(self) -> None:
@@ -411,6 +448,8 @@ class App:
         gofr.go:219-245 + shutdown.go:11-32)."""
 
         async def _drain() -> None:
+            if getattr(self, "_remote_level", None) is not None:
+                await self._remote_level.stop()
             for task in self._background_tasks:
                 task.cancel()
             for task in self._background_tasks:

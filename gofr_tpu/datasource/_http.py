@@ -1,0 +1,100 @@
+"""Shared base for HTTP-protocol datasource drivers.
+
+Several of the reference's datasources speak plain HTTP (Solr, OpenTSDB
+REST APIs; ClickHouse's HTTP interface; Dgraph's HTTP endpoints). No Python
+client libraries ship in this image, so these drivers implement the
+protocols directly over aiohttp — the same choice as the from-scratch RESP
+client in datasource/redis. This base centralizes the driver contract
+(use_logger/use_metrics/use_tracer/connect), per-op duration histograms,
+and structured query logs, mirroring the uniform observability the
+reference wires into every driver (e.g. clickhouse QueryLog, solr
+observability decorators).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any
+
+__all__ = ["HTTPDriver"]
+
+
+class HTTPDriver:
+    """Async HTTP datasource base: subclasses set ``metric_name`` and call
+    ``self._request`` / ``self._observe``."""
+
+    metric_name = "app_http_datasource_stats"
+
+    def __init__(self, base_url: str, *, timeout: float = 10.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self._timeout = timeout
+        self._session = None
+        self._logger = None
+        self._metrics = None
+        self._tracer = None
+
+    # -- provider contract (reference container/datasources.go:278-290) -------
+    def use_logger(self, logger) -> None:
+        self._logger = logger
+
+    def use_metrics(self, metrics) -> None:
+        self._metrics = metrics
+
+    def use_tracer(self, tracer) -> None:
+        self._tracer = tracer
+
+    def connect(self) -> None:
+        """Sessions are created lazily on the running loop; connect is kept
+        for contract parity and logs intent."""
+        if self._logger is not None:
+            self._logger.debugf("%s connecting to %s",
+                                type(self).__name__, self.base_url)
+
+    async def _ensure_session(self):
+        if self._session is None or self._session.closed:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self._timeout)
+            )
+        return self._session
+
+    async def _request(self, method: str, path: str, *, params: dict | None = None,
+                       data: Any = None, json_body: Any = None,
+                       headers: dict | None = None) -> tuple[int, bytes]:
+        session = await self._ensure_session()
+        url = path if path.startswith("http") else self.base_url + path
+        span = None
+        if self._tracer is not None:
+            span = self._tracer.start_span(
+                f"{type(self).__name__.lower()} {method} {path}", kind="CLIENT")
+        try:
+            async with session.request(method, url, params=params, data=data,
+                                       json=json_body, headers=headers) as resp:
+                body = await resp.read()
+                return resp.status, body
+        finally:
+            if span is not None:
+                span.end()
+
+    def _observe(self, op: str, start: float, detail: str = "") -> None:
+        dur = time.perf_counter() - start
+        if self._metrics is not None:
+            try:
+                self._metrics.record_histogram(self.metric_name, dur, operation=op)
+            except Exception:
+                pass
+        if self._logger is not None:
+            self._logger.debug({
+                "datasource": type(self).__name__, "operation": op,
+                "duration_us": int(dur * 1e6), "detail": detail[:120],
+            })
+
+    @staticmethod
+    def _json(body: bytes) -> Any:
+        return json.loads(body) if body else None
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()

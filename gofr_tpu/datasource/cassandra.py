@@ -1,0 +1,135 @@
+"""Cassandra datasource (provider-injected session).
+
+Reference: a separate Go module wrapping gocql with query/exec/batch/CAS +
+context variants (SURVEY §2.8, datasource/cassandra, 1,303 LoC). CQL's
+binary protocol is out of scope to reimplement; like the reference keeps
+gocql OUT of the main module, this driver keeps the client library out of
+the framework: it wraps an injected low-level session — the
+`cassandra-driver` package's Session when installed, or anything
+implementing ``execute(query, params)`` — and adds the framework's uniform
+instrumentation (duration histogram, structured query log, health).
+
+Mount with ``app.add_cassandra(Cassandra(session=...))`` or let ``connect``
+dial via the cassandra-driver package if present.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Sequence
+
+__all__ = ["Cassandra", "CassandraError"]
+
+
+class CassandraError(Exception):
+    pass
+
+
+class Cassandra:
+    metric_name = "app_cassandra_stats"
+
+    def __init__(self, *, hosts: Sequence[str] = ("localhost",),
+                 keyspace: str = "", port: int = 9042,
+                 session: Any = None) -> None:
+        self.hosts = list(hosts)
+        self.keyspace = keyspace
+        self.port = port
+        self._session = session
+        self._logger = None
+        self._metrics = None
+        self._tracer = None
+
+    # -- provider contract -----------------------------------------------------
+    def use_logger(self, logger) -> None:
+        self._logger = logger
+
+    def use_metrics(self, metrics) -> None:
+        self._metrics = metrics
+
+    def use_tracer(self, tracer) -> None:
+        self._tracer = tracer
+
+    def connect(self) -> None:
+        if self._session is not None:
+            return
+        try:
+            from cassandra.cluster import Cluster  # type: ignore
+        except ImportError as exc:
+            raise CassandraError(
+                "no session injected and the cassandra-driver package is not "
+                "installed; pass Cassandra(session=...)"
+            ) from exc
+        cluster = Cluster(self.hosts, port=self.port)
+        self._session = cluster.connect(self.keyspace or None)
+        if self._logger is not None:
+            self._logger.infof("cassandra connected to %s", self.hosts)
+
+    # -- ops -------------------------------------------------------------------
+    def _observe(self, op: str, start: float, stmt: str) -> None:
+        dur = time.perf_counter() - start
+        if self._metrics is not None:
+            try:
+                self._metrics.record_histogram(self.metric_name, dur, operation=op)
+            except Exception:
+                pass
+        if self._logger is not None:
+            self._logger.debug({"datasource": "Cassandra", "operation": op,
+                                "duration_us": int(dur * 1e6),
+                                "query": stmt[:120]})
+
+    def _require(self):
+        if self._session is None:
+            raise CassandraError("not connected (call connect or inject session)")
+        return self._session
+
+    async def query(self, stmt: str, params: Sequence | None = None) -> list:
+        """SELECT -> list of rows (driver-native row objects / dicts)."""
+        return await self._run("query", stmt, params)
+
+    async def exec(self, stmt: str, params: Sequence | None = None) -> None:
+        """INSERT/UPDATE/DELETE/DDL."""
+        await self._run("exec", stmt, params)
+
+    async def batch_exec(self, stmts: Sequence[tuple[str, Sequence | None]]) -> None:
+        """Logged batch: executes statements as one unit when the underlying
+        session supports BatchStatement, else sequentially."""
+        session = self._require()
+        start = time.perf_counter()
+        try:
+            try:
+                from cassandra.query import BatchStatement  # type: ignore
+
+                batch = BatchStatement()
+                for stmt, params in stmts:
+                    batch.add(stmt, params or ())
+                await asyncio.to_thread(session.execute, batch)
+            except ImportError:
+                for stmt, params in stmts:
+                    await asyncio.to_thread(session.execute, stmt, params or ())
+        finally:
+            self._observe("batch", start, f"{len(stmts)} statements")
+
+    async def _run(self, op: str, stmt: str, params: Sequence | None) -> list:
+        session = self._require()
+        start = time.perf_counter()
+        try:
+            result = await asyncio.to_thread(session.execute, stmt, params or ())
+            return list(result) if result is not None else []
+        finally:
+            self._observe(op, start, stmt)
+
+    async def health_check(self) -> dict:
+        try:
+            await self.query("SELECT release_version FROM system.local")
+        except Exception as exc:
+            return {"status": "DOWN", "details": {"hosts": self.hosts,
+                                                  "error": str(exc)[:200]}}
+        return {"status": "UP", "details": {"hosts": self.hosts,
+                                            "keyspace": self.keyspace}}
+
+    async def close(self) -> None:
+        if self._session is not None:
+            shutdown = getattr(self._session, "shutdown", None)
+            if shutdown is not None:
+                await asyncio.to_thread(shutdown)

@@ -1,0 +1,92 @@
+"""ClickHouse driver over the native HTTP interface (port 8123).
+
+Reference: the separate module wrapping clickhouse-go with Exec/Select/
+AsyncInsert + health + query observability (SURVEY §2.8,
+datasource/clickhouse, 635 LoC). No Python client ships here, so this
+speaks the HTTP interface directly: queries POST as text, results stream
+back as JSONEachRow.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any
+
+from ._http import HTTPDriver
+
+__all__ = ["ClickHouse", "ClickHouseError"]
+
+
+class ClickHouseError(Exception):
+    pass
+
+
+class ClickHouse(HTTPDriver):
+    metric_name = "app_clickhouse_stats"
+
+    def __init__(self, host: str = "localhost", port: int = 8123, *,
+                 database: str = "default", user: str = "default",
+                 password: str = "", timeout: float = 10.0) -> None:
+        super().__init__(f"http://{host}:{port}", timeout=timeout)
+        self.database = database
+        self._params = {"database": database, "user": user}
+        if password:
+            self._params["password"] = password
+
+    async def _sql(self, query: str, *, fmt: str | None = None) -> bytes:
+        start = time.perf_counter()
+        q = query + (f" FORMAT {fmt}" if fmt else "")
+        status, body = await self._request("POST", "/", params=self._params,
+                                           data=q.encode())
+        self._observe("exec", start, query)
+        if status != 200:
+            raise ClickHouseError(body.decode(errors="replace")[:500])
+        return body
+
+    async def exec(self, query: str) -> None:
+        """DDL / INSERT ... VALUES / any statement without a result set."""
+        await self._sql(query)
+
+    async def select(self, query: str) -> list[dict]:
+        """SELECT -> list of row dicts (JSONEachRow)."""
+        body = await self._sql(query, fmt="JSONEachRow")
+        return [json.loads(line) for line in body.splitlines() if line.strip()]
+
+    async def insert_rows(self, table: str, rows: list[dict]) -> None:
+        """Batch insert via JSONEachRow payload."""
+        if not rows:
+            return
+        start = time.perf_counter()
+        data = "\n".join(json.dumps(r) for r in rows).encode()
+        params = dict(self._params,
+                      query=f"INSERT INTO {table} FORMAT JSONEachRow")
+        status, body = await self._request("POST", "/", params=params, data=data)
+        self._observe("insert", start, table)
+        if status != 200:
+            raise ClickHouseError(body.decode(errors="replace")[:500])
+
+    async def async_insert(self, table: str, rows: list[dict]) -> None:
+        """Server-side buffered insert (reference AsyncInsert): the HTTP
+        interface enables it per-query via settings."""
+        if not rows:
+            return
+        start = time.perf_counter()
+        data = "\n".join(json.dumps(r) for r in rows).encode()
+        params = dict(self._params,
+                      query=f"INSERT INTO {table} FORMAT JSONEachRow",
+                      async_insert="1", wait_for_async_insert="0")
+        status, body = await self._request("POST", "/", params=params, data=data)
+        self._observe("async_insert", start, table)
+        if status != 200:
+            raise ClickHouseError(body.decode(errors="replace")[:500])
+
+    async def health_check(self) -> dict:
+        try:
+            rows = await self.select("SELECT 1 AS ok")
+            up = bool(rows and rows[0].get("ok") == 1)
+        except Exception as exc:
+            return {"status": "DOWN", "details": {"host": self.base_url,
+                                                  "error": str(exc)[:200]}}
+        return {"status": "UP" if up else "DOWN",
+                "details": {"host": self.base_url, "database": self.database}}

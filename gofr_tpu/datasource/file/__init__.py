@@ -3,8 +3,9 @@
 Mirrors the reference's file abstraction (pkg/gofr/datasource/file/
 interface.go:35-79 defines FileSystem: Create/Open/Remove/Mkdir/ReadDir/...,
 and file.go's ReadAll returns a RowReader iterating JSON arrays, CSV rows, or
-text lines). FTP/SFTP/S3 in the reference are separate modules; here an FTP
-implementation rides stdlib ``ftplib`` and the rest raise a clear error.
+text lines). The reference's remote stores are separate modules; here they
+are sibling modules: ftp.py (stdlib ftplib), s3.py (REST + from-scratch
+SigV4), sftp.py (provider-injected paramiko-style client).
 """
 
 from __future__ import annotations

@@ -1,0 +1,190 @@
+"""FTP file store over stdlib ftplib.
+
+Reference: separate module on jlaffaye/ftp implementing the full FileSystem
++ dir ops (SURVEY §2.8, datasource/file/ftp, 1,598 LoC). Python ships
+ftplib, so this is a real implementation; the ``ftp_factory`` hook lets
+tests (and exotic deployments) inject the underlying client.
+"""
+
+from __future__ import annotations
+
+import ftplib
+import io
+import os
+import time
+from typing import Any, Callable
+
+from . import RowReader
+
+__all__ = ["FTPFileSystem"]
+
+
+class _FTPFile:
+    """In-memory handle: reads are buffered downloads, writes upload on
+    close (FTP has no random-access writes)."""
+
+    def __init__(self, fs: "FTPFileSystem", name: str, content: bytes,
+                 writable: bool) -> None:
+        self._fs = fs
+        self.name = os.path.basename(name)
+        self.path = name
+        self._buf = io.BytesIO(content)
+        self._writable = writable
+        self._dirty = False
+
+    def read(self, n: int = -1) -> bytes:
+        return self._buf.read(n)
+
+    def write(self, data: bytes | str) -> int:
+        if not self._writable:
+            raise PermissionError(f"{self.path} opened read-only")
+        if isinstance(data, str):
+            data = data.encode()
+        self._dirty = True
+        return self._buf.write(data)
+
+    def seek(self, pos: int, whence: int = 0) -> int:
+        return self._buf.seek(pos, whence)
+
+    def read_all(self) -> RowReader:
+        pos = self._buf.tell()
+        self._buf.seek(0)
+        content = self._buf.read()
+        self._buf.seek(pos)
+        return RowReader(content, self.name)
+
+    def close(self) -> None:
+        if self._dirty:
+            self._buf.seek(0)
+            self._fs._conn.storbinary(f"STOR {self.path}", self._buf)
+            self._dirty = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class FTPFileSystem:
+    metric_name = "app_ftp_stats"
+
+    def __init__(self, host: str = "localhost", port: int = 21, *,
+                 user: str = "anonymous", password: str = "",
+                 timeout: float = 10.0,
+                 ftp_factory: Callable[[], Any] | None = None) -> None:
+        self.host, self.port = host, port
+        self._user, self._password = user, password
+        self._timeout = timeout
+        self._factory = ftp_factory
+        self._conn: Any = None
+        self._logger = None
+        self._metrics = None
+
+    # -- provider contract -----------------------------------------------------
+    def use_logger(self, logger) -> None:
+        self._logger = logger
+
+    def use_metrics(self, metrics) -> None:
+        self._metrics = metrics
+
+    def use_tracer(self, tracer) -> None:
+        pass
+
+    def connect(self) -> None:
+        if self._factory is not None:
+            self._conn = self._factory()
+            return
+        self._conn = ftplib.FTP()
+        self._conn.connect(self.host, self.port, timeout=self._timeout)
+        self._conn.login(self._user, self._password)
+        if self._logger is not None:
+            self._logger.infof("ftp connected to %s:%d", self.host, self.port)
+
+    def _observe(self, op: str, start: float) -> None:
+        if self._metrics is not None:
+            try:
+                self._metrics.record_histogram(
+                    self.metric_name, time.perf_counter() - start, operation=op)
+            except Exception:
+                pass
+
+    # -- FileSystem ------------------------------------------------------------
+    def create(self, name: str):
+        start = time.perf_counter()
+        self._conn.storbinary(f"STOR {name}", io.BytesIO(b""))
+        self._observe("create", start)
+        return _FTPFile(self, name, b"", writable=True)
+
+    def open(self, name: str):
+        start = time.perf_counter()
+        buf = io.BytesIO()
+        self._conn.retrbinary(f"RETR {name}", buf.write)
+        self._observe("open", start)
+        return _FTPFile(self, name, buf.getvalue(), writable=True)
+
+    def remove(self, name: str) -> None:
+        start = time.perf_counter()
+        self._conn.delete(name)
+        self._observe("remove", start)
+
+    def rename(self, old: str, new: str) -> None:
+        self._conn.rename(old, new)
+
+    def mkdir(self, name: str) -> None:
+        self._conn.mkd(name)
+
+    def mkdir_all(self, name: str) -> None:
+        parts = [p for p in name.split("/") if p]
+        path = ""
+        for p in parts:
+            path = f"{path}/{p}" if path else p
+            try:
+                self._conn.mkd(path)
+            except ftplib.error_perm:
+                pass  # already exists
+
+    def remove_all(self, name: str) -> None:
+        for entry in self.read_dir(name):
+            full = f"{name}/{entry}"
+            try:
+                self.remove(full)
+            except ftplib.error_perm:
+                self.remove_all(full)
+        self._conn.rmd(name)
+
+    def read_dir(self, name: str) -> list[str]:
+        start = time.perf_counter()
+        names = self._conn.nlst(name)
+        self._observe("read_dir", start)
+        return [os.path.basename(n) for n in names]
+
+    def stat(self, name: str) -> dict:
+        out: dict[str, Any] = {"name": name}
+        try:
+            out["size"] = self._conn.size(name)
+        except ftplib.error_perm:
+            out["size"] = None
+        return out
+
+    def getwd(self) -> str:
+        return self._conn.pwd()
+
+    def chdir(self, name: str) -> None:
+        self._conn.cwd(name)
+
+    def health_check(self) -> dict:
+        try:
+            self._conn.voidcmd("NOOP")
+        except Exception as exc:
+            return {"status": "DOWN",
+                    "details": {"host": f"{self.host}:{self.port}",
+                                "error": str(exc)[:200]}}
+        return {"status": "UP", "details": {"host": f"{self.host}:{self.port}"}}
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.quit()
+            except Exception:
+                pass

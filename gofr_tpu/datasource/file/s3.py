@@ -1,0 +1,279 @@
+"""S3 file store: REST API with from-scratch SigV4 signing.
+
+Reference: separate module on aws-sdk-go-v2 emulating directories over
+buckets (SURVEY §2.8, datasource/file/s3, 1,564 LoC). No AWS SDK ships in
+this image; S3's REST surface (GET/PUT/DELETE object, ListObjectsV2) plus
+AWS Signature Version 4 is small enough to implement directly over
+http.client — hmac/hashlib are stdlib. Keys are treated as paths with the
+usual prefix-as-directory emulation.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import http.client
+import io
+import os
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+from typing import Any
+
+from . import RowReader
+
+__all__ = ["S3FileSystem", "S3Error"]
+
+
+class S3Error(Exception):
+    pass
+
+
+def _sign(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+class _S3File:
+    def __init__(self, fs: "S3FileSystem", key: str, content: bytes,
+                 writable: bool = True) -> None:
+        self._fs = fs
+        self.path = key
+        self.name = os.path.basename(key)
+        self._buf = io.BytesIO(content)
+        self._writable = writable
+        self._dirty = False
+
+    def read(self, n: int = -1) -> bytes:
+        return self._buf.read(n)
+
+    def write(self, data: bytes | str) -> int:
+        if isinstance(data, str):
+            data = data.encode()
+        self._dirty = True
+        return self._buf.write(data)
+
+    def seek(self, pos: int, whence: int = 0) -> int:
+        return self._buf.seek(pos, whence)
+
+    def read_all(self) -> RowReader:
+        pos = self._buf.tell()
+        self._buf.seek(0)
+        content = self._buf.read()
+        self._buf.seek(pos)
+        return RowReader(content, self.name)
+
+    def close(self) -> None:
+        if self._dirty:
+            self._fs._put_object(self.path, self._buf.getvalue())
+            self._dirty = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class S3FileSystem:
+    """path-style addressing: http(s)://endpoint/bucket/key."""
+
+    metric_name = "app_s3_stats"
+
+    def __init__(self, bucket: str, *, region: str = "us-east-1",
+                 access_key: str = "", secret_key: str = "",
+                 endpoint: str | None = None, secure: bool = True,
+                 timeout: float = 15.0) -> None:
+        self.bucket = bucket
+        self.region = region
+        self._ak, self._sk = access_key, secret_key
+        if endpoint is None:
+            endpoint = f"s3.{region}.amazonaws.com"
+            secure = True
+        self._host = endpoint
+        self._secure = secure
+        self._timeout = timeout
+        self._cwd = ""
+        self._logger = None
+        self._metrics = None
+
+    # -- provider contract -----------------------------------------------------
+    def use_logger(self, logger) -> None:
+        self._logger = logger
+
+    def use_metrics(self, metrics) -> None:
+        self._metrics = metrics
+
+    def use_tracer(self, tracer) -> None:
+        pass
+
+    def connect(self) -> None:
+        if self._logger is not None:
+            self._logger.infof("s3 store: bucket %s via %s", self.bucket, self._host)
+
+    # -- SigV4 + transport -----------------------------------------------------
+    def _request(self, method: str, key: str, *, body: bytes = b"",
+                 query: dict[str, str] | None = None) -> tuple[int, bytes, dict]:
+        path = f"/{self.bucket}/{urllib.parse.quote(key)}" if key else f"/{self.bucket}"
+        qs = urllib.parse.urlencode(sorted((query or {}).items()))
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        payload_hash = hashlib.sha256(body).hexdigest()
+
+        headers = {
+            "host": self._host.split(":")[0] if ":" not in self._host else self._host,
+            "x-amz-content-sha256": payload_hash,
+            "x-amz-date": amz_date,
+        }
+        signed_headers = ";".join(sorted(headers))
+        canonical = "\n".join([
+            method, path, qs,
+            "".join(f"{k}:{headers[k]}\n" for k in sorted(headers)),
+            signed_headers, payload_hash,
+        ])
+        scope = f"{datestamp}/{self.region}/s3/aws4_request"
+        to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope,
+            hashlib.sha256(canonical.encode()).hexdigest(),
+        ])
+        k = _sign(("AWS4" + self._sk).encode(), datestamp)
+        k = _sign(k, self.region)
+        k = _sign(k, "s3")
+        k = _sign(k, "aws4_request")
+        signature = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+        headers["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self._ak}/{scope}, "
+            f"SignedHeaders={signed_headers}, Signature={signature}"
+        )
+
+        conn_cls = http.client.HTTPSConnection if self._secure else http.client.HTTPConnection
+        conn = conn_cls(self._host, timeout=self._timeout)
+        try:
+            url = path + (f"?{qs}" if qs else "")
+            conn.request(method, url, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, data, dict(resp.getheaders())
+        finally:
+            conn.close()
+
+    def _observe(self, op: str, start: float) -> None:
+        if self._metrics is not None:
+            try:
+                self._metrics.record_histogram(
+                    self.metric_name, time.perf_counter() - start, operation=op)
+            except Exception:
+                pass
+
+    def _full(self, name: str) -> str:
+        name = name.lstrip("/")
+        return f"{self._cwd}/{name}".lstrip("/") if self._cwd else name
+
+    def _put_object(self, key: str, body: bytes) -> None:
+        start = time.perf_counter()
+        status, data, _ = self._request("PUT", key, body=body)
+        self._observe("put", start)
+        if status >= 300:
+            raise S3Error(f"PUT {key}: {status} {data[:200]!r}")
+
+    # -- FileSystem ------------------------------------------------------------
+    def create(self, name: str):
+        key = self._full(name)
+        self._put_object(key, b"")
+        return _S3File(self, key, b"")
+
+    def open(self, name: str):
+        key = self._full(name)
+        start = time.perf_counter()
+        status, data, _ = self._request("GET", key)
+        self._observe("get", start)
+        if status == 404:
+            raise FileNotFoundError(key)
+        if status >= 300:
+            raise S3Error(f"GET {key}: {status} {data[:200]!r}")
+        return _S3File(self, key, data)
+
+    def remove(self, name: str) -> None:
+        key = self._full(name)
+        start = time.perf_counter()
+        status, data, _ = self._request("DELETE", key)
+        self._observe("delete", start)
+        if status >= 300 and status != 404:
+            raise S3Error(f"DELETE {key}: {status} {data[:200]!r}")
+
+    def rename(self, old: str, new: str) -> None:
+        f = self.open(old)
+        self._put_object(self._full(new), f.read())
+        self.remove(old)
+
+    def mkdir(self, name: str) -> None:
+        """S3 has no directories; create the conventional zero-byte marker."""
+        self._put_object(self._full(name).rstrip("/") + "/", b"")
+
+    def mkdir_all(self, name: str) -> None:
+        self.mkdir(name)
+
+    def read_dir(self, name: str) -> list[str]:
+        prefix = self._full(name).rstrip("/")
+        prefix = prefix + "/" if prefix else ""
+        start = time.perf_counter()
+        status, data, _ = self._request(
+            "GET", "", query={"list-type": "2", "prefix": prefix,
+                              "delimiter": "/"})
+        self._observe("list", start)
+        if status >= 300:
+            raise S3Error(f"LIST {prefix}: {status} {data[:200]!r}")
+        root = ET.fromstring(data)
+        ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
+        names = []
+        for el in root.iter(f"{ns}Key"):
+            rel = el.text[len(prefix):]
+            if rel and "/" not in rel.rstrip("/"):
+                names.append(rel)
+        for el in root.iter(f"{ns}Prefix"):
+            rel = (el.text or "")[len(prefix):]
+            if rel and rel != "/":
+                names.append(rel.rstrip("/"))
+        return sorted(set(names))
+
+    def remove_all(self, name: str) -> None:
+        prefix = self._full(name).rstrip("/") + "/"
+        status, data, _ = self._request(
+            "GET", "", query={"list-type": "2", "prefix": prefix})
+        if status >= 300:
+            raise S3Error(f"LIST {prefix}: {status}")
+        root = ET.fromstring(data)
+        ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
+        for el in root.iter(f"{ns}Key"):
+            self._request("DELETE", el.text)
+        self._request("DELETE", prefix)
+
+    def stat(self, name: str) -> dict:
+        key = self._full(name)
+        status, _, headers = self._request("HEAD", key)
+        if status >= 300:
+            raise FileNotFoundError(key)
+        return {"name": key,
+                "size": int(headers.get("Content-Length", 0)),
+                "modified": headers.get("Last-Modified")}
+
+    def getwd(self) -> str:
+        return "/" + self._cwd
+
+    def chdir(self, name: str) -> None:
+        self._cwd = name.strip("/")
+
+    def health_check(self) -> dict:
+        try:
+            status, data, _ = self._request(
+                "GET", "", query={"list-type": "2", "max-keys": "1"})
+            up = status < 300
+        except Exception as exc:
+            return {"status": "DOWN", "details": {"bucket": self.bucket,
+                                                  "error": str(exc)[:200]}}
+        return {"status": "UP" if up else "DOWN",
+                "details": {"bucket": self.bucket, "endpoint": self._host}}
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,178 @@
+"""SFTP file store (provider-injected client).
+
+Reference: separate module on pkg/sftp (SURVEY §2.8, datasource/file/sftp,
+827 LoC). The SSH transport layer stays in its client library (paramiko
+when installed); this driver delegates the FileSystem surface to an
+injected paramiko-style ``SFTPClient`` and adds the framework's
+instrumentation — the same keep-heavy-deps-out pattern as cassandra.py.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import stat as stat_mod
+import time
+from typing import Any
+
+from . import RowReader
+
+__all__ = ["SFTPFileSystem", "SFTPError"]
+
+
+class SFTPError(Exception):
+    pass
+
+
+class _SFTPFile:
+    def __init__(self, fh: Any, name: str) -> None:
+        self._fh = fh
+        self.path = name
+        self.name = os.path.basename(name)
+
+    def read(self, n: int = -1) -> bytes:
+        return self._fh.read() if n < 0 else self._fh.read(n)
+
+    def write(self, data: bytes | str) -> int:
+        if isinstance(data, str):
+            data = data.encode()
+        self._fh.write(data)
+        return len(data)
+
+    def seek(self, pos: int, whence: int = 0) -> int:
+        self._fh.seek(pos, whence)
+        return pos
+
+    def read_all(self) -> RowReader:
+        self._fh.seek(0)
+        return RowReader(self._fh.read(), self.name)
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class SFTPFileSystem:
+    metric_name = "app_sftp_stats"
+
+    def __init__(self, host: str = "localhost", port: int = 22, *,
+                 user: str = "", password: str = "",
+                 client: Any = None) -> None:
+        self.host, self.port = host, port
+        self._user, self._password = user, password
+        self._client = client
+        self._logger = None
+        self._metrics = None
+
+    def use_logger(self, logger) -> None:
+        self._logger = logger
+
+    def use_metrics(self, metrics) -> None:
+        self._metrics = metrics
+
+    def use_tracer(self, tracer) -> None:
+        pass
+
+    def connect(self) -> None:
+        if self._client is not None:
+            return
+        try:
+            import paramiko  # type: ignore
+        except ImportError as exc:
+            raise SFTPError(
+                "no client injected and paramiko is not installed; pass "
+                "SFTPFileSystem(client=...)"
+            ) from exc
+        transport = paramiko.Transport((self.host, self.port))
+        transport.connect(username=self._user, password=self._password)
+        self._client = paramiko.SFTPClient.from_transport(transport)
+
+    def _require(self):
+        if self._client is None:
+            raise SFTPError("not connected (call connect or inject client)")
+        return self._client
+
+    def _observe(self, op: str, start: float) -> None:
+        if self._metrics is not None:
+            try:
+                self._metrics.record_histogram(
+                    self.metric_name, time.perf_counter() - start, operation=op)
+            except Exception:
+                pass
+
+    # -- FileSystem ------------------------------------------------------------
+    def create(self, name: str):
+        start = time.perf_counter()
+        fh = self._require().open(name, "wb")
+        self._observe("create", start)
+        return _SFTPFile(fh, name)
+
+    def open(self, name: str):
+        start = time.perf_counter()
+        fh = self._require().open(name, "rb")
+        self._observe("open", start)
+        return _SFTPFile(fh, name)
+
+    def remove(self, name: str) -> None:
+        self._require().remove(name)
+
+    def rename(self, old: str, new: str) -> None:
+        self._require().rename(old, new)
+
+    def mkdir(self, name: str) -> None:
+        self._require().mkdir(name)
+
+    def mkdir_all(self, name: str) -> None:
+        client = self._require()
+        parts = [p for p in name.split("/") if p]
+        path = ""
+        for p in parts:
+            path = f"{path}/{p}" if path else p
+            try:
+                client.mkdir(path)
+            except OSError:
+                pass
+
+    def remove_all(self, name: str) -> None:
+        client = self._require()
+        for attr in client.listdir_attr(name):
+            full = f"{name}/{attr.filename}"
+            if stat_mod.S_ISDIR(attr.st_mode or 0):
+                self.remove_all(full)
+            else:
+                client.remove(full)
+        client.rmdir(name)
+
+    def read_dir(self, name: str) -> list[str]:
+        return sorted(self._require().listdir(name))
+
+    def stat(self, name: str) -> dict:
+        st = self._require().stat(name)
+        return {"name": name, "size": st.st_size, "modified": st.st_mtime}
+
+    def getwd(self) -> str:
+        return self._require().getcwd() or "/"
+
+    def chdir(self, name: str) -> None:
+        self._require().chdir(name)
+
+    def health_check(self) -> dict:
+        try:
+            self._require().listdir(".")
+        except Exception as exc:
+            return {"status": "DOWN",
+                    "details": {"host": f"{self.host}:{self.port}",
+                                "error": str(exc)[:200]}}
+        return {"status": "UP", "details": {"host": f"{self.host}:{self.port}"}}
+
+    def close(self) -> None:
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:
+                pass

@@ -1,0 +1,168 @@
+"""MongoDB datasource (provider-injected client).
+
+Reference: separate module wrapping mongo-driver with full CRUD +
+sessions/transactions (SURVEY §2.8, datasource/mongo, 610 LoC). The BSON
+wire protocol stays in the client library (pymongo/motor when installed,
+or any object with pymongo's database API); this driver adds the
+framework's instrumentation and the reference's method surface:
+find / find_one / insert_one / insert_many / update_by_id / update_one /
+update_many / delete_one / delete_many / count_documents / drop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any
+
+__all__ = ["Mongo", "MongoError"]
+
+
+class MongoError(Exception):
+    pass
+
+
+class Mongo:
+    metric_name = "app_mongo_stats"
+
+    def __init__(self, *, uri: str = "mongodb://localhost:27017",
+                 database: str = "test", client: Any = None) -> None:
+        self.uri = uri
+        self.database_name = database
+        self._client = client
+        self._db = None
+        self._logger = None
+        self._metrics = None
+        self._tracer = None
+
+    # -- provider contract -----------------------------------------------------
+    def use_logger(self, logger) -> None:
+        self._logger = logger
+
+    def use_metrics(self, metrics) -> None:
+        self._metrics = metrics
+
+    def use_tracer(self, tracer) -> None:
+        self._tracer = tracer
+
+    def connect(self) -> None:
+        if self._client is None:
+            try:
+                from pymongo import MongoClient  # type: ignore
+            except ImportError as exc:
+                raise MongoError(
+                    "no client injected and pymongo is not installed; pass "
+                    "Mongo(client=...)"
+                ) from exc
+            self._client = MongoClient(self.uri)
+        self._db = self._client[self.database_name]
+        if self._logger is not None:
+            self._logger.infof("mongo connected to %s/%s", self.uri,
+                               self.database_name)
+
+    def _observe(self, op: str, start: float, coll: str) -> None:
+        dur = time.perf_counter() - start
+        if self._metrics is not None:
+            try:
+                self._metrics.record_histogram(self.metric_name, dur,
+                                               operation=op, collection=coll)
+            except Exception:
+                pass
+        if self._logger is not None:
+            self._logger.debug({"datasource": "Mongo", "operation": op,
+                                "collection": coll,
+                                "duration_us": int(dur * 1e6)})
+
+    def _coll(self, name: str):
+        if self._db is None:
+            if self._client is not None:
+                self._db = self._client[self.database_name]
+            else:
+                raise MongoError("not connected (call connect or inject client)")
+        return self._db[name]
+
+    async def _run(self, op: str, coll: str, fn, *args, **kw):
+        start = time.perf_counter()
+        try:
+            return await asyncio.to_thread(fn, *args, **kw)
+        finally:
+            self._observe(op, start, coll)
+
+    # -- CRUD (reference container/datasources.go Mongo interface) -------------
+    async def find(self, collection: str, filter: dict | None = None, *,
+                   limit: int = 0, sort: Any = None) -> list[dict]:
+        def run():
+            cur = self._coll(collection).find(filter or {})
+            if sort:
+                cur = cur.sort(sort)
+            if limit:
+                cur = cur.limit(limit)
+            return list(cur)
+
+        return await self._run("find", collection, run)
+
+    async def find_one(self, collection: str, filter: dict | None = None) -> dict | None:
+        return await self._run("find_one", collection,
+                               self._coll(collection).find_one, filter or {})
+
+    async def insert_one(self, collection: str, document: dict) -> Any:
+        res = await self._run("insert_one", collection,
+                              self._coll(collection).insert_one, document)
+        return getattr(res, "inserted_id", res)
+
+    async def insert_many(self, collection: str, documents: list[dict]) -> list:
+        res = await self._run("insert_many", collection,
+                              self._coll(collection).insert_many, documents)
+        return list(getattr(res, "inserted_ids", []))
+
+    async def update_by_id(self, collection: str, id: Any, update: dict) -> int:
+        res = await self._run("update_by_id", collection,
+                              self._coll(collection).update_one,
+                              {"_id": id}, {"$set": update})
+        return getattr(res, "modified_count", 0)
+
+    async def update_one(self, collection: str, filter: dict, update: dict) -> int:
+        res = await self._run("update_one", collection,
+                              self._coll(collection).update_one, filter, update)
+        return getattr(res, "modified_count", 0)
+
+    async def update_many(self, collection: str, filter: dict, update: dict) -> int:
+        res = await self._run("update_many", collection,
+                              self._coll(collection).update_many, filter, update)
+        return getattr(res, "modified_count", 0)
+
+    async def delete_one(self, collection: str, filter: dict) -> int:
+        res = await self._run("delete_one", collection,
+                              self._coll(collection).delete_one, filter)
+        return getattr(res, "deleted_count", 0)
+
+    async def delete_many(self, collection: str, filter: dict) -> int:
+        res = await self._run("delete_many", collection,
+                              self._coll(collection).delete_many, filter)
+        return getattr(res, "deleted_count", 0)
+
+    async def count_documents(self, collection: str, filter: dict | None = None) -> int:
+        return await self._run("count", collection,
+                               self._coll(collection).count_documents, filter or {})
+
+    async def drop(self, collection: str) -> None:
+        await self._run("drop", collection, self._coll(collection).drop)
+
+    async def health_check(self) -> dict:
+        try:
+            if self._client is None:
+                raise MongoError("not connected")
+            cmd = getattr(self._client, "admin", None)
+            if cmd is not None and hasattr(cmd, "command"):
+                await asyncio.to_thread(cmd.command, "ping")
+        except Exception as exc:
+            return {"status": "DOWN", "details": {"uri": self.uri,
+                                                  "error": str(exc)[:200]}}
+        return {"status": "UP", "details": {"uri": self.uri,
+                                            "database": self.database_name}}
+
+    async def close(self) -> None:
+        if self._client is not None:
+            closer = getattr(self._client, "close", None)
+            if closer is not None:
+                await asyncio.to_thread(closer)

@@ -1,0 +1,126 @@
+"""OpenTSDB driver over its REST API.
+
+Reference: the largest separate datasource module (SURVEY §2.8,
+datasource/opentsdb, 1,755 LoC) — datapoint puts, queries with
+aggregators, annotations, and version/health. REST-native, implemented
+fully here.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from ._http import HTTPDriver
+
+__all__ = ["OpenTSDB", "OpenTSDBError", "DataPoint"]
+
+
+class OpenTSDBError(Exception):
+    pass
+
+
+class DataPoint(dict):
+    """{"metric", "timestamp", "value", "tags"} — dict subclass so callers
+    can build points literally or via this constructor."""
+
+    def __init__(self, metric: str, value: float, *, timestamp: int | None = None,
+                 tags: dict[str, str] | None = None) -> None:
+        super().__init__(metric=metric, value=value,
+                         timestamp=timestamp or int(time.time()),
+                         tags=tags or {"host": "gofr"})
+
+
+class OpenTSDB(HTTPDriver):
+    metric_name = "app_opentsdb_stats"
+
+    def __init__(self, host: str = "localhost", port: int = 4242, *,
+                 timeout: float = 10.0) -> None:
+        super().__init__(f"http://{host}:{port}", timeout=timeout)
+
+    async def _call(self, op: str, method: str, path: str, **kw) -> Any:
+        start = time.perf_counter()
+        status, body = await self._request(method, path, **kw)
+        self._observe(op, start, path)
+        out = self._json(body)
+        if status >= 400:
+            msg = out.get("error", {}).get("message", "") if isinstance(out, dict) else ""
+            raise OpenTSDBError(f"{status}: {msg or body[:200]!r}")
+        return out
+
+    # -- datapoints ------------------------------------------------------------
+    async def put_datapoints(self, points: list[dict], *,
+                             details: bool = True) -> dict:
+        params = {"details": "true"} if details else None
+        out = await self._call("put", "POST", "/api/put", json_body=points,
+                               params=params)
+        return out or {}
+
+    async def query(self, *, start: str | int, metric: str,
+                    aggregator: str = "sum", end: str | int | None = None,
+                    tags: dict[str, str] | None = None,
+                    downsample: str | None = None) -> list[dict]:
+        sub: dict[str, Any] = {"aggregator": aggregator, "metric": metric}
+        if tags:
+            sub["tags"] = tags
+        if downsample:
+            sub["downsample"] = downsample
+        body: dict[str, Any] = {"start": start, "queries": [sub]}
+        if end is not None:
+            body["end"] = end
+        return await self._call("query", "POST", "/api/query", json_body=body) or []
+
+    async def query_last(self, metric: str, tags: dict[str, str] | None = None
+                         ) -> list[dict]:
+        body = {"queries": [{"metric": metric, "tags": tags or {}}],
+                "resolveNames": True, "backScan": 24}
+        return await self._call("query_last", "POST", "/api/query/last",
+                                json_body=body) or []
+
+    # -- annotations -----------------------------------------------------------
+    async def post_annotation(self, start_time: int, *, description: str = "",
+                              notes: str = "", tsuid: str | None = None) -> dict:
+        body: dict[str, Any] = {"startTime": start_time,
+                                "description": description, "notes": notes}
+        if tsuid:
+            body["tsuid"] = tsuid
+        return await self._call("annotation", "POST", "/api/annotation",
+                                json_body=body) or {}
+
+    async def query_annotation(self, start_time: int,
+                               tsuid: str | None = None) -> dict:
+        params = {"start_time": str(start_time)}
+        if tsuid:
+            params["tsuid"] = tsuid
+        return await self._call("annotation_get", "GET", "/api/annotation",
+                                params=params) or {}
+
+    async def delete_annotation(self, start_time: int,
+                                tsuid: str | None = None) -> None:
+        params = {"start_time": str(start_time)}
+        if tsuid:
+            params["tsuid"] = tsuid
+        await self._call("annotation_del", "DELETE", "/api/annotation",
+                         params=params)
+
+    # -- metadata --------------------------------------------------------------
+    async def aggregators(self) -> list[str]:
+        return await self._call("aggregators", "GET", "/api/aggregators") or []
+
+    async def suggest(self, type_: str = "metrics", q: str = "",
+                      max_results: int = 25) -> list[str]:
+        return await self._call("suggest", "GET", "/api/suggest",
+                                params={"type": type_, "q": q,
+                                        "max": str(max_results)}) or []
+
+    async def version(self) -> dict:
+        return await self._call("version", "GET", "/api/version") or {}
+
+    async def health_check(self) -> dict:
+        try:
+            v = await self.version()
+        except Exception as exc:
+            return {"status": "DOWN", "details": {"host": self.base_url,
+                                                  "error": str(exc)[:200]}}
+        return {"status": "UP", "details": {"host": self.base_url,
+                                            "version": v.get("version", "?")}}

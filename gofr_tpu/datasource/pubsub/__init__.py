@@ -205,6 +205,13 @@ def new_pubsub(backend: str, config, logger=None, metrics=None):
         )
         r.connect()
         return RedisListBroker(r, logger, metrics)
-    if backend in ("kafka", "mqtt", "google", "nats", "eventhub"):
+    if backend == "nats":
+        from .nats import NATS
+
+        broker = config.get_or_default("PUBSUB_BROKER", "localhost:4222")
+        host, _, port = broker.partition(":")
+        return NATS(host or "localhost", int(port or 4222),
+                    logger=logger, metrics=metrics)
+    if backend in ("kafka", "mqtt", "google", "eventhub"):
         raise UnavailableDriverError(backend, f"{backend} client")
     raise ValueError(f"unsupported PUBSUB_BACKEND {backend!r}")

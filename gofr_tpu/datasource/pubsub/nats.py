@@ -1,0 +1,169 @@
+"""NATS pub/sub backend: a from-scratch client for the core NATS protocol.
+
+Reference: separate module over nats.go/JetStream with connection, stream
+and subscription managers (SURVEY §2.8, datasource/pubsub/nats). No Python
+NATS client ships in this image, and core NATS is a simple text protocol
+(INFO/CONNECT/PUB/SUB/MSG/PING/PONG), so — like the RESP client in
+datasource/redis — this implements the wire protocol directly over asyncio
+streams. JetStream persistence is out of scope; delivery semantics here
+are core-NATS at-most-once (commit/nack are no-ops, as with the
+reference's core-NATS mode).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any
+
+from . import Message
+
+__all__ = ["NATS", "NATSError"]
+
+
+class NATSError(Exception):
+    pass
+
+
+class NATS:
+    """PubSub-protocol implementation over one NATS connection."""
+
+    def __init__(self, host: str = "localhost", port: int = 4222, *,
+                 name: str = "gofr-tpu", logger=None, metrics=None) -> None:
+        self.host, self.port, self.name = host, port, name
+        self._logger = logger
+        self._metrics = metrics
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._queues: dict[int, asyncio.Queue] = {}
+        self._subjects: dict[str, int] = {}
+        self._next_sid = 1
+        self._reader_task: asyncio.Task | None = None
+        self._server_info: dict = {}
+        self._lock = asyncio.Lock()
+        self._connected = False
+
+    # -- provider contract -----------------------------------------------------
+    def use_logger(self, logger) -> None:
+        self._logger = logger
+
+    def use_metrics(self, metrics) -> None:
+        self._metrics = metrics
+
+    def use_tracer(self, tracer) -> None:
+        pass
+
+    def connect(self) -> None:
+        """Lazy: the socket dials on first use inside the running loop."""
+
+    async def _ensure(self) -> None:
+        if self._connected:
+            return
+        async with self._lock:
+            if self._connected:
+                return
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port)
+            line = await self._reader.readline()  # INFO {...}
+            if not line.startswith(b"INFO "):
+                raise NATSError(f"unexpected greeting {line[:40]!r}")
+            self._server_info = json.loads(line[5:].decode())
+            opts = {"verbose": False, "pedantic": False, "name": self.name,
+                    "lang": "python", "version": "0.1", "protocol": 1}
+            self._writer.write(f"CONNECT {json.dumps(opts)}\r\nPING\r\n".encode())
+            await self._writer.drain()
+            # consume through the PONG that answers our PING
+            while True:
+                line = await self._reader.readline()
+                if line.startswith(b"PONG"):
+                    break
+                if line.startswith(b"-ERR"):
+                    raise NATSError(line.decode().strip())
+            self._connected = True
+            self._reader_task = asyncio.get_running_loop().create_task(
+                self._read_loop(), name="gofr-nats-reader")
+            if self._logger is not None:
+                self._logger.infof("nats connected to %s:%d", self.host, self.port)
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                line = await self._reader.readline()
+                if not line:
+                    break
+                if line.startswith(b"MSG "):
+                    parts = line[4:].strip().split(b" ")
+                    # subject sid [reply] nbytes
+                    subject = parts[0].decode()
+                    sid = int(parts[1])
+                    nbytes = int(parts[-1])
+                    payload = await self._reader.readexactly(nbytes + 2)
+                    q = self._queues.get(sid)
+                    if q is not None:
+                        q.put_nowait((subject, payload[:-2]))
+                elif line.startswith(b"PING"):
+                    self._writer.write(b"PONG\r\n")
+                    await self._writer.drain()
+                # +OK / PONG / INFO updates are ignored
+        except (asyncio.CancelledError, ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            self._connected = False
+
+    def _count(self, metric: str, topic: str) -> None:
+        if self._metrics is not None:
+            try:
+                self._metrics.increment_counter(metric, topic=topic)
+            except Exception:
+                pass
+
+    # -- PubSub protocol -------------------------------------------------------
+    async def publish(self, topic: str, message: bytes | str) -> None:
+        await self._ensure()
+        payload = message.encode() if isinstance(message, str) else bytes(message)
+        self._writer.write(b"PUB %s %d\r\n%s\r\n"
+                           % (topic.encode(), len(payload), payload))
+        await self._writer.drain()
+        self._count("app_pubsub_publish_total_count", topic)
+
+    async def subscribe(self, topic: str) -> Message:
+        await self._ensure()
+        sid = self._subjects.get(topic)
+        if sid is None:
+            sid = self._next_sid
+            self._next_sid += 1
+            self._subjects[topic] = sid
+            self._queues[sid] = asyncio.Queue()
+            self._writer.write(b"SUB %s %d\r\n" % (topic.encode(), sid))
+            await self._writer.drain()
+        subject, payload = await self._queues[sid].get()
+        self._count("app_pubsub_subscribe_total_count", topic)
+        return Message(subject, payload, committer=None)
+
+    def create_topic(self, name: str) -> None:
+        """Core NATS subjects are implicit; kept for protocol parity."""
+
+    def delete_topic(self, name: str) -> None:
+        sid = self._subjects.pop(name, None)
+        if sid is not None and self._writer is not None:
+            self._writer.write(b"UNSUB %d\r\n" % sid)
+            self._queues.pop(sid, None)
+
+    def health_check(self) -> dict:
+        status = "UP" if self._connected else "DOWN"
+        return {"status": status,
+                "details": {"host": f"{self.host}:{self.port}",
+                            "server": self._server_info.get("server_name", "?"),
+                            "subscriptions": sorted(self._subjects)}}
+
+    async def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+        self._connected = False

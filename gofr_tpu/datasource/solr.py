@@ -1,0 +1,109 @@
+"""Apache Solr driver over its REST API.
+
+Reference: separate module with search + document + schema ops over REST
+(SURVEY §2.8, datasource/solr, 571 LoC). Solr is REST-native, so this
+driver is a complete implementation, not a gated wrapper.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from ._http import HTTPDriver
+
+__all__ = ["Solr", "SolrError"]
+
+
+class SolrError(Exception):
+    pass
+
+
+class Solr(HTTPDriver):
+    metric_name = "app_solr_stats"
+
+    def __init__(self, host: str = "localhost", port: int = 8983, *,
+                 timeout: float = 10.0) -> None:
+        super().__init__(f"http://{host}:{port}/solr", timeout=timeout)
+
+    async def _call(self, op: str, method: str, path: str, **kw) -> Any:
+        start = time.perf_counter()
+        status, body = await self._request(method, path, **kw)
+        self._observe(op, start, path)
+        out = self._json(body)
+        if status >= 400:
+            msg = ""
+            if isinstance(out, dict):
+                msg = out.get("error", {}).get("msg", "")
+            raise SolrError(f"{status}: {msg or body[:200]!r}")
+        return out
+
+    # -- documents -------------------------------------------------------------
+    async def search(self, collection: str, query: str = "*:*", *,
+                     fields: str | None = None, rows: int = 10,
+                     start: int = 0, sort: str | None = None,
+                     filters: list[str] | None = None) -> dict:
+        params: dict[str, Any] = {"q": query, "rows": str(rows),
+                                  "start": str(start), "wt": "json"}
+        if fields:
+            params["fl"] = fields
+        if sort:
+            params["sort"] = sort
+        if filters:
+            params["fq"] = filters
+        out = await self._call("search", "GET", f"/{collection}/select",
+                               params=params)
+        return out.get("response", {})
+
+    async def create(self, collection: str, docs: list[dict],
+                     *, commit: bool = True) -> None:
+        params = {"commit": "true"} if commit else None
+        await self._call("create", "POST", f"/{collection}/update",
+                         json_body=docs, params=params)
+
+    async def update(self, collection: str, docs: list[dict],
+                     *, commit: bool = True) -> None:
+        await self.create(collection, docs, commit=commit)
+
+    async def delete(self, collection: str, *, ids: list[str] | None = None,
+                     query: str | None = None, commit: bool = True) -> None:
+        body: dict[str, Any] = {}
+        if ids:
+            body["delete"] = ids
+        elif query:
+            body["delete"] = {"query": query}
+        else:
+            raise ValueError("delete needs ids or query")
+        params = {"commit": "true"} if commit else None
+        await self._call("delete", "POST", f"/{collection}/update",
+                         json_body=body, params=params)
+
+    # -- schema ----------------------------------------------------------------
+    async def retrieve_schema(self, collection: str) -> dict:
+        out = await self._call("schema", "GET", f"/{collection}/schema")
+        return out.get("schema", {})
+
+    async def add_field(self, collection: str, name: str, type_: str, *,
+                        stored: bool = True, indexed: bool = True) -> None:
+        await self._call("add_field", "POST", f"/{collection}/schema",
+                         json_body={"add-field": {
+                             "name": name, "type": type_,
+                             "stored": stored, "indexed": indexed}})
+
+    async def update_field(self, collection: str, name: str, type_: str) -> None:
+        await self._call("update_field", "POST", f"/{collection}/schema",
+                         json_body={"replace-field": {"name": name, "type": type_}})
+
+    async def delete_field(self, collection: str, name: str) -> None:
+        await self._call("delete_field", "POST", f"/{collection}/schema",
+                         json_body={"delete-field": {"name": name}})
+
+    async def health_check(self) -> dict:
+        try:
+            out = await self._call("health", "GET",
+                                   "/admin/cores", params={"action": "STATUS"})
+            cores = sorted((out or {}).get("status", {}).keys())
+        except Exception as exc:
+            return {"status": "DOWN", "details": {"host": self.base_url,
+                                                  "error": str(exc)[:200]}}
+        return {"status": "UP", "details": {"host": self.base_url, "cores": cores}}

@@ -1,0 +1,119 @@
+"""Aux verticals: remote log level, zip upload util, OAuth service option."""
+
+import io
+import zipfile
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from gofr_tpu.fileutil import Zip
+from gofr_tpu.logging import Level, new_logger
+from gofr_tpu.logging.remote import RemoteLevelUpdater, extract_level
+
+
+def test_extract_level_shapes():
+    assert extract_level("DEBUG") == "DEBUG"
+    assert extract_level({"data": {"logLevel": "WARN"}}) == "WARN"
+    assert extract_level({"data": [{"serviceName": "x",
+                                    "logLevel": {"LOG_LEVEL": "ERROR"}}]}) == "ERROR"
+    assert extract_level({"level": "INFO"}) == "INFO"
+    assert extract_level({"data": []}) is None
+    assert extract_level(42) is None
+
+
+def test_remote_level_poll_applies_change(run, capsys):
+    async def scenario():
+        level_holder = {"level": "DEBUG"}
+
+        async def handler(request):
+            return web.json_response({"data": {"logLevel": level_holder["level"]}})
+
+        app = web.Application()
+        app.add_routes([web.get("/level", handler)])
+        server = TestServer(app)
+        await server.start_server()
+        logger = new_logger("INFO")
+        upd = RemoteLevelUpdater(
+            logger, f"http://{server.host}:{server.port}/level", 0.01)
+        try:
+            assert await upd.poll_once()
+            first = logger.level
+            level_holder["level"] = "ERROR"
+            assert await upd.poll_once()
+            second = logger.level
+            level_holder["level"] = "NOT_A_LEVEL"
+            assert not await upd.poll_once()
+            return first, second, logger.level
+        finally:
+            await server.close()
+
+    first, second, final = run(scenario())
+    assert first == Level.DEBUG
+    assert second == Level.ERROR
+    assert final == Level.ERROR  # bad value ignored
+
+
+def _zip_bytes(entries: dict[str, bytes]) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        for name, data in entries.items():
+            zf.writestr(name, data)
+    return buf.getvalue()
+
+
+def test_zip_parses_entries(tmp_path):
+    z = Zip(_zip_bytes({"a.txt": b"alpha", "sub/b.csv": b"1,2"}))
+    assert z.files == {"a.txt": b"alpha", "sub/b.csv": b"1,2"}
+    written = z.create_local_copies(str(tmp_path))
+    assert sorted(p.split("/")[-1] for p in written) == ["a.txt", "b.csv"]
+    assert (tmp_path / "sub" / "b.csv").read_bytes() == b"1,2"
+
+
+def test_zip_blocks_path_traversal(tmp_path):
+    z = Zip(_zip_bytes({"ok.txt": b"x"}))
+    z.files["../evil.txt"] = b"bad"  # forge a traversal entry
+    with pytest.raises(ValueError):
+        z.create_local_copies(str(tmp_path))
+
+
+def test_oauth_service_fetches_and_caches_token(run):
+    from gofr_tpu.service import OAuthConfig, new_http_service
+
+    async def scenario():
+        token_calls = {"n": 0}
+
+        async def token(request):
+            token_calls["n"] += 1
+            form = await request.post()
+            assert form["grant_type"] == "client_credentials"
+            assert form["client_id"] == "cid"
+            return web.json_response({"access_token": f"tok{token_calls['n']}",
+                                      "expires_in": 3600})
+
+        async def api(request):
+            return web.json_response(
+                {"auth": request.headers.get("Authorization", "")})
+
+        app = web.Application()
+        app.add_routes([web.post("/token", token), web.get("/api", api)])
+        server = TestServer(app)
+        await server.start_server()
+        base = f"http://{server.host}:{server.port}"
+        svc = new_http_service(
+            base, None, None, None,
+            OAuthConfig(client_id="cid", client_secret="sec",
+                        token_url=f"{base}/token"),
+        )
+        try:
+            r1 = await svc.get("/api")
+            r2 = await svc.get("/api")
+            return r1.json(), r2.json(), token_calls["n"]
+        finally:
+            await svc.close()
+            await server.close()
+
+    j1, j2, calls = run(scenario())
+    assert j1["auth"] == "Bearer tok1"
+    assert j2["auth"] == "Bearer tok1"  # cached
+    assert calls == 1

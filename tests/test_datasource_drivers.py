@@ -1,0 +1,424 @@
+"""Datasource drivers against in-process fakes — the analogue of the
+reference's hermetic pkg tests (SURVEY §4: containerized brokers in CI,
+mocks in unit tests): HTTP drivers hit aiohttp fake servers speaking each
+protocol; Cassandra/Mongo wrap fake injected clients; NATS talks to a mini
+server speaking the real wire protocol.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from gofr_tpu.datasource.cassandra import Cassandra, CassandraError
+from gofr_tpu.datasource.clickhouse import ClickHouse, ClickHouseError
+from gofr_tpu.datasource.dgraph import Dgraph
+from gofr_tpu.datasource.mongo import Mongo
+from gofr_tpu.datasource.opentsdb import OpenTSDB
+from gofr_tpu.datasource.pubsub.nats import NATS
+from gofr_tpu.datasource.solr import Solr
+
+
+async def _serve(routes) -> TestServer:
+    app = web.Application()
+    app.add_routes(routes)
+    server = TestServer(app)
+    await server.start_server()
+    return server
+
+
+# ------------------------------------------------------------------ clickhouse
+def test_clickhouse_select_insert_health(run):
+    tables: dict[str, list] = {"t": []}
+
+    async def handler(request: web.Request):
+        q = request.query.get("query") or (await request.text())
+        if q.startswith("INSERT INTO"):
+            table = q.split()[2]
+            body = await request.text()
+            tables.setdefault(table, []).extend(
+                json.loads(line) for line in body.splitlines() if line.strip())
+            return web.Response(text="")
+        if "SELECT 1" in q:
+            return web.Response(text='{"ok":1}\n')
+        if q.startswith("SELECT * FROM t"):
+            return web.Response(
+                text="".join(json.dumps(r) + "\n" for r in tables["t"]))
+        if q.startswith("BAD"):
+            return web.Response(status=400, text="Syntax error")
+        return web.Response(text="")
+
+    async def scenario():
+        server = await _serve([web.post("/", handler)])
+        ch = ClickHouse(host=server.host, port=server.port)
+        try:
+            await ch.insert_rows("t", [{"id": 1}, {"id": 2}])
+            rows = await ch.select("SELECT * FROM t")
+            h = await ch.health_check()
+            with pytest.raises(ClickHouseError):
+                await ch.exec("BAD QUERY")
+            return rows, h
+        finally:
+            await ch.close()
+            await server.close()
+
+    rows, h = run(scenario())
+    assert rows == [{"id": 1}, {"id": 2}]
+    assert h["status"] == "UP"
+
+
+# ------------------------------------------------------------------------ solr
+def test_solr_crud_and_schema(run):
+    docs: list = []
+
+    async def update(request: web.Request):
+        body = await request.json()
+        if isinstance(body, list):
+            docs.extend(body)
+        elif "delete" in body:
+            docs.clear()
+        return web.json_response({"responseHeader": {"status": 0}})
+
+    async def select(request: web.Request):
+        return web.json_response(
+            {"response": {"numFound": len(docs), "docs": docs}})
+
+    async def cores(request: web.Request):
+        return web.json_response({"status": {"core0": {}}})
+
+    async def schema(request: web.Request):
+        if request.method == "GET":
+            return web.json_response({"schema": {"name": "s", "fields": []}})
+        return web.json_response({"responseHeader": {"status": 0}})
+
+    async def scenario():
+        server = await _serve([
+            web.post("/solr/c/update", update),
+            web.get("/solr/c/select", select),
+            web.get("/solr/admin/cores", cores),
+            web.get("/solr/c/schema", schema),
+            web.post("/solr/c/schema", schema),
+        ])
+        s = Solr(host=server.host, port=server.port)
+        s.base_url = f"http://{server.host}:{server.port}/solr"
+        try:
+            await s.create("c", [{"id": "1", "name": "ada"}])
+            found = await s.search("c", "name:ada")
+            sch = await s.retrieve_schema("c")
+            await s.add_field("c", "age", "pint")
+            h = await s.health_check()
+            await s.delete("c", query="*:*")
+            empty = await s.search("c")
+            return found, sch, h, empty
+        finally:
+            await s.close()
+            await server.close()
+
+    found, sch, h, empty = run(scenario())
+    assert found["numFound"] == 1 and found["docs"][0]["name"] == "ada"
+    assert sch["name"] == "s"
+    assert h["status"] == "UP" and h["details"]["cores"] == ["core0"]
+    assert empty["numFound"] == 0
+
+
+# -------------------------------------------------------------------- opentsdb
+def test_opentsdb_put_query_annotations(run):
+    points: list = []
+
+    async def put(request: web.Request):
+        points.extend(await request.json())
+        return web.json_response({"success": len(points), "failed": 0})
+
+    async def query(request: web.Request):
+        body = await request.json()
+        m = body["queries"][0]["metric"]
+        return web.json_response(
+            [{"metric": m, "dps": {str(p["timestamp"]): p["value"]}
+              } for p in points if p["metric"] == m])
+
+    async def version(request: web.Request):
+        return web.json_response({"version": "2.4.0"})
+
+    async def annotation(request: web.Request):
+        return web.json_response(await request.json())
+
+    async def aggregators(request: web.Request):
+        return web.json_response(["sum", "avg", "max"])
+
+    async def scenario():
+        server = await _serve([
+            web.post("/api/put", put),
+            web.post("/api/query", query),
+            web.get("/api/version", version),
+            web.post("/api/annotation", annotation),
+            web.get("/api/aggregators", aggregators),
+        ])
+        db = OpenTSDB(host=server.host, port=server.port)
+        try:
+            res = await db.put_datapoints(
+                [{"metric": "cpu", "timestamp": 1000, "value": 0.5,
+                  "tags": {"host": "a"}}])
+            q = await db.query(start=900, metric="cpu")
+            aggs = await db.aggregators()
+            ann = await db.post_annotation(1000, description="deploy")
+            h = await db.health_check()
+            return res, q, aggs, ann, h
+        finally:
+            await db.close()
+            await server.close()
+
+    res, q, aggs, ann, h = run(scenario())
+    assert res["success"] == 1
+    assert q[0]["metric"] == "cpu" and q[0]["dps"] == {"1000": 0.5}
+    assert aggs == ["sum", "avg", "max"]
+    assert ann["description"] == "deploy"
+    assert h["status"] == "UP" and h["details"]["version"] == "2.4.0"
+
+
+# ---------------------------------------------------------------------- dgraph
+def test_dgraph_query_mutate_alter_health(run):
+    store: dict = {}
+
+    async def mutate(request: web.Request):
+        body = json.loads(await request.text())
+        for obj in body.get("set", []):
+            store[obj["uid"]] = obj
+        return web.json_response({"data": {"code": "Success",
+                                           "uids": {o["uid"]: o["uid"]
+                                                    for o in body.get("set", [])}}})
+
+    async def query(request: web.Request):
+        return web.json_response({"data": {"all": list(store.values())}})
+
+    async def alter(request: web.Request):
+        return web.json_response({"data": {"code": "Success"}})
+
+    async def health(request: web.Request):
+        return web.json_response([{"status": "healthy", "version": "v23"}])
+
+    async def scenario():
+        server = await _serve([
+            web.post("/mutate", mutate), web.post("/query", query),
+            web.post("/alter", alter), web.get("/health", health),
+        ])
+        dg = Dgraph(host=server.host, port=server.port)
+        try:
+            await dg.alter("name: string @index(term) .")
+            m = await dg.mutate(set_json=[{"uid": "_:a", "name": "ada"}])
+            q = await dg.query("{ all(func: has(name)) { name } }")
+            h = await dg.health_check()
+            return m, q, h
+        finally:
+            await dg.close()
+            await server.close()
+
+    m, q, h = run(scenario())
+    assert m["code"] == "Success"
+    assert q["all"][0]["name"] == "ada"
+    assert h["status"] == "UP" and h["details"]["version"] == "v23"
+
+
+# ------------------------------------------------------- injected-client duos
+class _FakeCassandraSession:
+    def __init__(self):
+        self.rows = [{"release_version": "4.1"}]
+        self.executed = []
+
+    def execute(self, stmt, params=()):
+        self.executed.append((str(stmt), tuple(params or ())))
+        if "SELECT" in str(stmt):
+            return self.rows
+        return []
+
+    def shutdown(self):
+        self.executed.append(("shutdown", ()))
+
+
+def test_cassandra_injected_session(run):
+    async def scenario():
+        sess = _FakeCassandraSession()
+        db = Cassandra(session=sess, keyspace="ks")
+        rows = await db.query("SELECT * FROM users WHERE id=%s", [1])
+        await db.exec("INSERT INTO users (id) VALUES (%s)", [2])
+        await db.batch_exec([("UPDATE a", None), ("UPDATE b", None)])
+        h = await db.health_check()
+        await db.close()
+        return sess, rows, h
+
+    sess, rows, h = run(scenario())
+    assert rows == [{"release_version": "4.1"}]
+    assert h["status"] == "UP"
+    assert ("shutdown", ()) in sess.executed
+    assert any("INSERT" in s for s, _ in sess.executed)
+
+
+def test_cassandra_unconnected_raises(run):
+    async def scenario():
+        db = Cassandra()
+        with pytest.raises(CassandraError):
+            await db.query("SELECT 1")
+
+    run(scenario())
+
+
+class _FakeMongoCollection:
+    def __init__(self):
+        self.docs = []
+
+    def find(self, f):
+        return [dict(d) for d in self.docs
+                if all(d.get(k) == v for k, v in f.items())]
+
+    def find_one(self, f):
+        rows = self.find(f)
+        return rows[0] if rows else None
+
+    def insert_one(self, doc):
+        self.docs.append(doc)
+
+        class R:
+            inserted_id = doc.get("_id", len(self.docs))
+
+        return R()
+
+    def update_one(self, f, update):
+        class R:
+            modified_count = 0
+
+        for d in self.docs:
+            if all(d.get(k) == v for k, v in f.items()):
+                d.update(update.get("$set", {}))
+                R.modified_count = 1
+                break
+        return R()
+
+    def delete_many(self, f):
+        before = len(self.docs)
+        self.docs = [d for d in self.docs
+                     if not all(d.get(k) == v for k, v in f.items())]
+
+        class R:
+            deleted_count = before - len(self.docs)
+
+        return R()
+
+    def count_documents(self, f):
+        return len(self.find(f))
+
+    def drop(self):
+        self.docs = []
+
+
+class _FakeMongoClient:
+    def __init__(self):
+        self.dbs: dict = {}
+
+        class _Admin:
+            def command(self, name):
+                return {"ok": 1}
+
+        self.admin = _Admin()
+
+    def __getitem__(self, name):
+        return self.dbs.setdefault(name, {})
+
+    def close(self):
+        self.closed = True
+
+
+def test_mongo_injected_client(run):
+    async def scenario():
+        client = _FakeMongoClient()
+        db_map: dict = {}
+
+        class _DB(dict):
+            def __getitem__(self, coll):
+                return db_map.setdefault(coll, _FakeMongoCollection())
+
+        client.dbs["appdb"] = _DB()
+        m = Mongo(client=client, database="appdb")
+        m.connect()
+        await m.insert_one("users", {"_id": 1, "name": "ada"})
+        found = await m.find_one("users", {"name": "ada"})
+        n = await m.update_one("users", {"_id": 1}, {"$set": {"name": "lovelace"}})
+        cnt = await m.count_documents("users")
+        deleted = await m.delete_many("users", {"_id": 1})
+        h = await m.health_check()
+        await m.close()
+        return found, n, cnt, deleted, h
+
+    found, n, cnt, deleted, h = run(scenario())
+    assert found["name"] == "ada"
+    assert n == 1 and cnt == 1 and deleted == 1
+    assert h["status"] == "UP"
+
+
+# ------------------------------------------------------------------------ nats
+class _MiniNATS:
+    """In-process server speaking enough of the NATS protocol for the client."""
+
+    def __init__(self):
+        self.server = None
+        self.subs: dict[str, list] = {}  # subject -> [(writer, sid)]
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._client, "127.0.0.1", 0)
+        return self.server.sockets[0].getsockname()[1]
+
+    async def _client(self, reader, writer):
+        writer.write(b'INFO {"server_name":"mini","max_payload":1048576}\r\n')
+        await writer.drain()
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                if line.startswith(b"CONNECT"):
+                    pass
+                elif line.startswith(b"PING"):
+                    writer.write(b"PONG\r\n")
+                    await writer.drain()
+                elif line.startswith(b"SUB "):
+                    _, subject, sid = line.split()
+                    self.subs.setdefault(subject.decode(), []).append(
+                        (writer, int(sid)))
+                elif line.startswith(b"PUB "):
+                    parts = line.split()
+                    subject, nbytes = parts[1].decode(), int(parts[-1])
+                    payload = (await reader.readexactly(nbytes + 2))[:-2]
+                    for w, sid in self.subs.get(subject, []):
+                        w.write(b"MSG %s %d %d\r\n%s\r\n"
+                                % (subject.encode(), sid, len(payload), payload))
+                        await w.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+
+    async def stop(self):
+        self.server.close()
+        # no wait_closed(): it can hang on 3.12 when handlers linger; the
+        # test loop is torn down right after anyway
+
+
+def test_nats_roundtrip_and_health(run):
+    async def scenario():
+        mini = _MiniNATS()
+        port = await mini.start()
+        n = NATS("127.0.0.1", port)
+        try:
+            sub_task = asyncio.create_task(n.subscribe("orders"))
+            await asyncio.sleep(0.05)  # let SUB register
+            await n.publish("orders", b'{"id": 7}')
+            msg = await asyncio.wait_for(sub_task, timeout=2)
+            h = n.health_check()
+            body = await msg.bind()
+            return msg.topic, body, h
+        finally:
+            await n.close()
+            await mini.stop()
+
+    topic, body, h = run(scenario())
+    assert topic == "orders"
+    assert body == {"id": 7}
+    assert h["status"] == "UP" and h["details"]["server"] == "mini"
