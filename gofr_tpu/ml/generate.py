@@ -161,8 +161,9 @@ class Generator:
 
         # donate the cache: in-place KV update on device, no copy per step
         self._chunk_fn = jax.jit(chunk_fn, donate_argnums=(2,))
-        self._prefill = jax.jit(
-            lambda p, t, l, c: llama.prefill(p, t, l, cfg, c)
+        self._prefill_into = jax.jit(
+            lambda p, t, l, c, slot: llama.prefill_into(p, t, l, cfg, c, slot),
+            donate_argnums=(3,),
         )
 
     # -- request management ---------------------------------------------------
@@ -186,18 +187,11 @@ class Generator:
         bucket = next((b for b in self.prefill_buckets if n <= b), self.max_seq)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = ids
-        tmp_cache = self._m.init_cache(self.cfg, 1, self.max_seq)
         with self._mesh_ctx():
-            logits, filled = self._prefill(
+            logits, self.cache = self._prefill_into(
                 self.params, jnp.asarray(padded), jnp.asarray([n], np.int32),
-                tmp_cache,
+                self.cache, jnp.int32(i),
             )
-        # scatter the prefilled row into slot i of the shared cache
-        self.cache = {
-            "k": self.cache["k"].at[:, i].set(filled["k"][:, 0]),
-            "v": self.cache["v"].at[:, i].set(filled["v"][:, 0]),
-            "len": self.cache["len"].at[i].set(n),
-        }
         key = jax.random.fold_in(self._prefill_key, self._n_requests)
         self._n_requests += 1
         first = int(sample_logits(logits, key, self.sampler)[0])

@@ -260,6 +260,26 @@ def prefill(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
     return logits, cache
 
 
+def prefill_into(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
+                 cfg: LlamaConfig, cache: dict, slot: jnp.ndarray
+                 ) -> tuple[jnp.ndarray, dict]:
+    """Prefill ONE prompt [1, S_pad] directly into row ``slot`` of a shared
+    multi-slot cache. One jitted program per request (donate the cache!):
+    the eager pad + scatter of the two-step prefill would copy the whole
+    cache through HBM outside XLA's control.
+    """
+    logits, filled = prefill(params, tokens, seq_lens, cfg,
+                             init_cache(cfg, 1, cache["k"].shape[2]))
+    new_cache = {
+        "k": jax.lax.dynamic_update_index_in_dim(
+            cache["k"], filled["k"][:, 0], slot, axis=1),
+        "v": jax.lax.dynamic_update_index_in_dim(
+            cache["v"], filled["v"][:, 0], slot, axis=1),
+        "len": cache["len"].at[slot].set(seq_lens[0]),
+    }
+    return logits, new_cache
+
+
 def decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
                 cfg: LlamaConfig) -> tuple[jnp.ndarray, dict]:
     """One token per row: tokens [B] -> (logits [B, V], updated cache).

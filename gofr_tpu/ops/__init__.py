@@ -104,7 +104,11 @@ def attention(
     ``kv_len`` masks out cache slots beyond the valid length, per batch row.
     """
     scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    # inputs stay in their native dtype (bf16 on the serving path) with f32
+    # MXU accumulation — casting k/v to f32 first would double the HBM
+    # traffic of every KV-cache sweep
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
     logits *= scale
     tq, tk = q.shape[1], k.shape[1]
     mask = None
@@ -120,7 +124,8 @@ def attention(
     if mask is not None:
         logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
     return out.astype(q.dtype)
 
 
