@@ -115,7 +115,13 @@ class S3FileSystem:
     def _request(self, method: str, key: str, *, body: bytes = b"",
                  query: dict[str, str] | None = None) -> tuple[int, bytes, dict]:
         path = f"/{self.bucket}/{urllib.parse.quote(key)}" if key else f"/{self.bucket}"
-        qs = urllib.parse.urlencode(sorted((query or {}).items()))
+        # SigV4 canonical query: each key/value RFC3986-encoded (space -> %20,
+        # nothing "safe"); urlencode's application/x-www-form-urlencoded
+        # '+' for space breaks the signature on prefixes containing spaces.
+        qs = "&".join(
+            f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+            for k, v in sorted((query or {}).items())
+        )
         now = datetime.datetime.now(datetime.timezone.utc)
         amz_date = now.strftime("%Y%m%dT%H%M%SZ")
         datestamp = now.strftime("%Y%m%d")
@@ -214,39 +220,49 @@ class S3FileSystem:
     def mkdir_all(self, name: str) -> None:
         self.mkdir(name)
 
+    def _list_pages(self, query: dict[str, str]):
+        """Yield parsed ListObjectsV2 page roots, following continuation
+        tokens — S3 caps each response at 1000 keys."""
+        query = dict(query)
+        while True:
+            status, data, _ = self._request("GET", "", query=query)
+            if status >= 300:
+                raise S3Error(f"LIST {query.get('prefix', '')}: {status} {data[:200]!r}")
+            root = ET.fromstring(data)
+            ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
+            yield ns, root
+            truncated = root.findtext(f"{ns}IsTruncated")
+            token = root.findtext(f"{ns}NextContinuationToken")
+            if truncated != "true" or not token:
+                return
+            query["continuation-token"] = token
+
     def read_dir(self, name: str) -> list[str]:
         prefix = self._full(name).rstrip("/")
         prefix = prefix + "/" if prefix else ""
         start = time.perf_counter()
-        status, data, _ = self._request(
-            "GET", "", query={"list-type": "2", "prefix": prefix,
-                              "delimiter": "/"})
-        self._observe("list", start)
-        if status >= 300:
-            raise S3Error(f"LIST {prefix}: {status} {data[:200]!r}")
-        root = ET.fromstring(data)
-        ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
         names = []
-        for el in root.iter(f"{ns}Key"):
-            rel = el.text[len(prefix):]
-            if rel and "/" not in rel.rstrip("/"):
-                names.append(rel)
-        for el in root.iter(f"{ns}Prefix"):
-            rel = (el.text or "")[len(prefix):]
-            if rel and rel != "/":
-                names.append(rel.rstrip("/"))
+        for ns, root in self._list_pages(
+            {"list-type": "2", "prefix": prefix, "delimiter": "/"}
+        ):
+            for el in root.iter(f"{ns}Key"):
+                rel = el.text[len(prefix):]
+                if rel and "/" not in rel.rstrip("/"):
+                    names.append(rel)
+            for el in root.iter(f"{ns}Prefix"):
+                rel = (el.text or "")[len(prefix):]
+                if rel and rel != "/":
+                    names.append(rel.rstrip("/"))
+        self._observe("list", start)
         return sorted(set(names))
 
     def remove_all(self, name: str) -> None:
         prefix = self._full(name).rstrip("/") + "/"
-        status, data, _ = self._request(
-            "GET", "", query={"list-type": "2", "prefix": prefix})
-        if status >= 300:
-            raise S3Error(f"LIST {prefix}: {status}")
-        root = ET.fromstring(data)
-        ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
-        for el in root.iter(f"{ns}Key"):
-            self._request("DELETE", el.text)
+        keys: list[str] = []
+        for ns, root in self._list_pages({"list-type": "2", "prefix": prefix}):
+            keys.extend(el.text for el in root.iter(f"{ns}Key"))
+        for key in keys:
+            self._request("DELETE", key)
         self._request("DELETE", prefix)
 
     def stat(self, name: str) -> dict:

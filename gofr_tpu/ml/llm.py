@@ -94,7 +94,21 @@ class LLMServer:
                 self._closed = True
                 return
             self._waiting.append(req)
-        while self._waiting and self.gen.free_slot() is not None:
+        while self._waiting:
+            if self.gen.free_slot() is None:
+                # no admission possible: break WITHOUT draining, so the
+                # chunk-decode pipeline stays one dispatch deep under
+                # backlog (a drain here would sync the device every loop)
+                break
+            # About to admit: settle device bookkeeping and release finished
+            # slots FIRST — add_request's internal drain() could otherwise
+            # finish another slot mid-admission and free_slot() would hand
+            # back a slot still present in self._active, overwriting its
+            # request (which then never receives _DONE). Draining here makes
+            # the drain inside add_request a no-op; it can only free MORE
+            # slots, never consume the one we just saw.
+            self.gen.drain()
+            self._finish_dead_slots()
             req = self._waiting.pop(0)
             try:
                 slot = self.gen.add_request(

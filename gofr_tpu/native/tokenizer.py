@@ -196,11 +196,12 @@ def train_bpe(corpus: Iterable[str | bytes], vocab_size: int,
               *, specials: Iterable[str] = ()) -> BPETokenizer:
     """Tiny reference BPE trainer (greedy most-frequent pair): enough to
     build real vocabularies for examples/tests without external files."""
+    specials = tuple(specials)  # a generator would be exhausted on first use
     data = [t.encode("utf-8") if isinstance(t, str) else bytes(t) for t in corpus]
     vocab: list[bytes] = [bytes([i]) for i in range(256)]
     seqs = [[b for b in d] for d in data if d]
     merges: list[tuple[int, int, int]] = []
-    while len(vocab) < vocab_size - len(tuple(specials)):
+    while len(vocab) < vocab_size - len(specials):
         counts: dict[tuple[int, int], int] = {}
         for seq in seqs:
             for a, b in zip(seq, seq[1:]):

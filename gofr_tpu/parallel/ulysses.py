@@ -33,7 +33,10 @@ def ulysses_attention_local(q, k, v, *, axis_name: str = "sp",
 
     n = jax.lax.psum(1, axis_name)
     if q.shape[2] % n:
-        raise ValueError(f"n_heads {q.shape[2]} must divide {axis_name}={n}")
+        raise ValueError(
+            f"{axis_name}={n} must divide the local (per-tp-shard) head "
+            f"count {q.shape[2]}"
+        )
     # seq-sharded -> head-sharded: split heads across the axis, gather seq
     a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
                             split_axis=2, concat_axis=1, tiled=True)

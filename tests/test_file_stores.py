@@ -106,8 +106,13 @@ class _FakeS3Handler(http.server.BaseHTTPRequestHandler):
         amz_date = self.headers["x-amz-date"]
         datestamp = amz_date[:8]
         parsed = urllib.parse.urlparse(self.path)
-        qs = urllib.parse.urlencode(sorted(urllib.parse.parse_qsl(
-            parsed.query, keep_blank_values=True)))
+        # AWS canonicalises with RFC3986 percent-encoding (space -> %20),
+        # NOT form-encoding ('+') — this is what real S3 checks against.
+        qs = "&".join(
+            f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+            for k, v in sorted(urllib.parse.parse_qsl(
+                parsed.query, keep_blank_values=True))
+        )
         payload_hash = hashlib.sha256(body).hexdigest()
         if payload_hash != self.headers["x-amz-content-sha256"]:
             return False
@@ -162,13 +167,24 @@ class _FakeS3Handler(http.server.BaseHTTPRequestHandler):
         if not self._verify_sig(b""):
             return self._respond(403)
         parsed = urllib.parse.urlparse(self.path)
-        if parsed.query:  # ListObjectsV2
+        if parsed.query:  # ListObjectsV2, paginated at 2 keys per page so
+            # every listing test exercises continuation-token handling
             q = dict(urllib.parse.parse_qsl(parsed.query))
             prefix = q.get("prefix", "")
             keys = sorted(k for k in self.store if k.startswith(prefix))
+            after = q.get("continuation-token", "")
+            if after:
+                keys = [k for k in keys if k > after]
+            page, rest = keys[:2], keys[2:]
             xml = "<ListBucketResult>" + "".join(
-                f"<Contents><Key>{k}</Key></Contents>" for k in keys
-            ) + "</ListBucketResult>"
+                f"<Contents><Key>{k}</Key></Contents>" for k in page
+            )
+            if rest:
+                xml += ("<IsTruncated>true</IsTruncated>"
+                        f"<NextContinuationToken>{page[-1]}</NextContinuationToken>")
+            else:
+                xml += "<IsTruncated>false</IsTruncated>"
+            xml += "</ListBucketResult>"
             return self._respond(200, xml.encode())
         key = urllib.parse.unquote(parsed.path.split(f"/{BUCKET}/", 1)[1])
         if key not in self.store:
@@ -220,6 +236,19 @@ def test_s3_roundtrip_with_real_sigv4(s3):
         s3.open("logs/app2.csv")
     assert s3.health_check()["status"] == "UP"
     assert _FakeS3Handler.sig_failures == []  # every request verified
+
+
+def test_s3_paginated_listing_and_space_prefix(s3):
+    # 5 keys > the fake's 2-key page size: read_dir/remove_all must follow
+    # continuation tokens; the "my dir" prefix exercises %20 canonical query
+    for i in range(5):
+        with s3.create(f"my dir/f{i}.txt") as f:
+            f.write(b"x")
+    assert s3.read_dir("my dir") == [f"f{i}.txt" for i in range(5)]
+    s3.remove_all("my dir")
+    assert s3.read_dir("my dir") == []
+    assert not any(k.startswith("my dir/") for k in _FakeS3Handler.store)
+    assert _FakeS3Handler.sig_failures == []
 
 
 def test_s3_bad_credentials_rejected(s3):

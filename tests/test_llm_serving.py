@@ -66,6 +66,35 @@ def test_concurrent_requests_beyond_slots(model, run):
     assert results == expects
 
 
+def test_chunked_decode_slot_reuse_no_hang(model, run):
+    """Regression (ADVICE r1): with chunk>1, add_request's internal drain()
+    can finish another slot mid-admission; admitting into it before the
+    server released it overwrote the old request, which then never received
+    its _DONE and awaited forever. Staggered max_new makes slots free at
+    different chunk boundaries; every request must still complete."""
+    cfg, params = model
+    prompts = [[i + 1, i + 2, i + 3] for i in range(8)]
+    lengths = [2, 7, 3, 9, 4, 6, 5, 8]
+    expects = [_expected(params, cfg, p, n) for p, n in zip(prompts, lengths)]
+
+    async def scenario():
+        server = LLMServer(Generator(params, cfg, batch_slots=3, max_seq=64,
+                                     prefill_buckets=(8,), chunk=4))
+        try:
+            return await asyncio.wait_for(
+                asyncio.gather(
+                    *(server.generate(p, n) for p, n in zip(prompts, lengths))
+                ),
+                timeout=120,
+            )
+        finally:
+            server.close()
+
+    results = run(scenario())
+    for got, want in zip(results, expects):
+        assert got == want
+
+
 def test_bad_prompt_raises_not_hangs(model, run):
     cfg, params = model
 
