@@ -239,14 +239,28 @@ class App:
         self,
         decoder: Callable[[str], dict] | None = None,
         *,
+        jwks_url: str | None = None,
+        refresh_interval: float = 300.0,
         allow_unverified: bool = False,
     ) -> None:
-        """Bearer-token auth. ``decoder`` must verify the signature and return
-        claims; without one the app refuses to start unless the caller
-        explicitly opts into unverified-claims mode (tests only)."""
+        """Bearer-token auth (reference middleware/oauth.go:63-143).
+
+        ``jwks_url`` is the production path: the framework fetches/caches
+        the provider's JWKS and verifies RS256 signatures itself
+        (http/jwks.py). Alternatively pass a verifying ``decoder``; without
+        either the app refuses to start unless the caller explicitly opts
+        into unverified-claims mode (tests only)."""
+        if jwks_url is not None:
+            from .http.jwks import JWKSProvider
+
+            provider = JWKSProvider(jwks_url,
+                                    refresh_interval=refresh_interval,
+                                    logger=self.logger)
+            self._auth_middlewares.append(mw.jwks_oauth_middleware(provider))
+            return
         if decoder is None and not allow_unverified:
             raise ValueError(
-                "enable_oauth requires a verifying decoder; pass "
+                "enable_oauth requires jwks_url or a verifying decoder; pass "
                 "allow_unverified=True only for tests"
             )
         self._auth_middlewares.append(mw.oauth_middleware(None, decoder))
@@ -286,6 +300,12 @@ class App:
         )
         app.router.add_get("/favicon.ico", self._favicon_handler)
         self._maybe_add_swagger(app)
+        if (self.config.get("APP_ENV") or "").upper() == "DEBUG":
+            # profiler routes, the TPU-native analogue of the reference's
+            # pprof mount under APP_ENV=DEBUG (http_server.go:65-72):
+            # jax.profiler traces capture device + host timelines viewable
+            # in tensorboard/xprof
+            self._add_profiler_routes(app)
 
         for method, pattern, handler in self._routes:
             app.router.add_route(
@@ -305,6 +325,46 @@ class App:
             "*", "/{tail:.*}", wrap_handler(catch_all_handler, self.container)
         )
         return app
+
+    def _add_profiler_routes(self, app: web.Application) -> None:
+        state = {"dir": None}
+
+        async def start_profile(request: web.Request) -> web.Response:
+            if state["dir"] is not None:
+                return web.json_response(
+                    {"error": {"message": "profile already running"}},
+                    status=409)
+            import tempfile
+
+            import jax
+
+            trace_dir = request.query.get("dir") or tempfile.mkdtemp(
+                prefix="gofr-profile-")
+            jax.profiler.start_trace(trace_dir)
+            state["dir"] = trace_dir
+            self.logger.infof("profiler trace started -> %s", trace_dir)
+            return web.json_response({"data": {"status": "started",
+                                               "dir": trace_dir}})
+
+        async def stop_profile(request: web.Request) -> web.Response:
+            if state["dir"] is None:
+                return web.json_response(
+                    {"error": {"message": "no profile running"}}, status=409)
+            import jax
+
+            jax.profiler.stop_trace()
+            trace_dir, state["dir"] = state["dir"], None
+            self.logger.infof("profiler trace stopped (%s)", trace_dir)
+            return web.json_response({"data": {"status": "stopped",
+                                               "dir": trace_dir}})
+
+        async def profile_status(request: web.Request) -> web.Response:
+            return web.json_response({"data": {
+                "running": state["dir"] is not None, "dir": state["dir"]}})
+
+        app.router.add_post("/debug/profile/start", start_profile)
+        app.router.add_post("/debug/profile/stop", stop_profile)
+        app.router.add_get("/debug/profile", profile_status)
 
     @staticmethod
     def _adapt_middleware(func) -> Any:

@@ -119,7 +119,9 @@ class _FakePipeline:
         out = []
         for op in self._ops:
             name, *args = op
-            out.append(getattr(self._redis, name)(*args))
+            # raw commands arrive verb-first ("HSET", key, field, value) —
+            # dispatch to the lowercase method like the RESP client would
+            out.append(getattr(self._redis, name.lower())(*args))
         self._ops = []
         return out
 

@@ -4,9 +4,10 @@ Mirrors the reference's AddRESTHandlers (pkg/gofr/crud_handlers.go:66-330 +
 datasource/sql/query_builder.go:21-90): reflect an entity dataclass into
 metadata (first field is the primary key; field metadata ``sql="not_null"`` /
 ``auto_increment`` honored), register POST/GET/GET-by-id/PUT/DELETE under
-``/{snake_case(entity)}``, generate dialect-aware SQL, and let the entity
-class override any verb by defining ``create/get_all/get/update/delete``
-methods itself.
+``/{snake_case(entity)}``, generate dialect-aware SQL (identifier quoting
+per dialect, ``RETURNING`` on postgres inserts — the ``?`` placeholder is
+normalized by each driver), and let the entity class override any verb by
+defining ``create/get_all/get/update/delete`` methods itself.
 """
 
 from __future__ import annotations
@@ -18,11 +19,60 @@ from typing import Any
 from .context import Context
 from .http.errors import EntityNotFound, InvalidInput
 
-__all__ = ["register_crud_handlers", "snake_case"]
+__all__ = ["register_crud_handlers", "snake_case", "quote_ident",
+           "insert_query", "select_all_query", "select_query",
+           "update_query", "delete_query"]
 
 
 def snake_case(name: str) -> str:
     return re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower()
+
+
+# -- dialect-aware SQL generation (reference sql/query_builder.go:21-90) ------
+
+def quote_ident(name: str, dialect: str) -> str:
+    """mysql quotes identifiers with backticks, postgres/sqlite with double
+    quotes (both also accept their own unquoted lowercase names, but
+    quoting keeps reserved words like ``order`` usable as tables)."""
+    return f"`{name}`" if dialect == "mysql" else f'"{name}"'
+
+
+def insert_query(meta: "_EntityMeta", fields: list[str], dialect: str) -> str:
+    q = quote_ident
+    cols = ", ".join(q(f, dialect) for f in fields)
+    ph = ", ".join("?" for _ in fields)
+    sql = f"INSERT INTO {q(meta.table, dialect)} ({cols}) VALUES ({ph})"
+    if dialect == "postgres" and meta.auto_increment:
+        # postgres has no lastrowid: the wire client surfaces RETURNING
+        sql += f" RETURNING {q(meta.primary_key, dialect)}"
+    return sql
+
+
+def select_all_query(meta: "_EntityMeta", dialect: str) -> str:
+    return f"SELECT * FROM {quote_ident(meta.table, dialect)}"
+
+
+def select_query(meta: "_EntityMeta", dialect: str) -> str:
+    q = quote_ident
+    return (f"SELECT * FROM {q(meta.table, dialect)} "
+            f"WHERE {q(meta.primary_key, dialect)} = ?")
+
+
+def update_query(meta: "_EntityMeta", fields: list[str], dialect: str) -> str:
+    q = quote_ident
+    sets = ", ".join(f"{q(f, dialect)} = ?" for f in fields)
+    return (f"UPDATE {q(meta.table, dialect)} SET {sets} "
+            f"WHERE {q(meta.primary_key, dialect)} = ?")
+
+
+def delete_query(meta: "_EntityMeta", dialect: str) -> str:
+    q = quote_ident
+    return (f"DELETE FROM {q(meta.table, dialect)} "
+            f"WHERE {q(meta.primary_key, dialect)} = ?")
+
+
+def _dialect(ctx: Context) -> str:
+    return getattr(ctx.sql, "dialect", "sqlite")
 
 
 @dataclasses.dataclass
@@ -72,11 +122,9 @@ def _create_handler(entity: type, meta: _EntityMeta):
         fields = list(meta.fields)
         if meta.auto_increment:
             fields = fields[1:]
-        cols = ", ".join(fields)
-        ph = ", ".join("?" for _ in fields)
         values = [getattr(obj, f) for f in fields]
         new_id = ctx.sql.exec_last_id(
-            f"INSERT INTO {meta.table} ({cols}) VALUES ({ph})", *values
+            insert_query(meta, fields, _dialect(ctx)), *values
         )
         if meta.auto_increment:
             return {"id": new_id, "message": f"{meta.name} successfully created with id: {new_id}"}
@@ -88,7 +136,7 @@ def _create_handler(entity: type, meta: _EntityMeta):
 
 def _get_all_handler(entity: type, meta: _EntityMeta):
     async def get_all(ctx: Context) -> Any:
-        return ctx.sql.select(entity, f"SELECT * FROM {meta.table}")
+        return ctx.sql.select(entity, select_all_query(meta, _dialect(ctx)))
 
     return get_all
 
@@ -97,7 +145,7 @@ def _get_handler(entity: type, meta: _EntityMeta):
     async def get(ctx: Context) -> Any:
         entity_id = ctx.path_param("id")
         rows = ctx.sql.select(
-            entity, f"SELECT * FROM {meta.table} WHERE {meta.primary_key} = ?", entity_id
+            entity, select_query(meta, _dialect(ctx)), entity_id
         )
         if not rows:
             raise EntityNotFound(meta.primary_key, entity_id)
@@ -111,11 +159,9 @@ def _update_handler(entity: type, meta: _EntityMeta):
         entity_id = ctx.path_param("id")
         obj = await ctx.bind(entity)
         fields = [f for f in meta.fields if f != meta.primary_key]
-        sets = ", ".join(f"{f} = ?" for f in fields)
         values = [getattr(obj, f) for f in fields]
         n = ctx.sql.exec(
-            f"UPDATE {meta.table} SET {sets} WHERE {meta.primary_key} = ?",
-            *values, entity_id,
+            update_query(meta, fields, _dialect(ctx)), *values, entity_id,
         )
         if n == 0:
             raise EntityNotFound(meta.primary_key, entity_id)
@@ -128,7 +174,7 @@ def _delete_handler(entity: type, meta: _EntityMeta):
     async def delete(ctx: Context) -> Any:
         entity_id = ctx.path_param("id")
         n = ctx.sql.exec(
-            f"DELETE FROM {meta.table} WHERE {meta.primary_key} = ?", entity_id
+            delete_query(meta, _dialect(ctx)), entity_id
         )
         if n == 0:
             raise EntityNotFound(meta.primary_key, entity_id)

@@ -5,7 +5,9 @@ The reference treats a broker message as a transport Request
 Kafka message feeds the same handler signature) and ships Kafka/Google/MQTT/
 NATS/EventHub clients. In-image we provide: an in-process broker (asyncio
 queues with consumer-group fan-out semantics), a Redis-lists broker riding
-our RESP client, and clear UnavailableDriverError for kafka/mqtt/google/nats.
+our RESP client, and from-scratch wire-protocol Kafka (kafka.py), MQTT
+3.1.1 (mqtt.py) and core-NATS (nats.py) clients; google/eventhub remain
+UnavailableDriverError (their cloud SDKs don't ship in this image).
 
 Commit semantics mirror the reference's subscriber runtime: a message is
 committed only after its handler succeeds (reference subscriber.go:72-75).
@@ -212,6 +214,26 @@ def new_pubsub(backend: str, config, logger=None, metrics=None):
         host, _, port = broker.partition(":")
         return NATS(host or "localhost", int(port or 4222),
                     logger=logger, metrics=metrics)
-    if backend in ("kafka", "mqtt", "google", "eventhub"):
-        raise UnavailableDriverError(backend, f"{backend} client")
+    if backend == "kafka":
+        from .kafka import Kafka
+
+        return Kafka(
+            config.get_or_default("PUBSUB_BROKER", "localhost:9092"),
+            group_id=config.get("CONSUMER_ID"),
+            offset_start=config.get_or_default("PUBSUB_OFFSET", "latest"),
+            logger=logger, metrics=metrics,
+        )
+    if backend == "mqtt":
+        from .mqtt import MQTT
+
+        broker = config.get_or_default("PUBSUB_BROKER", "localhost:1883")
+        host, _, port = broker.partition(":")
+        return MQTT(host or "localhost", int(port or 1883),
+                    client_id=config.get_or_default("MQTT_CLIENT_ID", "gofr-tpu"),
+                    qos=int(config.get_or_default("MQTT_QOS", "1")),
+                    logger=logger, metrics=metrics)
+    if backend in ("google", "eventhub"):
+        # cloud-SDK-bound backends: no SDK ships in this image (README
+        # documents the gap; reference google/google.go, eventhub/eventhub.go)
+        raise UnavailableDriverError(backend, f"{backend} cloud SDK")
     raise ValueError(f"unsupported PUBSUB_BACKEND {backend!r}")

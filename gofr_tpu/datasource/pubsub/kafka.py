@@ -1,0 +1,540 @@
+"""Kafka pub/sub backend: a from-scratch client for the Kafka wire protocol.
+
+Reference: pkg/gofr/datasource/pubsub/kafka/kafka.go:56-271 (segmentio/
+kafka-go: writer batching, per-topic readers with consumer-group or
+partition offsets, topic create/delete through the controller, publish/
+subscribe counters, reader stats in health). No Kafka client library ships
+in this image, so — like the RESP/NATS clients in this package — this
+implements the binary protocol directly over asyncio streams:
+
+- Metadata v0 (api 3) for partition discovery and health
+- Produce v0 (api 0, acks=1) with CRC-framed v0 message sets
+- Fetch v0 (api 1) with server-side long-poll (max_wait)
+- ListOffsets v0 (api 2) for earliest/latest start positions
+- OffsetCommit/OffsetFetch v0 (apis 8/9) for consumer-group offsets
+- CreateTopics/DeleteTopics v0 (apis 19/20)
+
+Delivery semantics mirror the reference subscriber runtime: messages carry
+a committer that advances the group offset only after the handler
+succeeds (reference subscriber.go:72-75); nack re-queues locally for
+at-least-once redelivery. Single-broker routing (the bootstrap broker is
+the leader for every partition) — the multi-node leader map is out of
+scope, as the reference's writer also pins one transport.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import time
+import zlib
+from typing import Any
+
+from . import Message
+
+__all__ = ["Kafka", "KafkaError", "KafkaProtocolError"]
+
+
+class KafkaError(Exception):
+    pass
+
+
+class KafkaProtocolError(KafkaError):
+    def __init__(self, api: str, code: int) -> None:
+        super().__init__(f"{api}: kafka error code {code}")
+        self.code = code
+
+
+# -- wire codec ----------------------------------------------------------------
+
+class Writer:
+    """Big-endian Kafka primitive encoder."""
+
+    def __init__(self) -> None:
+        self._parts: list[bytes] = []
+
+    def int8(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">b", v)); return self
+
+    def int16(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">h", v)); return self
+
+    def int32(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">i", v)); return self
+
+    def int64(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">q", v)); return self
+
+    def string(self, s: str | None) -> "Writer":
+        if s is None:
+            return self.int16(-1)
+        raw = s.encode()
+        self.int16(len(raw)); self._parts.append(raw); return self
+
+    def bytes_(self, b: bytes | None) -> "Writer":
+        if b is None:
+            return self.int32(-1)
+        self.int32(len(b)); self._parts.append(b); return self
+
+    def raw(self, b: bytes) -> "Writer":
+        self._parts.append(b); return self
+
+    def array(self, items, encode) -> "Writer":
+        self.int32(len(items))
+        for item in items:
+            encode(self, item)
+        return self
+
+    def build(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class Reader:
+    """Big-endian Kafka primitive decoder."""
+
+    def __init__(self, data: bytes) -> None:
+        self._d = data
+        self._o = 0
+
+    def _take(self, n: int) -> bytes:
+        if self._o + n > len(self._d):
+            raise KafkaError("truncated response")
+        out = self._d[self._o:self._o + n]
+        self._o += n
+        return out
+
+    def int8(self) -> int:
+        return struct.unpack(">b", self._take(1))[0]
+
+    def int16(self) -> int:
+        return struct.unpack(">h", self._take(2))[0]
+
+    def int32(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def int64(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def string(self) -> str | None:
+        n = self.int16()
+        return None if n < 0 else self._take(n).decode()
+
+    def bytes_(self) -> bytes | None:
+        n = self.int32()
+        return None if n < 0 else self._take(n)
+
+    def array(self, decode) -> list:
+        return [decode(self) for _ in range(self.int32())]
+
+    def remaining(self) -> int:
+        return len(self._d) - self._o
+
+
+def encode_message_set(values: list[tuple[bytes | None, bytes]]) -> bytes:
+    """v0 message set: [offset int64, size int32, crc int32, magic, attrs,
+    key bytes, value bytes] per message; offsets are assigned by the broker
+    on produce (we send 0)."""
+    out = Writer()
+    for key, value in values:
+        body = (Writer().int8(0).int8(0)  # magic 0, no compression
+                .bytes_(key).bytes_(value).build())
+        crc = zlib.crc32(body) & 0xFFFFFFFF
+        msg = struct.pack(">I", crc) + body
+        out.int64(0).int32(len(msg)).raw(msg)
+    return out.build()
+
+
+def decode_message_set(data: bytes) -> list[tuple[int, bytes | None, bytes]]:
+    """Parse a v0 message set into (offset, key, value); a trailing
+    partial message (broker truncation at max_bytes) is dropped."""
+    out: list[tuple[int, bytes | None, bytes]] = []
+    r = Reader(data)
+    while r.remaining() >= 12:
+        offset = r.int64()
+        size = r.int32()
+        if r.remaining() < size:
+            break  # partial trailing message
+        m = Reader(r._take(size))
+        crc = m.int32() & 0xFFFFFFFF
+        body_start = m._o
+        magic = m.int8()
+        m.int8()  # attributes (compression unsupported: magic-0 plain only)
+        key = m.bytes_()
+        value = m.bytes_()
+        if magic != 0:
+            raise KafkaError(f"unsupported message magic {magic}")
+        if zlib.crc32(m._d[body_start:]) & 0xFFFFFFFF != crc:
+            raise KafkaError(f"crc mismatch at offset {offset}")
+        out.append((offset, key, value or b""))
+    return out
+
+
+# -- connection ----------------------------------------------------------------
+
+class _Conn:
+    """One broker connection: framed request/response with correlation ids.
+
+    Kafka responses come back in request order on a connection; a lock
+    serializes request+response so correlation ids always match.
+    """
+
+    def __init__(self, host: str, port: int, client_id: str) -> None:
+        self.host, self.port = host, port
+        self.client_id = client_id
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._corr = 0
+        self._lock = asyncio.Lock()
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None and not self._writer.is_closing()
+
+    async def _ensure(self) -> None:
+        if not self.connected:
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port)
+
+    async def request(self, api_key: int, api_version: int, body: bytes) -> Reader:
+        async with self._lock:
+            await self._ensure()
+            self._corr += 1
+            corr = self._corr
+            header = (Writer().int16(api_key).int16(api_version)
+                      .int32(corr).string(self.client_id).build())
+            frame = header + body
+            self._writer.write(struct.pack(">i", len(frame)) + frame)
+            await self._writer.drain()
+            size_raw = await self._reader.readexactly(4)
+            (size,) = struct.unpack(">i", size_raw)
+            payload = await self._reader.readexactly(size)
+            r = Reader(payload)
+            got = r.int32()
+            if got != corr:
+                raise KafkaError(f"correlation mismatch: sent {corr} got {got}")
+            return r
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+# -- client --------------------------------------------------------------------
+
+class _TopicReader:
+    """Fetch state for one subscribed topic (reference kafka.go per-topic
+    reader map): per-partition next offsets + a local delivery queue."""
+
+    __slots__ = ("offsets", "queue", "started")
+
+    def __init__(self) -> None:
+        self.offsets: dict[int, int] = {}
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.started = False
+
+
+class Kafka:
+    """PubSub-protocol Kafka client over the native wire protocol.
+
+    Config mirrors the reference's kafka.Config (kafka.go:34-54): broker
+    address, consumer group, offset start ('latest'/'earliest'), batch
+    timeout for fetch long-poll.
+    """
+
+    def __init__(self, broker: str = "localhost:9092", *,
+                 group_id: str | None = None, client_id: str = "gofr-tpu",
+                 offset_start: str = "latest", fetch_max_wait_ms: int = 250,
+                 fetch_max_bytes: int = 1 << 20,
+                 logger=None, metrics=None) -> None:
+        host, _, port = broker.partition(":")
+        self.broker = broker
+        self._conn = _Conn(host or "localhost", int(port or 9092), client_id)
+        self.group_id = group_id
+        self.offset_start = offset_start
+        self._fetch_wait = fetch_max_wait_ms
+        self._fetch_bytes = fetch_max_bytes
+        self._logger = logger
+        self._metrics = metrics
+        self._readers: dict[str, _TopicReader] = {}
+        self._meta_cache: dict[str, list[int]] = {}
+        self._rr = 0
+        self.stats = {"published": 0, "consumed": 0, "committed": 0,
+                      "errors": 0}
+
+    # -- provider contract -----------------------------------------------------
+    def use_logger(self, logger) -> None:
+        self._logger = logger
+
+    def use_metrics(self, metrics) -> None:
+        self._metrics = metrics
+
+    def use_tracer(self, tracer) -> None:
+        pass
+
+    def connect(self) -> None:
+        """Lazy: the socket dials on first use inside the running loop."""
+        if self._logger is not None:
+            self._logger.infof("kafka backend: broker %s group %s",
+                               self.broker, self.group_id or "-")
+
+    def _count(self, metric: str, topic: str) -> None:
+        if self._metrics is not None:
+            try:
+                self._metrics.increment_counter(metric, topic=topic)
+            except Exception:
+                pass
+
+    # -- metadata --------------------------------------------------------------
+    async def _metadata(self, topics: list[str] | None = None) -> dict:
+        body = Writer().array(topics or [], lambda w, t: w.string(t)).build()
+        r = await self._conn.request(3, 0, body)
+        brokers = r.array(lambda x: (x.int32(), x.string(), x.int32()))
+
+        def part(x: Reader):
+            perr, pid = x.int16(), x.int32()
+            x.int32()  # leader
+            x.array(lambda y: y.int32())  # replicas
+            x.array(lambda y: y.int32())  # isr
+            return perr, pid
+
+        def topic(x: Reader):
+            terr, name = x.int16(), x.string()
+            parts = x.array(part)
+            return name, terr, [pid for _, pid in parts]
+
+        tops = {name: (terr, pids) for name, terr, pids in r.array(topic)}
+        return {"brokers": brokers, "topics": tops}
+
+    async def _partitions(self, topic: str) -> list[int]:
+        if topic not in self._meta_cache:
+            meta = await self._metadata([topic])
+            terr, pids = meta["topics"].get(topic, (3, []))
+            if terr not in (0,) or not pids:
+                raise KafkaProtocolError(f"metadata {topic}", terr or 3)
+            self._meta_cache[topic] = sorted(pids)
+        return self._meta_cache[topic]
+
+    # -- produce ---------------------------------------------------------------
+    async def publish(self, topic: str, message: bytes | str,
+                      key: bytes | None = None) -> None:
+        if isinstance(message, str):
+            message = message.encode()
+        self._count("app_pubsub_publish_total_count", topic)
+        try:
+            pids = await self._partitions(topic)
+            pid = pids[self._rr % len(pids)]  # round-robin like the writer
+            self._rr += 1
+            mset = encode_message_set([(key, message)])
+            body = (Writer().int16(1).int32(5000)  # acks=1, timeout
+                    .array([topic], lambda w, t: (
+                        w.string(t).array([pid], lambda w2, p: (
+                            w2.int32(p).bytes_(mset)))))
+                    .build())
+            r = await self._conn.request(0, 0, body)
+
+            def p_resp(x: Reader):
+                pid_, err = x.int32(), x.int16()
+                x.int64()  # base offset
+                return pid_, err
+
+            for _t, parts in r.array(lambda x: (x.string(), x.array(p_resp))):
+                for _pid, err in parts:
+                    if err:
+                        raise KafkaProtocolError(f"produce {topic}", err)
+        except Exception:
+            self.stats["errors"] += 1
+            raise
+        self.stats["published"] += 1
+        self._count("app_pubsub_publish_success_count", topic)
+
+    # -- offsets ---------------------------------------------------------------
+    async def _list_offset(self, topic: str, pid: int, earliest: bool) -> int:
+        ts = -2 if earliest else -1
+        body = (Writer().int32(-1)
+                .array([topic], lambda w, t: (
+                    w.string(t).array([pid], lambda w2, p: (
+                        w2.int32(p).int64(ts).int32(1)))))
+                .build())
+        r = await self._conn.request(2, 0, body)
+
+        def p(x: Reader):
+            pid_, err = x.int32(), x.int16()
+            offs = x.array(lambda y: y.int64())
+            if err:
+                raise KafkaProtocolError(f"list_offsets {topic}", err)
+            return offs[0] if offs else 0
+
+        for _t, parts in r.array(lambda x: (x.string(), x.array(p))):
+            return parts[0]
+        return 0
+
+    async def _fetch_committed(self, topic: str, pid: int) -> int:
+        body = (Writer().string(self.group_id)
+                .array([topic], lambda w, t: (
+                    w.string(t).array([pid], lambda w2, p: w2.int32(p))))
+                .build())
+        r = await self._conn.request(9, 0, body)
+
+        def p(x: Reader):
+            pid_, off = x.int32(), x.int64()
+            x.string()  # metadata
+            x.int16()   # error (unknown-offset returns -1 offset, code 0)
+            return off
+
+        for _t, parts in r.array(lambda x: (x.string(), x.array(p))):
+            return parts[0]
+        return -1
+
+    async def _commit(self, topic: str, pid: int, offset: int) -> None:
+        body = (Writer().string(self.group_id)
+                .array([topic], lambda w, t: (
+                    w.string(t).array([(pid, offset)], lambda w2, po: (
+                        w2.int32(po[0]).int64(po[1]).string("")))))
+                .build())
+        r = await self._conn.request(8, 0, body)
+        for _t, parts in r.array(
+                lambda x: (x.string(), x.array(
+                    lambda y: (y.int32(), y.int16())))):
+            for _pid, err in parts:
+                if err:
+                    raise KafkaProtocolError(f"offset_commit {topic}", err)
+        self.stats["committed"] += 1
+
+    # -- consume ---------------------------------------------------------------
+    async def _start_offsets(self, topic: str) -> dict[int, int]:
+        offsets = {}
+        for pid in await self._partitions(topic):
+            start = -1
+            if self.group_id:
+                start = await self._fetch_committed(topic, pid)
+            if start < 0:
+                start = await self._list_offset(
+                    topic, pid, earliest=self.offset_start == "earliest")
+            offsets[pid] = start
+        return offsets
+
+    async def _fetch_once(self, topic: str, reader: _TopicReader) -> int:
+        """One Fetch across the topic's partitions; enqueue decoded
+        messages, advance local offsets. Returns message count."""
+        parts = sorted(reader.offsets.items())
+        body = (Writer().int32(-1).int32(self._fetch_wait).int32(1)
+                .array([topic], lambda w, t: (
+                    w.string(t).array(parts, lambda w2, po: (
+                        w2.int32(po[0]).int64(po[1]).int32(self._fetch_bytes)))))
+                .build())
+        r = await self._conn.request(1, 0, body)
+        n = 0
+
+        def p(x: Reader):
+            pid, err = x.int32(), x.int16()
+            x.int64()  # high watermark
+            mset = x.bytes_() or b""
+            return pid, err, mset
+
+        for _t, presps in r.array(lambda x: (x.string(), x.array(p))):
+            for pid, err, mset in presps:
+                if err:
+                    raise KafkaProtocolError(f"fetch {topic}", err)
+                for offset, key, value in decode_message_set(mset):
+                    if offset < reader.offsets[pid]:
+                        continue  # v0 resends from segment starts
+                    reader.offsets[pid] = offset + 1
+                    reader.queue.put_nowait((pid, offset, key, value))
+                    n += 1
+        return n
+
+    async def subscribe(self, topic: str) -> Message:
+        """Long-poll the next message; commit advances the group offset
+        (commit-on-success is driven by the subscriber runtime)."""
+        self._count("app_pubsub_subscribe_total_count", topic)
+        reader = self._readers.get(topic)
+        if reader is None:
+            reader = self._readers[topic] = _TopicReader()
+        if not reader.started:
+            reader.offsets = await self._start_offsets(topic)
+            reader.started = True
+        while reader.queue.empty():
+            if await self._fetch_once(topic, reader) == 0:
+                await asyncio.sleep(0)  # long-poll happens broker-side
+        pid, offset, key, value = reader.queue.get_nowait()
+        self.stats["consumed"] += 1
+
+        def committer(msg: Message) -> None:
+            self._count("app_pubsub_subscribe_success_count", topic)
+            if self.group_id:
+                asyncio.get_running_loop().create_task(
+                    self._commit(topic, pid, offset + 1))
+
+        def nacker(msg: Message) -> None:
+            reader.queue.put_nowait((pid, offset, key, value))
+
+        meta = {"partition": pid, "offset": offset}
+        if key:
+            meta["key"] = key.decode(errors="replace")
+        return Message(topic, value, meta, committer=committer, nacker=nacker)
+
+    # -- admin -----------------------------------------------------------------
+    async def create_topic_async(self, name: str, partitions: int = 1,
+                                 replication: int = 1) -> None:
+        body = (Writer().array([name], lambda w, t: (
+                    w.string(t).int32(partitions).int16(replication)
+                    .array([], lambda *_: None)
+                    .array([], lambda *_: None)))
+                .int32(5000).build())
+        r = await self._conn.request(19, 0, body)
+        for _t, err in r.array(lambda x: (x.string(), x.int16())):
+            if err and err != 36:  # 36 = already exists
+                raise KafkaProtocolError(f"create_topic {name}", err)
+        self._meta_cache.pop(name, None)
+
+    async def delete_topic_async(self, name: str) -> None:
+        body = (Writer().array([name], lambda w, t: w.string(t))
+                .int32(5000).build())
+        r = await self._conn.request(20, 0, body)
+        for _t, err in r.array(lambda x: (x.string(), x.int16())):
+            if err and err != 3:  # 3 = unknown topic
+                raise KafkaProtocolError(f"delete_topic {name}", err)
+        self._meta_cache.pop(name, None)
+        self._readers.pop(name, None)
+
+    def create_topic(self, name: str) -> None:
+        _run_sync(self.create_topic_async(name))
+
+    def delete_topic(self, name: str) -> None:
+        _run_sync(self.delete_topic_async(name))
+
+    # -- health ----------------------------------------------------------------
+    async def health_check_async(self) -> dict:
+        start = time.perf_counter()
+        try:
+            meta = await self._metadata()
+        except Exception as exc:
+            return {"status": "DOWN",
+                    "details": {"broker": self.broker, "error": str(exc)[:200]}}
+        return {"status": "UP", "details": {
+            "broker": self.broker,
+            "brokers": len(meta["brokers"]),
+            "topics": sorted(meta["topics"]),
+            "ping_ms": round((time.perf_counter() - start) * 1e3, 2),
+            "stats": dict(self.stats),
+        }}
+
+    def health_check(self) -> dict:
+        try:
+            return _run_sync(self.health_check_async())
+        except RuntimeError:
+            return {"status": "UNKNOWN", "details": {"broker": self.broker}}
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+def _run_sync(coro):
+    """Run a coroutine from sync context (admin/health called outside the
+    loop, e.g. migrations); inside a running loop, schedule and wait."""
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return asyncio.run(coro)
+    raise RuntimeError("use the *_async variant inside the event loop")

@@ -1,0 +1,299 @@
+"""MQTT pub/sub backend: a from-scratch MQTT 3.1.1 client.
+
+Reference: pkg/gofr/datasource/pubsub/mqtt/mqtt.go:63-409 (eclipse/paho
+with QoS/order/keepalive config, subscribe loop into a message channel).
+No paho ships in this image; MQTT 3.1.1 is a compact binary protocol
+(CONNECT/CONNACK, PUBLISH/PUBACK, SUBSCRIBE/SUBACK, PING) implemented here
+directly over asyncio streams, like the NATS/Kafka/RESP clients.
+
+Delivery semantics: QoS 1 inbound messages are PUBACK'd from the message's
+``commit()`` — the subscriber runtime acks only after the handler
+succeeds, giving broker-side at-least-once redelivery (the reference gets
+the same from paho's manual-ack mode). ``create_topic``/``delete_topic``
+are no-ops: MQTT topics are implicit (mqtt.go behaves the same).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from . import Message
+
+__all__ = ["MQTT", "MQTTError"]
+
+CONNECT, CONNACK, PUBLISH, PUBACK = 1, 2, 3, 4
+SUBSCRIBE, SUBACK, UNSUBSCRIBE, UNSUBACK = 8, 9, 10, 11
+PINGREQ, PINGRESP, DISCONNECT = 12, 13, 14
+
+
+class MQTTError(Exception):
+    pass
+
+
+def encode_remaining_length(n: int) -> bytes:
+    """MQTT varint: 7 bits per byte, MSB = continuation."""
+    out = bytearray()
+    while True:
+        n, digit = divmod(n, 128)
+        out.append(digit | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+async def read_packet(reader: asyncio.StreamReader) -> tuple[int, int, bytes]:
+    """Read one control packet: returns (type, flags, body)."""
+    first = (await reader.readexactly(1))[0]
+    ptype, flags = first >> 4, first & 0x0F
+    length, shift = 0, 0
+    while True:
+        b = (await reader.readexactly(1))[0]
+        length |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+        if shift > 21:
+            raise MQTTError("malformed remaining length")
+    body = await reader.readexactly(length) if length else b""
+    return ptype, flags, body
+
+
+def mqtt_string(s: str | bytes) -> bytes:
+    raw = s.encode() if isinstance(s, str) else s
+    return len(raw).to_bytes(2, "big") + raw
+
+
+def packet(ptype: int, flags: int, body: bytes) -> bytes:
+    return bytes([(ptype << 4) | flags]) + encode_remaining_length(len(body)) + body
+
+
+def topic_matches(pattern: str, topic: str) -> bool:
+    """MQTT filter match: ``+`` one level, ``#`` rest (must be last)."""
+    pp, tp = pattern.split("/"), topic.split("/")
+    for i, seg in enumerate(pp):
+        if seg == "#":
+            return True
+        if i >= len(tp):
+            return False
+        if seg != "+" and seg != tp[i]:
+            return False
+    return len(pp) == len(tp)
+
+
+class MQTT:
+    """PubSub-protocol implementation over one MQTT 3.1.1 connection."""
+
+    def __init__(self, host: str = "localhost", port: int = 1883, *,
+                 client_id: str = "gofr-tpu", qos: int = 1,
+                 keepalive_s: int = 30, logger=None, metrics=None) -> None:
+        self.host, self.port = host, port
+        self.client_id = client_id
+        self.qos = 1 if qos else 0
+        self.keepalive_s = keepalive_s
+        self._logger = logger
+        self._metrics = metrics
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._read_task: asyncio.Task | None = None
+        self._ping_task: asyncio.Task | None = None
+        self._lock = asyncio.Lock()
+        self._connected = False
+        self._next_pid = 1
+        self._acks: dict[int, asyncio.Future] = {}  # pid -> PUBACK/SUBACK
+        self._subscriptions: dict[str, asyncio.Queue] = {}
+        self.stats = {"published": 0, "consumed": 0, "acked": 0}
+
+    # -- provider contract -----------------------------------------------------
+    def use_logger(self, logger) -> None:
+        self._logger = logger
+
+    def use_metrics(self, metrics) -> None:
+        self._metrics = metrics
+
+    def use_tracer(self, tracer) -> None:
+        pass
+
+    def connect(self) -> None:
+        """Lazy: the socket dials on first use inside the running loop."""
+        if self._logger is not None:
+            self._logger.infof("mqtt backend: %s:%d qos=%d", self.host,
+                               self.port, self.qos)
+
+    def _count(self, metric: str, topic: str) -> None:
+        if self._metrics is not None:
+            try:
+                self._metrics.increment_counter(metric, topic=topic)
+            except Exception:
+                pass
+
+    async def _ensure(self) -> None:
+        if self._connected:
+            return
+        async with self._lock:
+            if self._connected:
+                return
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port)
+            var = (mqtt_string("MQTT") + bytes([4])         # protocol level 4
+                   + bytes([0x02])                           # clean session
+                   + self.keepalive_s.to_bytes(2, "big"))
+            self._writer.write(packet(CONNECT, 0, var + mqtt_string(self.client_id)))
+            await self._writer.drain()
+            ptype, _f, body = await read_packet(self._reader)
+            if ptype != CONNACK or len(body) < 2 or body[1] != 0:
+                raise MQTTError(f"connect refused: type={ptype} body={body!r}")
+            self._connected = True
+            loop = asyncio.get_running_loop()
+            self._read_task = loop.create_task(self._read_loop(),
+                                               name="gofr-mqtt-reader")
+            self._ping_task = loop.create_task(self._ping_loop(),
+                                               name="gofr-mqtt-ping")
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                ptype, flags, body = await read_packet(self._reader)
+                if ptype == PUBLISH:
+                    qos = (flags >> 1) & 0x03
+                    tlen = int.from_bytes(body[:2], "big")
+                    topic = body[2:2 + tlen].decode()
+                    rest = body[2 + tlen:]
+                    pid = 0
+                    if qos:
+                        pid = int.from_bytes(rest[:2], "big")
+                        rest = rest[2:]
+                    for pattern, q in self._subscriptions.items():
+                        if topic_matches(pattern, topic):
+                            q.put_nowait((topic, rest, qos, pid))
+                            break
+                    else:
+                        if qos:  # nothing consumes it: ack to drop
+                            await self._send(packet(
+                                PUBACK, 0, pid.to_bytes(2, "big")))
+                elif ptype in (PUBACK, SUBACK, UNSUBACK):
+                    pid = int.from_bytes(body[:2], "big")
+                    fut = self._acks.pop(pid, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(body)
+                elif ptype == PINGRESP:
+                    pass
+        except (asyncio.CancelledError, ConnectionError,
+                asyncio.IncompleteReadError):
+            pass
+        finally:
+            self._connected = False
+
+    async def _ping_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(max(self.keepalive_s / 2, 1))
+                await self._send(packet(PINGREQ, 0, b""))
+        except (asyncio.CancelledError, ConnectionError):
+            pass
+
+    async def _send(self, raw: bytes) -> None:
+        self._writer.write(raw)
+        await self._writer.drain()
+
+    def _pid(self) -> int:
+        pid = self._next_pid
+        self._next_pid = pid % 0xFFFF + 1
+        return pid
+
+    # -- pubsub protocol -------------------------------------------------------
+    async def publish(self, topic: str, message: bytes | str) -> None:
+        if isinstance(message, str):
+            message = message.encode()
+        await self._ensure()
+        self._count("app_pubsub_publish_total_count", topic)
+        if self.qos:
+            pid = self._pid()
+            fut = asyncio.get_running_loop().create_future()
+            self._acks[pid] = fut
+            body = mqtt_string(topic) + pid.to_bytes(2, "big") + message
+            await self._send(packet(PUBLISH, self.qos << 1, body))
+            await asyncio.wait_for(fut, timeout=10)
+        else:
+            await self._send(packet(PUBLISH, 0, mqtt_string(topic) + message))
+        self.stats["published"] += 1
+        self._count("app_pubsub_publish_success_count", topic)
+
+    async def _subscribe_topic(self, topic: str) -> asyncio.Queue:
+        q = self._subscriptions.get(topic)
+        if q is not None:
+            return q
+        q = self._subscriptions[topic] = asyncio.Queue()
+        pid = self._pid()
+        fut = asyncio.get_running_loop().create_future()
+        self._acks[pid] = fut
+        body = pid.to_bytes(2, "big") + mqtt_string(topic) + bytes([self.qos])
+        await self._send(packet(SUBSCRIBE, 0x02, body))
+        ack = await asyncio.wait_for(fut, timeout=10)
+        if len(ack) >= 3 and ack[2] == 0x80:
+            del self._subscriptions[topic]
+            raise MQTTError(f"subscribe to {topic!r} rejected")
+        return q
+
+    async def subscribe(self, topic: str) -> Message:
+        await self._ensure()
+        self._count("app_pubsub_subscribe_total_count", topic)
+        q = await self._subscribe_topic(topic)
+        actual_topic, payload, qos, pid = await q.get()
+        self.stats["consumed"] += 1
+
+        def committer(msg: Message) -> None:
+            # at-least-once: PUBACK only after the handler succeeded
+            self._count("app_pubsub_subscribe_success_count", topic)
+            self.stats["acked"] += 1
+            if qos:
+                asyncio.get_running_loop().create_task(
+                    self._send(packet(PUBACK, 0, pid.to_bytes(2, "big"))))
+
+        def nacker(msg: Message) -> None:
+            # no PUBACK: the broker redelivers; also requeue locally so a
+            # single-client test loop sees it again without reconnect
+            q.put_nowait((actual_topic, payload, qos, pid))
+
+        return Message(actual_topic, payload, {"qos": qos, "packet_id": pid},
+                       committer=committer, nacker=nacker)
+
+    def create_topic(self, name: str) -> None:
+        pass  # topics are implicit in MQTT
+
+    def delete_topic(self, name: str) -> None:
+        pass
+
+    # -- health ----------------------------------------------------------------
+    async def health_check_async(self) -> dict:
+        start = time.perf_counter()
+        try:
+            await self._ensure()
+        except Exception as exc:
+            return {"status": "DOWN", "details": {
+                "broker": f"{self.host}:{self.port}", "error": str(exc)[:200]}}
+        return {"status": "UP", "details": {
+            "broker": f"{self.host}:{self.port}", "client_id": self.client_id,
+            "qos": self.qos, "subscriptions": sorted(self._subscriptions),
+            "ping_ms": round((time.perf_counter() - start) * 1e3, 2),
+            "stats": dict(self.stats)}}
+
+    def health_check(self) -> dict:
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return asyncio.run(self.health_check_async())
+        status = "UP" if self._connected else "UNKNOWN"
+        return {"status": status, "details": {
+            "broker": f"{self.host}:{self.port}", "stats": dict(self.stats)}}
+
+    def close(self) -> None:
+        for task in (self._read_task, self._ping_task):
+            if task is not None:
+                task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.write(packet(DISCONNECT, 0, b""))
+            except Exception:
+                pass
+            self._writer.close()
+        self._connected = False

@@ -262,9 +262,150 @@ class SQL(_Common):
             self._worker.close()
 
 
-def new_sql(config, logger=None, metrics=None) -> SQL:
+class WireTx(_Common):
+    """Transaction over a wire connection: BEGIN ... COMMIT/ROLLBACK."""
+
+    def __init__(self, db: "WireSQL") -> None:
+        self._db = db
+        self._worker = db._worker
+        self._logger = db._logger
+        self._metrics = db._metrics
+        self._done = False
+        db.exec("BEGIN")
+
+    def exec(self, query: str, *args: Any) -> int:
+        return self._db.exec(query, *args)
+
+    def exec_last_id(self, query: str, *args: Any) -> int | None:
+        return self._db.exec_last_id(query, *args)
+
+    def query(self, query: str, *args: Any) -> list[dict]:
+        return self._db.query(query, *args)
+
+    def commit(self) -> None:
+        if not self._done:
+            self._db.exec("COMMIT")
+            self._done = True
+
+    def rollback(self) -> None:
+        if not self._done:
+            self._db.exec("ROLLBACK")
+            self._done = True
+
+    def __enter__(self) -> "WireTx":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.rollback()
+        else:
+            self.commit()
+
+
+class WireSQL(_Common):
+    """SQL datasource over a from-scratch wire client (postgres/mysql).
+
+    Mirrors the reference's per-dialect connection builder + lazy retry
+    (sql/sql.go:39-128): the socket dials on first use from the worker
+    thread; a failed connection is dropped so the next statement re-dials,
+    and health reports DOWN with the connect error in between.
+    """
+
+    def __init__(self, dialect: str, *, host: str, port: int, user: str,
+                 password: str, database: str, logger=None, metrics=None) -> None:
+        if dialect not in ("postgres", "mysql"):
+            raise ValueError(f"unsupported wire dialect {dialect!r}")
+        self.dialect = dialect
+        self.host, self.port = host, port
+        self.user, self._password = user, password
+        self.database = database
+        self._logger = logger
+        self._metrics = metrics
+        self._worker = _Worker(name=f"gofr-sql-{dialect}")
+        self._driver = None
+        self._connect_error: str | None = None
+
+    def _dial(self):
+        """Runs on the worker thread."""
+        if self._driver is None:
+            if self.dialect == "postgres":
+                from .pgwire import PGWire
+
+                self._driver = PGWire(self.host, self.port, self.user,
+                                      self._password, self.database)
+            else:
+                from .mywire import MySQLWire
+
+                self._driver = MySQLWire(self.host, self.port, self.user,
+                                         self._password, self.database)
+            self._connect_error = None
+            if self._logger is not None:
+                self._logger.infof("connected to %s at %s:%d/%s",
+                                   self.dialect, self.host, self.port,
+                                   self.database)
+        return self._driver
+
+    def _execute(self, query: str, args: tuple):
+        start = time.perf_counter()
+        try:
+            def run():
+                try:
+                    return self._dial().execute(query, args)
+                except (OSError, ConnectionError) as exc:
+                    # drop the connection: next call re-dials (retry loop)
+                    self._driver = None
+                    self._connect_error = str(exc)
+                    raise
+            return self._worker.call(run)
+        finally:
+            self._observe(query, start, args)
+
+    def exec(self, query: str, *args: Any) -> int:
+        _c, _r, rowcount, _l = self._execute(query, args)
+        return rowcount
+
+    def exec_last_id(self, query: str, *args: Any) -> int | None:
+        """mysql: OK-packet last_insert_id; postgres: use ``RETURNING id``
+        (the dialect-aware CRUD builder emits it)."""
+        _c, _r, _n, last_id = self._execute(query, args)
+        return last_id
+
+    def query(self, query: str, *args: Any) -> list[dict]:
+        cols, rows, _n, _l = self._execute(query, args)
+        return [dict(zip(cols, row)) for row in rows]
+
+    def begin(self) -> WireTx:
+        return WireTx(self)
+
+    def health_check(self) -> dict:
+        try:
+            self.query("SELECT 1")
+            return {"status": "UP", "details": {
+                "dialect": self.dialect, "database": self.database,
+                "host": f"{self.host}:{self.port}"}}
+        except Exception as exc:
+            return {"status": "DOWN", "details": {
+                "dialect": self.dialect,
+                "error": self._connect_error or str(exc)[:200]}}
+
+    def close(self) -> None:
+        def run():
+            if self._driver is not None:
+                self._driver.close()
+                self._driver = None
+        try:
+            self._worker.call(run)
+        finally:
+            self._worker.close()
+
+
+_DEFAULT_PORTS = {"postgres": 5432, "mysql": 3306}
+
+
+def new_sql(config, logger=None, metrics=None):
     """Construct from config (reference sql/sql.go NewSQL): DB_DIALECT
-    selects the driver; only sqlite ships in-image."""
+    selects sqlite (stdlib), or the from-scratch postgres/mysql wire
+    clients with DB_HOST/DB_PORT/DB_USER/DB_PASSWORD/DB_NAME."""
     dialect = (config.get("DB_DIALECT") or "sqlite").lower()
     if dialect == "sqlite":
         name = config.get_or_default("DB_NAME", ":memory:")
@@ -273,5 +414,14 @@ def new_sql(config, logger=None, metrics=None) -> SQL:
             logger.infof("connected to sqlite database %s", name)
         return db
     if dialect in ("mysql", "postgres"):
-        raise UnavailableDriverError(dialect, f"{dialect} client")
+        return WireSQL(
+            dialect,
+            host=config.get_or_default("DB_HOST", "localhost"),
+            port=int(config.get_or_default(
+                "DB_PORT", str(_DEFAULT_PORTS[dialect]))),
+            user=config.get_or_default("DB_USER", "root"),
+            password=config.get_or_default("DB_PASSWORD", ""),
+            database=config.get_or_default("DB_NAME", ""),
+            logger=logger, metrics=metrics,
+        )
     raise ValueError(f"unsupported DB_DIALECT {dialect!r}")

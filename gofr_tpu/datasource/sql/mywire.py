@@ -1,0 +1,263 @@
+"""MySQL client/server-protocol client, from scratch over a socket.
+
+Reference: the SQL driver's mysql dialect rides database/sql +
+go-sql-driver (pkg/gofr/datasource/sql/sql.go:39-128). No mysql client
+library ships in this image; this implements the classic protocol
+directly: handshake v10 + ``mysql_native_password`` auth (sha1 scramble),
+COM_QUERY text resultsets (length-encoded integers/strings), OK/ERR/EOF
+packets.
+
+Parameters are client-side-escaped into the query text (the text protocol
+has no server-side binding; go-sql-driver does the same when
+interpolateParams is enabled). Escaping covers NUL/quote/backslash per
+mysql_real_escape_string.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+
+__all__ = ["MySQLWire", "MySQLError", "escape_value"]
+
+CLIENT_PROTOCOL_41 = 0x00000200
+CLIENT_SECURE_CONNECTION = 0x00008000
+CLIENT_PLUGIN_AUTH = 0x00080000
+CLIENT_CONNECT_WITH_DB = 0x00000008
+
+
+class MySQLError(Exception):
+    def __init__(self, code: int, message: str) -> None:
+        self.code = code
+        super().__init__(f"mysql error {code}: {message}")
+
+
+# '' (quote doubling) is valid in MySQL regardless of
+# NO_BACKSLASH_ESCAPES and in ANSI SQL; backslash still needs escaping for
+# MySQL's default mode (a raw \ before the closing quote would consume it)
+_ESCAPES = {0: "\\0", 26: "\\Z", 39: "''", 92: "\\\\"}
+
+
+def escape_value(v) -> str:
+    """Render one parameter as a safe SQL literal."""
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, bytes):
+        return "X'" + v.hex() + "'"
+    out = []
+    for ch in str(v):
+        e = _ESCAPES.get(ord(ch))
+        out.append(e if e is not None else ch)
+    return "'" + "".join(out) + "'"
+
+
+def interpolate(query: str, args: tuple) -> str:
+    """Substitute ``?`` placeholders (skipping quoted regions) with escaped
+    literals."""
+    out, ai, i, n = [], 0, 0, len(query)
+    while i < n:
+        ch = query[i]
+        if ch in ("'", '"'):
+            j = i + 1
+            while j < n:
+                if query[j] == "\\":
+                    j += 2
+                    continue
+                if query[j] == ch:
+                    j += 1
+                    break
+                j += 1
+            out.append(query[i:j])
+            i = j
+        elif ch == "?":
+            if ai >= len(args):
+                raise MySQLError(0, "not enough args for placeholders")
+            out.append(escape_value(args[ai]))
+            ai += 1
+            i += 1
+        else:
+            out.append(ch)
+            i += 1
+    if ai != len(args):
+        raise MySQLError(0, f"query wants {ai} args, got {len(args)}")
+    return "".join(out)
+
+
+def native_password_scramble(password: str, salt: bytes) -> bytes:
+    """sha1(pass) xor sha1(salt + sha1(sha1(pass)))."""
+    if not password:
+        return b""
+    h1 = hashlib.sha1(password.encode()).digest()
+    h2 = hashlib.sha1(h1).digest()
+    h3 = hashlib.sha1(salt + h2).digest()
+    return bytes(a ^ b for a, b in zip(h1, h3))
+
+
+def _lenenc_int(data: bytes, off: int) -> tuple[int, int]:
+    first = data[off]
+    if first < 0xFB:
+        return first, off + 1
+    if first == 0xFC:
+        return struct.unpack("<H", data[off + 1:off + 3])[0], off + 3
+    if first == 0xFD:
+        return int.from_bytes(data[off + 1:off + 4], "little"), off + 4
+    return struct.unpack("<Q", data[off + 1:off + 9])[0], off + 9
+
+
+def _lenenc_str(data: bytes, off: int) -> tuple[bytes | None, int]:
+    if data[off] == 0xFB:  # NULL
+        return None, off + 1
+    n, off = _lenenc_int(data, off)
+    return data[off:off + n], off + n
+
+
+class MySQLWire:
+    """One synchronous mysql connection (classic text protocol)."""
+
+    def __init__(self, host: str, port: int, user: str, password: str,
+                 database: str, *, timeout: float = 10.0) -> None:
+        self.host, self.port = host, port
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._buf = b""
+        self._seq = 0
+        self._handshake(user, password, database)
+
+    # -- framing: 3-byte little-endian length + sequence byte ------------------
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise MySQLError(0, "connection closed by server")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _read_packet(self) -> bytes:
+        head = self._recv_exact(4)
+        size = int.from_bytes(head[:3], "little")
+        self._seq = head[3] + 1
+        return self._recv_exact(size)
+
+    def _send_packet(self, payload: bytes) -> None:
+        self._sock.sendall(len(payload).to_bytes(3, "little")
+                           + bytes([self._seq & 0xFF]) + payload)
+        self._seq += 1
+
+    # -- handshake -------------------------------------------------------------
+    def _handshake(self, user: str, password: str, database: str) -> None:
+        greeting = self._read_packet()
+        if greeting[0] == 0xFF:
+            raise self._err(greeting)
+        if greeting[0] != 10:
+            raise MySQLError(0, f"unsupported handshake v{greeting[0]}")
+        off = 1
+        end = greeting.index(b"\0", off)
+        self.server_version = greeting[off:end].decode()
+        off = end + 1 + 4  # thread id
+        salt = greeting[off:off + 8]
+        off += 8 + 1  # filler
+        off += 2 + 1 + 2 + 2  # caps low, charset, status, caps high
+        auth_len = greeting[off]
+        off += 1 + 10  # reserved
+        salt += greeting[off:off + max(13, auth_len - 8)].rstrip(b"\0")
+        salt = salt[:20]
+
+        caps = (CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION
+                | CLIENT_PLUGIN_AUTH | CLIENT_CONNECT_WITH_DB)
+        scramble = native_password_scramble(password, salt)
+        payload = (struct.pack("<IIB23x", caps, 1 << 24, 33)
+                   + user.encode() + b"\0"
+                   + bytes([len(scramble)]) + scramble
+                   + database.encode() + b"\0"
+                   + b"mysql_native_password\0")
+        self._send_packet(payload)
+        resp = self._read_packet()
+        if resp[0] == 0xFF:
+            raise self._err(resp)
+        if resp[0] == 0xFE:  # AuthSwitchRequest
+            end = resp.index(b"\0", 1)
+            plugin = resp[1:end].decode()
+            if plugin != "mysql_native_password":
+                raise MySQLError(0, f"unsupported auth plugin {plugin}")
+            salt2 = resp[end + 1:].rstrip(b"\0")[:20]
+            self._send_packet(native_password_scramble(password, salt2))
+            resp = self._read_packet()
+            if resp[0] == 0xFF:
+                raise self._err(resp)
+
+    @staticmethod
+    def _err(packet: bytes) -> MySQLError:
+        code = struct.unpack("<H", packet[1:3])[0]
+        msg = packet[3:].decode(errors="replace")
+        if msg.startswith("#"):
+            msg = msg[6:]  # strip SQL-state marker
+        return MySQLError(code, msg)
+
+    # -- COM_QUERY -------------------------------------------------------------
+    def execute(self, query: str, args: tuple = ()
+                ) -> tuple[list[str], list[tuple], int, int | None]:
+        """Run one statement; returns (columns, rows, rowcount, last_id)."""
+        self._seq = 0
+        self._send_packet(b"\x03" + interpolate(query, args).encode())
+        first = self._read_packet()
+        if first[0] == 0xFF:
+            raise self._err(first)
+        if first[0] == 0x00:  # OK: non-resultset statement
+            affected, off = _lenenc_int(first, 1)
+            last_id, _ = _lenenc_int(first, off)
+            return [], [], affected, last_id or None
+        ncols, _ = _lenenc_int(first, 0)
+        cols: list[str] = []
+        types: list[int] = []
+        for _ in range(ncols):
+            defn = self._read_packet()
+            off = 0
+            parts = []
+            for _f in range(6):  # catalog, schema, table, org_table, name, org
+                s, off = _lenenc_str(defn, off)
+                parts.append(s)
+            cols.append((parts[4] or b"").decode())
+            off += 1  # fixed-length marker (0x0c)
+            off += 2 + 4  # charset, column length
+            types.append(defn[off])
+        pkt = self._read_packet()
+        if pkt[0] == 0xFE and len(pkt) < 9:
+            pkt = self._read_packet()  # EOF after column defs
+        rows: list[tuple] = []
+        while True:
+            if pkt[0] == 0xFF:
+                raise self._err(pkt)
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break  # EOF: resultset done
+            off, vals = 0, []
+            for ct in types:
+                raw, off = _lenenc_str(pkt, off)
+                vals.append(self._convert(ct, raw))
+            rows.append(tuple(vals))
+            pkt = self._read_packet()
+        return cols, rows, len(rows), None
+
+    @staticmethod
+    def _convert(col_type: int, raw: bytes | None):
+        if raw is None:
+            return None
+        text = raw.decode()
+        # MYSQL_TYPE_*: 1-9 ints/floats, 0x0f/0xfd/0xfe strings, 0xf6 decimal
+        if col_type in (1, 2, 3, 8, 9, 13):
+            return int(text)
+        if col_type in (4, 5, 0, 0xF6):
+            return float(text)
+        return text
+
+    def close(self) -> None:
+        try:
+            self._seq = 0
+            self._send_packet(b"\x01")  # COM_QUIT
+        except Exception:
+            pass
+        self._sock.close()

@@ -1,0 +1,273 @@
+"""PostgreSQL wire-protocol (v3) client, from scratch over a socket.
+
+Reference: the SQL driver's postgres dialect rides database/sql + lib/pq
+(pkg/gofr/datasource/sql/sql.go:39-128). No postgres client library ships
+in this image; protocol v3 is small and text-friendly, so — like the
+RESP/NATS/Kafka clients — this speaks it directly:
+
+- startup + auth: cleartext, md5, and SCRAM-SHA-256 (stdlib hashlib/hmac)
+- extended query protocol (Parse/Bind/Describe/Execute/Sync) so ``?``
+  placeholders bind server-side as $N text parameters — no client-side
+  string interpolation
+- RowDescription type OIDs drive text→Python conversion (bool/int/float)
+
+Synchronous by design: every call runs on the SQL datasource's dedicated
+worker thread (sql/__init__.py), never on the event loop.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import re
+import socket
+import struct
+
+__all__ = ["PGWire", "PGError", "convert_placeholders"]
+
+
+class PGError(Exception):
+    def __init__(self, fields: dict[str, str]) -> None:
+        self.fields = fields
+        super().__init__(f"{fields.get('S', 'ERROR')} {fields.get('C', '')}: "
+                         f"{fields.get('M', 'unknown')}")
+
+
+_QUOTED = re.compile(r"'(?:[^']|'')*'|\"(?:[^\"]|\"\")*\"")
+
+
+def convert_placeholders(query: str) -> tuple[str, int]:
+    """Rewrite ``?`` placeholders (outside quoted regions) to ``$1..$n``."""
+    out, n, last = [], 0, 0
+    spans = [m.span() for m in _QUOTED.finditer(query)]
+
+    def quoted(i: int) -> bool:
+        return any(a <= i < b for a, b in spans)
+
+    for i, ch in enumerate(query):
+        if ch == "?" and not quoted(i):
+            out.append(query[last:i])
+            n += 1
+            out.append(f"${n}")
+            last = i + 1
+    out.append(query[last:])
+    return "".join(out), n
+
+
+# OID -> converter for text-format results
+_OID_BOOL = {16}
+_OID_INT = {20, 21, 23, 26, 28}
+_OID_FLOAT = {700, 701, 1700}
+
+
+def _convert(oid: int, raw: bytes | None):
+    if raw is None:
+        return None
+    text = raw.decode()
+    if oid in _OID_INT:
+        return int(text)
+    if oid in _OID_FLOAT:
+        return float(text)
+    if oid in _OID_BOOL:
+        return text == "t"
+    return text
+
+
+class PGWire:
+    """One synchronous postgres connection (protocol 3.0)."""
+
+    def __init__(self, host: str, port: int, user: str, password: str,
+                 database: str, *, timeout: float = 10.0) -> None:
+        self.host, self.port = host, port
+        self.user, self.password, self.database = user, password, database
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._buf = b""
+        self._startup()
+
+    # -- framing ---------------------------------------------------------------
+    def _send(self, type_byte: bytes, payload: bytes) -> None:
+        self._sock.sendall(type_byte + struct.pack(">i", len(payload) + 4) + payload)
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise PGError({"M": "connection closed by server"})
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _read_message(self) -> tuple[bytes, bytes]:
+        head = self._recv_exact(5)
+        mtype = head[:1]
+        (size,) = struct.unpack(">i", head[1:])
+        return mtype, self._recv_exact(size - 4)
+
+    # -- startup + auth --------------------------------------------------------
+    def _startup(self) -> None:
+        params = f"user\0{self.user}\0database\0{self.database}\0\0".encode()
+        payload = struct.pack(">i", 196608) + params  # protocol 3.0
+        self._sock.sendall(struct.pack(">i", len(payload) + 4) + payload)
+        scram = None
+        while True:
+            mtype, body = self._read_message()
+            if mtype == b"R":
+                (code,) = struct.unpack(">i", body[:4])
+                if code == 0:
+                    continue  # AuthenticationOk
+                if code == 3:  # cleartext
+                    self._send(b"p", self.password.encode() + b"\0")
+                elif code == 5:  # md5(md5(password+user)+salt)
+                    salt = body[4:8]
+                    inner = hashlib.md5(
+                        (self.password + self.user).encode()).hexdigest()
+                    digest = hashlib.md5(inner.encode() + salt).hexdigest()
+                    self._send(b"p", b"md5" + digest.encode() + b"\0")
+                elif code == 10:  # SASL: pick SCRAM-SHA-256
+                    scram = _Scram(self.password)
+                    first = scram.client_first()
+                    self._send(b"p", b"SCRAM-SHA-256\0"
+                               + struct.pack(">i", len(first)) + first)
+                elif code == 11 and scram is not None:  # SASL continue
+                    self._send(b"p", scram.client_final(body[4:]))
+                elif code == 12 and scram is not None:  # SASL final
+                    scram.verify_server(body[4:])
+                else:
+                    raise PGError({"M": f"unsupported auth code {code}"})
+            elif mtype == b"E":
+                raise PGError(self._parse_error(body))
+            elif mtype == b"Z":  # ReadyForQuery
+                return
+            # 'S' ParameterStatus / 'K' BackendKeyData / 'N' notice: ignore
+
+    @staticmethod
+    def _parse_error(body: bytes) -> dict[str, str]:
+        fields = {}
+        for part in body.split(b"\0"):
+            if part:
+                fields[chr(part[0])] = part[1:].decode(errors="replace")
+        return fields
+
+    # -- extended query --------------------------------------------------------
+    def execute(self, query: str, args: tuple = ()
+                ) -> tuple[list[str], list[tuple], int, int | None]:
+        """Run one statement; returns (columns, rows, rowcount, last_id).
+
+        ``last_id`` is the first column of the first row when the statement
+        used RETURNING (postgres has no lastrowid).
+        """
+        q, nparams = convert_placeholders(query)
+        if nparams != len(args):
+            raise PGError({"M": f"query wants {nparams} args, got {len(args)}"})
+        self._send(b"P", b"\0" + q.encode() + b"\0" + struct.pack(">h", 0))
+        bind = [b"\0\0", struct.pack(">h", 0), struct.pack(">h", len(args))]
+        for a in args:
+            if a is None:
+                bind.append(struct.pack(">i", -1))
+            else:
+                if isinstance(a, bool):
+                    raw = b"true" if a else b"false"
+                elif isinstance(a, bytes):
+                    raw = a
+                else:
+                    raw = str(a).encode()
+                bind.append(struct.pack(">i", len(raw)) + raw)
+        bind.append(struct.pack(">h", 0))  # result formats: all text
+        self._send(b"B", b"".join(bind))
+        self._send(b"D", b"P\0")
+        self._send(b"E", b"\0" + struct.pack(">i", 0))
+        self._send(b"S", b"")
+
+        cols: list[str] = []
+        oids: list[int] = []
+        rows: list[tuple] = []
+        rowcount = 0
+        error: dict | None = None
+        while True:
+            mtype, body = self._read_message()
+            if mtype == b"T":
+                (n,) = struct.unpack(">h", body[:2])
+                off = 2
+                for _ in range(n):
+                    end = body.index(b"\0", off)
+                    cols.append(body[off:end].decode())
+                    off = end + 1
+                    _table, _attr, oid, _tl, _tm, _fmt = struct.unpack(
+                        ">ihihih", body[off:off + 18])
+                    oids.append(oid)
+                    off += 18
+            elif mtype == b"D":
+                (n,) = struct.unpack(">h", body[:2])
+                off, vals = 2, []
+                for i in range(n):
+                    (ln,) = struct.unpack(">i", body[off:off + 4])
+                    off += 4
+                    if ln < 0:
+                        vals.append(None)
+                    else:
+                        vals.append(_convert(oids[i] if i < len(oids) else 25,
+                                             body[off:off + ln]))
+                        off += ln
+                rows.append(tuple(vals))
+            elif mtype == b"C":
+                tag = body.rstrip(b"\0").decode()
+                parts = tag.split(" ")
+                if parts and parts[-1].isdigit():
+                    rowcount = int(parts[-1])
+            elif mtype == b"E":
+                error = self._parse_error(body)
+            elif mtype == b"Z":
+                break
+            # '1' ParseComplete / '2' BindComplete / 'n' NoData / 'N': ignore
+        if error is not None:
+            raise PGError(error)
+        last_id = None
+        if rows and rows[0] and isinstance(rows[0][0], int):
+            last_id = rows[0][0]
+        return cols, rows, rowcount if not rows else len(rows), last_id
+
+    def close(self) -> None:
+        try:
+            self._send(b"X", b"")
+        except Exception:
+            pass
+        self._sock.close()
+
+
+class _Scram:
+    """SCRAM-SHA-256 client (RFC 5802/7677) on stdlib crypto."""
+
+    def __init__(self, password: str) -> None:
+        self._password = password.encode()
+        self._nonce = base64.b64encode(os.urandom(18)).decode()
+        self._client_first_bare = f"n={''},r={self._nonce}"
+        self._server_signature: bytes | None = None
+
+    def client_first(self) -> bytes:
+        return ("n,," + self._client_first_bare).encode()
+
+    def client_final(self, server_first: bytes) -> bytes:
+        sf = server_first.decode()
+        parts = dict(p.split("=", 1) for p in sf.split(","))
+        r, s, i = parts["r"], base64.b64decode(parts["s"]), int(parts["i"])
+        if not r.startswith(self._nonce):
+            raise PGError({"M": "scram: server nonce mismatch"})
+        salted = hashlib.pbkdf2_hmac("sha256", self._password, s, i)
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored = hashlib.sha256(client_key).digest()
+        final_bare = f"c=biws,r={r}"
+        auth_msg = ",".join([self._client_first_bare, sf, final_bare]).encode()
+        sig = hmac.new(stored, auth_msg, hashlib.sha256).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, sig))
+        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        self._server_signature = hmac.new(
+            server_key, auth_msg, hashlib.sha256).digest()
+        return (final_bare + ",p=" + base64.b64encode(proof).decode()).encode()
+
+    def verify_server(self, server_final: bytes) -> None:
+        parts = dict(p.split("=", 1)
+                     for p in server_final.decode().split(","))
+        if "v" not in parts or base64.b64decode(parts["v"]) != self._server_signature:
+            raise PGError({"M": "scram: bad server signature"})

@@ -335,6 +335,27 @@ def oauth_middleware(
     return mw
 
 
+def jwks_oauth_middleware(provider) -> Middleware:
+    """Bearer-token guard verifying RS256 against a cached JWKS document
+    (the reference's production path, middleware/oauth.go:63-143); see
+    http/jwks.py for the provider."""
+
+    async def mw(request: web.Request, nxt: Handler) -> web.StreamResponse:
+        if is_well_known(request.path) or request.method == "OPTIONS":
+            return await nxt(request)
+        header = request.headers.get("Authorization", "")
+        if not header.startswith("Bearer "):
+            return _unauthorized()
+        try:
+            claims = await provider.verify(header[7:])
+        except Exception as exc:
+            return _unauthorized(f"invalid token: {exc}")
+        request["gofr_auth"] = ("oauth", claims)
+        return await nxt(request)
+
+    return mw
+
+
 def _decode_unverified(token: str) -> dict:
     parts = token.split(".")
     if len(parts) != 3:

@@ -1,18 +1,28 @@
 """Database migrations.
 
 Mirrors the reference's migration vertical (pkg/gofr/migration/): ``run``
-sorts the version map, ensures a ``gofr_migrations`` bookkeeping table
-(migration/sql.go:12-18 DDL), skips versions ≤ the last applied, and wraps
-each migration in a SQL transaction + Redis pipeline — commit bookkeeping on
-success, rollback and halt on failure (migration/migration.go:28-92). The
-``Datasource`` handed to user UP functions exposes the sql/redis/pubsub
-handles (migration/interface.go:13-64), and pub/sub migrations can create or
-delete topics. For the TPU build this doubles as the model/weight registry
-evolution tool.
+sorts the version map, and a CHAIN of per-datasource migrators — SQL,
+Redis, ClickHouse, Cassandra, Mongo, PubSub — mirrors the decorator
+composition of migration.go:111-176: every present datasource keeps its own
+``gofr_migrations`` bookkeeping (table / hash / collection), the last
+applied version is the MAX across datasources, each pending migration runs
+inside whatever transactional bracket the datasource offers (SQL Tx +
+Redis pipeline; ClickHouse/Cassandra/Mongo have no multi-statement
+transactions — their migrators record bookkeeping post-hoc, as the
+reference's do), and a failure rolls back what can be rolled back and
+halts (migration/migration.go:28-92).
+
+UP functions may be sync or ``async def`` (the async datasource handles —
+clickhouse/cassandra/mongo — require an async UP); ``run`` drives them
+with ``asyncio.run`` since migrations execute at startup, before the
+serving loop exists. For the TPU build this doubles as the model/weight
+registry evolution tool.
 """
 
 from __future__ import annotations
 
+import asyncio
+import inspect
 import time
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -29,9 +39,11 @@ CREATE TABLE IF NOT EXISTS gofr_migrations (
 )
 """
 
+_REDIS_KEY = "gofr_migrations"
+
 
 class Datasource:
-    """What a migration's UP function receives."""
+    """What a migration's UP function receives (migration/interface.go:13-64)."""
 
     def __init__(self, container) -> None:
         self._container = container
@@ -39,6 +51,9 @@ class Datasource:
         self.redis = container.redis
         self.kv = container.kv
         self.pubsub = container.pubsub
+        self.clickhouse = container.clickhouse
+        self.cassandra = container.cassandra
+        self.mongo = container.mongo
         self.logger = container.logger
 
     def create_topic(self, name: str) -> None:
@@ -55,27 +70,182 @@ class Migrate:
     up: Callable[[Datasource], Any]
 
 
-def _last_version(sql) -> int:
-    row = sql.query_row("SELECT MAX(version) AS v FROM gofr_migrations")
-    return int(row["v"]) if row and row["v"] is not None else 0
+def _ts(t: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t))
 
 
-def run(migrations: dict[int, Migrate | Callable], container) -> None:
-    """Apply pending migrations in version order; halt on first failure."""
+async def _maybe(result):
+    if inspect.isawaitable(result):
+        return await result
+    return result
+
+
+# -- per-datasource migrators (decorator chain of migration.go:111-176) -------
+
+class _SQLMigrator:
+    name = "sql"
+
+    def __init__(self, sql) -> None:
+        self._sql = sql
+
+    async def ensure(self) -> None:
+        self._sql.exec(_CREATE_TABLE)
+
+    async def last(self) -> int:
+        row = self._sql.query_row(
+            "SELECT MAX(version) AS v FROM gofr_migrations")
+        return int(row["v"]) if row and row["v"] is not None else 0
+
+    async def begin(self, ds: Datasource):
+        tx = self._sql.begin()
+        ds.sql = tx
+        return tx
+
+    async def commit(self, tx, version: int, start: float, dur_ms: int) -> None:
+        tx.exec(
+            "INSERT INTO gofr_migrations (version, method, start_time, duration)"
+            " VALUES (?, ?, ?, ?)", version, "UP", _ts(start), dur_ms)
+        tx.commit()
+
+    async def rollback(self, tx) -> None:
+        tx.rollback()
+
+
+class _RedisMigrator:
+    name = "redis"
+
+    def __init__(self, redis) -> None:
+        self._redis = redis
+
+    async def ensure(self) -> None:
+        pass  # the hash appears on first commit
+
+    async def last(self) -> int:
+        records = self._redis.hgetall(_REDIS_KEY)
+        return max((int(v) for v in records), default=0)
+
+    async def begin(self, ds: Datasource):
+        pipe = self._redis.pipeline()
+        ds.redis = pipe
+        return pipe
+
+    async def commit(self, pipe, version: int, start: float, dur_ms: int) -> None:
+        pipe.command("HSET", _REDIS_KEY, str(version),
+                     f'{{"method":"UP","startTime":"{_ts(start)}",'
+                     f'"duration":{dur_ms}}}')
+        pipe.exec()
+
+    async def rollback(self, pipe) -> None:
+        pipe.discard()
+
+
+class _ClickHouseMigrator:
+    name = "clickhouse"
+
+    def __init__(self, ch) -> None:
+        self._ch = ch
+
+    async def ensure(self) -> None:
+        await self._ch.exec(
+            "CREATE TABLE IF NOT EXISTS gofr_migrations "
+            "(version Int64, method String, start_time String, duration Int64) "
+            "ENGINE = MergeTree ORDER BY version")
+
+    async def last(self) -> int:
+        rows = await self._ch.select(
+            "SELECT max(version) AS v FROM gofr_migrations")
+        v = rows[0]["v"] if rows else 0
+        return int(v or 0)
+
+    async def begin(self, ds: Datasource):
+        return None  # no transactions in clickhouse
+
+    async def commit(self, _state, version: int, start: float, dur_ms: int) -> None:
+        await self._ch.insert_rows("gofr_migrations", [{
+            "version": version, "method": "UP", "start_time": _ts(start),
+            "duration": dur_ms}])
+
+    async def rollback(self, _state) -> None:
+        pass  # nothing to roll back; the bookkeeping row was never written
+
+
+class _CassandraMigrator:
+    name = "cassandra"
+
+    def __init__(self, cas) -> None:
+        self._cas = cas
+
+    async def ensure(self) -> None:
+        await self._cas.exec(
+            "CREATE TABLE IF NOT EXISTS gofr_migrations "
+            "(version bigint PRIMARY KEY, method text, start_time text, "
+            "duration bigint)")
+
+    async def last(self) -> int:
+        rows = await self._cas.query("SELECT version FROM gofr_migrations")
+        return max((int(r["version"] if isinstance(r, dict) else r[0])
+                    for r in rows), default=0)
+
+    async def begin(self, ds: Datasource):
+        return None  # CQL has no multi-statement transactions
+
+    async def commit(self, _state, version: int, start: float, dur_ms: int) -> None:
+        await self._cas.exec(
+            "INSERT INTO gofr_migrations (version, method, start_time, duration)"
+            " VALUES (?, ?, ?, ?)", (version, "UP", _ts(start), dur_ms))
+
+    async def rollback(self, _state) -> None:
+        pass
+
+
+class _MongoMigrator:
+    name = "mongo"
+
+    def __init__(self, mongo) -> None:
+        self._mongo = mongo
+
+    async def ensure(self) -> None:
+        pass  # the collection appears on first insert
+
+    async def last(self) -> int:
+        rows = await self._mongo.find("gofr_migrations")
+        return max((int(r.get("version", 0)) for r in rows), default=0)
+
+    async def begin(self, ds: Datasource):
+        return None
+
+    async def commit(self, _state, version: int, start: float, dur_ms: int) -> None:
+        await self._mongo.insert_one("gofr_migrations", {
+            "version": version, "method": "UP", "startTime": _ts(start),
+            "duration": dur_ms})
+
+    async def rollback(self, _state) -> None:
+        pass
+
+
+def _build_chain(container) -> list:
+    chain = []
+    if container.sql is not None:
+        chain.append(_SQLMigrator(container.sql))
+    if container.redis is not None:
+        chain.append(_RedisMigrator(container.redis))
+    if container.clickhouse is not None:
+        chain.append(_ClickHouseMigrator(container.clickhouse))
+    if container.cassandra is not None:
+        chain.append(_CassandraMigrator(container.cassandra))
+    if container.mongo is not None:
+        chain.append(_MongoMigrator(container.mongo))
+    return chain
+
+
+async def _run_async(migrations: dict[int, Any], container) -> None:
     logger = container.logger
-    if not migrations:
-        return
-    invalid = [k for k in migrations if not isinstance(k, int) or k <= 0]
-    if invalid:
-        logger.errorf("invalid migration versions: %s", invalid)
-        return
-
-    sql = container.sql
-    if sql is not None:
-        sql.exec(_CREATE_TABLE)
-        last = _last_version(sql)
-    else:
-        last = 0
+    chain = _build_chain(container)
+    for m in chain:
+        await m.ensure()
+    last = 0
+    for m in chain:
+        last = max(last, await m.last())
 
     for version in sorted(migrations):
         if version <= last:
@@ -83,32 +253,36 @@ def run(migrations: dict[int, Migrate | Callable], container) -> None:
         entry = migrations[version]
         up = entry.up if isinstance(entry, Migrate) else entry
         start = time.time()
-        tx = sql.begin() if sql is not None else None
-        redis_pipe = container.redis.pipeline() if container.redis is not None else None
         ds = Datasource(container)
-        if tx is not None:
-            ds.sql = tx
-        if redis_pipe is not None:
-            ds.redis = redis_pipe
+        states = [(m, await m.begin(ds)) for m in chain]
         try:
-            up(ds)
-            duration_ms = int((time.time() - start) * 1e3)
-            if tx is not None:
-                tx.exec(
-                    "INSERT INTO gofr_migrations (version, method, start_time, duration)"
-                    " VALUES (?, ?, ?, ?)",
-                    version, "UP",
-                    time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(start)),
-                    duration_ms,
-                )
-                tx.commit()
-            if redis_pipe is not None:
-                redis_pipe.exec()
-            logger.infof("migration %d applied in %dms", version, duration_ms)
+            await _maybe(up(ds))
+            dur_ms = int((time.time() - start) * 1e3)
+            for m, state in states:
+                await m.commit(state, version, start, dur_ms)
+            logger.infof("migration %d applied in %dms", version, dur_ms)
         except Exception as exc:
-            if tx is not None:
-                tx.rollback()
-            if redis_pipe is not None:
-                redis_pipe.discard()
+            for m, state in states:
+                try:
+                    await m.rollback(state)
+                except Exception:
+                    logger.errorf("migration %d: %s rollback failed", version,
+                                  m.name)
             logger.errorf("migration %d failed: %s; halting", version, exc)
             raise
+
+
+def run(migrations: dict[int, Migrate | Callable], container) -> None:
+    """Apply pending migrations in version order; halt on first failure.
+
+    Runs at startup (before the event loop): async datasources are driven
+    with a private loop.
+    """
+    logger = container.logger
+    if not migrations:
+        return
+    invalid = [k for k in migrations if not isinstance(k, int) or k <= 0]
+    if invalid:
+        logger.errorf("invalid migration versions: %s", invalid)
+        return
+    asyncio.run(_run_async(migrations, container))

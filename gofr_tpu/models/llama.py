@@ -31,7 +31,7 @@ import numpy as np
 from ..ops import (
     apply_rope,
     attention,
-    decode_attention,
+    cached_decode_attention,
     flash_attention,
     repeat_kv,
     rms_norm,
@@ -148,9 +148,9 @@ def init_params(cfg: LlamaConfig, key) -> dict:
     }
 
 
-def _layer(cfg: LlamaConfig, x, lp, cos, sin, *, kv_len=None, k_cache=None,
-           v_cache=None, pos=None, full_seq: bool):
-    """One decoder block. Returns (x, k_proj, v_proj[, caches])."""
+def _layer(cfg: LlamaConfig, x, lp, cos, sin, *, kv_len=None, full_seq=True):
+    """One full-sequence decoder block (training / prefill).
+    Returns (x, k_proj, v_proj)."""
     b, s, _ = x.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -163,21 +163,11 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, *, kv_len=None, k_cache=None,
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    if full_seq:
-        kf, vf = repeat_kv(k, cfg.n_rep), repeat_kv(v, cfg.n_rep)
-        if cfg.use_flash:
-            o = flash_attention(q, kf, vf, causal=True, kv_len=kv_len)
-        else:
-            o = attention(q, kf, vf, causal=True, kv_len=kv_len)
-        new_k, new_v = k, v
+    kf, vf = repeat_kv(k, cfg.n_rep), repeat_kv(v, cfg.n_rep)
+    if cfg.use_flash:
+        o = flash_attention(q, kf, vf, causal=True, kv_len=kv_len)
     else:
-        # decode: write this token into the cache at each row's position
-        rows = jnp.arange(b)
-        new_k = k_cache.at[rows, pos].set(k[:, 0])
-        new_v = v_cache.at[rows, pos].set(v[:, 0])
-        kf = repeat_kv(new_k, cfg.n_rep)
-        vf = repeat_kv(new_v, cfg.n_rep)
-        o = decode_attention(q, kf, vf, kv_len=pos + 1)
+        o = attention(q, kf, vf, causal=True, kv_len=kv_len)
 
     o = o.reshape(b, s, H * hd)
     x = x + constrain(o @ lp["wo"], P("dp", "sp", None))
@@ -186,7 +176,43 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, *, kv_len=None, k_cache=None,
     x = x + constrain(
         swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]), P("dp", "sp", None)
     )
-    return x, new_k, new_v
+    return x, k, v
+
+
+def _decode_layer(cfg: LlamaConfig, x, lp, cos, sin, k_all, v_all, layer,
+                  pos, rows):
+    """One decode block writing directly into the FULL stacked cache.
+
+    The caches ride the layer scan's CARRY so XLA aliases them in place: a
+    first version returned per-layer caches through scan ys, which
+    restacked (= copied) the entire multi-GB cache every token — that copy,
+    not attention, was the r1 decode bottleneck (BENCH_r01 8.4 ms steps).
+    Here the only cache write is the [B, KV, D] scatter of the new token at
+    ``[layer, rows, pos]``.
+    """
+    b = x.shape[0]
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(b, 1, H, hd)
+    k = (h @ lp["wk"]).reshape(b, 1, KV, hd)
+    v = (h @ lp["wv"]).reshape(b, 1, KV, hd)
+    q = constrain(q, P("dp", None, "tp", None))
+    k = constrain(k, P("dp", None, "tp", None))
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    k_all = k_all.at[layer, rows, pos].set(k[:, 0])
+    v_all = v_all.at[layer, rows, pos].set(v[:, 0])
+    o = cached_decode_attention(q, k_all, v_all, pos + 1, layer=layer,
+                                use_kernel=cfg.use_flash)
+
+    x = x + constrain(o.reshape(b, 1, H * hd) @ lp["wo"], P("dp", "sp", None))
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    x = x + constrain(
+        swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]), P("dp", "sp", None)
+    )
+    return x, k_all, v_all
 
 
 def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
@@ -291,14 +317,19 @@ def decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
     pos = cache["len"]  # [B]
     x = params["embed"][tokens][:, None, :].astype(cfg.dtype)
     cos, sin = rope_table(pos[:, None], cfg.head_dim, cfg.rope_theta)
+    rows = jnp.arange(b)
 
-    def body(x, xs):
-        lp, kc, vc = xs
-        x, nk, nv = _layer(cfg, x, lp, cos, sin, k_cache=kc, v_cache=vc,
-                           pos=pos, full_seq=False)
-        return x, (nk, nv)
+    # weights stream through scan xs; the FULL caches ride the carry with a
+    # carried layer counter, so cache updates alias in place (see
+    # _decode_layer docstring for why ys-restacking was the r1 bottleneck)
+    def body(carry, lp):
+        x, k_all, v_all, layer = carry
+        x, k_all, v_all = _decode_layer(
+            cfg, x, lp, cos, sin, k_all, v_all, layer, pos, rows)
+        return (x, k_all, v_all, layer + 1), None
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    (x, ks, vs, _), _ = jax.lax.scan(
+        body, (x, cache["k"], cache["v"], jnp.int32(0)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
     # cap len at capacity: rows past the end keep decoding garbage (their

@@ -1,0 +1,168 @@
+"""Pallas TPU grouped-query decode attention over a padded KV cache.
+
+The decode step's cost is one sweep of the KV cache per layer; with a
+padded [B, S_max, KV, D] cache, XLA reads and masks all S_max positions
+even when a row holds a 100-token conversation in a 2048-slot cache. This
+kernel makes the sweep proportional to the VALID length instead:
+
+- grid = (B,): ONE cell per batch row (a first version gridded over
+  (B, S-blocks) and lost everything to per-cell overhead — 256 tiny
+  sequential cells per layer; this shape has 32).
+- the caches stay in HBM (``memory_space=ANY``); the kernel issues its own
+  double-buffered ``make_async_copy`` per [block_s, KV, D] chunk inside a
+  ``fori_loop`` whose trip count is ``cdiv(kv_len[b], block_s)`` — the
+  padded tail is neither DMA'd nor computed, so cost tracks the live
+  prefix, not S_max (guide: "DMA Pipeline Pattern").
+- query heads stay grouped: per KV head ``g`` the kernel contracts the
+  ``n_rep`` query rows against the un-expanded chunk, preserving the
+  no-``repeat_kv`` property of ``ops.gqa_decode_attention`` inside VMEM.
+- online softmax (running max / sum / accumulator carried in f32 through
+  the loop, as in flash_attention.py).
+
+``kv_len`` rides scalar prefetch so trip counts are available before the
+body runs. Reference has no counterpart (pure-Go, no ML — SURVEY §2.10);
+this is the serving-path analogue of the prefill flash kernel, needed to
+hold the BASELINE.md config-#4 token rate at large slot counts and caches.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+__all__ = ["gqa_decode_attention_tpu"]
+
+
+def _decode_kernel(kvlen_ref, layer_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf,
+                   v_buf, k_sem, v_sem, *, block_s: int, kv_heads: int,
+                   n_rep: int):
+    """One batch row: pipelined chunk sweep of its live cache prefix.
+
+    q_ref/o_ref: [H, D] VMEM; k_hbm/v_hbm: [L, B, S_max, KV, D] in HBM
+    (the layer to read is the scalar ``layer_ref[0]``);
+    k_buf/v_buf: [2, block_s, KV, D] VMEM double buffers.
+    """
+    b = pl.program_id(0)
+    kvlen = kvlen_ref[b]
+    layer = layer_ref[0]
+    n_blocks = pl.cdiv(kvlen, block_s)  # >= 1: a live row has len >= 1
+    h, d = q_ref.shape
+    scale = d ** -0.5
+
+    def copy_in(hbm, buf, sem, slot, idx):
+        return pltpu.make_async_copy(
+            hbm.at[layer, b, pl.ds(idx * block_s, block_s)], buf.at[slot],
+            sem.at[slot])
+
+    copy_in(k_hbm, k_buf, k_sem, 0, 0).start()
+    copy_in(v_hbm, v_buf, v_sem, 0, 0).start()
+
+    q = q_ref[:].astype(jnp.float32) * scale  # [H, D]
+
+    def body(i, carry):
+        acc, m, l = carry
+        slot = jax.lax.rem(i, 2)
+        nxt = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < n_blocks)
+        def _prefetch():
+            copy_in(k_hbm, k_buf, k_sem, nxt, i + 1).start()
+            copy_in(v_hbm, v_buf, v_sem, nxt, i + 1).start()
+
+        copy_in(k_hbm, k_buf, k_sem, slot, i).wait()
+        copy_in(v_hbm, v_buf, v_sem, slot, i).wait()
+
+        kpos = i * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_s), 1)
+        valid = kpos < kvlen  # [1, block_s]
+        accs, ms, ls = [], [], []
+        for g in range(kv_heads):  # static unroll: KV is small (e.g. 8)
+            r0 = g * n_rep
+            k = k_buf[slot, :, g, :].astype(jnp.float32)  # [block_s, D]
+            v = v_buf[slot, :, g, :].astype(jnp.float32)
+            logits = jax.lax.dot_general(
+                q[r0:r0 + n_rep], k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [n_rep, block_s]
+            logits = jnp.where(valid, logits, NEG_INF)
+            m_prev = m[r0:r0 + n_rep]
+            l_prev = l[r0:r0 + n_rep]
+            a_prev = acc[r0:r0 + n_rep]
+            m_cur = jnp.max(logits, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(logits - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            accs.append(a_prev * alpha + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+            ms.append(m_new)
+            ls.append(alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True))
+        return (jnp.concatenate(accs, axis=0),
+                jnp.concatenate(ms, axis=0),
+                jnp.concatenate(ls, axis=0))
+
+    acc0 = jnp.zeros((h, d), jnp.float32)
+    m0 = jnp.full((h, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((h, 1), jnp.float32)
+    acc, _m, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_s", "interpret"))
+def gqa_decode_attention_tpu(q, k_cache, v_cache, kv_len, *, layer=None,
+                             block_s: int = 256, interpret: bool = False):
+    """q: [B, 1, H, D]; caches: [B, S_max, KV, D] per-layer, or the full
+    stacked [L, B, S_max, KV, D] with ``layer`` the (traced) index to read;
+    kv_len: [B] int32.
+
+    Returns [B, 1, H, D] in q.dtype. S_max must divide by ``block_s``
+    (serving caches are power-of-two sized; callers fall back to the XLA
+    path otherwise).
+    """
+    b, tq, h, d = q.shape
+    if k_cache.ndim == 4:
+        k_cache, v_cache = k_cache[None], v_cache[None]
+        layer = 0
+    if layer is None:
+        raise ValueError("stacked caches require a layer index")
+    s_max, kv = k_cache.shape[2], k_cache.shape[3]
+    if tq != 1:
+        raise ValueError(f"decode kernel takes one query token, got Tq={tq}")
+    block_s = min(block_s, s_max)
+    if s_max % block_s:
+        raise ValueError(f"S_max {s_max} must divide block_s {block_s}")
+    n_rep = h // kv
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+    layer = jnp.asarray(layer, jnp.int32).reshape(1)
+
+    kernel = functools.partial(
+        _decode_kernel, block_s=block_s, kv_heads=kv, n_rep=n_rep,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((None, h, d), lambda bi, kvlen, lyr: (bi, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # k cache stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),  # v cache stays in HBM
+        ],
+        out_specs=pl.BlockSpec((None, h, d), lambda bi, kvlen, lyr: (bi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_s, kv, d), k_cache.dtype),
+            pltpu.VMEM((2, block_s, kv, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(kv_len, layer, q[:, 0], k_cache, v_cache)
+    return out[:, None]

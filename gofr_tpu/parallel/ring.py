@@ -26,7 +26,7 @@ from . import P
 __all__ = ["ring_attention_local", "ring_attention"]
 
 
-def ring_attention_local(q, k, v, *, axis_name: str = "sp",
+def ring_attention_local(q, k, v, kv_len=None, *, axis_name: str = "sp",
                          causal: bool = True) -> jnp.ndarray:
     """Per-shard body: q/k/v are this device's [B, T_loc, H, D] slices along
     the sequence; must run inside shard_map/vmap with ``axis_name`` bound.
@@ -34,6 +34,8 @@ def ring_attention_local(q, k, v, *, axis_name: str = "sp",
     Device i starts with K/V block i and passes its current block to device
     i+1 each step (receiving from i-1), so after j steps it holds block
     (i - j) mod n. Online softmax in f32 accumulates across blocks.
+    ``kv_len`` [B] masks global key positions beyond each row's true length
+    (padded serving buckets).
     """
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -48,10 +50,13 @@ def ring_attention_local(q, k, v, *, axis_name: str = "sp",
         acc, m, l, kc, vc = carry
         src = (idx - j) % n  # which global block we currently hold
         logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32))
+        k_pos = src * t_loc + jnp.arange(t_loc)
         if causal:
-            k_pos = src * t_loc + jnp.arange(t_loc)
             mask = k_pos[None, :] <= q_pos[:, None]  # [t_loc, t_loc]
             logits = jnp.where(mask[None, None], logits, -1e30)
+        if kv_len is not None:
+            valid = k_pos[None, :] < kv_len[:, None]  # [b, t_loc]
+            logits = jnp.where(valid[:, None, None, :], logits, -1e30)
         m_cur = jnp.max(logits, axis=-1, keepdims=True)  # [b,h,q,1]
         m_new = jnp.maximum(m, m_cur)
         p = jnp.exp(logits - m_new)
@@ -72,16 +77,27 @@ def ring_attention_local(q, k, v, *, axis_name: str = "sp",
     return out.transpose(0, 2, 1, 3).astype(q.dtype)  # back to BSHD
 
 
-def ring_attention(q, k, v, mesh, *, causal: bool = True,
+def ring_attention(q, k, v, mesh, kv_len=None, *, causal: bool = True,
                    batch_axis: str = "dp", seq_axis: str = "sp",
                    head_axis: str = "tp") -> jnp.ndarray:
     """shard_map wrapper: q/k/v are full [B, S, H, D] arrays; batch rides
     ``dp``, sequence ``sp``, heads ``tp`` (GQA must be expanded first so q
-    and k/v shard identically along heads)."""
+    and k/v shard identically along heads). Optional ``kv_len`` [B] masks
+    padded tails (sharded along the batch axis with q)."""
     spec = P(batch_axis, seq_axis, head_axis, None)
-    fn = functools.partial(ring_attention_local, axis_name=seq_axis,
-                           causal=causal)
+    if kv_len is None:
+        fn = functools.partial(ring_attention_local, axis_name=seq_axis,
+                               causal=causal)
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+
+    def fn(q, k, v, kv_len):
+        return ring_attention_local(q, k, v, kv_len, axis_name=seq_axis,
+                                    causal=causal)
+
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
-    )(q, k, v)
+        fn, mesh=mesh, in_specs=(spec, spec, spec, P(batch_axis)),
+        out_specs=spec, check_vma=False,
+    )(q, k, v, jnp.asarray(kv_len, jnp.int32))

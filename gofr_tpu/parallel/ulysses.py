@@ -25,10 +25,12 @@ from . import P
 __all__ = ["ulysses_attention_local", "ulysses_attention"]
 
 
-def ulysses_attention_local(q, k, v, *, axis_name: str = "sp",
+def ulysses_attention_local(q, k, v, kv_len=None, *, axis_name: str = "sp",
                             causal: bool = True):
     """Per-shard body under shard_map: q/k/v are [B, T/sp, H, D] sequence
-    shards; returns the same shape. Heads must divide the axis size."""
+    shards; returns the same shape. Heads must divide the axis size.
+    ``kv_len`` [B] masks padded tails (positions are global after the
+    all-to-all reshard)."""
     from ..ops import attention
 
     n = jax.lax.psum(1, axis_name)
@@ -41,20 +43,31 @@ def ulysses_attention_local(q, k, v, *, axis_name: str = "sp",
     a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
                             split_axis=2, concat_axis=1, tiled=True)
     qh, kh, vh = a2a(q), a2a(k), a2a(v)      # [B, T, H/sp, D]
-    o = attention(qh, kh, vh, causal=causal)
+    o = attention(qh, kh, vh, causal=causal, kv_len=kv_len)
     # head-sharded -> seq-sharded
     return jax.lax.all_to_all(o, axis_name=axis_name, split_axis=1,
                               concat_axis=2, tiled=True)
 
 
-def ulysses_attention(q, k, v, mesh, *, causal: bool = True,
+def ulysses_attention(q, k, v, mesh, kv_len=None, *, causal: bool = True,
                       batch_axis: str = "dp", seq_axis: str = "sp",
                       head_axis: str = "tp"):
-    """shard_map wrapper over full [B, S, H, D] arrays (GQA expanded)."""
+    """shard_map wrapper over full [B, S, H, D] arrays (GQA expanded);
+    optional ``kv_len`` [B] masks padded tails."""
     spec = P(batch_axis, seq_axis, head_axis, None)
-    fn = functools.partial(ulysses_attention_local, axis_name=seq_axis,
-                           causal=causal)
+    if kv_len is None:
+        fn = functools.partial(ulysses_attention_local, axis_name=seq_axis,
+                               causal=causal)
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+
+    def fn(q, k, v, kv_len):
+        return ulysses_attention_local(q, k, v, kv_len, axis_name=seq_axis,
+                                       causal=causal)
+
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
-    )(q, k, v)
+        fn, mesh=mesh, in_specs=(spec, spec, spec, P(batch_axis)),
+        out_specs=spec, check_vma=False,
+    )(q, k, v, jnp.asarray(kv_len, jnp.int32))

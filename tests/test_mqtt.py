@@ -1,0 +1,217 @@
+"""MQTT backend against a fake broker speaking real MQTT 3.1.1 packets.
+
+CONNECT/CONNACK handshake, SUBSCRIBE/SUBACK, PUBLISH both directions with
+QoS 1 PUBACK bookkeeping — the commit-on-success contract: the broker
+tracks un-acked deliveries and the client PUBACKs only from commit().
+"""
+
+import asyncio
+
+import pytest
+
+from gofr_tpu.datasource.pubsub.mqtt import (
+    CONNACK,
+    CONNECT,
+    MQTT,
+    PINGREQ,
+    PINGRESP,
+    PUBACK,
+    PUBLISH,
+    SUBACK,
+    SUBSCRIBE,
+    MQTTError,
+    encode_remaining_length,
+    mqtt_string,
+    packet,
+    read_packet,
+    topic_matches,
+)
+
+
+class FakeMQTTBroker:
+    """Single-client in-memory MQTT 3.1.1 broker."""
+
+    def __init__(self):
+        self.server = None
+        self.port = None
+        self.subscriptions: list[str] = []
+        self.unacked: dict[int, str] = {}   # pid -> topic (inbound QoS1)
+        self.acked: list[int] = []
+        self.published: list[tuple[str, bytes, int]] = []
+        self._writer = None
+        self._next_pid = 100
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._serve, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+    async def deliver(self, topic: str, payload: bytes, qos: int = 1):
+        """Broker -> client PUBLISH."""
+        if qos:
+            pid = self._next_pid
+            self._next_pid += 1
+            self.unacked[pid] = topic
+            body = mqtt_string(topic) + pid.to_bytes(2, "big") + payload
+            self._writer.write(packet(PUBLISH, qos << 1, body))
+        else:
+            self._writer.write(packet(PUBLISH, 0, mqtt_string(topic) + payload))
+        await self._writer.drain()
+
+    async def _serve(self, reader, writer):
+        self._writer = writer
+        try:
+            ptype, _f, body = await read_packet(reader)
+            assert ptype == CONNECT
+            assert body[2:6] == b"MQTT" and body[6] == 4  # 3.1.1
+            writer.write(packet(CONNACK, 0, bytes([0, 0])))
+            await writer.drain()
+            while True:
+                ptype, flags, body = await read_packet(reader)
+                if ptype == SUBSCRIBE:
+                    pid = int.from_bytes(body[:2], "big")
+                    tlen = int.from_bytes(body[2:4], "big")
+                    topic = body[4:4 + tlen].decode()
+                    qos = body[4 + tlen]
+                    self.subscriptions.append(topic)
+                    writer.write(packet(
+                        SUBACK, 0, pid.to_bytes(2, "big") + bytes([qos])))
+                    await writer.drain()
+                elif ptype == PUBLISH:
+                    qos = (flags >> 1) & 0x03
+                    tlen = int.from_bytes(body[:2], "big")
+                    topic = body[2:2 + tlen].decode()
+                    rest = body[2 + tlen:]
+                    if qos:
+                        pid = int.from_bytes(rest[:2], "big")
+                        rest = rest[2:]
+                        writer.write(packet(PUBACK, 0, pid.to_bytes(2, "big")))
+                        await writer.drain()
+                    self.published.append((topic, rest, qos))
+                elif ptype == PUBACK:
+                    pid = int.from_bytes(body[:2], "big")
+                    self.unacked.pop(pid, None)
+                    self.acked.append(pid)
+                elif ptype == PINGREQ:
+                    writer.write(packet(PINGRESP, 0, b""))
+                    await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+
+@pytest.fixture()
+def broker():
+    return FakeMQTTBroker()
+
+
+# ------------------------------------------------------------------- codec
+def test_remaining_length_varint():
+    assert encode_remaining_length(0) == b"\x00"
+    assert encode_remaining_length(127) == b"\x7f"
+    assert encode_remaining_length(128) == b"\x80\x01"
+    assert encode_remaining_length(16_383) == b"\xff\x7f"
+    assert encode_remaining_length(16_384) == b"\x80\x80\x01"
+
+
+def test_topic_matching():
+    assert topic_matches("a/b", "a/b")
+    assert not topic_matches("a/b", "a/c")
+    assert topic_matches("a/+", "a/b")
+    assert not topic_matches("a/+", "a/b/c")
+    assert topic_matches("a/#", "a/b/c")
+    assert not topic_matches("a/#", "b/x")
+
+
+# ------------------------------------------------------------------ client
+def test_publish_qos1_waits_for_puback(broker, run):
+    async def scenario():
+        await broker.start()
+        m = MQTT("127.0.0.1", broker.port, qos=1)
+        await m.publish("sensors/temp", b"21.5")
+        m.close()
+        await broker.stop()
+
+    run(scenario())
+    assert broker.published == [("sensors/temp", b"21.5", 1)]
+
+
+def test_subscribe_commit_sends_puback(broker, run):
+    async def scenario():
+        await broker.start()
+        m = MQTT("127.0.0.1", broker.port, qos=1)
+        await m._ensure()
+        sub_task = asyncio.create_task(m.subscribe("alerts"))
+        while not broker.subscriptions:
+            await asyncio.sleep(0.01)
+        await broker.deliver("alerts", b"fire", qos=1)
+        msg = await asyncio.wait_for(sub_task, timeout=5)
+        assert msg.value == b"fire"
+        assert broker.unacked  # not acked until commit
+        msg.commit()
+        for _ in range(100):
+            if not broker.unacked:
+                break
+            await asyncio.sleep(0.01)
+        assert not broker.unacked and broker.acked
+        m.close()
+        await broker.stop()
+
+    run(scenario())
+
+
+def test_nack_redelivers_without_ack(broker, run):
+    async def scenario():
+        await broker.start()
+        m = MQTT("127.0.0.1", broker.port, qos=1)
+        await m._ensure()
+        sub_task = asyncio.create_task(m.subscribe("jobs"))
+        while not broker.subscriptions:
+            await asyncio.sleep(0.01)
+        await broker.deliver("jobs", b"task-1", qos=1)
+        msg = await asyncio.wait_for(sub_task, timeout=5)
+        msg.nack()
+        again = await asyncio.wait_for(m.subscribe("jobs"), timeout=5)
+        assert again.value == b"task-1"
+        assert broker.unacked  # still un-acked at the broker
+        m.close()
+        await broker.stop()
+
+    run(scenario())
+
+
+def test_wildcard_subscription_receives_subtopics(broker, run):
+    async def scenario():
+        await broker.start()
+        m = MQTT("127.0.0.1", broker.port, qos=0)
+        await m._ensure()
+        sub_task = asyncio.create_task(m.subscribe("metrics/#"))
+        while not broker.subscriptions:
+            await asyncio.sleep(0.01)
+        await broker.deliver("metrics/cpu/0", b"0.93", qos=0)
+        msg = await asyncio.wait_for(sub_task, timeout=5)
+        assert msg.topic == "metrics/cpu/0"
+        assert msg.value == b"0.93"
+        m.close()
+        await broker.stop()
+
+    run(scenario())
+
+
+def test_health_and_unreachable(broker, run):
+    async def scenario():
+        await broker.start()
+        m = MQTT("127.0.0.1", broker.port)
+        up = await m.health_check_async()
+        m.close()
+        await broker.stop()
+        down = await MQTT("127.0.0.1", 1).health_check_async()
+        return up, down
+
+    up, down = run(scenario())
+    assert up["status"] == "UP"
+    assert down["status"] == "DOWN"

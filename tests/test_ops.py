@@ -83,6 +83,30 @@ def test_decode_attention_matches_full():
     np.testing.assert_allclose(np.asarray(full[:, 4]), np.asarray(dec[:, 0]), rtol=1e-5)
 
 
+def test_gqa_decode_attention_matches_expanded():
+    """Grouped decode == decode over repeat_kv-expanded caches, exactly the
+    same math without materializing the expansion."""
+    from gofr_tpu.ops import gqa_decode_attention, repeat_kv
+
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    B, S, KV, n_rep, D = 3, 16, 2, 4, 8
+    q = jax.random.normal(kq, (B, 1, KV * n_rep, D))
+    kc = jax.random.normal(kk, (B, S, KV, D))
+    vc = jax.random.normal(kv_, (B, S, KV, D))
+    kv_len = jnp.array([5, 16, 1])
+    want = decode_attention(q, repeat_kv(kc, n_rep), repeat_kv(vc, n_rep),
+                            kv_len=kv_len)
+    got = gqa_decode_attention(q, kc, vc, kv_len=kv_len)
+    # contraction order differs -> tiny f32 reassociation noise
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=1e-4, atol=1e-6)
+    # MHA fallthrough (n_rep == 1)
+    got_mha = gqa_decode_attention(q[:, :, :KV], kc, vc, kv_len=kv_len)
+    want_mha = decode_attention(q[:, :, :KV], kc, vc, kv_len=kv_len)
+    np.testing.assert_allclose(np.asarray(want_mha), np.asarray(got_mha), rtol=1e-5)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_kernel_matches_reference(causal):
     key = jax.random.PRNGKey(4)

@@ -1,0 +1,582 @@
+"""Postgres + MySQL wire clients against fake servers speaking the real
+protocols (md5/SCRAM auth, extended-query protocol, handshake v10 +
+native-password scramble, COM_QUERY text resultsets), each backed by an
+in-memory sqlite that executes the received SQL — hermetic analogues of the
+reference CI's MySQL container (SURVEY §4).
+"""
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import sqlite3
+import struct
+
+import pytest
+
+from gofr_tpu.datasource.sql import WireSQL
+from gofr_tpu.datasource.sql.mywire import (
+    MySQLError,
+    escape_value,
+    interpolate,
+    native_password_scramble,
+)
+from gofr_tpu.datasource.sql.pgwire import PGError, _Scram, convert_placeholders
+
+PG_USER, PG_PASS, PG_DB = "gofr", "sekret", "appdb"
+MY_USER, MY_PASS, MY_DB = "root", "mypass", "appdb"
+
+
+# ------------------------------------------------------------ fake postgres
+class FakePG:
+    """Protocol-3.0 server: md5 auth + extended query over sqlite."""
+
+    def __init__(self):
+        # isolation_level=None: autocommit, so the client's explicit
+        # BEGIN/COMMIT/ROLLBACK statements drive sqlite transactions
+        self.db = sqlite3.connect(":memory:", check_same_thread=False,
+                                  isolation_level=None)
+        self.server = None
+        self.port = None
+        self.auth_failures = 0
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._serve, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+        self.db.close()
+
+    @staticmethod
+    def _msg(t: bytes, payload: bytes) -> bytes:
+        return t + struct.pack(">i", len(payload) + 4) + payload
+
+    async def _serve(self, reader, writer):
+        try:
+            (size,) = struct.unpack(">i", await reader.readexactly(4))
+            body = await reader.readexactly(size - 4)
+            (proto,) = struct.unpack(">i", body[:4])
+            if proto == 80877103:  # SSLRequest -> refuse, expect plain retry
+                writer.write(b"N")
+                await writer.drain()
+                (size,) = struct.unpack(">i", await reader.readexactly(4))
+                body = await reader.readexactly(size - 4)
+            params = body[4:].split(b"\0")
+            user = params[params.index(b"user") + 1].decode()
+            salt = b"\x01\x02\x03\x04"
+            writer.write(self._msg(b"R", struct.pack(">i", 5) + salt))
+            await writer.drain()
+            t, payload = await self._read(reader)
+            assert t == b"p"
+            inner = hashlib.md5((PG_PASS + user).encode()).hexdigest()
+            expect = b"md5" + hashlib.md5(
+                inner.encode() + salt).hexdigest().encode()
+            if payload.rstrip(b"\0") != expect or user != PG_USER:
+                self.auth_failures += 1
+                writer.write(self._msg(
+                    b"E", b"SFATAL\0C28P01\0Mpassword authentication failed\0\0"))
+                await writer.drain()
+                return
+            writer.write(self._msg(b"R", struct.pack(">i", 0)))
+            writer.write(self._msg(b"S", b"server_version\0fake-16\0"))
+            writer.write(self._msg(b"Z", b"I"))
+            await writer.drain()
+            await self._query_loop(reader, writer)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    async def _read(self, reader):
+        t = await reader.readexactly(1)
+        (size,) = struct.unpack(">i", await reader.readexactly(4))
+        return t, await reader.readexactly(size - 4)
+
+    async def _query_loop(self, reader, writer):
+        query, args = "", []
+        while True:
+            t, body = await self._read(reader)
+            if t == b"P":
+                # "" stmt name, query text, param type count
+                query = body.split(b"\0")[1].decode()
+            elif t == b"B":
+                args = self._parse_bind(body)
+            elif t in (b"D", b"E"):
+                pass
+            elif t == b"S":
+                self._run(writer, query, args)
+                await writer.drain()
+            elif t == b"X":
+                return
+
+    @staticmethod
+    def _parse_bind(body: bytes) -> list:
+        off = body.index(b"\0") + 1
+        off = body.index(b"\0", off) + 1
+        (nfmt,) = struct.unpack(">h", body[off:off + 2])
+        off += 2 + 2 * nfmt
+        (nparams,) = struct.unpack(">h", body[off:off + 2])
+        off += 2
+        out = []
+        for _ in range(nparams):
+            (ln,) = struct.unpack(">i", body[off:off + 4])
+            off += 4
+            if ln < 0:
+                out.append(None)
+            else:
+                out.append(body[off:off + ln].decode())
+                off += ln
+        return out
+
+    def _run(self, writer, query: str, args: list):
+        # $N -> ? (ordered: extended-protocol params arrive positionally)
+        q, n = query, 1
+        while f"${n}" in q:
+            q = q.replace(f"${n}", "?", 1)
+            n += 1
+        try:
+            cur = self.db.execute(q, args)
+            rows = cur.fetchall() if cur.description else []
+        except sqlite3.Error as exc:
+            writer.write(self._msg(
+                b"E", f"SERROR\0C42601\0M{exc}\0\0".encode()))
+            writer.write(self._msg(b"Z", b"I"))
+            return
+        writer.write(self._msg(b"1", b"") + self._msg(b"2", b""))
+        verb = q.strip().split(" ", 1)[0].upper()
+        if cur.description:
+            cols = [d[0] for d in cur.description]
+            oids = []
+            for i in range(len(cols)):
+                sample = next((r[i] for r in rows if r[i] is not None), None)
+                oids.append(20 if isinstance(sample, int)
+                            else 701 if isinstance(sample, float) else 25)
+            fields = b"".join(
+                c.encode() + b"\0" + struct.pack(">ihihih", 0, 0, oid, -1, -1, 0)
+                for c, oid in zip(cols, oids))
+            writer.write(self._msg(
+                b"T", struct.pack(">h", len(cols)) + fields))
+            for row in rows:
+                parts = [struct.pack(">h", len(row))]
+                for v in row:
+                    if v is None:
+                        parts.append(struct.pack(">i", -1))
+                    else:
+                        raw = str(v).encode()
+                        parts.append(struct.pack(">i", len(raw)) + raw)
+                writer.write(self._msg(b"D", b"".join(parts)))
+            tag = f"{verb} {len(rows)}"
+        elif verb == "INSERT":
+            tag = f"INSERT 0 {cur.rowcount}"
+        else:
+            tag = f"{verb} {max(cur.rowcount, 0)}"
+        writer.write(self._msg(b"C", tag.encode() + b"\0"))
+        writer.write(self._msg(b"Z", b"I"))
+
+
+# -------------------------------------------------------------- fake mysql
+class FakeMySQL:
+    """Handshake-v10 server: native-password auth + COM_QUERY over sqlite."""
+
+    SALT = b"abcdefgh12345678abcd"  # 20 bytes
+
+    def __init__(self):
+        self.db = sqlite3.connect(":memory:", check_same_thread=False,
+                                  isolation_level=None)
+        self.server = None
+        self.port = None
+        self.auth_failures = 0
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._serve, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+        self.db.close()
+
+    @staticmethod
+    def _packet(seq: int, payload: bytes) -> bytes:
+        return len(payload).to_bytes(3, "little") + bytes([seq]) + payload
+
+    async def _read_packet(self, reader):
+        head = await reader.readexactly(4)
+        size = int.from_bytes(head[:3], "little")
+        return head[3], await reader.readexactly(size)
+
+    async def _serve(self, reader, writer):
+        try:
+            greeting = (bytes([10]) + b"8.0-fake\0"
+                        + struct.pack("<I", 7) + self.SALT[:8] + b"\0"
+                        + struct.pack("<H", 0xF7FF) + bytes([33])
+                        + struct.pack("<H", 2) + struct.pack("<H", 0x81FF)
+                        + bytes([21]) + b"\0" * 10
+                        + self.SALT[8:] + b"\0"
+                        + b"mysql_native_password\0")
+            writer.write(self._packet(0, greeting))
+            await writer.drain()
+            _seq, resp = await self._read_packet(reader)
+            caps, _maxp, _cs = struct.unpack("<IIB", resp[:9])
+            off = 32
+            end = resp.index(b"\0", off)
+            user = resp[off:end].decode()
+            off = end + 1
+            alen = resp[off]
+            auth = resp[off + 1:off + 1 + alen]
+            expect = native_password_scramble(MY_PASS, self.SALT)
+            if user != MY_USER or auth != expect:
+                self.auth_failures += 1
+                writer.write(self._packet(
+                    2, b"\xff" + struct.pack("<H", 1045)
+                    + b"#28000Access denied"))
+                await writer.drain()
+                return
+            writer.write(self._packet(2, b"\x00\x00\x00\x02\x00\x00\x00"))
+            await writer.drain()
+            while True:
+                _seq, cmd = await self._read_packet(reader)
+                if cmd[0] == 0x01:  # COM_QUIT
+                    return
+                if cmd[0] == 0x03:
+                    self._query(writer, cmd[1:].decode())
+                    await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    @staticmethod
+    def _lenenc(n: int) -> bytes:
+        if n < 0xFB:
+            return bytes([n])
+        if n < 1 << 16:
+            return b"\xfc" + struct.pack("<H", n)
+        return b"\xfd" + n.to_bytes(3, "little")
+
+    def _query(self, writer, sql: str):
+        seq = 1
+        try:
+            cur = self.db.execute(sql)
+            rows = cur.fetchall() if cur.description else []
+        except sqlite3.Error as exc:
+            writer.write(self._packet(
+                seq, b"\xff" + struct.pack("<H", 1064)
+                + f"#42000{exc}".encode()))
+            return
+        if not cur.description:
+            ok = (b"\x00" + self._lenenc(max(cur.rowcount, 0))
+                  + self._lenenc(cur.lastrowid or 0)
+                  + struct.pack("<HH", 2, 0))
+            writer.write(self._packet(seq, ok))
+            return
+        cols = [d[0] for d in cur.description]
+        types = []
+        for i in range(len(cols)):
+            sample = next((r[i] for r in rows if r[i] is not None), None)
+            types.append(8 if isinstance(sample, int)
+                         else 5 if isinstance(sample, float) else 253)
+        writer.write(self._packet(seq, self._lenenc(len(cols))))
+        seq += 1
+        for name, t in zip(cols, types):
+
+            def s(x: bytes) -> bytes:
+                return self._lenenc(len(x)) + x
+
+            defn = (s(b"def") + s(b"") + s(b"t") + s(b"t")
+                    + s(name.encode()) + s(name.encode())
+                    + bytes([0x0C]) + struct.pack("<HIBHB", 33, 255, t, 0, 0)
+                    + b"\0\0")
+            writer.write(self._packet(seq, defn))
+            seq += 1
+        writer.write(self._packet(seq, b"\xfe\x00\x00\x02\x00"))
+        seq += 1
+        for row in rows:
+            out = b""
+            for v in row:
+                if v is None:
+                    out += b"\xfb"
+                else:
+                    raw = str(v).encode()
+                    out += self._lenenc(len(raw)) + raw
+            writer.write(self._packet(seq, out))
+            seq += 1
+        writer.write(self._packet(seq, b"\xfe\x00\x00\x02\x00"))
+
+
+# ------------------------------------------------------------- unit tests
+def test_pg_placeholder_conversion():
+    q, n = convert_placeholders("SELECT * FROM t WHERE a=? AND b=?")
+    assert q == "SELECT * FROM t WHERE a=$1 AND b=$2" and n == 2
+    q, n = convert_placeholders("SELECT '?' || \"q?\" , ? FROM t")
+    assert q == "SELECT '?' || \"q?\" , $1 FROM t" and n == 1
+
+
+def test_scram_client_proof_verifies_server_side():
+    """Full RFC 5802 exchange against an independent server-side check."""
+    password, salt, iters = "s3cret", b"salty-salt", 4096
+    c = _Scram(password)
+    first = c.client_first().decode()
+    assert first.startswith("n,,n=,r=")
+    client_nonce = first.split("r=", 1)[1]
+    server_nonce = client_nonce + "SRVNONCE"
+    server_first = (f"r={server_nonce},s={base64.b64encode(salt).decode()},"
+                    f"i={iters}")
+    final = c.client_final(server_first.encode()).decode()
+    channel, rest = final.split(",", 1)
+    assert channel == "c=biws"
+    proof_b64 = final.split(",p=", 1)[1]
+    final_bare = final[:final.index(",p=")]
+    # server side: recover ClientKey from the proof and check StoredKey
+    salted = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, iters)
+    stored = hashlib.sha256(
+        hmac.new(salted, b"Client Key", hashlib.sha256).digest()).digest()
+    auth_msg = ",".join([first[3:], server_first, final_bare]).encode()
+    sig = hmac.new(stored, auth_msg, hashlib.sha256).digest()
+    proof = base64.b64decode(proof_b64)
+    client_key = bytes(a ^ b for a, b in zip(proof, sig))
+    assert hashlib.sha256(client_key).digest() == stored
+    # server signature accepted by the client
+    server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+    v = hmac.new(server_key, auth_msg, hashlib.sha256).digest()
+    c.verify_server(b"v=" + base64.b64encode(v))
+
+
+def test_mysql_escaping_and_interpolation():
+    assert escape_value(None) == "NULL"
+    assert escape_value(True) == "1"
+    assert escape_value(7) == "7"
+    assert escape_value("o'neil\\x") == "'o''neil\\\\x'"
+    assert escape_value(b"\x01\x02") == "X'0102'"
+    q = interpolate("SELECT * FROM t WHERE name=? AND note='lit?'", ("a'b",))
+    assert q == "SELECT * FROM t WHERE name='a''b' AND note='lit?'"
+    with pytest.raises(MySQLError):
+        interpolate("SELECT ?", ())
+
+
+def test_mysql_scramble_shape():
+    s = native_password_scramble("pw", b"x" * 20)
+    assert len(s) == 20
+    assert native_password_scramble("", b"x" * 20) == b""
+
+
+# -------------------------------------------------------- wire integration
+def _pg_sql(port) -> WireSQL:
+    return WireSQL("postgres", host="127.0.0.1", port=port, user=PG_USER,
+                   password=PG_PASS, database=PG_DB)
+
+
+def _my_sql(port) -> WireSQL:
+    return WireSQL("mysql", host="127.0.0.1", port=port, user=MY_USER,
+                   password=MY_PASS, database=MY_DB)
+
+
+def test_postgres_roundtrip_md5_auth(run):
+    async def scenario():
+        fake = FakePG()
+        await fake.start()
+        loop = asyncio.get_running_loop()
+
+        def work():
+            db = _pg_sql(fake.port)
+            db.exec("CREATE TABLE users (id INTEGER PRIMARY KEY, "
+                    "name TEXT, score REAL)")
+            db.exec("INSERT INTO users (name, score) VALUES (?, ?)", "ada", 9.5)
+            last = db.exec_last_id(
+                "INSERT INTO users (name, score) VALUES (?, ?) RETURNING id",
+                "bob", 7.25)
+            rows = db.query("SELECT id, name, score FROM users ORDER BY id")
+            n = db.exec("UPDATE users SET score = ? WHERE name = ?", 10.0, "ada")
+            health = db.health_check()
+            db.close()
+            return last, rows, n, health
+
+        last, rows, n, health = await loop.run_in_executor(None, work)
+        await fake.stop()
+        return last, rows, n, health
+
+    last, rows, n, health = run(scenario())
+    assert last == 2
+    assert rows == [{"id": 1, "name": "ada", "score": 9.5},
+                    {"id": 2, "name": "bob", "score": 7.25}]
+    assert n == 1
+    assert health["status"] == "UP" and health["details"]["dialect"] == "postgres"
+
+
+def test_postgres_tx_rollback_and_bad_auth(run):
+    async def scenario():
+        fake = FakePG()
+        await fake.start()
+        loop = asyncio.get_running_loop()
+
+        def work():
+            db = _pg_sql(fake.port)
+            db.exec("CREATE TABLE t (x INTEGER)")
+            with db.begin() as tx:
+                tx.exec("INSERT INTO t VALUES (?)", 1)
+            try:
+                with db.begin() as tx:
+                    tx.exec("INSERT INTO t VALUES (?)", 2)
+                    raise RuntimeError("boom")
+            except RuntimeError:
+                pass
+            rows = db.query("SELECT x FROM t")
+            db.close()
+
+            bad = WireSQL("postgres", host="127.0.0.1", port=fake.port,
+                          user=PG_USER, password="wrong", database=PG_DB)
+            health = bad.health_check()
+            bad.close()
+            return rows, health
+
+        rows, bad_health = await loop.run_in_executor(None, work)
+        await fake.stop()
+        return rows, bad_health, fake.auth_failures
+
+    rows, bad_health, auth_failures = run(scenario())
+    assert rows == [{"x": 1}]  # rollback discarded x=2
+    assert bad_health["status"] == "DOWN"
+    assert auth_failures == 1
+
+
+def test_mysql_roundtrip_native_auth(run):
+    async def scenario():
+        fake = FakeMySQL()
+        await fake.start()
+        loop = asyncio.get_running_loop()
+
+        def work():
+            db = _my_sql(fake.port)
+            db.exec("CREATE TABLE items (id INTEGER PRIMARY KEY, "
+                    "name TEXT, qty INTEGER)")
+            last = db.exec_last_id(
+                "INSERT INTO items (name, qty) VALUES (?, ?)", "bolt", 12)
+            db.exec("INSERT INTO items (name, qty) VALUES (?, ?)", "o'nut", 5)
+            rows = db.query("SELECT id, name, qty FROM items ORDER BY id")
+            n = db.exec("DELETE FROM items WHERE qty < ?", 10)
+            health = db.health_check()
+            db.close()
+            return last, rows, n, health
+
+        last, rows, n, health = await loop.run_in_executor(None, work)
+        await fake.stop()
+        return last, rows, n, health
+
+    last, rows, n, health = run(scenario())
+    assert last == 1
+    assert rows == [{"id": 1, "name": "bolt", "qty": 12},
+                    {"id": 2, "name": "o'nut", "qty": 5}]
+    assert n == 1
+    assert health["status"] == "UP" and health["details"]["dialect"] == "mysql"
+
+
+def test_crud_dialect_sql_generation():
+    """Per-dialect CRUD SQL (reference sql/query_builder.go:21-90)."""
+    import dataclasses
+
+    from gofr_tpu.crud import (
+        delete_query,
+        insert_query,
+        scan_entity,
+        select_query,
+        update_query,
+    )
+
+    @dataclasses.dataclass
+    class Order:
+        id: int = dataclasses.field(
+            default=0, metadata={"sql": "auto_increment"})
+        item: str = ""
+
+    meta = scan_entity(Order)
+    assert insert_query(meta, ["item"], "postgres") == (
+        'INSERT INTO "order" ("item") VALUES (?) RETURNING "id"')
+    assert insert_query(meta, ["item"], "mysql") == (
+        "INSERT INTO `order` (`item`) VALUES (?)")
+    assert insert_query(meta, ["item"], "sqlite") == (
+        'INSERT INTO "order" ("item") VALUES (?)')
+    assert select_query(meta, "mysql") == (
+        "SELECT * FROM `order` WHERE `id` = ?")
+    assert update_query(meta, ["item"], "postgres") == (
+        'UPDATE "order" SET "item" = ? WHERE "id" = ?')
+    assert delete_query(meta, "postgres") == (
+        'DELETE FROM "order" WHERE "id" = ?')
+
+
+def test_crud_end_to_end_over_postgres_wire(run):
+    """Full vertical: HTTP CRUD handlers -> WireSQL -> pg wire protocol ->
+    fake server -> sqlite; RETURNING drives the created id."""
+    import dataclasses
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gofr_tpu.app import App
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.container.mock import new_mock_container
+
+    @dataclasses.dataclass
+    class Gadget:
+        id: int = dataclasses.field(
+            default=0, metadata={"sql": "auto_increment"})
+        name: str = ""
+
+    async def scenario():
+        fake = FakePG()
+        await fake.start()
+        fake.db.execute(
+            "CREATE TABLE gadget (id INTEGER PRIMARY KEY AUTOINCREMENT, "
+            "name TEXT)")
+        app = App(config=MapConfig({"APP_NAME": "crud-pg"}))
+        container, _ = new_mock_container()
+        container.tracer = app.tracer
+        app.container = container
+        loop = asyncio.get_running_loop()
+        container.sql = await loop.run_in_executor(
+            None, lambda: _pg_sql(fake.port))
+        app.add_rest_handlers(Gadget)
+        server = TestServer(app._build_http_app())
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            r = await client.post("/gadget", json={"name": "widget"})
+            created = await r.json()
+            r2 = await client.get("/gadget/1")
+            got = await r2.json()
+            r3 = await client.delete("/gadget/1")
+            missing = await client.get("/gadget/1")
+            return r.status, created, got, r3.status, missing.status
+        finally:
+            await client.close()
+            container.sql.close()
+            await fake.stop()
+
+    status, created, got, del_status, missing = run(scenario())
+    assert status == 201
+    assert created["data"]["id"] == 1
+    assert got["data"] == {"id": 1, "name": "widget"}
+    assert del_status == 204
+    assert missing == 404
+
+
+def test_mysql_bad_password_rejected(run):
+    async def scenario():
+        fake = FakeMySQL()
+        await fake.start()
+        loop = asyncio.get_running_loop()
+
+        def work():
+            bad = WireSQL("mysql", host="127.0.0.1", port=fake.port,
+                          user=MY_USER, password="nope", database=MY_DB)
+            health = bad.health_check()
+            bad.close()
+            return health
+
+        health = await loop.run_in_executor(None, work)
+        await fake.stop()
+        return health, fake.auth_failures
+
+    health, failures = run(scenario())
+    assert health["status"] == "DOWN"
+    assert failures == 1
