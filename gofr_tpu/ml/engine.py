@@ -69,19 +69,35 @@ class Engine:
         metrics=None,
         example_inputs: tuple | None = None,
         out_sharding=None,
+        backend: str = "jit",
+        plugin_path: str | None = None,
     ) -> None:
         self.name = name
         self.config = config or EngineConfig()
         self._logger = logger
         self._metrics = metrics
-        self._apply = jax.jit(apply_fn)
+        self.backend = backend
+        if backend == "pjrt":
+            # native PJRT C-API path: jax traces, our binding executes
+            from .pjrt_backend import PjrtExecutor
+
+            self._pjrt = PjrtExecutor(apply_fn, params,
+                                      plugin_path=plugin_path)
+            self._run = self._pjrt
+            self._params = params
+        elif backend == "jit":
+            self._pjrt = None
+            self._apply = jax.jit(apply_fn)
+            self._params = jax.device_put(params)
+            self._run = lambda *xs: self._apply(self._params, *xs)
+        else:
+            raise ValueError(f"unknown engine backend {backend!r}")
         self._work: queue.Queue = queue.Queue()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"gofr-ml-{name}"
         )
         self.steps = 0
         self.device = jax.devices()[0]
-        self._params = jax.device_put(params)
         self._thread.start()
         if example_inputs is not None and self.config.warmup:
             self.predict_sync(*example_inputs)  # compile before first request
@@ -102,7 +118,7 @@ class Engine:
     def _execute(self, *inputs: Any) -> Any:
         start = time.perf_counter()
         arrays = [jnp.asarray(x) for x in inputs]
-        out = self._apply(self._params, *arrays)
+        out = self._run(*arrays)
         out = jax.tree.map(lambda a: np.asarray(a), out)  # blocks until done
         self.steps += 1
         dur = time.perf_counter() - start
@@ -139,3 +155,6 @@ class Engine:
 
     def close(self) -> None:
         self._work.put(None)
+        if self._pjrt is not None:
+            self._thread.join(timeout=5)
+            self._pjrt.close()

@@ -127,7 +127,21 @@ class Generator:
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_seq
         ) or (max_seq,)
+        self.mesh = mesh
         self.cache = llama.init_cache(cfg, batch_slots, max_seq)
+        if mesh is not None and getattr(cfg, "sequence_parallel", False):
+            # long-context serving: KV cache sequence axis sharded over sp,
+            # decode attention combines shards via pmax/psum (ring.py)
+            from ..parallel import NamedSharding
+            from ..parallel import P as _P
+
+            kv_sh = NamedSharding(mesh, _P(None, "dp", "sp", None, None))
+            self.cache = {
+                "k": jax.device_put(self.cache["k"], kv_sh),
+                "v": jax.device_put(self.cache["v"], kv_sh),
+                "len": jax.device_put(self.cache["len"],
+                                      NamedSharding(mesh, _P("dp"))),
+            }
         self.slots = [_Slot() for _ in range(batch_slots)]
         # two independent streams: decode keys fold the step counter,
         # prefill keys fold a request counter — no collisions between the
@@ -138,18 +152,25 @@ class Generator:
         self._n_requests = 0
         self._tok_dev = jnp.zeros((batch_slots,), jnp.int32)  # device-resident
         self._inflight: collections.deque = collections.deque()  # [chunk, B] arrays
+        self._pending_first: collections.deque = collections.deque()  # (slot, dev scalar)
         self.steps = 0
 
         sampler_cfg = self.sampler
         n_chunk = self.chunk
 
         def chunk_fn(params, tok, cache, step0, base_key):
-            """``chunk`` fused decode+sample steps; returns all sampled
-            tokens [chunk, B] plus the final carry."""
+            """``chunk`` fused decode+sample steps. Returns [chunk+1, B]
+            tokens: row 0 is the INPUT token row (how newly-admitted slots'
+            first sampled tokens reach the host — a separate per-admission
+            transfer would cost a full ~200 ms synchronous tunnel D2H; this
+            way firsts ride the chunk fetch that happens anyway), rows
+            1..chunk are this chunk's samples; plus the final carry."""
+            tok_in = tok
 
             def body(carry, j):
                 tok, cache = carry
-                logits, cache = llama.decode_step(params, tok, cache, cfg)
+                logits, cache = llama.decode_step(params, tok, cache, cfg,
+                                                  mesh=mesh)
                 key = jax.random.fold_in(base_key, step0 + j)
                 nxt = _sample_impl(logits, key, sampler_cfg)
                 return (nxt, cache), nxt
@@ -157,12 +178,27 @@ class Generator:
             (tok, cache), toks = jax.lax.scan(
                 body, (tok, cache), jnp.arange(n_chunk)
             )
-            return toks, tok, cache
+            return jnp.concatenate([tok_in[None], toks], axis=0), tok, cache
 
         # donate the cache: in-place KV update on device, no copy per step
         self._chunk_fn = jax.jit(chunk_fn, donate_argnums=(2,))
+
+        def post_prefill(tok_dev, logits, prefill_key, n_req, slot):
+            """Sample the first token and park it in the device-resident
+            token row — ONE program with traced (n_req, slot). An eager
+            ``fold_in(key, python_int)`` + ``.at[int].set(int)`` here
+            compiled a fresh trivial executable per request (per counter
+            value and even per sampled token value), which under the
+            remote-compile tunnel cost ~130 ms per admission — the real
+            prefill cost was <1 ms (r1 BENCH prefill mystery)."""
+            key = jax.random.fold_in(prefill_key, n_req)
+            first = _sample_impl(logits, key, sampler_cfg)[0]
+            return tok_dev.at[slot].set(first)
+
+        self._post_prefill = jax.jit(post_prefill, donate_argnums=(0,))
         self._prefill_into = jax.jit(
-            lambda p, t, l, c, slot: llama.prefill_into(p, t, l, cfg, c, slot),
+            lambda p, t, l, c, slot: llama.prefill_into(p, t, l, cfg, c, slot,
+                                                        mesh=mesh),
             donate_argnums=(3,),
         )
 
@@ -192,23 +228,46 @@ class Generator:
                 self.params, jnp.asarray(padded), jnp.asarray([n], np.int32),
                 self.cache, jnp.int32(i),
             )
-        key = jax.random.fold_in(self._prefill_key, self._n_requests)
+        self._tok_dev = self._post_prefill(
+            self._tok_dev, logits, self._prefill_key,
+            jnp.uint32(self._n_requests), jnp.int32(i),
+        )
         self._n_requests += 1
-        first = int(sample_logits(logits, key, self.sampler)[0])
-        self._tok_dev = self._tok_dev.at[i].set(first)
+        # Admission is fully ASYNC: the sampled first token stays on device
+        # in _tok_dev and its VALUE reaches the host in row 0 of the next
+        # decode chunk (see chunk_fn). A synchronous int(first) here
+        # serialized every admission on a ~150 ms tunnel round-trip — that,
+        # not prefill compute (<1 ms), was the r1 "prefill stall".
+        self._pending_first.append(i)
         s = _Slot()
         s.live = True
-        s.tokens = [first]
+        s.tokens = []
         s.max_new = max_new_tokens
-        s.produced = 1
+        s.produced = 1  # the pending first token counts as sampled
         s.prompt_len = n
-        s.eos_hit = self.eos_id is not None and first == self.eos_id
+        s.eos_hit = False
         s.callback = callback
         self.slots[i] = s
-        if callback is not None:
-            callback(i, first)
-        self._maybe_finish(i)
         return i
+
+    def _resolve_first(self, tok_in_row: np.ndarray) -> None:
+        """Fold newly-admitted slots' first tokens (row 0 of an arriving
+        chunk = the token row that chunk decoded FROM) into slot state,
+        before the chunk's own samples are processed. add_request drains
+        the pipeline before admitting, so every pending slot's first is in
+        the next chunk's input row."""
+        while self._pending_first:
+            slot = self._pending_first.popleft()
+            s = self.slots[slot]
+            t = int(tok_in_row[slot])
+            if not s.live:
+                continue
+            s.tokens.append(t)
+            if self.eos_id is not None and t == self.eos_id:
+                s.eos_hit = True
+            if s.callback is not None:
+                s.callback(slot, t)
+            self._maybe_finish(slot)
 
     def _maybe_finish(self, i: int) -> None:
         s = self.slots[i]
@@ -238,6 +297,10 @@ class Generator:
             )
         self.steps += self.chunk
         try:
+            # best-effort prefetch; on transports where this is itself a
+            # blocking transfer (the axon tunnel) the cost is the same as
+            # the np.asarray in _process, so it stays — the pipeline depth
+            # below is what keeps the device busy while the host reads.
             toks.copy_to_host_async()
         except Exception:
             pass
@@ -251,7 +314,10 @@ class Generator:
             self._process(np.asarray(self._inflight.popleft()))
 
     def _process(self, toks: np.ndarray) -> None:
-        """Apply one [chunk, B] token block to slot state, in step order."""
+        """Apply one [1 input + chunk sampled, B] token block to slot
+        state, in step order. The input row resolves pending firsts."""
+        self._resolve_first(toks[0])
+        toks = toks[1:]
         for row in toks:
             for i, s in enumerate(self.slots):
                 if not s.live:

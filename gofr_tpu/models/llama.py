@@ -58,6 +58,7 @@ class LlamaConfig:
         dtype: Any = jnp.bfloat16,
         use_flash: bool = True,
         remat: bool = False,
+        attn_impl: str = "auto",
     ) -> None:
         self.vocab_size = vocab_size
         self.dim = dim
@@ -72,6 +73,17 @@ class LlamaConfig:
         self.dtype = dtype
         self.use_flash = use_flash
         self.remat = remat
+        # "auto" (single-device flash/dense), "ring" or "ulysses": sequence-
+        # parallel attention over the sp mesh axis — the long-context path.
+        # Selecting one requires passing ``mesh=`` to forward/prefill/
+        # decode_step (the Generator does this when built with a mesh).
+        if attn_impl not in ("auto", "ring", "ulysses"):
+            raise ValueError(f"unknown attn_impl {attn_impl!r}")
+        self.attn_impl = attn_impl
+
+    @property
+    def sequence_parallel(self) -> bool:
+        return self.attn_impl in ("ring", "ulysses")
 
     @property
     def n_rep(self) -> int:
@@ -148,7 +160,8 @@ def init_params(cfg: LlamaConfig, key) -> dict:
     }
 
 
-def _layer(cfg: LlamaConfig, x, lp, cos, sin, *, kv_len=None, full_seq=True):
+def _layer(cfg: LlamaConfig, x, lp, cos, sin, *, kv_len=None, full_seq=True,
+           mesh=None):
     """One full-sequence decoder block (training / prefill).
     Returns (x, k_proj, v_proj)."""
     b, s, _ = x.shape
@@ -164,7 +177,16 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, *, kv_len=None, full_seq=True):
     k = apply_rope(k, cos, sin)
 
     kf, vf = repeat_kv(k, cfg.n_rep), repeat_kv(v, cfg.n_rep)
-    if cfg.use_flash:
+    if cfg.sequence_parallel and mesh is not None:
+        # long-context: exact sequence-parallel attention over sp — K/V
+        # blocks never leave their shard (ring) or reshard once (ulysses)
+        from ..parallel.ring import ring_attention
+        from ..parallel.ulysses import ulysses_attention
+
+        sp_attn = (ring_attention if cfg.attn_impl == "ring"
+                   else ulysses_attention)
+        o = sp_attn(q, kf, vf, mesh, kv_len=kv_len, causal=True)
+    elif cfg.use_flash:
         o = flash_attention(q, kf, vf, causal=True, kv_len=kv_len)
     else:
         o = attention(q, kf, vf, causal=True, kv_len=kv_len)
@@ -180,7 +202,7 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, *, kv_len=None, full_seq=True):
 
 
 def _decode_layer(cfg: LlamaConfig, x, lp, cos, sin, k_all, v_all, layer,
-                  pos, rows):
+                  pos, rows, mesh=None):
     """One decode block writing directly into the FULL stacked cache.
 
     The caches ride the layer scan's CARRY so XLA aliases them in place: a
@@ -204,8 +226,15 @@ def _decode_layer(cfg: LlamaConfig, x, lp, cos, sin, k_all, v_all, layer,
 
     k_all = k_all.at[layer, rows, pos].set(k[:, 0])
     v_all = v_all.at[layer, rows, pos].set(v[:, 0])
-    o = cached_decode_attention(q, k_all, v_all, pos + 1, layer=layer,
-                                use_kernel=cfg.use_flash)
+    if cfg.sequence_parallel and mesh is not None:
+        # S-sharded cache: grouped online-softmax per shard + one
+        # pmax/psum combine (parallel/ring.py) — no cache all-gather
+        from ..parallel.ring import sp_decode_attention
+
+        o = sp_decode_attention(q, k_all, v_all, pos + 1, mesh, layer=layer)
+    else:
+        o = cached_decode_attention(q, k_all, v_all, pos + 1, layer=layer,
+                                    use_kernel=cfg.use_flash)
 
     x = x + constrain(o.reshape(b, 1, H * hd) @ lp["wo"], P("dp", "sp", None))
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -216,7 +245,7 @@ def _decode_layer(cfg: LlamaConfig, x, lp, cos, sin, k_all, v_all, layer,
 
 
 def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
-            *, seq_lens: jnp.ndarray | None = None) -> jnp.ndarray:
+            *, seq_lens: jnp.ndarray | None = None, mesh=None) -> jnp.ndarray:
     """Full-sequence forward: tokens [B, S] -> f32 logits [B, S, V].
 
     Used for training and for prefill-without-cache; ``seq_lens`` masks
@@ -228,7 +257,8 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
     cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
 
     def body(x, lp):
-        x, _, _ = _layer(cfg, x, lp, cos, sin, kv_len=seq_lens, full_seq=True)
+        x, _, _ = _layer(cfg, x, lp, cos, sin, kv_len=seq_lens, full_seq=True,
+                         mesh=mesh)
         return x, None
 
     if cfg.remat:
@@ -254,7 +284,8 @@ def init_cache(cfg: LlamaConfig, batch: int, max_seq: int | None = None) -> dict
 
 
 def prefill(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
-            cfg: LlamaConfig, cache: dict) -> tuple[jnp.ndarray, dict]:
+            cfg: LlamaConfig, cache: dict, mesh=None
+            ) -> tuple[jnp.ndarray, dict]:
     """Run the prompt [B, S_pad] through the model, filling the cache.
 
     Returns (last-token logits [B, V], cache). S_pad is a shape bucket;
@@ -267,7 +298,8 @@ def prefill(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
     cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
 
     def body(x, lp):
-        x, k, v = _layer(cfg, x, lp, cos, sin, kv_len=seq_lens, full_seq=True)
+        x, k, v = _layer(cfg, x, lp, cos, sin, kv_len=seq_lens, full_seq=True,
+                         mesh=mesh)
         return x, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
@@ -287,7 +319,7 @@ def prefill(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
 
 
 def prefill_into(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
-                 cfg: LlamaConfig, cache: dict, slot: jnp.ndarray
+                 cfg: LlamaConfig, cache: dict, slot: jnp.ndarray, mesh=None
                  ) -> tuple[jnp.ndarray, dict]:
     """Prefill ONE prompt [1, S_pad] directly into row ``slot`` of a shared
     multi-slot cache. One jitted program per request (donate the cache!):
@@ -295,7 +327,8 @@ def prefill_into(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
     cache through HBM outside XLA's control.
     """
     logits, filled = prefill(params, tokens, seq_lens, cfg,
-                             init_cache(cfg, 1, cache["k"].shape[2]))
+                             init_cache(cfg, 1, cache["k"].shape[2]),
+                             mesh=mesh)
     new_cache = {
         "k": jax.lax.dynamic_update_index_in_dim(
             cache["k"], filled["k"][:, 0], slot, axis=1),
@@ -307,7 +340,7 @@ def prefill_into(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
 
 
 def decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
-                cfg: LlamaConfig) -> tuple[jnp.ndarray, dict]:
+                cfg: LlamaConfig, mesh=None) -> tuple[jnp.ndarray, dict]:
     """One token per row: tokens [B] -> (logits [B, V], updated cache).
 
     Rows may sit at different positions (continuous batching); each row
@@ -325,7 +358,7 @@ def decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
     def body(carry, lp):
         x, k_all, v_all, layer = carry
         x, k_all, v_all = _decode_layer(
-            cfg, x, lp, cos, sin, k_all, v_all, layer, pos, rows)
+            cfg, x, lp, cos, sin, k_all, v_all, layer, pos, rows, mesh=mesh)
         return (x, k_all, v_all, layer + 1), None
 
     (x, ks, vs, _), _ = jax.lax.scan(

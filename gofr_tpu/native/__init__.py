@@ -21,23 +21,26 @@ _BUILD_LOCK = threading.Lock()
 _LIBS: dict[str, object] = {}
 
 
-def build_and_load(source_name: str, lib_stem: str):
+def build_and_load(source_name: str, lib_stem: str, extra_flags: tuple = ()):
     """Compile ``<pkg>/<source_name>`` to a cached .so and ctypes-load it.
     Returns None when no toolchain is available (callers fall back)."""
     import ctypes
 
     pkg_dir = os.path.dirname(os.path.abspath(__file__))
     src = os.path.join(pkg_dir, source_name)
+    hasher = hashlib.sha256()
     with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        hasher.update(f.read())
+    hasher.update("\0".join(extra_flags).encode())
+    digest = hasher.hexdigest()[:16]
     so_path = os.path.join(pkg_dir, f"{lib_stem}-{digest}.so")
 
     with _BUILD_LOCK:
         if so_path in _LIBS:
             return _LIBS[so_path]
         if not os.path.exists(so_path):
-            cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src,
-                   "-o", so_path + ".tmp"]
+            cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                   *extra_flags, src, "-o", so_path + ".tmp"]
             try:
                 subprocess.run(cmd, check=True, capture_output=True, timeout=120)
                 os.replace(so_path + ".tmp", so_path)  # atomic publish

@@ -1,0 +1,293 @@
+// A minimal in-process PJRT plugin speaking the real C API, for hermetic
+// tests of the shim (gofr_tpu/native/pjrt_shim.cpp).
+//
+// The image ships no CPU PJRT plugin .so (jaxlib links XLA:CPU
+// statically), so CI validates the binding the same way the round-1
+// pub/sub tests validate the Kafka client: against a fake that speaks
+// the genuine wire contract. This plugin implements exactly the
+// function-pointer subset the shim calls — version negotiation, client
+// lifecycle, named-value option decoding, program "compilation",
+// host<->device byte transfers, and execution — over host memory.
+//
+// Executable semantics: by default Execute echoes each input buffer to
+// the corresponding output (num_outputs == num_args at compile time is
+// unknown, so it is fixed when Execute first sees arguments; NumOutputs
+// reports the value recorded at compile from the program text). If the
+// program code contains the marker "gofr_fake_add_f32", the executable
+// instead produces ONE output: the elementwise f32 sum of its first two
+// inputs — enough to prove typed data actually flows through the
+// binding rather than just pointers.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+struct PJRT_Error {
+  std::string msg;
+};
+
+namespace {
+
+struct FakeBuffer {
+  PJRT_Buffer_Type type;
+  std::vector<int64_t> dims;
+  std::vector<uint8_t> bytes;
+};
+
+struct FakeClient {
+  // one fake device; the pointer value just needs to be stable+nonnull
+  int device_marker = 0;
+  std::vector<PJRT_NamedValue> seen_options;  // names only, for tests
+  std::string option_log;                     // "k=v;" pairs, string/int
+};
+
+struct FakeExec {
+  bool add_mode = false;
+  size_t num_outputs = 1;
+};
+
+PJRT_Error* make_err(const std::string& m) {
+  auto* e = new PJRT_Error;
+  e->msg = m;
+  return e;
+}
+
+size_t elem_size(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_PRED:
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+      return 1;
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_BF16:
+      return 2;
+    case PJRT_Buffer_Type_S32:
+    case PJRT_Buffer_Type_U32:
+    case PJRT_Buffer_Type_F32:
+      return 4;
+    default:
+      return 8;
+  }
+}
+
+// --- API implementations (only the subset the shim uses) -------------------
+
+void error_destroy(PJRT_Error_Destroy_Args* args) { delete args->error; }
+
+void error_message(PJRT_Error_Message_Args* args) {
+  args->message = args->error->msg.c_str();
+  args->message_size = args->error->msg.size();
+}
+
+PJRT_Error* error_getcode(PJRT_Error_GetCode_Args* args) {
+  args->code = PJRT_Error_Code_INTERNAL;
+  return nullptr;
+}
+
+PJRT_Error* plugin_initialize(PJRT_Plugin_Initialize_Args*) { return nullptr; }
+
+PJRT_Error* event_destroy(PJRT_Event_Destroy_Args*) { return nullptr; }
+PJRT_Error* event_await(PJRT_Event_Await_Args*) { return nullptr; }
+
+PJRT_Error* client_create(PJRT_Client_Create_Args* args) {
+  auto* c = new FakeClient;
+  for (size_t i = 0; i < args->num_options; ++i) {
+    const PJRT_NamedValue& nv = args->create_options[i];
+    c->option_log.append(nv.name, nv.name_size);
+    c->option_log.push_back('=');
+    if (nv.type == PJRT_NamedValue_kString) {
+      c->option_log.append(nv.string_value, nv.value_size);
+    } else if (nv.type == PJRT_NamedValue_kInt64) {
+      c->option_log += std::to_string(nv.int64_value);
+    } else if (nv.type == PJRT_NamedValue_kBool) {
+      c->option_log += nv.bool_value ? "true" : "false";
+    }
+    c->option_log.push_back(';');
+  }
+  args->client = reinterpret_cast<PJRT_Client*>(c);
+  return nullptr;
+}
+
+PJRT_Error* client_destroy(PJRT_Client_Destroy_Args* args) {
+  delete reinterpret_cast<FakeClient*>(args->client);
+  return nullptr;
+}
+
+PJRT_Error* client_platform_name(PJRT_Client_PlatformName_Args* args) {
+  static const char kName[] = "gofr_fake";
+  args->platform_name = kName;
+  args->platform_name_size = sizeof(kName) - 1;
+  return nullptr;
+}
+
+PJRT_Error* client_addressable_devices(
+    PJRT_Client_AddressableDevices_Args* args) {
+  auto* c = reinterpret_cast<FakeClient*>(args->client);
+  static thread_local PJRT_Device* dev_list[1];
+  dev_list[0] = reinterpret_cast<PJRT_Device*>(&c->device_marker);
+  args->addressable_devices = dev_list;
+  args->num_addressable_devices = 1;
+  return nullptr;
+}
+
+PJRT_Error* client_compile(PJRT_Client_Compile_Args* args) {
+  if (args->program == nullptr || args->program->code_size == 0)
+    return make_err("empty program");
+  std::string code(args->program->code, args->program->code_size);
+  auto* e = new FakeExec;
+  e->add_mode = code.find("gofr_fake_add_f32") != std::string::npos;
+  // echo mode: outputs mirror args; count encoded as "gofr_fake_echo<N>"
+  size_t pos = code.find("gofr_fake_echo");
+  if (pos != std::string::npos)
+    e->num_outputs = std::strtoul(code.c_str() + pos + 14, nullptr, 10);
+  args->executable = reinterpret_cast<PJRT_LoadedExecutable*>(e);
+  return nullptr;
+}
+
+PJRT_Error* loaded_executable_destroy(
+    PJRT_LoadedExecutable_Destroy_Args* args) {
+  delete reinterpret_cast<FakeExec*>(args->executable);
+  return nullptr;
+}
+
+PJRT_Error* loaded_executable_get_executable(
+    PJRT_LoadedExecutable_GetExecutable_Args* args) {
+  // same object plays both roles
+  args->executable =
+      reinterpret_cast<PJRT_Executable*>(args->loaded_executable);
+  return nullptr;
+}
+
+PJRT_Error* executable_num_outputs(PJRT_Executable_NumOutputs_Args* args) {
+  args->num_outputs =
+      reinterpret_cast<FakeExec*>(args->executable)->num_outputs;
+  return nullptr;
+}
+
+PJRT_Error* buffer_from_host(PJRT_Client_BufferFromHostBuffer_Args* args) {
+  if (args->num_byte_strides != 0)
+    return make_err("fake plugin: dense layouts only");
+  auto* b = new FakeBuffer;
+  b->type = args->type;
+  b->dims.assign(args->dims, args->dims + args->num_dims);
+  size_t n = elem_size(args->type);
+  for (size_t i = 0; i < args->num_dims; ++i) n *= args->dims[i];
+  b->bytes.assign(static_cast<const uint8_t*>(args->data),
+                  static_cast<const uint8_t*>(args->data) + n);
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(b);
+  args->done_with_host_buffer = nullptr;  // synchronous copy: ready now
+  return nullptr;
+}
+
+PJRT_Error* buffer_destroy(PJRT_Buffer_Destroy_Args* args) {
+  delete reinterpret_cast<FakeBuffer*>(args->buffer);
+  return nullptr;
+}
+
+PJRT_Error* buffer_dimensions(PJRT_Buffer_Dimensions_Args* args) {
+  auto* b = reinterpret_cast<FakeBuffer*>(args->buffer);
+  args->dims = b->dims.data();
+  args->num_dims = b->dims.size();
+  return nullptr;
+}
+
+PJRT_Error* buffer_element_type(PJRT_Buffer_ElementType_Args* args) {
+  args->type = reinterpret_cast<FakeBuffer*>(args->buffer)->type;
+  return nullptr;
+}
+
+PJRT_Error* buffer_to_host(PJRT_Buffer_ToHostBuffer_Args* args) {
+  auto* b = reinterpret_cast<FakeBuffer*>(args->src);
+  if (args->dst == nullptr) {
+    args->dst_size = b->bytes.size();
+    args->event = nullptr;
+    return nullptr;
+  }
+  if (args->dst_size < b->bytes.size()) return make_err("dst too small");
+  std::memcpy(args->dst, b->bytes.data(), b->bytes.size());
+  args->dst_size = b->bytes.size();
+  args->event = nullptr;
+  return nullptr;
+}
+
+PJRT_Error* loaded_executable_execute(
+    PJRT_LoadedExecutable_Execute_Args* args) {
+  auto* e = reinterpret_cast<FakeExec*>(args->executable);
+  if (args->num_devices != 1) return make_err("fake plugin: one device");
+  PJRT_Buffer* const* in = args->argument_lists[0];
+  PJRT_Buffer** out = args->output_lists[0];
+  if (e->add_mode) {
+    if (args->num_args < 2) return make_err("add mode needs 2 args");
+    auto* a = reinterpret_cast<FakeBuffer*>(in[0]);
+    auto* b = reinterpret_cast<FakeBuffer*>(in[1]);
+    if (a->type != PJRT_Buffer_Type_F32 || b->type != PJRT_Buffer_Type_F32 ||
+        a->bytes.size() != b->bytes.size())
+      return make_err("add mode: two equal-sized f32 arrays required");
+    auto* r = new FakeBuffer(*a);
+    const float* fa = reinterpret_cast<const float*>(a->bytes.data());
+    const float* fb = reinterpret_cast<const float*>(b->bytes.data());
+    float* fr = reinterpret_cast<float*>(r->bytes.data());
+    for (size_t i = 0; i < r->bytes.size() / 4; ++i) fr[i] = fa[i] + fb[i];
+    out[0] = reinterpret_cast<PJRT_Buffer*>(r);
+  } else {
+    for (size_t i = 0; i < e->num_outputs; ++i) {
+      if (i >= args->num_args) return make_err("echo: more outputs than args");
+      out[i] = reinterpret_cast<PJRT_Buffer*>(
+          new FakeBuffer(*reinterpret_cast<FakeBuffer*>(in[i])));
+    }
+  }
+  if (args->device_complete_events != nullptr)
+    args->device_complete_events[0] = nullptr;  // synchronous: done already
+  return nullptr;
+}
+
+const PJRT_Api* build_api() {
+  static PJRT_Api api;
+  std::memset(&api, 0, sizeof(api));
+  api.struct_size = PJRT_Api_STRUCT_SIZE;
+  api.pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+  api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  api.PJRT_Error_Destroy = error_destroy;
+  api.PJRT_Error_Message = error_message;
+  api.PJRT_Error_GetCode = error_getcode;
+  api.PJRT_Plugin_Initialize = plugin_initialize;
+  api.PJRT_Event_Destroy = event_destroy;
+  api.PJRT_Event_Await = event_await;
+  api.PJRT_Client_Create = client_create;
+  api.PJRT_Client_Destroy = client_destroy;
+  api.PJRT_Client_PlatformName = client_platform_name;
+  api.PJRT_Client_AddressableDevices = client_addressable_devices;
+  api.PJRT_Client_Compile = client_compile;
+  api.PJRT_Client_BufferFromHostBuffer = buffer_from_host;
+  api.PJRT_LoadedExecutable_Destroy = loaded_executable_destroy;
+  api.PJRT_LoadedExecutable_GetExecutable = loaded_executable_get_executable;
+  api.PJRT_LoadedExecutable_Execute = loaded_executable_execute;
+  api.PJRT_Executable_NumOutputs = executable_num_outputs;
+  api.PJRT_Buffer_Destroy = buffer_destroy;
+  api.PJRT_Buffer_Dimensions = buffer_dimensions;
+  api.PJRT_Buffer_ElementType = buffer_element_type;
+  api.PJRT_Buffer_ToHostBuffer = buffer_to_host;
+  return &api;
+}
+
+}  // namespace
+
+extern "C" {
+
+const PJRT_Api* GetPjrtApi() { return build_api(); }
+
+// test hook: expose the option log of a client so tests can assert the
+// NamedValue encoding crossed the boundary intact
+const char* GofrFake_OptionLog(void* client) {
+  return reinterpret_cast<FakeClient*>(client)->option_log.c_str();
+}
+
+}  // extern "C"

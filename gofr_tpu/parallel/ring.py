@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from . import P
 
-__all__ = ["ring_attention_local", "ring_attention"]
+__all__ = ["ring_attention_local", "ring_attention", "sp_decode_attention"]
 
 
 def ring_attention_local(q, k, v, kv_len=None, *, axis_name: str = "sp",
@@ -101,3 +101,70 @@ def ring_attention(q, k, v, mesh, kv_len=None, *, causal: bool = True,
         fn, mesh=mesh, in_specs=(spec, spec, spec, P(batch_axis)),
         out_specs=spec, check_vma=False,
     )(q, k, v, jnp.asarray(kv_len, jnp.int32))
+
+
+# -- sequence-parallel decode -------------------------------------------------
+
+def _sp_decode_local(q, k_cache, v_cache, kv_len, layer, *, axis_name: str,
+                     n_rep: int):
+    """Per-shard decode-attention body: this device holds a [.., S/sp, ..]
+    slice of the KV cache; q (one token per row) is replicated along sp.
+
+    Each shard runs the grouped (no ``repeat_kv``) online-softmax over its
+    LOCAL keys, then the shards combine exactly with one ``pmax`` (global
+    row max) and two ``psum``s (rescaled numerator and denominator) — the
+    decode-time analogue of ring attention, except a single query needs no
+    rotation: the combine is one collective round over ICI.
+    """
+    idx = jax.lax.axis_index(axis_name)
+    if k_cache.ndim == 5:  # stacked [L, B, S_loc, KV, D], traced layer index
+        k_cache = jax.lax.dynamic_index_in_dim(k_cache, layer, 0,
+                                               keepdims=False)
+        v_cache = jax.lax.dynamic_index_in_dim(v_cache, layer, 0,
+                                               keepdims=False)
+    b, s_loc, kv, d = k_cache.shape
+    scale = d ** -0.5
+    qg = (q.reshape(b, kv, n_rep, d).astype(jnp.float32) * scale)
+    pos = idx * s_loc + jnp.arange(s_loc)  # global key positions
+    logits = jnp.einsum("bgrd,bsgd->bgrs", qg,
+                        k_cache.astype(jnp.float32))
+    valid = pos[None, :] < kv_len[:, None]  # [b, s_loc]
+    logits = jnp.where(valid[:, None, None, :], logits, -1e30)
+    m = jnp.max(logits, axis=-1, keepdims=True)          # [b,g,r,1] local max
+    m_glob = jax.lax.pmax(m, axis_name)
+    p = jnp.exp(logits - m_glob)
+    l_loc = jnp.sum(p, axis=-1, keepdims=True)
+    acc_loc = jnp.einsum("bgrs,bsgd->bgrd", p, v_cache.astype(jnp.float32))
+    l_glob = jax.lax.psum(l_loc, axis_name)
+    acc_glob = jax.lax.psum(acc_loc, axis_name)
+    out = acc_glob / jnp.maximum(l_glob, 1e-30)
+    return out.reshape(b, 1, kv * n_rep, d).astype(q.dtype)
+
+
+def sp_decode_attention(q, k_cache, v_cache, kv_len, mesh, *, layer=None,
+                        batch_axis: str = "dp", seq_axis: str = "sp"):
+    """Decode attention over a KV cache whose sequence axis is sharded along
+    ``sp`` (stacked [L, B, S, KV, D] cache with traced ``layer``, or
+    per-layer [B, S, KV, D]). q: [B, 1, H, D] grouped-query token; returns
+    [B, 1, H, D], replicated along sp.
+
+    This is what lets the Generator serve contexts longer than one chip's
+    HBM: the cache rides P(None, dp, sp, None, None) and each decode step
+    pays one pmax+psum round instead of an all-gather of the cache.
+    """
+    stacked = k_cache.ndim == 5
+    n_rep = q.shape[2] // k_cache.shape[3 if stacked else 2]
+    cache_spec = (P(None, batch_axis, seq_axis, None, None) if stacked
+                  else P(batch_axis, seq_axis, None, None))
+    q_spec = P(batch_axis, None, None, None)
+
+    def fn(q, k, v, kv_len, layer):
+        return _sp_decode_local(q, k, v, kv_len, layer, axis_name=seq_axis,
+                                n_rep=n_rep)
+
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(q_spec, cache_spec, cache_spec, P(batch_axis), P()),
+        out_specs=q_spec, check_vma=False,
+    )(q, k_cache, v_cache, jnp.asarray(kv_len, jnp.int32),
+      jnp.asarray(0 if layer is None else layer, jnp.int32))
