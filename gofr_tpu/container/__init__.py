@@ -109,6 +109,12 @@ class Container:
         m.new_histogram("app_ml_batch_size", "dynamic batcher batch sizes",
                         buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
         m.new_histogram("app_ml_queue_seconds", "request time in batch queue")
+        m.new_histogram(
+            "app_llm_ttft_seconds", "LLM time to first token",
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2),
+        )
+        m.new_histogram("app_llm_queue_seconds",
+                        "LLM request wait before slot admission")
         self._start_time = time.time()
 
     def refresh_process_metrics(self) -> None:

@@ -68,6 +68,7 @@ class MLDatasource:
             if batching is True:
                 batching = DynamicBatcher(engine, metrics=self._metrics)
             self._batchers[name] = batching
+            engine.warmup_buckets()  # batcher pads to buckets: compile all now
         if self._logger is not None:
             self._logger.infof("model %s registered on %s", name, str(engine.device))
         return engine
@@ -81,7 +82,11 @@ class MLDatasource:
         from .llm import LLMServer
 
         if generator is None:
+            warm = gen_kwargs.pop("warmup", True)
             generator = Generator(params, cfg, **gen_kwargs)
+            if warm:
+                # startup pays every decode/prefill compile, not a request
+                generator.warmup()
         server = LLMServer(generator, name=name, logger=self._logger,
                            metrics=self._metrics)
         self._llms[name] = server

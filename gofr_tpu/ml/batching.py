@@ -49,14 +49,18 @@ class DynamicBatcher:
         *,
         max_batch: int = 64,
         max_delay_s: float = 0.005,
+        max_inflight: int = 2,
         metrics=None,
     ) -> None:
         self._engine = engine
         self._max_batch = max_batch
         self._max_delay = max_delay_s
+        self._max_inflight = max_inflight
         self._metrics = metrics
         self._queue: asyncio.Queue[_Pending] = asyncio.Queue()
         self._task: asyncio.Task | None = None
+        self._inflight: set[asyncio.Task] = set()
+        self._inflight_slots: asyncio.Semaphore | None = None
         self._closed = False
 
     def _ensure_collector(self) -> None:
@@ -77,6 +81,14 @@ class DynamicBatcher:
         while not self._closed:
             first = await self._queue.get()
             batch = [first]
+            # Greedily absorb any backlog that built up while the previous
+            # batch was on device — their enqueue times are already past the
+            # delay window, so they must ride the very next batch.
+            while len(batch) < self._max_batch:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
             deadline = first.enqueued_at + self._max_delay
             while len(batch) < self._max_batch:
                 remaining = deadline - time.perf_counter()
@@ -86,7 +98,25 @@ class DynamicBatcher:
                     batch.append(await asyncio.wait_for(self._queue.get(), remaining))
                 except asyncio.TimeoutError:
                     break
-            await self._run_batch(batch)
+            # Run the batch as a task so collection of the NEXT batch overlaps
+            # device execution of this one (keeps the dispatch queue fed — the
+            # engine thread serializes actual device calls). The semaphore
+            # bounds in-flight batches: under sustained overload the collector
+            # blocks here and requests back up in _queue instead of growing an
+            # unbounded set of stacked device batches.
+            if self._inflight_slots is None:
+                self._inflight_slots = asyncio.Semaphore(self._max_inflight)
+            await self._inflight_slots.acquire()
+
+            async def _run_and_release(b=batch) -> None:
+                try:
+                    await self._run_batch(b)
+                finally:
+                    self._inflight_slots.release()
+
+            task = asyncio.get_running_loop().create_task(_run_and_release())
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
 
     async def _run_batch(self, batch: list[_Pending]) -> None:
         n = len(batch)

@@ -98,6 +98,7 @@ class Engine:
         )
         self.steps = 0
         self.device = jax.devices()[0]
+        self._example_inputs = example_inputs
         self._thread.start()
         if example_inputs is not None and self.config.warmup:
             self.predict_sync(*example_inputs)  # compile before first request
@@ -117,7 +118,12 @@ class Engine:
 
     def _execute(self, *inputs: Any) -> Any:
         start = time.perf_counter()
-        arrays = [jnp.asarray(x) for x in inputs]
+        if self._pjrt is not None:
+            # the native binding does its own host->device transfer; a
+            # jnp.asarray here would bounce each input through jax's device
+            arrays = [np.asarray(x) for x in inputs]
+        else:
+            arrays = [jnp.asarray(x) for x in inputs]
         out = self._run(*arrays)
         out = jax.tree.map(lambda a: np.asarray(a), out)  # blocks until done
         self.steps += 1
@@ -147,6 +153,27 @@ class Engine:
     def bucket_for(self, n: int) -> int:
         return _next_bucket(n, self.config.batch_buckets)
 
+    def warmup_buckets(self) -> None:
+        """Compile every batch-shape bucket up front by tiling the example
+        row, so no XLA compile ever lands on a live request (each distinct
+        batch bucket is a separate jit trace; paying them at startup is the
+        TPU-first trade — serving latency must never include a compile)."""
+        if self._example_inputs is None or not self.config.warmup:
+            return
+        examples = [np.asarray(x) for x in self._example_inputs]
+        if examples[0].ndim == 0:
+            return  # no batch axis to tile along: nothing to pre-compile
+        example_b = examples[0].shape[0]
+        for b in self.config.batch_buckets:
+            if b == example_b:
+                continue  # the constructor's warmup already compiled this one
+            # scalars (0-d side inputs) pass through untiled
+            tiled = [
+                x if x.ndim == 0 else np.repeat(x[:1], b, axis=0)
+                for x in examples
+            ]
+            self.predict_sync(*tiled)
+
     def memory_stats(self) -> dict | None:
         try:
             return self.device.memory_stats()
@@ -157,4 +184,9 @@ class Engine:
         self._work.put(None)
         if self._pjrt is not None:
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                # worker still mid-execution (slow compile / stalled device):
+                # destroying the native client now would be a use-after-free
+                # in the worker; leak the client instead of crashing.
+                return
             self._pjrt.close()

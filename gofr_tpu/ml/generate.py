@@ -156,32 +156,40 @@ class Generator:
         self.steps = 0
 
         sampler_cfg = self.sampler
-        n_chunk = self.chunk
 
-        def chunk_fn(params, tok, cache, step0, base_key):
-            """``chunk`` fused decode+sample steps. Returns [chunk+1, B]
-            tokens: row 0 is the INPUT token row (how newly-admitted slots'
-            first sampled tokens reach the host — a separate per-admission
-            transfer would cost a full ~200 ms synchronous tunnel D2H; this
-            way firsts ride the chunk fetch that happens anyway), rows
-            1..chunk are this chunk's samples; plus the final carry."""
-            tok_in = tok
+        def make_chunk_fn(n_chunk: int):
+            def chunk_fn(params, tok, cache, step0, base_key):
+                """``n_chunk`` fused decode+sample steps. Returns
+                [n_chunk+1, B] tokens: row 0 is the INPUT token row (how
+                newly-admitted slots' first sampled tokens reach the host — a
+                separate per-admission transfer would cost a full ~200 ms
+                synchronous tunnel D2H; this way firsts ride the chunk fetch
+                that happens anyway), rows 1..n_chunk are this chunk's
+                samples; plus the final carry."""
+                tok_in = tok
 
-            def body(carry, j):
-                tok, cache = carry
-                logits, cache = llama.decode_step(params, tok, cache, cfg,
-                                                  mesh=mesh)
-                key = jax.random.fold_in(base_key, step0 + j)
-                nxt = _sample_impl(logits, key, sampler_cfg)
-                return (nxt, cache), nxt
+                def body(carry, j):
+                    tok, cache = carry
+                    logits, cache = llama.decode_step(params, tok, cache, cfg,
+                                                      mesh=mesh)
+                    key = jax.random.fold_in(base_key, step0 + j)
+                    nxt = _sample_impl(logits, key, sampler_cfg)
+                    return (nxt, cache), nxt
 
-            (tok, cache), toks = jax.lax.scan(
-                body, (tok, cache), jnp.arange(n_chunk)
-            )
-            return jnp.concatenate([tok_in[None], toks], axis=0), tok, cache
+                (tok, cache), toks = jax.lax.scan(
+                    body, (tok, cache), jnp.arange(n_chunk)
+                )
+                return jnp.concatenate([tok_in[None], toks], axis=0), tok, cache
 
-        # donate the cache: in-place KV update on device, no copy per step
-        self._chunk_fn = jax.jit(chunk_fn, donate_argnums=(2,))
+            # donate the cache: in-place KV update on device, no copy per step
+            return jax.jit(chunk_fn, donate_argnums=(2,))
+
+        self._chunk_fn = make_chunk_fn(self.chunk)
+        # TTFT path: a 1-step mini-chunk dispatched while first tokens are
+        # pending, so a new request's first token reaches the host ~one full
+        # chunk earlier instead of waiting out `chunk` decode steps.
+        self._mini_chunk_fn = self._chunk_fn if self.chunk == 1 \
+            else make_chunk_fn(1)
 
         def post_prefill(tok_dev, logits, prefill_key, n_req, slot):
             """Sample the first token and park it in the device-resident
@@ -202,6 +210,78 @@ class Generator:
             donate_argnums=(3,),
         )
 
+        def post_prefill_many(tok_dev, logits, prefill_key, n_req0, slots,
+                              valid):
+            """Batched first-token sampling for an admission wave: one key
+            per wave (categorical samples rows independently), sequential
+            unrolled scatter so identity writes for padding rows can never
+            clobber a real row written earlier in the same wave."""
+            key = jax.random.fold_in(prefill_key, n_req0)
+            firsts = _sample_impl(logits, key, sampler_cfg)
+            for i in range(slots.shape[0]):
+                cur = tok_dev[slots[i]]
+                tok_dev = tok_dev.at[slots[i]].set(
+                    jnp.where(valid[i], firsts[i], cur))
+            return tok_dev
+
+        self._post_prefill_many = jax.jit(post_prefill_many,
+                                          donate_argnums=(0,))
+        self._prefill_many = jax.jit(
+            lambda p, t, l, c, slots, valid: llama.prefill_into_many(
+                p, t, l, cfg, c, slots, valid, mesh=mesh),
+            donate_argnums=(3,),
+        )
+        # admission-wave shape buckets: 1 (the common trickle) and
+        # _admit_cap (bursts). Waves of 2..cap-1 pad to cap with masked
+        # rows — a little extra MXU work instead of a fresh compile.
+        self._admit_cap = min(8, batch_slots)
+
+    def warmup(self) -> None:
+        """Compile the decode programs (full chunk + TTFT mini-chunk) and
+        the prefill buckets before the first request — a lazy first-use
+        compile would land on exactly the TTFT path the mini-chunk exists
+        to shorten. All slots are dead during warmup, so the sampled
+        garbage never reaches bookkeeping; admission overwrites slot state.
+        """
+        fns = [self._chunk_fn]
+        if self._mini_chunk_fn is not self._chunk_fn:
+            fns.append(self._mini_chunk_fn)
+        with self._mesh_ctx():
+            for fn in fns:
+                _toks, self._tok_dev, self.cache = fn(
+                    self.params, self._tok_dev, self.cache,
+                    jnp.int32(0), self._base_key,
+                )
+            for bucket in self.prefill_buckets:
+                padded = jnp.zeros((1, bucket), jnp.int32)
+                logits, self.cache = self._prefill_into(
+                    self.params, padded, jnp.asarray([1], np.int32),
+                    self.cache, jnp.int32(0),
+                )
+                self._tok_dev = self._post_prefill(
+                    self._tok_dev, logits, self._prefill_key,
+                    jnp.uint32(0), jnp.int32(0),
+                )
+                if self._admit_cap > 1:  # the wave-admission shapes too
+                    b = self._admit_cap
+                    logits, self.cache = self._prefill_many(
+                        self.params, jnp.zeros((b, bucket), jnp.int32),
+                        jnp.ones((b,), jnp.int32), self.cache,
+                        jnp.zeros((b,), jnp.int32),
+                        jnp.zeros((b,), bool),  # all rows masked: no writes
+                    )
+                    self._tok_dev = self._post_prefill_many(
+                        self._tok_dev, logits, self._prefill_key,
+                        jnp.uint32(0), jnp.zeros((b,), jnp.int32),
+                        jnp.zeros((b,), bool),
+                    )
+        # a REAL device->host fetch, not block_until_ready: through remote
+        # transports the latter returns before queued work has drained, and
+        # the first live request's token fetch would then absorb the entire
+        # warmup queue (~1.5 s measured) — exactly the TTFT hit warmup exists
+        # to prevent.
+        np.asarray(self._tok_dev)
+
     # -- request management ---------------------------------------------------
     def free_slot(self) -> int | None:
         for i, s in enumerate(self.slots):
@@ -212,43 +292,90 @@ class Generator:
     def add_request(self, prompt_ids, max_new_tokens: int,
                     callback=None) -> int:
         """Prefill the prompt into a free slot; returns the slot index."""
-        self.drain()  # settle bookkeeping before reusing a slot
-        i = self.free_slot()
-        if i is None:
-            raise RuntimeError("no free generation slot")
-        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
-        n = len(ids)
-        if n == 0 or n >= self.max_seq:
-            raise ValueError(f"prompt length {n} out of range (1..{self.max_seq - 1})")
-        bucket = next((b for b in self.prefill_buckets if n <= b), self.max_seq)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = ids
-        with self._mesh_ctx():
-            logits, self.cache = self._prefill_into(
-                self.params, jnp.asarray(padded), jnp.asarray([n], np.int32),
-                self.cache, jnp.int32(i),
-            )
-        self._tok_dev = self._post_prefill(
-            self._tok_dev, logits, self._prefill_key,
-            jnp.uint32(self._n_requests), jnp.int32(i),
-        )
-        self._n_requests += 1
-        # Admission is fully ASYNC: the sampled first token stays on device
-        # in _tok_dev and its VALUE reaches the host in row 0 of the next
-        # decode chunk (see chunk_fn). A synchronous int(first) here
-        # serialized every admission on a ~150 ms tunnel round-trip — that,
-        # not prefill compute (<1 ms), was the r1 "prefill stall".
-        self._pending_first.append(i)
-        s = _Slot()
-        s.live = True
-        s.tokens = []
-        s.max_new = max_new_tokens
-        s.produced = 1  # the pending first token counts as sampled
-        s.prompt_len = n
-        s.eos_hit = False
-        s.callback = callback
-        self.slots[i] = s
-        return i
+        return self.add_requests([(prompt_ids, max_new_tokens, callback)])[0]
+
+    def add_requests(self, requests) -> list[int]:
+        """Admit a WAVE of requests — ``[(prompt_ids, max_new, callback)]``
+        — with as few device programs as possible. Remote transports charge
+        ~100 ms dispatch overhead per program; N per-request prefills ahead
+        of the first decode chunk cost N× that in TTFT, a batched wave pays
+        it once (llama.prefill_into_many). Waves larger than the admission
+        cap split; a wave of 2..cap-1 pads to cap with masked rows.
+
+        Admission stays fully ASYNC: sampled first tokens stay on device in
+        ``_tok_dev`` and their values reach the host in row 0 of the next
+        decode chunk (see chunk_fn) — a synchronous fetch here serialized
+        every admission on a ~150 ms round-trip (the r1 "prefill stall").
+        """
+        self.drain()  # settle bookkeeping before reusing slots
+        prepped = []
+        for prompt_ids, max_new, callback in requests:
+            ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+            n = len(ids)
+            if n == 0 or n >= self.max_seq:
+                raise ValueError(
+                    f"prompt length {n} out of range (1..{self.max_seq - 1})")
+            prepped.append((ids, n, max_new, callback))
+
+        out: list[int] = []
+        for start in range(0, len(prepped), self._admit_cap):
+            wave = prepped[start:start + self._admit_cap]
+            slots = []
+            for _ in wave:
+                i = self.free_slot()
+                if i is None:
+                    raise RuntimeError("no free generation slot")
+                slots.append(i)
+                self.slots[i].live = True  # reserve within this wave
+            b = 1 if len(wave) == 1 else self._admit_cap
+            s_bucket = next(
+                (s for s in self.prefill_buckets
+                 if all(n <= s for _, n, _, _ in wave)), self.max_seq)
+            tokens = np.zeros((b, s_bucket), np.int32)
+            lens = np.ones((b,), np.int32)
+            valid = np.zeros((b,), bool)
+            slot_arr = np.full((b,), slots[0], np.int32)
+            for row, (ids, n, _, _) in enumerate(wave):
+                tokens[row, :n] = ids
+                lens[row] = n
+                valid[row] = True
+                slot_arr[row] = slots[row]
+            with self._mesh_ctx():
+                if b == 1:
+                    logits, self.cache = self._prefill_into(
+                        self.params, jnp.asarray(tokens),
+                        jnp.asarray(lens), self.cache,
+                        jnp.int32(slots[0]),
+                    )
+                    self._tok_dev = self._post_prefill(
+                        self._tok_dev, logits, self._prefill_key,
+                        jnp.uint32(self._n_requests), jnp.int32(slots[0]),
+                    )
+                else:
+                    logits, self.cache = self._prefill_many(
+                        self.params, jnp.asarray(tokens), jnp.asarray(lens),
+                        self.cache, jnp.asarray(slot_arr),
+                        jnp.asarray(valid),
+                    )
+                    self._tok_dev = self._post_prefill_many(
+                        self._tok_dev, logits, self._prefill_key,
+                        jnp.uint32(self._n_requests), jnp.asarray(slot_arr),
+                        jnp.asarray(valid),
+                    )
+            self._n_requests += len(wave)
+            for slot, (ids, n, max_new, callback) in zip(slots, wave):
+                self._pending_first.append(slot)
+                s = _Slot()
+                s.live = True
+                s.tokens = []
+                s.max_new = max_new
+                s.produced = 1  # the pending first token counts as sampled
+                s.prompt_len = n
+                s.eos_hit = False
+                s.callback = callback
+                self.slots[slot] = s
+            out.extend(slots)
+        return out
 
     def _resolve_first(self, tok_in_row: np.ndarray) -> None:
         """Fold newly-admitted slots' first tokens (row 0 of an arriving
@@ -290,12 +417,19 @@ class Generator:
         if self.n_live == 0:
             self.drain()
             return
+        # Pending first tokens -> ONE 1-step mini-chunk so they surface a
+        # full chunk earlier (TTFT); otherwise the throughput-sized chunk.
+        # All firsts pending at dispatch ride that chunk's input row, and
+        # the mini path drains synchronously below, so pending_first is
+        # empty again before the next step() call.
+        mini = bool(self._pending_first)
+        fn = self._mini_chunk_fn if mini else self._chunk_fn
         with self._mesh_ctx():
-            toks, self._tok_dev, self.cache = self._chunk_fn(
+            toks, self._tok_dev, self.cache = fn(
                 self.params, self._tok_dev, self.cache,
                 jnp.int32(self.steps), self._base_key,
             )
-        self.steps += self.chunk
+        self.steps += 1 if mini else self.chunk
         try:
             # best-effort prefetch; on transports where this is itself a
             # blocking transfer (the axon tunnel) the cost is the same as
@@ -305,8 +439,15 @@ class Generator:
         except Exception:
             pass
         self._inflight.append(toks)
-        while len(self._inflight) > 1:
-            self._process(np.asarray(self._inflight.popleft()))
+        if mini:
+            # TTFT: the chunk carrying new requests' first tokens is read
+            # back NOW instead of lagging one dispatch — one blocking
+            # round-trip traded for a whole chunk cycle of first-token
+            # latency; steady-state decode keeps the async pipeline.
+            self.drain()
+        else:
+            while len(self._inflight) > 1:
+                self._process(np.asarray(self._inflight.popleft()))
 
     def drain(self) -> None:
         """Flush pending token chunks into host bookkeeping."""

@@ -29,7 +29,7 @@ _DONE = object()
 
 class _Request:
     __slots__ = ("prompt", "max_new", "out_q", "loop", "enqueued_at", "slot",
-                 "first_token_at")
+                 "first_token_at", "cancelled")
 
     def __init__(self, prompt, max_new, out_q, loop) -> None:
         self.prompt = prompt
@@ -39,6 +39,7 @@ class _Request:
         self.enqueued_at = time.perf_counter()
         self.slot = None
         self.first_token_at = None
+        self.cancelled = False  # consumer went away: stop decoding the slot
 
 
 class LLMServer:
@@ -49,12 +50,14 @@ class LLMServer:
     """
 
     def __init__(self, generator, *, name: str = "llm", logger=None,
-                 metrics=None, idle_wait_s: float = 0.002) -> None:
+                 metrics=None, idle_wait_s: float = 0.002,
+                 admit_window_s: float = 0.004) -> None:
         self.gen = generator
         self.name = name
         self._logger = logger
         self._metrics = metrics
         self._idle_wait = idle_wait_s
+        self._admit_window = admit_window_s
         self._requests: _queue.Queue[_Request | None] = _queue.Queue()
         self._waiting: list[_Request] = []
         self._active: dict[int, _Request] = {}
@@ -67,7 +70,14 @@ class LLMServer:
 
     # -- serving thread -------------------------------------------------------
     def _serve_loop(self) -> None:
+        try:
+            self._serve()
+        finally:
+            self._flush_on_close()
+
+    def _serve(self) -> None:
         while not self._closed:
+            self._reap_cancelled()
             self._admit_waiting()
             if self.gen.n_live:
                 self.gen.step()
@@ -82,6 +92,48 @@ class LLMServer:
                 if req is None:
                     return
                 self._waiting.append(req)
+                # collect the rest of the burst before admitting: concurrent
+                # clients arrive over a few ms, and one wave (one batched
+                # prefill + one mini-chunk) gives every stream the first
+                # wave's TTFT instead of the second's
+                deadline = time.perf_counter() + self._admit_window
+                while True:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    try:
+                        more = self._requests.get(timeout=remaining)
+                    except _queue.Empty:
+                        break
+                    if more is None:
+                        self._closed = True
+                        return
+                    self._waiting.append(more)
+
+    def _flush_on_close(self) -> None:
+        """The serving thread is exiting: every parked or still-queued
+        consumer must be woken with an error + _DONE, or its
+        ``await out_q.get()`` blocks forever."""
+        self._closed = True
+        leftovers = list(self._waiting)
+        self._waiting = []
+        while True:
+            try:
+                req = self._requests.get_nowait()
+            except _queue.Empty:
+                break
+            if req is not None:
+                leftovers.append(req)
+        for slot, req in list(self._active.items()):
+            leftovers.append(req)
+            del self._active[slot]
+        exc = RuntimeError("llm server closed")
+        for req in leftovers:
+            try:
+                req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
+                req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
+            except Exception:
+                pass  # consumer loop itself already gone
 
     def _admit_waiting(self) -> None:
         # pull everything queued, then admit as long as slots are free
@@ -101,34 +153,66 @@ class LLMServer:
                 # backlog (a drain here would sync the device every loop)
                 break
             # About to admit: settle device bookkeeping and release finished
-            # slots FIRST — add_request's internal drain() could otherwise
+            # slots FIRST — add_requests' internal drain() could otherwise
             # finish another slot mid-admission and free_slot() would hand
             # back a slot still present in self._active, overwriting its
             # request (which then never receives _DONE). Draining here makes
-            # the drain inside add_request a no-op; it can only free MORE
-            # slots, never consume the one we just saw.
+            # the drain inside add_requests a no-op; it can only free MORE
+            # slots, never consume the ones we just saw.
             self.gen.drain()
             self._finish_dead_slots()
-            req = self._waiting.pop(0)
-            try:
-                slot = self.gen.add_request(
-                    req.prompt, req.max_new,
-                    callback=lambda i, t, r=req: self._emit(r, t),
-                )
-            except Exception as exc:  # bad prompt etc. -> relay to caller
+            # admit everything that fits as ONE wave: a batched prefill pays
+            # the per-program dispatch overhead once for the whole burst
+            n_free = sum(not s.live for s in self.gen.slots)
+            batch, rejected = [], []
+            while self._waiting and len(batch) < n_free:
+                req = self._waiting.pop(0)
+                try:
+                    ids = self._validate(req)
+                except Exception as exc:
+                    rejected.append((req, exc))
+                    continue
+                batch.append((req, ids))
+            for req, exc in rejected:
                 req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
                 req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
+            if not batch:
                 continue
-            req.slot = slot
-            self._active[slot] = req
-            if self._metrics is not None:
-                try:
-                    self._metrics.record_histogram(
-                        "app_llm_queue_seconds",
-                        time.perf_counter() - req.enqueued_at, model=self.name,
-                    )
-                except Exception:
-                    pass
+            try:
+                slots = self.gen.add_requests([
+                    (ids, req.max_new,
+                     (lambda i, t, r=req: self._emit(r, t)))
+                    for req, ids in batch
+                ])
+            except Exception as exc:  # device-side failure: relay to all
+                for req, _ in batch:
+                    req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
+                    req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
+                continue
+            now = time.perf_counter()
+            for (req, _), slot in zip(batch, slots):
+                req.slot = slot
+                self._active[slot] = req
+                if self._metrics is not None:
+                    try:
+                        self._metrics.record_histogram(
+                            "app_llm_queue_seconds",
+                            now - req.enqueued_at, model=self.name,
+                        )
+                    except Exception:
+                        pass
+
+    def _validate(self, req) -> Any:
+        """Shape-check the prompt on the serving thread so one bad request
+        rejects cleanly instead of failing the whole admission wave."""
+        import numpy as np
+
+        ids = np.asarray(req.prompt, np.int32).reshape(-1)
+        n = len(ids)
+        if n == 0 or n >= self.gen.max_seq:
+            raise ValueError(
+                f"prompt length {n} out of range (1..{self.gen.max_seq - 1})")
+        return ids
 
     def _emit(self, req: _Request, token: int) -> None:
         if req.first_token_at is None:
@@ -142,6 +226,16 @@ class LLMServer:
                 except Exception:
                     pass
         req.loop.call_soon_threadsafe(req.out_q.put_nowait, token)
+
+    def _reap_cancelled(self) -> None:
+        """Stop decoding for consumers that went away (client disconnect /
+        stream abandoned): their slots would otherwise burn decode steps to
+        max_new_tokens, delaying every waiting request."""
+        if self._waiting:
+            self._waiting = [r for r in self._waiting if not r.cancelled]
+        for slot, req in self._active.items():
+            if req.cancelled and self.gen.slots[slot].live:
+                self.gen.slots[slot].live = False
 
     def _finish_dead_slots(self) -> None:
         for slot, req in list(self._active.items()):
@@ -160,14 +254,21 @@ class LLMServer:
             raise RuntimeError("llm server is closed")
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue = asyncio.Queue()
-        self._requests.put(_Request(prompt_ids, max_new_tokens, out_q, loop))
-        while True:
-            item = await out_q.get()
-            if item is _DONE:
-                return
-            if isinstance(item, Exception):
-                raise item
-            yield item
+        req = _Request(prompt_ids, max_new_tokens, out_q, loop)
+        self._requests.put(req)
+        try:
+            while True:
+                item = await out_q.get()
+                if item is _DONE:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            # consumer closed the stream (disconnect, break, cancellation):
+            # flag it so the serving thread frees the slot instead of
+            # decoding to max_new_tokens for nobody
+            req.cancelled = True
 
     async def generate(self, prompt_ids, max_new_tokens: int = 64) -> list[int]:
         """Collect the full completion."""

@@ -339,6 +339,38 @@ def prefill_into(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
     return logits, new_cache
 
 
+def prefill_into_many(params: dict, tokens: jnp.ndarray,
+                      seq_lens: jnp.ndarray, cfg: LlamaConfig, cache: dict,
+                      slots: jnp.ndarray, valid: jnp.ndarray, mesh=None
+                      ) -> tuple[jnp.ndarray, dict]:
+    """Prefill a WAVE of B prompts [B, S_pad] into rows ``slots`` [B] of the
+    shared cache in ONE program. Remote transports charge ~100 ms of
+    dispatch overhead per execution, so admitting N requests as N separate
+    prefill programs serializes N×overhead ahead of the first decode chunk
+    — batching the wave pays the overhead once. ``valid`` masks padding
+    rows (B is a shape bucket): an invalid row writes its target slot's
+    existing contents back, so it clobbers nothing.
+    """
+    b = tokens.shape[0]
+    logits, filled = prefill(params, tokens, seq_lens, cfg,
+                             init_cache(cfg, b, cache["k"].shape[2]),
+                             mesh=mesh)
+    k, v, lens = cache["k"], cache["v"], cache["len"]
+    for i in range(b):  # static B: unrolled scatter, one row per request
+        slot = slots[i]
+        k_row = jnp.where(valid[i], filled["k"][:, i],
+                          jax.lax.dynamic_index_in_dim(k, slot, axis=1,
+                                                       keepdims=False))
+        v_row = jnp.where(valid[i], filled["v"][:, i],
+                          jax.lax.dynamic_index_in_dim(v, slot, axis=1,
+                                                       keepdims=False))
+        k = jax.lax.dynamic_update_index_in_dim(k, k_row, slot, axis=1)
+        v = jax.lax.dynamic_update_index_in_dim(v, v_row, slot, axis=1)
+        lens = lens.at[slot].set(
+            jnp.where(valid[i], seq_lens[i], lens[slot]))
+    return logits, {"k": k, "v": v, "len": lens}
+
+
 def decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
                 cfg: LlamaConfig, mesh=None) -> tuple[jnp.ndarray, dict]:
     """One token per row: tokens [B] -> (logits [B, V], updated cache).

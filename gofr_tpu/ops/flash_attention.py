@@ -29,7 +29,8 @@ NEG_INF = -1e30
 
 
 def _attn_kernel(kvlen_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                 causal: bool, q_offset: int, seq_k: int, has_kvlen: bool):
+                 causal: bool, q_offset: int, seq_k: int, has_kvlen: bool,
+                 n_heads: int):
     """One (batch*head, q_block) cell: loop K blocks with online softmax."""
     block_q, head_dim = q_ref.shape
     q = q_ref[:].astype(jnp.float32) * (head_dim ** -0.5)
@@ -37,7 +38,9 @@ def _attn_kernel(kvlen_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
     q_start = q_block_idx * block_q + q_offset
 
     n_kblocks = pl.cdiv(seq_k, block_k)
-    kvlen = kvlen_ref[0] if has_kvlen else seq_k
+    # the whole [B] length vector rides SMEM (a per-cell (1,) block would
+    # violate the rank-1 block tiling rule for B > 1); index our row here
+    kvlen = kvlen_ref[pl.program_id(0) // n_heads] if has_kvlen else seq_k
 
     def body(kb, carry):
         acc, m_prev, l_prev = carry
@@ -111,14 +114,14 @@ def flash_attention_tpu(q, k, v, kv_len=None, *, causal: bool = True,
 
     kernel = functools.partial(
         _attn_kernel, block_k=block_k, causal=causal, q_offset=q_offset,
-        seq_k=tk, has_kvlen=has_kvlen,
+        seq_k=tk, has_kvlen=has_kvlen, n_heads=h,
     )
     out = pl.pallas_call(
         kernel,
         grid=(b * h, tq // block_q),
         in_specs=[
-            # per-row valid length, scalar in SMEM (row = grid cell // heads)
-            pl.BlockSpec((1,), lambda i, j: (i // h,),
+            # full [B] valid-length vector in SMEM for every cell
+            pl.BlockSpec((b,), lambda i, j: (0,),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
