@@ -54,10 +54,15 @@ class PjrtExecutor:
             client_options = pjrt.axon_client_options()
         self._client = self._plugin.create_client(client_options or {})
         self._apply = apply_fn
-        self._params_host = jax.tree.map(np.asarray, params)
-        leaves, self._params_tree = jax.tree.flatten(self._params_host)
-        self._param_bufs = [self._client.to_device(np.asarray(x))
-                            for x in leaves]
+        leaves, self._params_tree = jax.tree.flatten(
+            jax.tree.map(np.asarray, params))
+        self._param_bufs = [self._client.to_device(x) for x in leaves]
+        # tracing only needs shapes — keeping the full host copy would pin
+        # a second multi-GB weight image in RAM for the engine's lifetime
+        self._params_abstract = jax.tree.unflatten(
+            self._params_tree,
+            [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in leaves])
+        del leaves
         self._cache: dict[tuple, tuple] = {}
 
     @property
@@ -73,9 +78,9 @@ class PjrtExecutor:
         # keep_unused: the executable's argument list must stay aligned
         # with the flattened (params, *inputs) leaves we feed it
         lowered = jax.jit(fn, backend="cpu", keep_unused=True).lower(
-            self._params_host, *np_inputs)
+            self._params_abstract, *np_inputs)
         hlo = str(lowered.compiler_ir("stablehlo"))
-        out_shape = jax.eval_shape(fn, self._params_host, *np_inputs)
+        out_shape = jax.eval_shape(fn, self._params_abstract, *np_inputs)
         _, out_tree = jax.tree.flatten(out_shape)
         exe = self._client.compile(hlo)
         return exe, out_tree

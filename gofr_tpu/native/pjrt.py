@@ -122,7 +122,7 @@ def _load_shim():
     lib.gofr_pjrt_execute.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
         ctypes.c_size_t, ctypes.POINTER(ctypes.c_void_p), ctypes.c_size_t,
-        ctypes.c_char_p, ctypes.c_size_t]
+        ctypes.c_longlong, ctypes.c_char_p, ctypes.c_size_t]
     return lib
 
 
@@ -285,6 +285,7 @@ class PjrtExecutable:
         self._lib = client._lib
         self._api = client._api
         self._handle = handle
+        self._num_outputs: int | None = None
 
     def destroy(self) -> None:
         if self._handle:
@@ -293,12 +294,14 @@ class PjrtExecutable:
 
     @property
     def num_outputs(self) -> int:
-        err = ctypes.create_string_buffer(_ERRCAP)
-        n = self._lib.gofr_pjrt_num_outputs(self._api, self._handle, err,
-                                            _ERRCAP)
-        if n < 0:
-            raise PjrtError(err.value.decode())
-        return int(n)
+        if self._num_outputs is None:
+            err = ctypes.create_string_buffer(_ERRCAP)
+            n = self._lib.gofr_pjrt_num_outputs(self._api, self._handle, err,
+                                                _ERRCAP)
+            if n < 0:
+                raise PjrtError(err.value.decode())
+            self._num_outputs = int(n)
+        return self._num_outputs
 
     def execute_buffers(self, buffers: list[PjrtBuffer]) -> list[PjrtBuffer]:
         n_in = len(buffers)
@@ -306,8 +309,12 @@ class PjrtExecutable:
             *[b._handle for b in buffers])
         out_arr = (ctypes.c_void_p * 256)()
         err = ctypes.create_string_buffer(_ERRCAP)
+        # cached output count skips a GetExecutable/NumOutputs round trip
+        # inside the shim on every call (hot serving path)
+        nout_hint = self.num_outputs
         n_out = self._lib.gofr_pjrt_execute(
-            self._api, self._handle, in_arr, n_in, out_arr, 256, err, _ERRCAP)
+            self._api, self._handle, in_arr, n_in, out_arr, 256,
+            nout_hint, err, _ERRCAP)
         if n_out < 0:
             raise PjrtError(f"execute: {err.value.decode()}")
         return [PjrtBuffer(self._client, out_arr[i]) for i in range(n_out)]

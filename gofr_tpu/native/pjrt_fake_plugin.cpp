@@ -165,6 +165,13 @@ PJRT_Error* loaded_executable_get_executable(
   return nullptr;
 }
 
+PJRT_Error* executable_destroy(PJRT_Executable_Destroy_Args* args) {
+  // GetExecutable aliases the loaded executable (no separate wrapper), so
+  // the caller-frees-wrapper contract is a no-op here.
+  (void)args;
+  return nullptr;
+}
+
 PJRT_Error* executable_num_outputs(PJRT_Executable_NumOutputs_Args* args) {
   args->num_outputs =
       reinterpret_cast<FakeExec*>(args->executable)->num_outputs;
@@ -269,6 +276,7 @@ const PJRT_Api* build_api() {
   api.PJRT_Client_BufferFromHostBuffer = buffer_from_host;
   api.PJRT_LoadedExecutable_Destroy = loaded_executable_destroy;
   api.PJRT_LoadedExecutable_GetExecutable = loaded_executable_get_executable;
+  api.PJRT_Executable_Destroy = executable_destroy;
   api.PJRT_LoadedExecutable_Execute = loaded_executable_execute;
   api.PJRT_Executable_NumOutputs = executable_num_outputs;
   api.PJRT_Buffer_Destroy = buffer_destroy;

@@ -254,8 +254,17 @@ long long gofr_pjrt_num_outputs(void* vapi, void* vexec,
   std::memset(&nargs, 0, sizeof(nargs));
   nargs.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
   nargs.executable = gargs.executable;
-  if (gofr_err(api, api->PJRT_Executable_NumOutputs(&nargs), err, errcap))
-    return -1;
+  bool failed =
+      gofr_err(api, api->PJRT_Executable_NumOutputs(&nargs), err, errcap);
+  // The wrapper executable returned by GetExecutable is caller-owned
+  // (pjrt_c_api.h contract) — destroy it or every call leaks one.
+  PJRT_Executable_Destroy_Args dargs;
+  std::memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+  dargs.executable = gargs.executable;
+  if (api->PJRT_Executable_Destroy)
+    gofr_err(api, api->PJRT_Executable_Destroy(&dargs), nullptr, 0);
+  if (failed) return -1;
   return static_cast<long long>(nargs.num_outputs);
 }
 
@@ -356,12 +365,17 @@ long long gofr_pjrt_buffer_to_host(void* vapi, void* vbuf, size_t ndims,
 }
 
 // Single-device synchronous execute: in[num_args] -> out[noutcap].
-// Returns the number of outputs written, or -1.
+// Returns the number of outputs written, or -1. nout_hint skips the
+// per-call GetExecutable/NumOutputs round-trip when the caller cached the
+// count at compile time (pass -1 to derive it here).
 long long gofr_pjrt_execute(void* vapi, void* vexec, void** in, size_t num_args,
                             void** out, size_t noutcap,
+                            long long nout_hint,
                             char* err, size_t errcap) {
   auto* api = static_cast<const PJRT_Api*>(vapi);
-  long long nout = gofr_pjrt_num_outputs(vapi, vexec, err, errcap);
+  long long nout = nout_hint >= 0
+      ? nout_hint
+      : gofr_pjrt_num_outputs(vapi, vexec, err, errcap);
   if (nout < 0) return -1;
   if (static_cast<size_t>(nout) > noutcap) {
     std::snprintf(err, errcap, "output capacity %zu < %lld", noutcap, nout);
@@ -394,7 +408,13 @@ long long gofr_pjrt_execute(void* vapi, void* vexec, void** in, size_t num_args,
   args.device_complete_events = done;
   if (gofr_err(api, api->PJRT_LoadedExecutable_Execute(&args), err, errcap))
     return -1;
-  if (gofr_await(api, done[0], err, errcap)) return -1;
+  if (gofr_await(api, done[0], err, errcap)) {
+    // execution failed after Execute populated the output buffers: destroy
+    // them or every failed execute leaks nout device allocations
+    for (long long i = 0; i < nout; ++i)
+      if (outputs[i]) gofr_pjrt_buffer_destroy(vapi, outputs[i]);
+    return -1;
+  }
   for (long long i = 0; i < nout; ++i) out[i] = outputs[i];
   return nout;
 }
