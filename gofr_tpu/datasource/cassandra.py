@@ -19,7 +19,9 @@ import asyncio
 import time
 from typing import Any, Sequence
 
-__all__ = ["Cassandra", "CassandraError"]
+from .cassandra_wire import CassandraWire  # native v4 client (re-export)
+
+__all__ = ["Cassandra", "CassandraError", "CassandraWire"]
 
 
 class CassandraError(Exception):

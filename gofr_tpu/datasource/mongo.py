@@ -15,7 +15,9 @@ import asyncio
 import time
 from typing import Any
 
-__all__ = ["Mongo", "MongoError"]
+from .mongo_wire import MongoWire  # native OP_MSG client (re-export)
+
+__all__ = ["Mongo", "MongoError", "MongoWire"]
 
 
 class MongoError(Exception):

@@ -1,0 +1,218 @@
+"""Native Cassandra v4 driver against an in-process fake speaking the real
+binary protocol: 9-byte frames, STARTUP/READY handshake, QUERY frames with
+long-string CQL, and typed Rows RESULT bodies."""
+
+import asyncio
+import datetime as dt
+import struct
+import uuid
+
+import pytest
+
+from gofr_tpu.datasource.cassandra_wire import (CassandraWire,
+                                                CassandraWireError,
+                                                interpolate, quote_value)
+from gofr_tpu.testutil import get_free_port
+
+_OP_STARTUP, _OP_READY, _OP_QUERY, _OP_RESULT, _OP_ERROR = 1, 2, 7, 8, 0
+
+
+def _string(s: str) -> bytes:
+    raw = s.encode()
+    return struct.pack(">H", len(raw)) + raw
+
+
+def _bytes(b: bytes | None) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+def rows_result(cols, rows) -> bytes:
+    """cols: [(name, type_id)]; rows: list of lists of raw bytes|None."""
+    out = struct.pack(">i", 2)                     # kind = Rows
+    out += struct.pack(">i", 0x0001)               # flags: global tables spec
+    out += struct.pack(">i", len(cols))
+    out += _string("ks") + _string("tbl")
+    for name, tid in cols:
+        out += _string(name) + struct.pack(">H", tid)
+    out += struct.pack(">i", len(rows))
+    for row in rows:
+        for cell in row:
+            out += _bytes(cell)
+    return out
+
+
+class FakeCassandra:
+    def __init__(self):
+        self.queries: list[str] = []
+        self.result_body = struct.pack(">i", 1)    # Void by default
+        self.port = get_free_port()
+        self._server = None
+
+    async def start(self):
+        self._server = await asyncio.start_server(self._serve, "127.0.0.1",
+                                                  self.port)
+
+    async def stop(self):
+        self._server.close()
+        try:
+            await asyncio.wait_for(self._server.wait_closed(), 1)
+        except (TimeoutError, asyncio.TimeoutError):
+            pass
+
+    async def _serve(self, reader, writer):
+        try:
+            while True:
+                header = await reader.readexactly(9)
+                ver, _flags, stream, opcode, length = struct.unpack(">BBhBi",
+                                                                    header)
+                assert ver == 0x04
+                body = await reader.readexactly(length) if length else b""
+
+                if opcode == _OP_STARTUP:
+                    reply_op, reply = _OP_READY, b""
+                elif opcode == _OP_QUERY:
+                    n = struct.unpack(">i", body[:4])[0]
+                    cql = body[4:4 + n].decode()
+                    consistency = struct.unpack(">H", body[4 + n:6 + n])[0]
+                    assert consistency == 0x0001
+                    self.queries.append(cql)
+                    if cql.startswith("SYNTAX"):
+                        reply_op = _OP_ERROR
+                        reply = struct.pack(">i", 0x2000) + _string("bad query")
+                    else:
+                        reply_op, reply = _OP_RESULT, self.result_body
+                else:
+                    raise AssertionError(f"unexpected opcode {opcode}")
+                writer.write(struct.pack(">BBhBi", 0x84, 0, stream, reply_op,
+                                         len(reply)) + reply)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+
+async def _pair(keyspace=None):
+    fake = FakeCassandra()
+    await fake.start()
+    db = CassandraWire(host="127.0.0.1", port=fake.port, keyspace=keyspace)
+    return fake, db
+
+
+# ----------------------------------------------------------------- pure logic
+def test_quote_and_interpolate():
+    assert quote_value(None) == "NULL"
+    assert quote_value(True) == "true"
+    assert quote_value(7) == "7"
+    assert quote_value("o'neil") == "'o''neil'"
+    assert quote_value(b"\x01\xff") == "0x01ff"
+    u = uuid.uuid4()
+    assert quote_value(u) == str(u)
+    assert interpolate("SELECT * FROM t WHERE a = ? AND b = ?", [1, "x"]) \
+        == "SELECT * FROM t WHERE a = 1 AND b = 'x'"
+    with pytest.raises(CassandraWireError):
+        interpolate("SELECT ?", [1, 2])
+
+
+# ------------------------------------------------------------------- protocol
+def test_handshake_use_keyspace_and_exec(run):
+    async def scenario():
+        fake, db = await _pair(keyspace="app")
+        try:
+            await db.exec("INSERT INTO users (id, name) VALUES (?, ?)",
+                          [1, "ada"])
+            assert fake.queries[0] == 'USE "app"'
+            assert fake.queries[1] == \
+                "INSERT INTO users (id, name) VALUES (1, 'ada')"
+        finally:
+            await db.close()
+            await fake.stop()
+
+    run(scenario())
+
+
+def test_typed_rows_decode(run):
+    async def scenario():
+        fake, db = await _pair()
+        now_ms = 1_700_000_000_000
+        uid = uuid.uuid4()
+        fake.result_body = rows_result(
+            [("id", 0x0009), ("name", 0x000D), ("score", 0x0007),
+             ("big", 0x0002), ("ok", 0x0004), ("when", 0x000B),
+             ("uid", 0x000C), ("missing", 0x000D)],
+            [[struct.pack(">i", 7), b"ada", struct.pack(">d", 2.5),
+              struct.pack(">q", 2**40), b"\x01",
+              struct.pack(">q", now_ms), uid.bytes, None]],
+        )
+        try:
+            rows = await db.query("SELECT * FROM t")
+            assert rows == [{
+                "id": 7, "name": "ada", "score": 2.5, "big": 2**40,
+                "ok": True,
+                "when": dt.datetime.fromtimestamp(now_ms / 1000,
+                                                  dt.timezone.utc),
+                "uid": uid, "missing": None,
+            }]
+        finally:
+            await db.close()
+            await fake.stop()
+
+    run(scenario())
+
+
+def test_collection_types_decode(run):
+    async def scenario():
+        fake, db = await _pair()
+        # list<int> column: [option list][option int]
+        body = struct.pack(">i", 2) + struct.pack(">i", 0x0001)
+        body += struct.pack(">i", 1) + _string("ks") + _string("tbl")
+        body += _string("nums") + struct.pack(">HH", 0x0020, 0x0009)
+        inner = struct.pack(">i", 2) + _bytes(struct.pack(">i", 1)) \
+            + _bytes(struct.pack(">i", 2))
+        body += struct.pack(">i", 1) + _bytes(inner)
+        fake.result_body = body
+        try:
+            rows = await db.query("SELECT nums FROM t")
+            assert rows == [{"nums": [1, 2]}]
+        finally:
+            await db.close()
+            await fake.stop()
+
+    run(scenario())
+
+
+def test_server_error_and_batch(run):
+    async def scenario():
+        fake, db = await _pair()
+        try:
+            try:
+                await db.query("SYNTAX ERROR HERE")
+                raise AssertionError("expected CassandraWireError")
+            except CassandraWireError as exc:
+                assert "bad query" in str(exc)
+            await db.batch_exec([("INSERT 1", None), ("INSERT ?", ["x"])])
+            assert fake.queries[-2:] == ["INSERT 1", "INSERT 'x'"]
+        finally:
+            await db.close()
+            await fake.stop()
+
+    run(scenario())
+
+
+def test_health_check(run):
+    async def scenario():
+        fake, db = await _pair()
+        fake.result_body = rows_result([("release_version", 0x000D)],
+                                       [[b"4.1.0"]])
+        try:
+            health = await db.health_check()
+            assert health["status"] == "UP"
+        finally:
+            await db.close()
+            await fake.stop()
+        down = CassandraWire(host="127.0.0.1", port=get_free_port())
+        assert (await down.health_check())["status"] == "DOWN"
+
+    run(scenario())
