@@ -17,7 +17,35 @@ import json
 import time
 from typing import Any
 
-__all__ = ["HTTPDriver"]
+__all__ = ["HTTPDriver", "ensure_loop_session"]
+
+
+def ensure_loop_session(current, timeout_s: float):
+    """Return an aiohttp session bound to the RUNNING loop, replacing
+    ``current`` if it belongs to another (now-dead) loop. Sessions bind to
+    the loop that created them; migrations run on a private loop (worker
+    thread) before serving starts, and reusing a session across loops
+    raises "attached to a different loop" or deadlocks. The old session's
+    sockets are torn down via its connector (synchronous) since its loop
+    can no longer run an async close().
+    """
+    import asyncio
+
+    import aiohttp
+
+    loop = asyncio.get_running_loop()
+    if (current is not None and not current.closed
+            and getattr(current, "_gofr_loop", None) is loop):
+        return current
+    if current is not None and not current.closed:
+        try:
+            current._connector.close()
+        except Exception:
+            pass
+    session = aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=timeout_s))
+    session._gofr_loop = loop
+    return session
 
 
 class HTTPDriver:
@@ -52,12 +80,7 @@ class HTTPDriver:
                                 type(self).__name__, self.base_url)
 
     async def _ensure_session(self):
-        if self._session is None or self._session.closed:
-            import aiohttp
-
-            self._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=self._timeout)
-            )
+        self._session = ensure_loop_session(self._session, self._timeout)
         return self._session
 
     async def _request(self, method: str, path: str, *, params: dict | None = None,

@@ -232,8 +232,30 @@ def new_pubsub(backend: str, config, logger=None, metrics=None):
                     client_id=config.get_or_default("MQTT_CLIENT_ID", "gofr-tpu"),
                     qos=int(config.get_or_default("MQTT_QOS", "1")),
                     logger=logger, metrics=metrics)
-    if backend in ("google", "eventhub"):
-        # cloud-SDK-bound backends: no SDK ships in this image (README
-        # documents the gap; reference google/google.go, eventhub/eventhub.go)
-        raise UnavailableDriverError(backend, f"{backend} cloud SDK")
+    if backend == "google":
+        from .google import GooglePubSub
+
+        return GooglePubSub(
+            config.get_or_default("GOOGLE_PROJECT", "gofr"),
+            # emulator-compatible REST endpoint; the real service needs a
+            # token_provider injected via add_datasource instead
+            config.get_or_default(
+                "PUBSUB_EMULATOR_HOST",
+                config.get_or_default("PUBSUB_BROKER", "http://localhost:8085"),
+            ),
+            subscription_prefix=config.get_or_default("CONSUMER_ID", "gofr"),
+            logger=logger, metrics=metrics,
+        )
+    if backend == "eventhub":
+        from .eventhub import EventHub
+
+        return EventHub(
+            config.get_or_default("EVENTHUB_NAMESPACE", "gofr"),
+            config.get_or_default("EVENTHUB_NAME", "events"),
+            key_name=config.get_or_default("EVENTHUB_KEY_NAME",
+                                           "RootManageSharedAccessKey"),
+            key=config.get_or_default("EVENTHUB_KEY", ""),
+            endpoint=config.get("EVENTHUB_ENDPOINT"),
+            logger=logger, metrics=metrics,
+        )
     raise ValueError(f"unsupported PUBSUB_BACKEND {backend!r}")
