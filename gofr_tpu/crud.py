@@ -12,12 +12,20 @@ defining ``create/get_all/get/update/delete`` methods itself.
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 import re
 from typing import Any
 
 from .context import Context
 from .http.errors import EntityNotFound, InvalidInput
+
+
+async def _sql(fn, *args):
+    """Run a blocking SQL-facade call off the event loop. The framework's
+    own handlers must never hold the loop for a statement round-trip —
+    other requests (and any in-process test doubles) starve otherwise."""
+    return await asyncio.to_thread(fn, *args)
 
 __all__ = ["register_crud_handlers", "snake_case", "quote_ident",
            "insert_query", "select_all_query", "select_query",
@@ -123,8 +131,9 @@ def _create_handler(entity: type, meta: _EntityMeta):
         if meta.auto_increment:
             fields = fields[1:]
         values = [getattr(obj, f) for f in fields]
-        new_id = ctx.sql.exec_last_id(
-            insert_query(meta, fields, _dialect(ctx)), *values
+        new_id = await _sql(
+            ctx.sql.exec_last_id, insert_query(meta, fields, _dialect(ctx)),
+            *values,
         )
         if meta.auto_increment:
             return {"id": new_id, "message": f"{meta.name} successfully created with id: {new_id}"}
@@ -136,7 +145,8 @@ def _create_handler(entity: type, meta: _EntityMeta):
 
 def _get_all_handler(entity: type, meta: _EntityMeta):
     async def get_all(ctx: Context) -> Any:
-        return ctx.sql.select(entity, select_all_query(meta, _dialect(ctx)))
+        return await _sql(ctx.sql.select, entity,
+                          select_all_query(meta, _dialect(ctx)))
 
     return get_all
 
@@ -144,8 +154,8 @@ def _get_all_handler(entity: type, meta: _EntityMeta):
 def _get_handler(entity: type, meta: _EntityMeta):
     async def get(ctx: Context) -> Any:
         entity_id = ctx.path_param("id")
-        rows = ctx.sql.select(
-            entity, select_query(meta, _dialect(ctx)), entity_id
+        rows = await _sql(
+            ctx.sql.select, entity, select_query(meta, _dialect(ctx)), entity_id
         )
         if not rows:
             raise EntityNotFound(meta.primary_key, entity_id)
@@ -160,8 +170,9 @@ def _update_handler(entity: type, meta: _EntityMeta):
         obj = await ctx.bind(entity)
         fields = [f for f in meta.fields if f != meta.primary_key]
         values = [getattr(obj, f) for f in fields]
-        n = ctx.sql.exec(
-            update_query(meta, fields, _dialect(ctx)), *values, entity_id,
+        n = await _sql(
+            ctx.sql.exec, update_query(meta, fields, _dialect(ctx)),
+            *values, entity_id,
         )
         if n == 0:
             raise EntityNotFound(meta.primary_key, entity_id)
@@ -173,8 +184,8 @@ def _update_handler(entity: type, meta: _EntityMeta):
 def _delete_handler(entity: type, meta: _EntityMeta):
     async def delete(ctx: Context) -> Any:
         entity_id = ctx.path_param("id")
-        n = ctx.sql.exec(
-            delete_query(meta, _dialect(ctx)), entity_id
+        n = await _sql(
+            ctx.sql.exec, delete_query(meta, _dialect(ctx)), entity_id
         )
         if n == 0:
             raise EntityNotFound(meta.primary_key, entity_id)

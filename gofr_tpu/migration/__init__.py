@@ -285,4 +285,25 @@ def run(migrations: dict[int, Migrate | Callable], container) -> None:
     if invalid:
         logger.errorf("invalid migration versions: %s", invalid)
         return
-    asyncio.run(_run_async(migrations, container))
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        asyncio.run(_run_async(migrations, container))
+        return
+    # called from inside a running loop (app built in an async test/server):
+    # drive the migrations on a private loop in a worker thread
+    import threading
+
+    result: list[BaseException] = []
+
+    def _worker() -> None:
+        try:
+            asyncio.run(_run_async(migrations, container))
+        except BaseException as exc:  # noqa: BLE001 - re-raised below
+            result.append(exc)
+
+    t = threading.Thread(target=_worker, name="gofr-migrations")
+    t.start()
+    t.join()
+    if result:
+        raise result[0]
