@@ -1,0 +1,33 @@
+"""gRPC server.
+
+Mirrors the reference's examples/grpc-server: a HelloService with a
+SayHello unary method served on :9000 alongside HTTP, with the logging +
+recovery interceptor chain and container access from the method body.
+"""
+
+import gofr_tpu
+from gofr_tpu.grpc import JSONService
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+
+    svc = JSONService("hello.HelloService")
+
+    async def say_hello(request, context):
+        name = request.get("name") or "World"
+        app.logger.infof("SayHello(%s)", name)
+        return {"message": f"Hello {name}!"}
+
+    svc.unary("SayHello", say_hello)
+    app.register_service(svc, impl=None)
+
+    async def alive(ctx: gofr_tpu.Context):
+        return {"grpc_port": app.grpc_port}
+
+    app.get("/grpc-info", alive)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -1,0 +1,50 @@
+"""Redis-backed HTTP server.
+
+Mirrors the reference's examples/http-server-using-redis: GET /redis/{key},
+POST /redis (set every pair in the JSON body with a TTL), and a pipeline
+route batching several commands in one round trip. Needs REDIS_HOST/
+REDIS_PORT in configs (the from-scratch RESP2 driver dials at startup).
+"""
+
+import gofr_tpu
+
+EXPIRY_S = 300
+
+
+async def redis_get(ctx: gofr_tpu.Context):
+    value = ctx.redis.get(ctx.path_param("key"))
+    if value is None:
+        raise gofr_tpu.errors.EntityNotFound("key", ctx.path_param("key"))
+    return value
+
+
+async def redis_set(ctx: gofr_tpu.Context):
+    body = await ctx.bind()
+    if not isinstance(body, dict) or not body:
+        raise gofr_tpu.errors.InvalidParam("body (want JSON object of pairs)")
+    for key, value in body.items():
+        ctx.redis.set(key, str(value), ex=EXPIRY_S)
+    return "Successful"
+
+
+async def redis_pipeline(ctx: gofr_tpu.Context):
+    results = (
+        ctx.redis.pipeline()
+        .set("pipe-a", "1")
+        .set("pipe-b", "2")
+        .get("pipe-a")
+        .exec()
+    )
+    return {"results": results}
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    app.get("/redis/{key}", redis_get)
+    app.post("/redis", redis_set)
+    app.get("/redis-pipeline", redis_pipeline)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

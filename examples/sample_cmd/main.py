@@ -1,0 +1,40 @@
+"""CLI application.
+
+Mirrors the reference's examples/sample-cmd: subcommand routing over
+``os.argv`` with ``-key=value`` params, help text, and a spinner/timer
+using the terminal package.
+"""
+
+import time
+
+import gofr_tpu
+from gofr_tpu.cmd import new_cmd
+
+
+async def hello(ctx: gofr_tpu.Context):
+    name = ctx.param("name")
+    return f"Hello {name}!" if name else "Hello World!"
+
+
+async def params(ctx: gofr_tpu.Context):
+    return f"Country: {ctx.param('country')}, City: {ctx.param('city')}"
+
+
+async def slow(ctx: gofr_tpu.Context):
+    # terminal output (spinner/progress) rides ctx.out on CMD apps
+    spinner = ctx.out.spinner()
+    time.sleep(0.05)
+    spinner.stop()
+    return "done"
+
+
+def main() -> int:
+    app = new_cmd()
+    app.sub_command("hello", hello, description="greet, optionally -name=you")
+    app.sub_command("params", params, description="echo -country= and -city=")
+    app.sub_command("slow", slow, description="spinner demo")
+    return app.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,72 @@
+"""File store operations.
+
+Mirrors the reference's examples/using-add-filestore: mount a FileSystem
+(local by default; FTP/SFTP/S3 plug in the same way) and expose
+create/read/list/delete over HTTP. Names are flattened to basenames so the
+store can't be walked out of FILE_STORE_DIR.
+"""
+
+import os
+
+import gofr_tpu
+from gofr_tpu.datasource.file import LocalFileSystem
+
+ROOT = os.environ.get("FILE_STORE_DIR", "./data")
+
+
+def _path(name: str) -> str:
+    return os.path.join(ROOT, os.path.basename(name))
+
+
+async def write_file(ctx: gofr_tpu.Context):
+    body = await ctx.bind()
+    name, content = body.get("name"), body.get("content", "")
+    if not name:
+        raise gofr_tpu.errors.MissingParam("name")
+    f = ctx.file.create(_path(name))
+    try:
+        f.write(content.encode())
+    finally:
+        f.close()
+    return {"written": os.path.basename(name), "bytes": len(content)}
+
+
+async def read_file(ctx: gofr_tpu.Context):
+    name = ctx.path_param("name")
+    try:
+        f = ctx.file.open(_path(name))
+    except FileNotFoundError:
+        raise gofr_tpu.errors.EntityNotFound("file", name)
+    try:
+        content = f.read()
+    finally:
+        f.close()
+    return {"name": name, "content": content.decode()}
+
+
+async def list_dir(ctx: gofr_tpu.Context):
+    return {"entries": ctx.file.read_dir(ROOT)}
+
+
+async def delete_file(ctx: gofr_tpu.Context):
+    name = ctx.path_param("name")
+    try:
+        ctx.file.remove(_path(name))
+    except FileNotFoundError:
+        raise gofr_tpu.errors.EntityNotFound("file", name)
+    return None
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    os.makedirs(ROOT, exist_ok=True)
+    app.add_file_store(LocalFileSystem())
+    app.post("/file", write_file)
+    app.get("/file/{name}", read_file)
+    app.get("/files", list_dir)
+    app.delete("/file/{name}", delete_file)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

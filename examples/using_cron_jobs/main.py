@@ -1,0 +1,30 @@
+"""Cron jobs.
+
+Mirrors the reference's examples/using-cron-jobs: a 6-field (seconds)
+schedule firing every second, with the tick count exposed over HTTP so a
+booted instance can be observed from outside.
+"""
+
+import gofr_tpu
+
+_state = {"ticks": 0}
+
+
+async def count(ctx: gofr_tpu.Context):
+    _state["ticks"] += 1
+    ctx.logger.infof("cron tick %d", _state["ticks"])
+
+
+async def ticks(ctx: gofr_tpu.Context):
+    return {"ticks": _state["ticks"]}
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    app.add_cron_job("* * * * * *", "counter", count)  # every second
+    app.get("/ticks", ticks)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -1,0 +1,53 @@
+"""Custom metrics for an e-commerce store.
+
+Mirrors the reference's examples/using-custom-metrics: counter, up-down
+counter, gauge, and histogram registered at startup, updated from handlers,
+scraped from the :2121/metrics Prometheus endpoint.
+"""
+
+import time
+
+import gofr_tpu
+
+TRANSACTION_SUCCESS = "transaction_success"
+TRANSACTION_TIME = "transaction_time"
+TOTAL_CREDIT_DAY_SALES = "total_credit_day_sale"
+PRODUCT_STOCK = "product_stock"
+
+
+async def transaction(ctx: gofr_tpu.Context):
+    start = time.perf_counter()
+    body = await ctx.bind()
+    amount = float(body.get("amount", 0))
+
+    ctx.metrics().increment_counter(TRANSACTION_SUCCESS)
+    ctx.metrics().delta_updown_counter(TOTAL_CREDIT_DAY_SALES, amount)
+    ctx.metrics().set_gauge(PRODUCT_STOCK, float(body.get("stock_left", 0)))
+    ctx.metrics().record_histogram(
+        TRANSACTION_TIME, (time.perf_counter() - start) * 1e3)
+    return "transaction successful"
+
+
+async def return_order(ctx: gofr_tpu.Context):
+    body = await ctx.bind()
+    # a return reverses the day's credit sale total
+    ctx.metrics().delta_updown_counter(
+        TOTAL_CREDIT_DAY_SALES, -float(body.get("amount", 0)))
+    return "return successful"
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    m = app.container.metrics_manager
+    m.new_counter(TRANSACTION_SUCCESS, "count of successful transactions")
+    m.new_updown_counter(TOTAL_CREDIT_DAY_SALES, "total credit sales in a day")
+    m.new_gauge(PRODUCT_STOCK, "number of products in stock")
+    m.new_histogram(TRANSACTION_TIME, "time taken by a transaction (ms)",
+                    buckets=(5, 10, 15, 20, 25, 35))
+    app.post("/transaction", transaction)
+    app.post("/return", return_order)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

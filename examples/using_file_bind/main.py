@@ -1,0 +1,38 @@
+"""Multipart file-upload binding.
+
+Mirrors the reference's examples/using-file-bind: a multipart form with a
+zip upload plus scalar fields binds to a dataclass — the zip field arrives
+as parsed archive contents (fileutil.Zip), scalars coerce to their
+annotated types.
+"""
+
+import dataclasses
+
+import gofr_tpu
+from gofr_tpu.fileutil import Zip
+
+
+@dataclasses.dataclass
+class UploadData:
+    name: str = ""
+    hello: bytes = b""  # raw uploaded file field
+
+
+async def upload(ctx: gofr_tpu.Context):
+    data = await ctx.bind(UploadData)
+    out = {"name": data.name, "hello_bytes": len(data.hello)}
+    # a .zip upload can be cracked open in-memory
+    if data.hello[:2] == b"PK":
+        z = Zip.from_bytes(data.hello)
+        out["zip_entries"] = sorted(z.files)
+    return out
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    app.post("/upload", upload)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -1,0 +1,39 @@
+"""Inter-service HTTP client.
+
+Mirrors the reference's examples/using-http-service: a downstream service
+registered with circuit-breaker + health options; handlers call it via
+``ctx.get_http_service`` and its health folds into /.well-known/health.
+Set FACT_SERVICE_URL to point at the downstream (default: numbersapi-like
+local stub if one is running).
+"""
+
+import os
+
+import gofr_tpu
+from gofr_tpu.service import CircuitBreakerConfig, HealthConfig, RetryConfig
+
+
+async def fact(ctx: gofr_tpu.Context):
+    svc = ctx.get_http_service("fact-service")
+    number = ctx.path_param("number")
+    resp = await svc.get(f"fact/{number}")
+    if resp.status_code >= 400:
+        raise gofr_tpu.errors.EntityNotFound("fact", number)
+    return gofr_tpu.Raw(resp.json())
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    app.add_http_service(
+        "fact-service",
+        os.environ.get("FACT_SERVICE_URL", "http://localhost:9091"),
+        CircuitBreakerConfig(threshold=4, interval=1.0),
+        HealthConfig(endpoint=".well-known/alive"),
+        RetryConfig(max_retries=2),
+    )
+    app.get("/fact/{number}", fact)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -1,0 +1,56 @@
+"""Database migrations.
+
+Mirrors the reference's examples/using-migrations: versioned schema
+evolution with gofr_migrations bookkeeping (skip <= last version), then
+normal CRUD routes over the migrated table.
+"""
+
+import gofr_tpu
+from gofr_tpu.migration import Migrate
+
+
+def create_table(ds):
+    ds.sql.exec(
+        "CREATE TABLE IF NOT EXISTS employee"
+        " (id INTEGER PRIMARY KEY, name TEXT NOT NULL, gender TEXT, phone TEXT)"
+    )
+
+
+def add_email_column(ds):
+    ds.sql.exec("ALTER TABLE employee ADD COLUMN email TEXT")
+
+
+ALL = {
+    20240226153000: Migrate(up=create_table),
+    20240226153001: Migrate(up=add_email_column),
+}
+
+
+async def add_employee(ctx: gofr_tpu.Context):
+    body = await ctx.bind()
+    ctx.sql.exec(
+        "INSERT INTO employee (id, name, gender, phone, email) VALUES (?,?,?,?,?)",
+        body["id"], body["name"], body.get("gender", ""),
+        body.get("phone", ""), body.get("email", ""),
+    )
+    return body
+
+
+async def get_employee(ctx: gofr_tpu.Context):
+    name = ctx.param("name")
+    rows = ctx.sql.query("SELECT id, name, email FROM employee WHERE name = ?", name)
+    if not rows:
+        raise gofr_tpu.errors.EntityNotFound("name", name)
+    return rows
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    app.migrate(ALL)
+    app.post("/employee", add_employee)
+    app.get("/employee", get_employee)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

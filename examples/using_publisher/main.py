@@ -1,0 +1,37 @@
+"""Pub/sub publisher.
+
+Mirrors the reference's examples/using-publisher: POST /publish-order and
+POST /publish-product push JSON events to their topics on the configured
+broker (PUBSUB_BACKEND=inproc|redis|nats|kafka|mqtt|google|eventhub).
+"""
+
+import json
+
+import gofr_tpu
+
+
+async def order(ctx: gofr_tpu.Context):
+    body = await ctx.bind()
+    if "orderId" not in body:
+        raise gofr_tpu.errors.MissingParam("orderId")
+    await ctx.pubsub.publish("order-logs", json.dumps(body).encode())
+    return "Published"
+
+
+async def product(ctx: gofr_tpu.Context):
+    body = await ctx.bind()
+    if "productId" not in body:
+        raise gofr_tpu.errors.MissingParam("productId")
+    await ctx.pubsub.publish("products", json.dumps(body).encode())
+    return "Published"
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    app.post("/publish-order", order)
+    app.post("/publish-product", product)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

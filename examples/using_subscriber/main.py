@@ -1,0 +1,39 @@
+"""Pub/sub subscriber.
+
+Mirrors the reference's examples/using-subscriber: two topic subscriptions
+driven by the framework's subscribe-handle-commit loop (commit only on
+success). Received events are counted and exposed over HTTP so a booted
+instance can be observed.
+"""
+
+import gofr_tpu
+
+_received = {"products": [], "order-logs": []}
+
+
+async def on_product(ctx: gofr_tpu.Context):
+    info = await ctx.bind()
+    ctx.logger.infof("Received product %s", info)
+    _received["products"].append(info)
+
+
+async def on_order(ctx: gofr_tpu.Context):
+    status = await ctx.bind()
+    ctx.logger.infof("Received order %s", status)
+    _received["order-logs"].append(status)
+
+
+async def stats(ctx: gofr_tpu.Context):
+    return {topic: len(events) for topic, events in _received.items()}
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    app.subscribe("products", on_product)
+    app.subscribe("order-logs", on_order)
+    app.get("/stats", stats)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -1,0 +1,24 @@
+"""WebSocket server.
+
+Mirrors the reference's examples/using-web-socket: ``/ws`` upgrades, the
+handler runs once per inbound frame (ctx.bind reads it), and the return
+value is serialized back onto the socket.
+"""
+
+import gofr_tpu
+
+
+async def ws_handler(ctx: gofr_tpu.Context):
+    message = await ctx.bind()
+    ctx.logger.infof("Received message: %s", message)
+    return {"echo": message}
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    app.websocket("/ws", ws_handler)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

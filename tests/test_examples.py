@@ -1,0 +1,423 @@
+"""Boot-and-curl integration tests for every example app.
+
+The analogue of the reference's per-example main_test.go files
+(examples/http-server/main_test.go:25-66): each test builds the example's
+real App, starts it on free TCP ports, drives it with a real HTTP/gRPC/WS
+client, and asserts on the envelope.
+"""
+
+import asyncio
+import io
+import json
+import os
+import sys
+import zipfile
+from contextlib import contextmanager
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gofr_tpu.testutil import get_free_port, stdout_output_for_func
+
+
+@contextmanager
+def example_env(**extra):
+    """Free ports + quiet logs in os.environ for an example boot; restores
+    the previous environment afterwards."""
+    env = {
+        "HTTP_PORT": str(get_free_port()),
+        "GRPC_PORT": str(get_free_port()),
+        "METRICS_PORT": str(get_free_port()),
+        "LOG_LEVEL": "ERROR",
+        **{k: str(v) for k, v in extra.items()},
+    }
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        yield env
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+async def _booted(app):
+    await app.start()
+    return f"http://127.0.0.1:{app.http_port}"
+
+
+# --------------------------------------------------------------- http_server
+def test_http_server_example(run, tmp_path):
+    async def scenario():
+        import aiohttp
+
+        with example_env(DB_DIALECT="sqlite", DB_NAME=str(tmp_path / "ex.db")):
+            from examples.http_server.main import main
+
+            app = main()
+            base = await _booted(app)
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(base + "/greet")
+                assert r.status == 200
+                assert await r.json() == {"data": "Hello World!"}
+
+                r = await s.get(base + "/hello", params={"name": "gofr"})
+                assert (await r.json())["data"] == "Hello gofr!"
+
+                # CRUD entity registered via add_rest_handlers
+                r = await s.post(base + "/employee",
+                                 json={"name": "Ada", "role": "eng"})
+                assert r.status == 201
+                r = await s.get(base + "/employee")
+                rows = (await r.json())["data"]
+                assert any(e["name"] == "Ada" for e in rows)
+
+                r = await s.get(base + "/missing/42")
+                assert r.status == 404
+                # liveness + health on the same server
+                r = await s.get(base + "/.well-known/alive")
+                assert r.status == 200
+            await app.shutdown()
+
+    run(scenario())
+
+
+# --------------------------------------------------------------- redis_server
+def test_redis_server_example(run):
+    async def scenario():
+        import aiohttp
+
+        from gofr_tpu.container.mock import FakeRedis
+
+        with example_env():
+            from examples.redis_server.main import main
+
+            app = main()
+            app.container.redis = FakeRedis()  # hermetic: no live broker
+            base = await _booted(app)
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(base + "/redis", json={"greeting": "hello"})
+                assert r.status == 201
+                r = await s.get(base + "/redis/greeting")
+                assert (await r.json())["data"] == "hello"
+                r = await s.get(base + "/redis/absent")
+                assert r.status == 404
+                r = await s.get(base + "/redis-pipeline")
+                assert (await r.json())["data"]["results"][-1] == "1"
+            await app.shutdown()
+
+    run(scenario())
+
+
+# ------------------------------------------------------- using_custom_metrics
+def test_using_custom_metrics_example(run):
+    async def scenario():
+        import aiohttp
+
+        with example_env():
+            from examples.using_custom_metrics.main import main
+
+            app = main()
+            base = await _booted(app)
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(base + "/transaction",
+                                 json={"amount": 100, "stock_left": 9})
+                assert r.status == 201
+                r = await s.post(base + "/return", json={"amount": 40})
+                assert r.status == 201
+                r = await s.get(
+                    f"http://127.0.0.1:{app.metrics_port}/metrics")
+                text = await r.text()
+                assert "transaction_success" in text
+                assert "total_credit_day_sale" in text
+                assert "product_stock 9" in text
+            await app.shutdown()
+
+    run(scenario())
+
+
+# ----------------------------------------------------------- using_cron_jobs
+def test_using_cron_jobs_example(run):
+    async def scenario():
+        import aiohttp
+
+        with example_env():
+            import examples.using_cron_jobs.main as mod
+
+            mod._state["ticks"] = 0
+            app = mod.main()
+            base = await _booted(app)
+            await asyncio.sleep(2.3)  # at least two 1s cron fires
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(base + "/ticks")
+                assert (await r.json())["data"]["ticks"] >= 1
+            await app.shutdown()
+
+    run(scenario())
+
+
+# ------------------------------------------------- using_publisher/subscriber
+def test_publisher_and_subscriber_examples(run):
+    async def scenario():
+        import aiohttp
+
+        with example_env(PUBSUB_BACKEND="inproc"):
+            import examples.using_subscriber.main as sub_mod
+            from examples.using_publisher.main import main as pub_main
+
+            sub_mod._received = {"products": [], "order-logs": []}
+            pub_app = pub_main()
+
+            with example_env(PUBSUB_BACKEND="inproc"):
+                sub_app = sub_mod.main()
+                # both apps must ride the SAME in-process broker
+                sub_app.container.pubsub = pub_app.container.pubsub
+                pub_base = await _booted(pub_app)
+                await sub_app.start()
+
+                async with aiohttp.ClientSession() as s:
+                    r = await s.post(pub_base + "/publish-order",
+                                     json={"orderId": "1", "status": "ok"})
+                    assert r.status == 201
+                    r = await s.post(pub_base + "/publish-product",
+                                     json={"productId": "7", "price": "10"})
+                    assert r.status == 201
+                    r = await s.post(pub_base + "/publish-order", json={})
+                    assert r.status == 400  # missing orderId
+
+                    for _ in range(50):  # subscriber loop drains async
+                        if (len(sub_mod._received["products"]) >= 1
+                                and len(sub_mod._received["order-logs"]) >= 1):
+                            break
+                        await asyncio.sleep(0.05)
+                    assert sub_mod._received["products"][0]["productId"] == "7"
+                    assert sub_mod._received["order-logs"][0]["orderId"] == "1"
+
+                    r = await s.get(
+                        f"http://127.0.0.1:{sub_app.http_port}/stats")
+                    stats = (await r.json())["data"]
+                    assert stats["products"] == 1
+                await sub_app.shutdown()
+            await pub_app.shutdown()
+
+    run(scenario())
+
+
+# -------------------------------------------------------- using_http_service
+def test_using_http_service_example(run):
+    async def scenario():
+        import aiohttp
+
+        import gofr_tpu
+
+        # downstream "facts" service: a second real gofr app
+        with example_env():
+            downstream = gofr_tpu.new_app()
+
+            async def fact(ctx):
+                return gofr_tpu.Raw({"number": int(ctx.path_param("n")),
+                                     "fact": "interesting"})
+
+            downstream.get("/fact/{n}", fact)
+            down_base = await _booted(downstream)
+
+        with example_env(FACT_SERVICE_URL=down_base):
+            from examples.using_http_service.main import main
+
+            app = main()
+            base = await _booted(app)
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(base + "/fact/7")
+                assert r.status == 200
+                assert (await r.json())["number"] == 7  # Raw: no envelope
+                # downstream health folds into readiness
+                r = await s.get(base + "/.well-known/health")
+                body = (await r.json())["data"]
+                assert "fact-service" in json.dumps(body)
+            await app.shutdown()
+            await downstream.shutdown()
+
+    run(scenario())
+
+
+# --------------------------------------------------------- using_migrations
+def test_using_migrations_example(run, tmp_path):
+    async def scenario():
+        import aiohttp
+
+        with example_env(DB_DIALECT="sqlite", DB_NAME=str(tmp_path / "m.db")):
+            from examples.using_migrations.main import main
+
+            app = main()  # runs both migrations at build
+            rows = app.container.sql.query(
+                "SELECT version FROM gofr_migrations ORDER BY version")
+            assert [r["version"] for r in rows] == [20240226153000, 20240226153001]
+            base = await _booted(app)
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(base + "/employee",
+                                 json={"id": 1, "name": "Grace",
+                                       "email": "g@x.io"})
+                assert r.status == 201
+                r = await s.get(base + "/employee", params={"name": "Grace"})
+                assert (await r.json())["data"][0]["email"] == "g@x.io"
+            await app.shutdown()
+
+    run(scenario())
+
+
+# ------------------------------------------------------ using_add_filestore
+def test_using_add_filestore_example(run, tmp_path):
+    async def scenario():
+        import aiohttp
+
+        with example_env(FILE_STORE_DIR=str(tmp_path / "store")):
+            import importlib
+
+            import examples.using_add_filestore.main as mod
+
+            mod = importlib.reload(mod)  # re-read FILE_STORE_DIR
+            app = mod.main()
+            base = await _booted(app)
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(base + "/file",
+                                 json={"name": "hello.txt", "content": "hi"})
+                assert r.status == 201
+                r = await s.get(base + "/file/hello.txt")
+                assert (await r.json())["data"]["content"] == "hi"
+                r = await s.get(base + "/files")
+                assert "hello.txt" in (await r.json())["data"]["entries"]
+                r = await s.delete(base + "/file/hello.txt")
+                assert r.status == 204
+                r = await s.get(base + "/file/hello.txt")
+                assert r.status == 404
+            await app.shutdown()
+
+    run(scenario())
+
+
+# --------------------------------------------------------- using_file_bind
+def test_using_file_bind_example(run):
+    async def scenario():
+        import aiohttp
+
+        with example_env():
+            from examples.using_file_bind.main import main
+
+            app = main()
+            base = await _booted(app)
+
+            buf = io.BytesIO()
+            with zipfile.ZipFile(buf, "w") as zf:
+                zf.writestr("a.txt", "alpha")
+                zf.writestr("b/c.txt", "beta")
+
+            form = aiohttp.FormData()
+            form.add_field("name", "bundle")
+            form.add_field("hello", buf.getvalue(),
+                           filename="hello.zip",
+                           content_type="application/zip")
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(base + "/upload", data=form)
+                assert r.status == 201, await r.text()
+                data = (await r.json())["data"]
+                assert data["name"] == "bundle"
+                assert data["zip_entries"] == ["a.txt", "b/c.txt"]
+            await app.shutdown()
+
+    run(scenario())
+
+
+# --------------------------------------------------------- using_web_socket
+def test_using_web_socket_example(run):
+    async def scenario():
+        import aiohttp
+
+        with example_env():
+            from examples.using_web_socket.main import main
+
+            app = main()
+            base = await _booted(app)
+            async with aiohttp.ClientSession() as s:
+                async with s.ws_connect(base + "/ws") as ws:
+                    await ws.send_json({"hello": "ws"})
+                    reply = await ws.receive_json()
+                    assert reply == {"echo": {"hello": "ws"}}
+            await app.shutdown()
+
+    run(scenario())
+
+
+# -------------------------------------------------------------- grpc_server
+def test_grpc_server_example(run):
+    async def scenario():
+        import aiohttp
+        import grpc.aio
+
+        with example_env():
+            from examples.grpc_server.main import main
+
+            app = main()
+            base = await _booted(app)
+            channel = grpc.aio.insecure_channel(f"127.0.0.1:{app.grpc_port}")
+            say_hello = channel.unary_unary(
+                "/hello.HelloService/SayHello",
+                request_serializer=lambda o: json.dumps(o).encode(),
+                response_deserializer=lambda raw: json.loads(raw) if raw else {},
+            )
+            resp = await say_hello({"name": "gofr"})
+            assert resp == {"message": "Hello gofr!"}
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(base + "/grpc-info")
+                assert (await r.json())["data"]["grpc_port"] == app.grpc_port
+            await channel.close()
+            await app.shutdown()
+
+    run(scenario())
+
+
+# --------------------------------------------------------------- sample_cmd
+def test_sample_cmd_example():
+    with example_env():
+        from examples.sample_cmd.main import main as cmd_main
+
+        def run_hello():
+            sys.argv = ["main.py", "hello", "-name=gofr"]
+            assert cmd_main() == 0
+
+        out = stdout_output_for_func(run_hello)
+        assert "Hello gofr!" in out
+
+        def run_params():
+            sys.argv = ["main.py", "params", "-country=NZ", "-city=Akl"]
+            assert cmd_main() == 0
+
+        out = stdout_output_for_func(run_params)
+        assert "Country: NZ" in out and "City: Akl" in out
+
+
+# --------------------------------------------------------------- mnist boot
+def test_mnist_server_example(run):
+    async def scenario():
+        import aiohttp
+        import numpy as np
+
+        with example_env():
+            from examples.mnist_server.main import main
+
+            app = main()
+            base = await _booted(app)
+            img = np.zeros((784,), np.float32).tolist()
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(base + "/predict", json={"image": img})
+                assert r.status == 201, await r.text()
+                data = (await r.json())["data"]
+                assert 0 <= data["digit"] <= 9
+                assert len(data["probs"]) == 10
+                r = await s.post(base + "/predict", json={"image": [1, 2]})
+                assert r.status == 400
+            await app.shutdown()
+
+    run(scenario())
