@@ -1,0 +1,53 @@
+"""BASELINE config #1: /greet echo handler p50 HTTP latency (no model).
+
+Boots examples/http_server in-process on free ports and measures closed-loop
+p50 latency + req/s with concurrent keep-alive connections — the framework
+overhead floor (router + middleware chain + envelope), the same surface the
+reference's echo example exercises (examples/http-server).
+"""
+
+from __future__ import annotations
+
+import os
+
+from common import boot, closed_loop, configure_free_ports, emit, percentile, run
+
+
+async def main() -> None:
+    ports = configure_free_ports()
+    os.environ.setdefault("LOG_LEVEL", "ERROR")
+
+    import aiohttp
+
+    from examples.http_server.main import main as build_app
+
+    app = build_app()
+    await boot(app)
+    url = f"http://127.0.0.1:{ports['HTTP_PORT']}/greet"
+    workers = int(os.environ.get("BENCH_WORKERS", "16"))
+    duration = float(os.environ.get("BENCH_DURATION_S", "3"))
+
+    async with aiohttp.ClientSession() as session:
+
+        async def once():
+            async with session.get(url) as r:
+                assert r.status == 200
+                await r.read()
+
+        lats, n = await closed_loop(workers, duration, once)
+
+    await app.shutdown()
+    p50_ms = percentile(lats, 50) * 1e3
+    emit(
+        "echo_http_p50_ms", p50_ms, "ms", None,
+        {
+            "req_per_s": round(n / duration, 1),
+            "p99_ms": round(percentile(lats, 99) * 1e3, 3),
+            "workers": workers,
+            "config": 1,
+        },
+    )
+
+
+if __name__ == "__main__":
+    run(main())

@@ -1,0 +1,73 @@
+"""BASELINE config #2: MNIST MLP via POST /predict — p50 latency + req/s.
+
+Concurrent clients coalesce through the DynamicBatcher into padded device
+batches; measures the full HTTP -> batcher -> engine -> device path on
+whatever backend is attached (single real chip under the driver, CPU in CI).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from common import boot, closed_loop, configure_free_ports, emit, percentile, run
+
+
+async def main() -> None:
+    ports = configure_free_ports()
+    os.environ.setdefault("LOG_LEVEL", "ERROR")
+
+    import aiohttp
+
+    from examples.mnist_server.main import main as build_app
+
+    app = build_app()
+    await boot(app)
+    url = f"http://127.0.0.1:{ports['HTTP_PORT']}/predict"
+    workers = int(os.environ.get("BENCH_WORKERS", "32"))
+    duration = float(os.environ.get("BENCH_DURATION_S", "4"))
+
+    rng = np.random.default_rng(0)
+    payloads = [
+        {"image": rng.random((784,), dtype=np.float32).tolist()}
+        for _ in range(workers)
+    ]
+
+    async with aiohttp.ClientSession() as session:
+        # warm compile before timing
+        async with session.post(url, json=payloads[0]) as r:
+            assert r.status < 300, await r.text()  # POST -> 201 (responder rules)
+
+        i = 0
+
+        async def once():
+            nonlocal i
+            i += 1
+            async with session.post(url, json=payloads[i % workers]) as r:
+                assert r.status < 300
+                await r.read()
+
+        lats, n = await closed_loop(workers, duration, once, warmup_s=1.0)
+
+    await app.shutdown()
+    emit(
+        "mnist_predict_p50_ms", percentile(lats, 50) * 1e3, "ms", None,
+        {
+            "req_per_s": round(n / duration, 1),
+            "p99_ms": round(percentile(lats, 99) * 1e3, 2),
+            "workers": workers,
+            "backend": _backend(),
+            "config": 2,
+        },
+    )
+
+
+def _backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+if __name__ == "__main__":
+    run(main())

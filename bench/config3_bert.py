@@ -1,0 +1,75 @@
+"""BASELINE config #3: BERT embeddings over gRPC unary, effective batch 32.
+
+32 concurrent unary Embed calls coalesce in the DynamicBatcher into device
+batches; reports aggregate embeddings/s and p50 per-call latency.
+BERT_PRESET=base selects bert-base dims (default on TPU, tiny on CPU).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from common import boot, closed_loop, configure_free_ports, emit, percentile, run
+
+
+async def main() -> None:
+    ports = configure_free_ports()
+    os.environ.setdefault("LOG_LEVEL", "ERROR")
+
+    import grpc.aio
+    import jax
+
+    if "BERT_PRESET" not in os.environ and jax.default_backend() == "tpu":
+        os.environ["BERT_PRESET"] = "base"
+
+    from examples.bert_server.main import main as build_app
+
+    app = build_app()
+    await boot(app)
+    workers = int(os.environ.get("BENCH_WORKERS", "32"))
+    duration = float(os.environ.get("BENCH_DURATION_S", "4"))
+
+    rng = np.random.default_rng(0)
+    reqs = [
+        {"token_ids": rng.integers(1, 1000, (64,)).tolist()}
+        for _ in range(workers)
+    ]
+
+    channel = grpc.aio.insecure_channel(f"127.0.0.1:{ports['GRPC_PORT']}")
+    embed = channel.unary_unary(
+        "/ml.Embeddings/Embed",
+        request_serializer=lambda o: json.dumps(o).encode(),
+        response_deserializer=lambda raw: json.loads(raw) if raw else {},
+    )
+    await embed(reqs[0])  # compile warmup
+
+    i = 0
+
+    async def once():
+        nonlocal i
+        i += 1
+        resp = await embed(reqs[i % workers])
+        assert "embedding" in resp
+
+    lats, n = await closed_loop(workers, duration, once, warmup_s=1.0)
+    await channel.close()
+    await app.shutdown()
+
+    emit(
+        "bert_grpc_embeddings_per_s", n / duration, "req/s", None,
+        {
+            "p50_ms": round(percentile(lats, 50) * 1e3, 2),
+            "p99_ms": round(percentile(lats, 99) * 1e3, 2),
+            "workers": workers,
+            "preset": os.environ.get("BERT_PRESET", "tiny"),
+            "backend": jax.default_backend(),
+            "config": 3,
+        },
+    )
+
+
+if __name__ == "__main__":
+    run(main())

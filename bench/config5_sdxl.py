@@ -1,0 +1,72 @@
+"""BASELINE config #5: text-to-image HTTP endpoint — images/min + p50.
+
+Boots examples/sdxl_server (tokenizer -> text encoder -> DiT DDIM sampler
+-> PNG) and measures concurrent GET /image. DIT_PRESET=base on TPU selects
+the larger DiT; multi-host DP is exercised separately by the dp-axis dryrun
+(`__graft_entry__.dryrun_multichip`) since this image has one host.
+"""
+
+from __future__ import annotations
+
+import os
+
+from common import boot, closed_loop, configure_free_ports, emit, percentile, run
+
+
+async def main() -> None:
+    ports = configure_free_ports()
+    os.environ.setdefault("LOG_LEVEL", "ERROR")
+
+    import aiohttp
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        os.environ.setdefault("DIT_PRESET", "base")
+        os.environ.setdefault("DIT_STEPS", "30")
+    else:
+        os.environ.setdefault("DIT_STEPS", "4")
+
+    from examples.sdxl_server.main import main as build_app
+
+    app = build_app()
+    await boot(app)
+    url = f"http://127.0.0.1:{ports['HTTP_PORT']}/image"
+    workers = int(os.environ.get("BENCH_WORKERS", "4"))
+    duration = float(os.environ.get("BENCH_DURATION_S", "8" if on_tpu else "4"))
+
+    prompts = ["a photo of a cat", "tpu rack at sunset", "mountain lake",
+               "abstract art", "city skyline at night"]
+
+    async with aiohttp.ClientSession() as session:
+        async with session.get(url, params={"prompt": prompts[0]}) as r:
+            assert r.status == 200, await r.text()  # compile warmup
+            assert (await r.read())[:4] == b"\x89PNG"
+
+        i = 0
+
+        async def once():
+            nonlocal i
+            i += 1
+            async with session.get(url, params={"prompt": prompts[i % len(prompts)]}) as r:
+                assert r.status == 200
+                await r.read()
+
+        lats, n = await closed_loop(workers, duration, once, warmup_s=1.0)
+
+    await app.shutdown()
+    emit(
+        "sdxl_images_per_min", n / duration * 60, "img/min", None,
+        {
+            "p50_s": round(percentile(lats, 50), 3),
+            "workers": workers,
+            "steps": int(os.environ.get("DIT_STEPS")),
+            "preset": os.environ.get("DIT_PRESET", "tiny"),
+            "backend": jax.default_backend(),
+            "config": 5,
+        },
+    )
+
+
+if __name__ == "__main__":
+    run(main())

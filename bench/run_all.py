@@ -1,0 +1,63 @@
+"""Run every BASELINE config bench in its own process; collect the JSON lines.
+
+Usage: python bench/run_all.py [--out BENCH_SUITE.json]
+Each config runs in a fresh subprocess so compile caches, env overrides, and
+device state never leak between configs. A config failure is recorded, not
+fatal — the suite always emits a complete report.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+CONFIGS = [
+    "config1_echo.py",
+    "config2_mnist.py",
+    "config3_bert.py",
+    "config4_llama.py",
+    "config5_sdxl.py",
+]
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = "BENCH_SUITE.json"
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+
+    results = []
+    for name in CONFIGS:
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, name)],
+            capture_output=True, text=True, timeout=1200, cwd=here,
+        )
+        parsed = None
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                parsed = json.loads(line)
+                break
+            except (json.JSONDecodeError, ValueError):
+                continue
+        results.append({
+            "config": name,
+            "rc": proc.returncode,
+            "wall_s": round(time.time() - t0, 1),
+            "result": parsed,
+            "stderr_tail": proc.stderr[-1500:] if proc.returncode else "",
+        })
+        status = "ok" if proc.returncode == 0 and parsed else "FAIL"
+        print(f"[{status}] {name}: {json.dumps(parsed) if parsed else proc.stderr[-300:]}",
+              flush=True)
+
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()
