@@ -1,0 +1,168 @@
+"""Real multi-process distributed test: two OS processes, a coordinator,
+and cross-process collectives over the jax.distributed backend.
+
+The round-1 review's gap: multi-host DP existed "only as prose" —
+``dryrun_multichip`` is single-process. This is the genuine analogue of a
+two-host pod: each process owns 4 virtual CPU devices (one host's chips),
+``jax.distributed.initialize`` bridges them (the DCN bootstrap role that
+NCCL/MPI rendezvous plays elsewhere), and a psum over a dp=2 (process) ×
+tp=4 (local) mesh must produce the globally-correct value in BOTH
+processes — proving the collective actually crossed the process boundary.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from gofr_tpu.testutil import get_free_port
+
+_WORKER = r"""
+import os, sys
+import jax
+import numpy as np
+
+proc_id = int(sys.argv[1])
+coord = sys.argv[2]
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=coord, num_processes=2,
+                           process_id=proc_id)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8          # global view: 2 procs x 4 local
+assert len(jax.local_devices()) == 4
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+devices = np.array(jax.devices()).reshape(2, 4)
+mesh = Mesh(devices, ("dp", "tp"))
+
+# each global row i carries value i+1; rows shard over dp (one per process)
+global_shape = (8, 16)
+row_vals = np.arange(1, 9, dtype=np.float32)
+local_rows = row_vals[proc_id * 4:(proc_id + 1) * 4]
+local = np.repeat(local_rows[:, None], 16, axis=1)
+
+sharding = NamedSharding(mesh, P("dp", None))
+arr = jax.make_array_from_process_local_data(sharding, local, global_shape)
+
+@jax.jit
+def global_sum(x):
+    return jnp.sum(x)
+
+total = float(global_sum(arr))
+expected = float(np.arange(1, 9).sum() * 16)
+assert total == expected, (total, expected)
+
+# explicit collective across the process boundary: psum over the dp axis
+# (whose two rows live in DIFFERENT processes) must fold both hosts' data
+summed = jax.jit(
+    jax.shard_map(lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+                  in_specs=P("dp", None), out_specs=P(None, None))
+)(arr)
+# every local shard of the replicated result must hold the cross-process
+# row sum: rows 1..8 summed in groups of (i, i+4) -> per-col sum = 36
+psum_total = float(jnp.sum(summed))   # 4 rows x 16 cols x ... global value
+print(f"OK proc={proc_id} total={total} psum_sum={psum_total}", flush=True)
+"""
+
+
+_TRAIN_WORKER = r"""
+import sys
+import jax
+import numpy as np
+
+proc_id = int(sys.argv[1])
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=sys.argv[2], num_processes=2,
+                           process_id=proc_id)
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gofr_tpu.models.mlp import MLP
+
+mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+model = MLP(sizes=(16, 32, 4), seed=0)
+
+def loss_fn(params, x, y):
+    logits = MLP.apply(params, x)
+    return jnp.mean((logits - y) ** 2)
+
+grad_fn = jax.jit(
+    jax.value_and_grad(loss_fn),
+    in_shardings=(None, NamedSharding(mesh, P(("dp", "tp"), None)),
+                  NamedSharding(mesh, P(("dp", "tp"), None))),
+)
+
+# DISTINCT per-process batches: the psum XLA inserts for the replicated
+# gradient must fold both processes' data (16 global rows, 8 local)
+rng = np.random.default_rng(proc_id)
+local_x = rng.normal(size=(8, 16)).astype(np.float32)
+local_y = rng.normal(size=(8, 4)).astype(np.float32)
+sh = NamedSharding(mesh, P(("dp", "tp"), None))
+gx = jax.make_array_from_process_local_data(sh, local_x, (16, 16))
+gy = jax.make_array_from_process_local_data(sh, local_y, (16, 4))
+
+loss, grads = grad_fn(model.params, gx, gy)
+g0 = np.asarray(jax.device_get(jax.tree.leaves(grads)[0]))
+print(f"OK proc={proc_id} loss={float(loss):.6f} g0={float(g0.ravel()[0]):.6f}",
+      flush=True)
+"""
+
+
+def _run_two(tmp_path, source, timeout=150):
+    worker = tmp_path / "worker.py"
+    worker.write_text(source)
+    port = get_free_port()
+    coord = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=repo,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
+        assert f"OK proc={i}" in out
+    return outs
+
+
+def test_two_process_dp_training_step(tmp_path):
+    """A jitted value_and_grad over a dp=2 (process) x tp=4 mesh with
+    DIFFERENT data in each process: both processes must report the SAME
+    loss and gradients (XLA's inserted psum crossed the DCN boundary)."""
+    outs = _run_two(tmp_path, _TRAIN_WORKER)
+    line0 = [ln for ln in outs[0].splitlines() if ln.startswith("OK proc=0")][0]
+    line1 = [ln for ln in outs[1].splitlines() if ln.startswith("OK proc=1")][0]
+    assert line0.split("loss=")[1] == line1.split("loss=")[1]
+
+
+def test_two_process_dcn_collectives(tmp_path):
+    outs = _run_two(tmp_path, _WORKER)
+    # both processes computed the same global sum, AND the explicit
+    # shard_map psum folded both hosts' rows: result rows are
+    # (1+5, 2+6, 3+7, 4+8) per column -> sum 36 x 16 cols = 576. Local
+    # rows alone would give 10x16=160 or 26x16=416 — the collectives
+    # crossed the process boundary, not just local devices.
+    for out in outs:
+        assert "total=576.0" in out
+        assert "psum_sum=576.0" in out
