@@ -68,6 +68,8 @@ def main() -> gofr_tpu.App:
     cfg = PRESETS[preset]()
     if preset == "tiny":
         cfg.use_flash = False
+    if os.environ.get("LLAMA_KV_QUANT") == "1":
+        cfg.kv_quant = True  # int8 cache: half the KV HBM (docs/tpu/llm-serving.md)
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     app.register_llm(
         "chat", params, cfg,

@@ -59,6 +59,7 @@ class LlamaConfig:
         use_flash: bool = True,
         remat: bool = False,
         attn_impl: str = "auto",
+        kv_quant: bool = False,
     ) -> None:
         self.vocab_size = vocab_size
         self.dim = dim
@@ -80,6 +81,12 @@ class LlamaConfig:
         if attn_impl not in ("auto", "ring", "ulysses"):
             raise ValueError(f"unknown attn_impl {attn_impl!r}")
         self.attn_impl = attn_impl
+        # int8 KV cache (ops.quantize_kv): halves decode's KV HBM traffic —
+        # the serving roofline at large slot counts. Not combined with
+        # sequence-parallel decode (the sp combine reads fp shards).
+        if kv_quant and self.sequence_parallel:
+            raise ValueError("kv_quant is not supported with ring/ulysses")
+        self.kv_quant = kv_quant
 
     @property
     def sequence_parallel(self) -> bool:
@@ -201,15 +208,17 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, *, kv_len=None, full_seq=True,
     return x, k, v
 
 
-def _decode_layer(cfg: LlamaConfig, x, lp, cos, sin, k_all, v_all, layer,
+def _decode_layer(cfg: LlamaConfig, x, lp, cos, sin, arrays, layer,
                   pos, rows, mesh=None):
     """One decode block writing directly into the FULL stacked cache.
 
-    The caches ride the layer scan's CARRY so XLA aliases them in place: a
-    first version returned per-layer caches through scan ys, which
-    restacked (= copied) the entire multi-GB cache every token — that copy,
-    not attention, was the r1 decode bottleneck (BENCH_r01 8.4 ms steps).
-    Here the only cache write is the [B, KV, D] scatter of the new token at
+    ``arrays`` is the cache dict minus "len" ("k"/"v", plus
+    "k_scale"/"v_scale" when int8-quantized). The caches ride the layer
+    scan's CARRY so XLA aliases them in place: a first version returned
+    per-layer caches through scan ys, which restacked (= copied) the
+    entire multi-GB cache every token — that copy, not attention, was the
+    r1 decode bottleneck (BENCH_r01 8.4 ms steps). Here the only cache
+    write is the [B, KV, D] scatter of the new token at
     ``[layer, rows, pos]``.
     """
     b = x.shape[0]
@@ -224,24 +233,50 @@ def _decode_layer(cfg: LlamaConfig, x, lp, cos, sin, k_all, v_all, layer,
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    k_all = k_all.at[layer, rows, pos].set(k[:, 0])
-    v_all = v_all.at[layer, rows, pos].set(v[:, 0])
-    if cfg.sequence_parallel and mesh is not None:
-        # S-sharded cache: grouped online-softmax per shard + one
-        # pmax/psum combine (parallel/ring.py) — no cache all-gather
-        from ..parallel.ring import sp_decode_attention
+    if cfg.kv_quant:
+        from ..ops import quantize_kv
 
-        o = sp_decode_attention(q, k_all, v_all, pos + 1, mesh, layer=layer)
+        kq, k_sc = quantize_kv(k[:, 0])
+        vq, v_sc = quantize_kv(v[:, 0])
+        # int8 values scatter flat ([B, KV*D] rows); scales are
+        # [L, B, KV, S]: scatter the [B, KV] token scales at each row's
+        # position via full advanced indexing
+        kv_idx = jnp.arange(KV)[None, :]
+        arrays = {
+            "k": arrays["k"].at[layer, rows, pos].set(kq.reshape(b, KV * hd)),
+            "v": arrays["v"].at[layer, rows, pos].set(vq.reshape(b, KV * hd)),
+            "k_scale": arrays["k_scale"].at[
+                layer, rows[:, None], kv_idx, pos[:, None]].set(k_sc),
+            "v_scale": arrays["v_scale"].at[
+                layer, rows[:, None], kv_idx, pos[:, None]].set(v_sc),
+        }
+        o = cached_decode_attention(
+            q, arrays["k"], arrays["v"], pos + 1, layer=layer,
+            use_kernel=cfg.use_flash,
+            k_scale=arrays["k_scale"], v_scale=arrays["v_scale"])
     else:
-        o = cached_decode_attention(q, k_all, v_all, pos + 1, layer=layer,
-                                    use_kernel=cfg.use_flash)
+        arrays = {
+            "k": arrays["k"].at[layer, rows, pos].set(k[:, 0]),
+            "v": arrays["v"].at[layer, rows, pos].set(v[:, 0]),
+        }
+        if cfg.sequence_parallel and mesh is not None:
+            # S-sharded cache: grouped online-softmax per shard + one
+            # pmax/psum combine (parallel/ring.py) — no cache all-gather
+            from ..parallel.ring import sp_decode_attention
+
+            o = sp_decode_attention(q, arrays["k"], arrays["v"], pos + 1,
+                                    mesh, layer=layer)
+        else:
+            o = cached_decode_attention(q, arrays["k"], arrays["v"], pos + 1,
+                                        layer=layer,
+                                        use_kernel=cfg.use_flash)
 
     x = x + constrain(o.reshape(b, 1, H * hd) @ lp["wo"], P("dp", "sp", None))
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     x = x + constrain(
         swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]), P("dp", "sp", None)
     )
-    return x, k_all, v_all
+    return x, arrays
 
 
 def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
@@ -276,6 +311,27 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
 def init_cache(cfg: LlamaConfig, batch: int, max_seq: int | None = None) -> dict:
     S = max_seq or cfg.max_seq_len
     shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_dim)
+    if cfg.kv_quant:
+        # re-check at the point of use: the constructor guard can be
+        # bypassed by post-hoc attribute assignment (cfg.kv_quant = True),
+        # and the quantized decode branch skips sp attention entirely —
+        # silently attending over one shard's keys
+        if cfg.sequence_parallel:
+            raise ValueError("kv_quant is not supported with ring/ulysses")
+        # int8 values are stored FLAT, [L, B, S, KV*D]: int8's VMEM tile is
+        # (32, 128), so a [block_s, KV, D] slab with KV=8 sublanes pads 4x
+        # (which made int8 SLOWER than bf16); the flat [block_s, KV*D] slab
+        # tiles perfectly. Scales are [L, B, KV, S] (seq minor) so their
+        # [KV, block_s] DMA slices stay 128-aligned too.
+        flat = (cfg.n_layers, batch, S, cfg.n_kv_heads * cfg.head_dim)
+        scale_shape = (cfg.n_layers, batch, cfg.n_kv_heads, S)
+        return {
+            "k": jnp.zeros(flat, jnp.int8),
+            "v": jnp.zeros(flat, jnp.int8),
+            "k_scale": jnp.zeros(scale_shape, jnp.bfloat16),
+            "v_scale": jnp.zeros(scale_shape, jnp.bfloat16),
+            "len": jnp.zeros((batch,), jnp.int32),
+        }
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -314,7 +370,24 @@ def prefill(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
     if pad < 0:
         raise ValueError(f"prompt bucket {s} exceeds cache length {S_max}")
     widen = lambda a: jnp.pad(a, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
-    cache = {"k": widen(ks), "v": widen(vs), "len": seq_lens.astype(jnp.int32)}
+    if cfg.kv_quant:
+        from ..ops import quantize_kv
+
+        # int8 values flatten [L, B, S, KV, D] -> [L, B, S, KV*D]; scales
+        # go [L, B, S, KV] -> [L, B, KV, S] (layouts: see init_cache)
+        L, B = ks.shape[0], ks.shape[1]
+        widen_q = lambda a: jnp.pad(a.reshape(L, B, s, -1),
+                                    ((0, 0), (0, 0), (0, pad), (0, 0)))
+        widen_s = lambda a: jnp.pad(a.transpose(0, 1, 3, 2),
+                                    ((0, 0), (0, 0), (0, 0), (0, pad)))
+        kq, k_sc = quantize_kv(ks)
+        vq, v_sc = quantize_kv(vs)
+        cache = {"k": widen_q(kq), "v": widen_q(vq),
+                 "k_scale": widen_s(k_sc), "v_scale": widen_s(v_sc),
+                 "len": seq_lens.astype(jnp.int32)}
+    else:
+        cache = {"k": widen(ks), "v": widen(vs),
+                 "len": seq_lens.astype(jnp.int32)}
     return logits, cache
 
 
@@ -330,12 +403,12 @@ def prefill_into(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
                              init_cache(cfg, 1, cache["k"].shape[2]),
                              mesh=mesh)
     new_cache = {
-        "k": jax.lax.dynamic_update_index_in_dim(
-            cache["k"], filled["k"][:, 0], slot, axis=1),
-        "v": jax.lax.dynamic_update_index_in_dim(
-            cache["v"], filled["v"][:, 0], slot, axis=1),
-        "len": cache["len"].at[slot].set(seq_lens[0]),
+        key: jax.lax.dynamic_update_index_in_dim(
+            cache[key], filled[key][:, 0], slot, axis=1)
+        for key in cache
+        if key != "len"
     }
+    new_cache["len"] = cache["len"].at[slot].set(seq_lens[0])
     return logits, new_cache
 
 
@@ -355,20 +428,19 @@ def prefill_into_many(params: dict, tokens: jnp.ndarray,
     logits, filled = prefill(params, tokens, seq_lens, cfg,
                              init_cache(cfg, b, cache["k"].shape[2]),
                              mesh=mesh)
-    k, v, lens = cache["k"], cache["v"], cache["len"]
+    arrays = {key: cache[key] for key in cache if key != "len"}
+    lens = cache["len"]
     for i in range(b):  # static B: unrolled scatter, one row per request
         slot = slots[i]
-        k_row = jnp.where(valid[i], filled["k"][:, i],
-                          jax.lax.dynamic_index_in_dim(k, slot, axis=1,
-                                                       keepdims=False))
-        v_row = jnp.where(valid[i], filled["v"][:, i],
-                          jax.lax.dynamic_index_in_dim(v, slot, axis=1,
-                                                       keepdims=False))
-        k = jax.lax.dynamic_update_index_in_dim(k, k_row, slot, axis=1)
-        v = jax.lax.dynamic_update_index_in_dim(v, v_row, slot, axis=1)
+        for key, arr in arrays.items():
+            row = jnp.where(valid[i], filled[key][:, i],
+                            jax.lax.dynamic_index_in_dim(arr, slot, axis=1,
+                                                         keepdims=False))
+            arrays[key] = jax.lax.dynamic_update_index_in_dim(
+                arr, row, slot, axis=1)
         lens = lens.at[slot].set(
             jnp.where(valid[i], seq_lens[i], lens[slot]))
-    return logits, {"k": k, "v": v, "len": lens}
+    return logits, {**arrays, "len": lens}
 
 
 def decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
@@ -388,20 +460,21 @@ def decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
     # carried layer counter, so cache updates alias in place (see
     # _decode_layer docstring for why ys-restacking was the r1 bottleneck)
     def body(carry, lp):
-        x, k_all, v_all, layer = carry
-        x, k_all, v_all = _decode_layer(
-            cfg, x, lp, cos, sin, k_all, v_all, layer, pos, rows, mesh=mesh)
-        return (x, k_all, v_all, layer + 1), None
+        x, arrays, layer = carry
+        x, arrays = _decode_layer(
+            cfg, x, lp, cos, sin, arrays, layer, pos, rows, mesh=mesh)
+        return (x, arrays, layer + 1), None
 
-    (x, ks, vs, _), _ = jax.lax.scan(
-        body, (x, cache["k"], cache["v"], jnp.int32(0)), params["layers"])
+    arrays0 = {key: cache[key] for key in cache if key != "len"}
+    (x, arrays, _), _ = jax.lax.scan(
+        body, (x, arrays0, jnp.int32(0)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
     # cap len at capacity: rows past the end keep decoding garbage (their
     # cache writes are dropped as out-of-bounds) but never index OOB.
     S_max = cache["k"].shape[2]
     new_len = jnp.minimum(pos + 1, S_max)
-    return logits, {"k": ks, "v": vs, "len": new_len}
+    return logits, {**arrays, "len": new_len}
 
 
 def loss_fn(params: dict, tokens: jnp.ndarray, targets: jnp.ndarray,

@@ -41,12 +41,16 @@ __all__ = ["gqa_decode_attention_tpu"]
 
 def _decode_kernel(kvlen_ref, layer_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf,
                    v_buf, k_sem, v_sem, *, block_s: int, kv_heads: int,
-                   n_rep: int):
+                   n_rep: int, ks_hbm=None, vs_hbm=None, ks_buf=None,
+                   vs_buf=None, ks_sem=None, vs_sem=None):
     """One batch row: pipelined chunk sweep of its live cache prefix.
 
     q_ref/o_ref: [H, D] VMEM; k_hbm/v_hbm: [L, B, S_max, KV, D] in HBM
     (the layer to read is the scalar ``layer_ref[0]``);
-    k_buf/v_buf: [2, block_s, KV, D] VMEM double buffers.
+    k_buf/v_buf: [2, block_s, KV, D] VMEM double buffers. With an int8
+    cache the ks/vs refs carry the [L, B, S_max, KV] bf16 scales (1/D-th
+    the data) and dequantization happens here in VMEM — HBM only ever
+    moves int8.
     """
     b = pl.program_id(0)
     kvlen = kvlen_ref[b]
@@ -54,14 +58,35 @@ def _decode_kernel(kvlen_ref, layer_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf,
     n_blocks = pl.cdiv(kvlen, block_s)  # >= 1: a live row has len >= 1
     h, d = q_ref.shape
     scale = d ** -0.5
+    quantized = ks_hbm is not None
 
     def copy_in(hbm, buf, sem, slot, idx):
         return pltpu.make_async_copy(
             hbm.at[layer, b, pl.ds(idx * block_s, block_s)], buf.at[slot],
             sem.at[slot])
 
-    copy_in(k_hbm, k_buf, k_sem, 0, 0).start()
-    copy_in(v_hbm, v_buf, v_sem, 0, 0).start()
+    def copy_scale(hbm, buf, sem, slot, idx):
+        # scales are [L, B, KV, S] (seq minor): the [KV, block_s] slice
+        # keeps the DMA's minor dim 128-aligned
+        return pltpu.make_async_copy(
+            hbm.at[layer, b, :, pl.ds(idx * block_s, block_s)], buf.at[slot],
+            sem.at[slot])
+
+    def start_block(slot, idx):
+        copy_in(k_hbm, k_buf, k_sem, slot, idx).start()
+        copy_in(v_hbm, v_buf, v_sem, slot, idx).start()
+        if quantized:
+            copy_scale(ks_hbm, ks_buf, ks_sem, slot, idx).start()
+            copy_scale(vs_hbm, vs_buf, vs_sem, slot, idx).start()
+
+    def wait_block(slot, idx):
+        copy_in(k_hbm, k_buf, k_sem, slot, idx).wait()
+        copy_in(v_hbm, v_buf, v_sem, slot, idx).wait()
+        if quantized:
+            copy_scale(ks_hbm, ks_buf, ks_sem, slot, idx).wait()
+            copy_scale(vs_hbm, vs_buf, vs_sem, slot, idx).wait()
+
+    start_block(0, 0)
 
     q = q_ref[:].astype(jnp.float32) * scale  # [H, D]
 
@@ -72,11 +97,9 @@ def _decode_kernel(kvlen_ref, layer_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf,
 
         @pl.when(i + 1 < n_blocks)
         def _prefetch():
-            copy_in(k_hbm, k_buf, k_sem, nxt, i + 1).start()
-            copy_in(v_hbm, v_buf, v_sem, nxt, i + 1).start()
+            start_block(nxt, i + 1)
 
-        copy_in(k_hbm, k_buf, k_sem, slot, i).wait()
-        copy_in(v_hbm, v_buf, v_sem, slot, i).wait()
+        wait_block(slot, i)
 
         kpos = i * block_s + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_s), 1)
@@ -84,8 +107,16 @@ def _decode_kernel(kvlen_ref, layer_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf,
         accs, ms, ls = [], [], []
         for g in range(kv_heads):  # static unroll: KV is small (e.g. 8)
             r0 = g * n_rep
-            k = k_buf[slot, :, g, :].astype(jnp.float32)  # [block_s, D]
-            v = v_buf[slot, :, g, :].astype(jnp.float32)
+            if quantized:
+                # flat int8 buf [block_s, KV*D]: head g is a static,
+                # 128-aligned column slice; dequant in VMEM
+                k = k_buf[slot, :, g * d:(g + 1) * d].astype(jnp.float32)
+                v = v_buf[slot, :, g * d:(g + 1) * d].astype(jnp.float32)
+                k = k * ks_buf[slot, g, :].astype(jnp.float32)[:, None]
+                v = v * vs_buf[slot, g, :].astype(jnp.float32)[:, None]
+            else:
+                k = k_buf[slot, :, g, :].astype(jnp.float32)  # [block_s, D]
+                v = v_buf[slot, :, g, :].astype(jnp.float32)
             logits = jax.lax.dot_general(
                 q[r0:r0 + n_rep], k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # [n_rep, block_s]
@@ -113,24 +144,43 @@ def _decode_kernel(kvlen_ref, layer_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf,
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
+def _decode_kernel_quant(kvlen_ref, layer_ref, q_ref, k_hbm, v_hbm, ks_hbm,
+                         vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf, k_sem,
+                         v_sem, ks_sem, vs_sem, *, block_s: int,
+                         kv_heads: int, n_rep: int):
+    """Positional-ref wrapper for the int8 variant (pallas passes refs in
+    in_specs/scratch order, so the two layouts need two entry points)."""
+    _decode_kernel(kvlen_ref, layer_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf,
+                   v_buf, k_sem, v_sem, block_s=block_s, kv_heads=kv_heads,
+                   n_rep=n_rep, ks_hbm=ks_hbm, vs_hbm=vs_hbm, ks_buf=ks_buf,
+                   vs_buf=vs_buf, ks_sem=ks_sem, vs_sem=vs_sem)
+
+
 @functools.partial(jax.jit, static_argnames=("block_s", "interpret"))
 def gqa_decode_attention_tpu(q, k_cache, v_cache, kv_len, *, layer=None,
+                             k_scale=None, v_scale=None,
                              block_s: int = 256, interpret: bool = False):
     """q: [B, 1, H, D]; caches: [B, S_max, KV, D] per-layer, or the full
     stacked [L, B, S_max, KV, D] with ``layer`` the (traced) index to read;
-    kv_len: [B] int32.
+    kv_len: [B] int32. Optional ``k_scale``/``v_scale`` ([..., KV, S_max]
+    bf16, seq minor) mark an int8 cache: dequantization happens in VMEM.
 
     Returns [B, 1, H, D] in q.dtype. S_max must divide by ``block_s``
     (serving caches are power-of-two sized; callers fall back to the XLA
     path otherwise).
     """
     b, tq, h, d = q.shape
-    if k_cache.ndim == 4:
+    quantized = k_scale is not None
+    per_layer_ndim = 3 if quantized else 4  # quantized caches are FLAT
+    if k_cache.ndim == per_layer_ndim:
         k_cache, v_cache = k_cache[None], v_cache[None]
+        if quantized:
+            k_scale, v_scale = k_scale[None], v_scale[None]
         layer = 0
     if layer is None:
         raise ValueError("stacked caches require a layer index")
-    s_max, kv = k_cache.shape[2], k_cache.shape[3]
+    s_max = k_cache.shape[2]
+    kv = k_scale.shape[2] if quantized else k_cache.shape[3]
     if tq != 1:
         raise ValueError(f"decode kernel takes one query token, got Tq={tq}")
     block_s = min(block_s, s_max)
@@ -140,29 +190,42 @@ def gqa_decode_attention_tpu(q, k_cache, v_cache, kv_len, *, layer=None,
     kv_len = jnp.asarray(kv_len, jnp.int32)
     layer = jnp.asarray(layer, jnp.int32).reshape(1)
 
-    kernel = functools.partial(
-        _decode_kernel, block_s=block_s, kv_heads=kv, n_rep=n_rep,
-    )
+    in_specs = [
+        pl.BlockSpec((None, h, d), lambda bi, kvlen, lyr: (bi, 0, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),  # k cache stays in HBM
+        pl.BlockSpec(memory_space=pltpu.ANY),  # v cache stays in HBM
+    ]
+    buf_shape = (2, block_s, kv * d) if quantized else (2, block_s, kv, d)
+    scratch = [
+        pltpu.VMEM(buf_shape, k_cache.dtype),
+        pltpu.VMEM(buf_shape, v_cache.dtype),
+    ]
+    sems = [pltpu.SemaphoreType.DMA((2,)), pltpu.SemaphoreType.DMA((2,))]
+    args = [kv_len, layer, q[:, 0], k_cache, v_cache]
+    if quantized:
+        kernel = functools.partial(
+            _decode_kernel_quant, block_s=block_s, kv_heads=kv, n_rep=n_rep)
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY),
+                     pl.BlockSpec(memory_space=pltpu.ANY)]
+        scratch += [pltpu.VMEM((2, kv, block_s), k_scale.dtype),
+                    pltpu.VMEM((2, kv, block_s), v_scale.dtype)]
+        sems += [pltpu.SemaphoreType.DMA((2,)), pltpu.SemaphoreType.DMA((2,))]
+        args += [k_scale, v_scale]
+    else:
+        kernel = functools.partial(
+            _decode_kernel, block_s=block_s, kv_heads=kv, n_rep=n_rep)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b,),
-        in_specs=[
-            pl.BlockSpec((None, h, d), lambda bi, kvlen, lyr: (bi, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),  # k cache stays in HBM
-            pl.BlockSpec(memory_space=pltpu.ANY),  # v cache stays in HBM
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, h, d), lambda bi, kvlen, lyr: (bi, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, block_s, kv, d), k_cache.dtype),
-            pltpu.VMEM((2, block_s, kv, d), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
+        scratch_shapes=scratch + sems,
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
         interpret=interpret,
-    )(kv_len, layer, q[:, 0], k_cache, v_cache)
+    )(*args)
     return out[:, None]

@@ -1,0 +1,112 @@
+"""int8 KV-cache quantization: numerical closeness to the fp cache and
+end-to-end generation through the quantized path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.models import llama
+from gofr_tpu.ops import dequantize_kv, quantize_kv
+
+
+def test_quantize_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(scale=2.0, size=(4, 64, 8, 128)),
+                    jnp.float32)
+    q, scale = quantize_kv(x)
+    assert q.dtype == jnp.int8 and scale.dtype == jnp.bfloat16
+    assert scale.shape == x.shape[:-1]
+    back = dequantize_kv(q, scale, jnp.float32)
+    # symmetric per-vector int8: max error is scale/2 ~ amax/254
+    err = jnp.max(jnp.abs(back - x) / jnp.maximum(
+        jnp.max(jnp.abs(x), axis=-1, keepdims=True), 1e-6))
+    assert float(err) < 1 / 127
+
+
+def test_zero_vector_quantizes_to_zero():
+    q, scale = quantize_kv(jnp.zeros((2, 3, 4)))
+    assert not np.any(np.asarray(q))
+    assert np.all(np.isfinite(np.asarray(scale, np.float32)))
+
+
+def _decode_logits(cfg, params, prompt):
+    cache = llama.init_cache(cfg, 2, 64)
+    logits, cache = llama.prefill_into(
+        params, prompt, jnp.asarray([prompt.shape[1]], jnp.int32), cfg,
+        cache, jnp.int32(0))
+    outs = [logits]
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    tok = jnp.concatenate([tok, jnp.zeros((1,), jnp.int32)])  # 2 slots
+    for _ in range(4):
+        logits, cache = llama.decode_step(params, tok, cache, cfg)
+        outs.append(logits[:1])
+        tok = tok.at[0].set(jnp.argmax(logits[0]).astype(jnp.int32))
+    return jnp.concatenate(outs, axis=0)
+
+
+def test_quantized_decode_close_to_fp():
+    cfg_fp = llama.tiny_llama(use_flash=False)
+    cfg_q = llama.tiny_llama(use_flash=False, kv_quant=True)
+    params = llama.init_params(cfg_fp, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(1)
+    prompt = jnp.asarray(rng.integers(1, cfg_fp.vocab_size, (1, 8)), jnp.int32)
+
+    fp = _decode_logits(cfg_fp, params, prompt)
+    q = _decode_logits(cfg_q, params, prompt)
+    # logits agree to within a fraction of their dynamic range
+    denom = jnp.maximum(jnp.max(jnp.abs(fp)), 1e-3)
+    rel = float(jnp.max(jnp.abs(fp - q)) / denom)
+    assert rel < 0.05, rel
+    # and the greedy continuation is identical on this model
+    assert np.array_equal(np.argmax(np.asarray(fp), -1),
+                          np.argmax(np.asarray(q), -1))
+
+
+def test_generator_end_to_end_with_kv_quant():
+    from gofr_tpu.ml.generate import Generator
+
+    cfg = llama.tiny_llama(use_flash=False, kv_quant=True)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    gen = Generator(params, cfg, batch_slots=4, max_seq=64,
+                    prefill_buckets=(16,), chunk=4)
+    assert gen.cache["k"].dtype == jnp.int8
+    assert "k_scale" in gen.cache
+    rng = np.random.default_rng(2)
+    out = gen.generate(rng.integers(1, cfg.vocab_size, (8,)).astype(np.int32),
+                       max_new_tokens=12)
+    assert len(out) == 12
+    assert all(0 <= t < cfg.vocab_size for t in out)
+
+
+def test_kv_quant_rejected_with_sequence_parallel():
+    with pytest.raises(ValueError):
+        llama.tiny_llama(attn_impl="ring", kv_quant=True)
+
+
+def test_decode_kernel_quantized_interpret():
+    """The Pallas int8 kernel path (interpret mode) matches the XLA
+    dequant path."""
+    from gofr_tpu.ops import gqa_decode_attention
+    from gofr_tpu.ops.decode_attention import gqa_decode_attention_tpu
+
+    rng = np.random.default_rng(3)
+    b, h, kv, d, s = 2, 8, 4, 128, 512
+    q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+    k_fp = jnp.asarray(rng.normal(size=(b, s, kv, d)), jnp.float32)
+    v_fp = jnp.asarray(rng.normal(size=(b, s, kv, d)), jnp.float32)
+    kv_len = jnp.asarray([300, 17], jnp.int32)
+    kq, ks = quantize_kv(k_fp)
+    vq, vs = quantize_kv(v_fp)
+
+    ref = gqa_decode_attention(q, dequantize_kv(kq, ks, jnp.float32),
+                               dequantize_kv(vq, vs, jnp.float32), kv_len)
+    # the kernel takes int8 values FLAT ([B, S, KV*D]) and scales
+    # seq-minor ([B, KV, S]) — the int8 VMEM-tiling-friendly layouts
+    out = gqa_decode_attention_tpu(q, kq.reshape(b, s, kv * d),
+                                   vq.reshape(b, s, kv * d), kv_len,
+                                   k_scale=ks.transpose(0, 2, 1),
+                                   v_scale=vs.transpose(0, 2, 1),
+                                   block_s=256, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)

@@ -1,0 +1,76 @@
+"""Swagger/OpenAPI serving: spec + viewer routes appear when
+./static/openapi.json exists (reference swagger.go:22-55 + gofr.go:98-106),
+and the static-file route refuses to serve the spec directly (403 guard,
+reference http/router.go:71-93)."""
+
+import json
+import os
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from gofr_tpu.app import App
+from gofr_tpu.config import MapConfig
+from gofr_tpu.container.mock import new_mock_container
+
+SPEC = {
+    "openapi": "3.0.0",
+    "info": {"title": "demo api", "version": "1.0.0"},
+    "paths": {"/greet": {"get": {"summary": "say hello"}}},
+}
+
+
+def _make_app() -> App:
+    app = App(config=MapConfig({"APP_NAME": "swagger-test"}))
+    container, _ = new_mock_container()
+    container.tracer = app.tracer
+    app.container = container
+    return app
+
+
+def test_swagger_routes_served_when_spec_present(run, tmp_path, monkeypatch):
+    (tmp_path / "static").mkdir()
+    (tmp_path / "static" / "openapi.json").write_text(json.dumps(SPEC))
+    monkeypatch.chdir(tmp_path)
+
+    async def scenario():
+        app = _make_app()
+        app.add_static_files("/static", str(tmp_path / "static"))
+        server = TestServer(app._build_http_app())
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            r = await client.get("/.well-known/openapi.json")
+            assert r.status == 200
+            assert (await r.json())["info"]["title"] == "demo api"
+
+            r = await client.get("/.well-known/swagger")
+            assert r.status == 200
+            assert "text/html" in r.headers["Content-Type"]
+            assert "API Documentation" in await r.text()
+
+            # the spec must NOT be fetchable through the static route
+            r = await client.get("/static/openapi.json")
+            assert r.status == 403
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+def test_no_swagger_routes_without_spec(run, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # no static/openapi.json here
+
+    async def scenario():
+        app = _make_app()
+        server = TestServer(app._build_http_app())
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            r = await client.get("/.well-known/openapi.json")
+            assert r.status == 404
+            r = await client.get("/.well-known/swagger")
+            assert r.status == 404
+        finally:
+            await client.close()
+
+    run(scenario())
