@@ -1,0 +1,211 @@
+"""OpenAI-compatible chat/completions API over the continuous-batching LLM.
+
+Lets clients built for the OpenAI wire format (SDKs, LangChain, curl
+recipes) point at this framework unchanged:
+
+- ``POST /v1/chat/completions``  — messages in, choice out; ``"stream":
+  true`` sends ``chat.completion.chunk`` frames over SSE ending with
+  ``data: [DONE]``
+- ``POST /v1/completions``       — prompt in, text out (+ streaming)
+- ``GET  /v1/models``            — model listing
+
+Env: LLAMA_PRESET=tiny|1b|8b, LLM_SLOTS, LLAMA_KV_QUANT=1. The byte-level
+tokenizer keeps the example self-contained; mount a trained one for real
+deployments.
+"""
+
+import os
+import time
+import uuid
+
+import jax
+
+import gofr_tpu
+from gofr_tpu.ml.generate import Sampler
+from gofr_tpu.models import llama
+from gofr_tpu.native.tokenizer import BPETokenizer
+
+TOKENIZER = BPETokenizer.byte_level(specials=["<eos>"])
+MODEL_ID = os.environ.get("MODEL_ID", "gofr-llama")
+
+PRESETS = {
+    "tiny": lambda: llama.tiny_llama(vocab_size=TOKENIZER.vocab_size),
+    "1b": lambda: llama.LlamaConfig(
+        vocab_size=32_128, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        ffn_dim=8192, max_seq_len=2048,
+    ),
+    "8b": llama.llama3_8b,
+}
+
+
+def _render_chat(messages) -> str:
+    """Minimal chat template: role-tagged lines + assistant cue."""
+    lines = [f"{m.get('role', 'user')}: {m.get('content', '')}"
+             for m in messages]
+    lines.append("assistant:")
+    return "\n".join(lines)
+
+
+def _decode(ids) -> str:
+    """Tokenizer-safe decode: ids beyond the tokenizer's vocab (models with
+    a larger embedding than the byte-level tokenizer, e.g. the 1b/8b
+    presets with random weights) render as the replacement character
+    instead of failing the request."""
+    vocab = TOKENIZER.vocab_size
+    known = [i for i in ids if 0 <= i < vocab]
+    if len(known) == len(ids):
+        return TOKENIZER.decode(list(ids))
+    out = []
+    for i in ids:
+        out.append(TOKENIZER.decode([i]) if 0 <= i < vocab else "�")
+    return "".join(out)
+
+
+def _usage(prompt_toks, completion_toks) -> dict:
+    return {"prompt_tokens": prompt_toks,
+            "completion_tokens": completion_toks,
+            "total_tokens": prompt_toks + completion_toks}
+
+
+def _choice_delta(index, content=None, role=None, finish=None) -> dict:
+    delta = {}
+    if role:
+        delta["role"] = role
+    if content is not None:
+        delta["content"] = content
+    return {"index": index, "delta": delta, "finish_reason": finish}
+
+
+def _prepare(ctx, prompt_text: str, body: dict):
+    """Tokenize the prompt and look up the LLM + generation budget."""
+    ids = TOKENIZER.encode(prompt_text)
+    max_new = int(body.get("max_tokens") or 64)
+    llm = ctx.ml.llm(MODEL_ID)
+    return ids, max_new, llm
+
+
+def _chunk(kind: str, rid: str, created: int, choices) -> dict:
+    return {"id": rid, "object": kind, "created": created,
+            "model": MODEL_ID, "choices": choices}
+
+
+async def chat_completions(ctx: gofr_tpu.Context):
+    body = await ctx.bind()
+    messages = body.get("messages")
+    if not messages:
+        raise gofr_tpu.errors.MissingParam("messages")
+    ids, max_new, llm = _prepare(ctx, _render_chat(messages), body)
+    rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+    created = int(time.time())
+
+    if body.get("stream"):
+        async with gofr_tpu.EventStream(ctx) as stream:
+            await stream.send(_chunk(
+                "chat.completion.chunk", rid, created,
+                [_choice_delta(0, role="assistant", content="")]))
+            n_out = 0
+            async for tok in llm.stream(ids, max_new):
+                n_out += 1
+                await stream.send(_chunk(
+                    "chat.completion.chunk", rid, created,
+                    [_choice_delta(0, content=_decode([tok]))]))
+            finish = "length" if n_out >= max_new else "stop"
+            await stream.send(_chunk(
+                "chat.completion.chunk", rid, created,
+                [_choice_delta(0, finish=finish)]))
+            if (body.get("stream_options") or {}).get("include_usage"):
+                await stream.send({**_chunk("chat.completion.chunk", rid,
+                                            created, []),
+                                   "usage": _usage(len(ids), n_out)})
+            await stream.done()
+        return stream.response
+
+    toks = await llm.generate(ids, max_new)
+    return gofr_tpu.Raw({
+        "id": rid, "object": "chat.completion", "created": created,
+        "model": MODEL_ID,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant",
+                        "content": _decode(toks)},
+            "finish_reason": "stop" if len(toks) < max_new else "length",
+        }],
+        "usage": _usage(len(ids), len(toks)),
+    })
+
+
+async def completions(ctx: gofr_tpu.Context):
+    body = await ctx.bind()
+    prompt = body.get("prompt")
+    if prompt is None:
+        raise gofr_tpu.errors.MissingParam("prompt")
+    if isinstance(prompt, list):
+        # OpenAI allows string arrays (batch) and token-id arrays; this
+        # example serves one completion per request
+        if len(prompt) == 1 and isinstance(prompt[0], str):
+            prompt = prompt[0]
+        else:
+            raise gofr_tpu.errors.InvalidParam(
+                "prompt (batch/token-array prompts unsupported: send one string)")
+    ids, max_new, llm = _prepare(ctx, prompt, body)
+    rid = f"cmpl-{uuid.uuid4().hex[:24]}"
+    created = int(time.time())
+
+    if body.get("stream"):
+        async with gofr_tpu.EventStream(ctx) as stream:
+            n_out = 0
+            async for tok in llm.stream(ids, max_new):
+                n_out += 1
+                await stream.send(_chunk(
+                    "text_completion", rid, created,
+                    [{"index": 0, "text": _decode([tok]),
+                      "finish_reason": None}]))
+            finish = "length" if n_out >= max_new else "stop"
+            await stream.send(_chunk(
+                "text_completion", rid, created,
+                [{"index": 0, "text": "", "finish_reason": finish}]))
+            await stream.done()
+        return stream.response
+
+    toks = await llm.generate(ids, max_new)
+    return gofr_tpu.Raw({
+        "id": rid, "object": "text_completion", "created": created,
+        "model": MODEL_ID,
+        "choices": [{"index": 0, "text": _decode(toks),
+                     "finish_reason": "stop" if len(toks) < max_new else "length"}],
+        "usage": _usage(len(ids), len(toks)),
+    })
+
+
+async def models(ctx: gofr_tpu.Context):
+    return gofr_tpu.Raw({
+        "object": "list",
+        "data": [{"id": MODEL_ID, "object": "model",
+                  "created": 0, "owned_by": "gofr-tpu"}],
+    })
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    preset = os.environ.get("LLAMA_PRESET", "tiny")
+    cfg = PRESETS[preset]()
+    if preset == "tiny":
+        cfg.use_flash = False
+    if os.environ.get("LLAMA_KV_QUANT") == "1":
+        cfg.kv_quant = True
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    app.register_llm(
+        MODEL_ID, params, cfg,
+        batch_slots=int(os.environ.get("LLM_SLOTS", "4")),
+        max_seq=min(cfg.max_seq_len, 1024),
+        chunk=int(os.environ.get("LLM_CHUNK", "4")),
+        sampler=Sampler(temperature=float(os.environ.get("LLM_TEMPERATURE", "0"))),
+    )
+    app.post("/v1/chat/completions", chat_completions)
+    app.post("/v1/completions", completions)
+    app.get("/v1/models", models)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -27,6 +27,7 @@ from .config import Config, EnvConfig, MapConfig
 from .context import Context
 from .http import errors
 from .http.response import File, Raw, Redirect, Response, Template
+from .http.sse import EventStream
 from .logging import Level, Logger, new_logger
 from .migration import Migrate
 
@@ -43,6 +44,7 @@ __all__ = [
     "EnvConfig",
     "File",
     "Level",
+    "EventStream",
     "Logger",
     "MapConfig",
     "Migrate",

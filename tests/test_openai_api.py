@@ -1,0 +1,100 @@
+"""OpenAI-compatible API example: wire-format parity for /v1/models,
+/v1/chat/completions and /v1/completions, including SSE streaming with the
+``data: [DONE]`` sentinel."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tests.test_examples import _booted, example_env
+
+
+def _parse_sse(text: str) -> list:
+    frames = []
+    for block in text.strip().split("\n\n"):
+        for line in block.splitlines():
+            if line.startswith("data: "):
+                frames.append(line[len("data: "):])
+    return frames
+
+
+def test_models_and_chat_completion(run):
+    async def scenario():
+        import aiohttp
+
+        with example_env(LLM_SLOTS="2", LLM_CHUNK="2"):
+            from examples.openai_server.main import main
+
+            app = main()
+            base = await _booted(app)
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(base + "/v1/models")
+                assert r.status == 200
+                listing = await r.json()
+                assert listing["object"] == "list"
+                model_id = listing["data"][0]["id"]
+
+                r = await s.post(base + "/v1/chat/completions", json={
+                    "model": model_id,
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 6,
+                })
+                assert r.status < 300, await r.text()
+                body = await r.json()
+                assert body["object"] == "chat.completion"
+                choice = body["choices"][0]
+                assert choice["message"]["role"] == "assistant"
+                assert isinstance(choice["message"]["content"], str)
+                assert body["usage"]["completion_tokens"] <= 6
+
+                # missing messages -> 400 envelope
+                r = await s.post(base + "/v1/chat/completions", json={})
+                assert r.status == 400
+            await app.shutdown()
+
+    run(scenario())
+
+
+def test_streaming_chat_and_completions(run):
+    async def scenario():
+        import aiohttp
+
+        with example_env(LLM_SLOTS="2", LLM_CHUNK="2"):
+            from examples.openai_server.main import main
+
+            app = main()
+            base = await _booted(app)
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(base + "/v1/chat/completions", json={
+                    "messages": [{"role": "user", "content": "stream"}],
+                    "max_tokens": 5,
+                    "stream": True,
+                })
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                frames = _parse_sse(await r.text())
+                assert frames[-1] == "[DONE]"
+                chunks = [json.loads(f) for f in frames[:-1]]
+                assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+                assert chunks[0]["choices"][0]["delta"]["role"] == "assistant"
+                assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+                # 5 content tokens between the role frame and the finish frame
+                contents = [c["choices"][0]["delta"].get("content")
+                            for c in chunks[1:-1]]
+                assert len(contents) == 5
+
+                r = await s.post(base + "/v1/completions", json={
+                    "prompt": "once upon",
+                    "max_tokens": 4,
+                    "stream": True,
+                })
+                frames = _parse_sse(await r.text())
+                assert frames[-1] == "[DONE]"
+                chunks = [json.loads(f) for f in frames[:-1]]
+                assert all(c["object"] == "text_completion" for c in chunks)
+                assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+            await app.shutdown()
+
+    run(scenario())
