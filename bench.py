@@ -15,6 +15,7 @@ bench/ (this file stays the driver's single-line entry point).
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
@@ -69,16 +70,17 @@ def main() -> None:
     from gofr_tpu.models import llama
 
     on_tpu = jax.default_backend() == "tpu"
+    kv_quant = os.environ.get("KV_QUANT") == "1"  # int8 cache (docs/tpu)
     if on_tpu:
         cfg = llama.LlamaConfig(
             vocab_size=32_128, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
-            ffn_dim=8192, max_seq_len=2048,
+            ffn_dim=8192, max_seq_len=2048, kv_quant=kv_quant,
         )
         # slots swept at 64/96/128/160/192: throughput rises to 160 slots
         # (8.2k tok/s) but 192 OOMs the 16 GB HBM; 128 keeps margin
         slots, chunk, n_chunks, prompt_len, max_seq = 128, 16, 16, 128, 1024
     else:  # CPU smoke fallback so the bench never hard-fails
-        cfg = llama.tiny_llama(use_flash=False)
+        cfg = llama.tiny_llama(use_flash=False, kv_quant=kv_quant)
         slots, chunk, n_chunks, prompt_len, max_seq = 4, 4, 4, 8, 64
 
     # probe BEFORE the model + KV cache occupy HBM: the 1 GiB probe at peak
@@ -120,7 +122,10 @@ def main() -> None:
     # pallas decode kernel reads only valid blocks) twice (k and v)
     avg_len = prompt_len + chunk + steps / 2
     weight_bytes = n_params * 2
-    kv_bytes = 2 * cfg.n_layers * slots * avg_len * cfg.n_kv_heads * cfg.head_dim * 2
+    kv_cells = 2 * cfg.n_layers * slots * avg_len * cfg.n_kv_heads
+    kv_bytes = kv_cells * cfg.head_dim * (1 if kv_quant else 2)
+    if kv_quant:
+        kv_bytes += kv_cells * 2  # bf16 per-token per-head scales
     hbm_gbps = (weight_bytes + kv_bytes) / step_s / 1e9
     # matmul FLOPs dominate: 2 * params * tokens-per-step (+ attention term)
     attn_flops = 4 * cfg.n_layers * slots * avg_len * cfg.n_heads * cfg.head_dim
@@ -137,6 +142,7 @@ def main() -> None:
             "backend": jax.default_backend(),
             "device": jax.devices()[0].device_kind,
             "slots": slots,
+            "kv_quant": kv_quant,
             "decode_steps": steps,
             "step_ms": round(1000 * step_s, 2),
             "hbm_gbps": round(hbm_gbps, 1),

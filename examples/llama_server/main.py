@@ -24,16 +24,6 @@ from gofr_tpu.native.tokenizer import BPETokenizer
 # byte-level fallback vocabulary; mount a trained one for real deployments
 TOKENIZER = BPETokenizer.byte_level(specials=["<eos>"])
 
-PRESETS = {
-    # tiny: model vocab == tokenizer vocab so decoded text is always valid
-    "tiny": lambda: llama.tiny_llama(vocab_size=TOKENIZER.vocab_size),
-    "1b": lambda: llama.LlamaConfig(
-        vocab_size=32_128, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
-        ffn_dim=8192, max_seq_len=2048,
-    ),
-    "8b": llama.llama3_8b,
-}
-
 
 def _prompt_ids(body) -> list[int]:
     if body.get("prompt_ids"):
@@ -64,12 +54,8 @@ async def stream_ws(ctx: gofr_tpu.Context):
 
 def main() -> gofr_tpu.App:
     app = gofr_tpu.new_app()
-    preset = os.environ.get("LLAMA_PRESET", "tiny")
-    cfg = PRESETS[preset]()
-    if preset == "tiny":
-        cfg.use_flash = False
-    if os.environ.get("LLAMA_KV_QUANT") == "1":
-        cfg.kv_quant = True  # int8 cache: half the KV HBM (docs/tpu/llm-serving.md)
+    # LLAMA_PRESET / LLAMA_KV_QUANT -> config (shared with openai_server)
+    cfg = llama.config_from_env(tiny_vocab_size=TOKENIZER.vocab_size)
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     app.register_llm(
         "chat", params, cfg,

@@ -28,15 +28,6 @@ from gofr_tpu.native.tokenizer import BPETokenizer
 TOKENIZER = BPETokenizer.byte_level(specials=["<eos>"])
 MODEL_ID = os.environ.get("MODEL_ID", "gofr-llama")
 
-PRESETS = {
-    "tiny": lambda: llama.tiny_llama(vocab_size=TOKENIZER.vocab_size),
-    "1b": lambda: llama.LlamaConfig(
-        vocab_size=32_128, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
-        ffn_dim=8192, max_seq_len=2048,
-    ),
-    "8b": llama.llama3_8b,
-}
-
 
 def _render_chat(messages) -> str:
     """Minimal chat template: role-tagged lines + assistant cue."""
@@ -59,6 +50,26 @@ def _decode(ids) -> str:
     for i in ids:
         out.append(TOKENIZER.decode([i]) if 0 <= i < vocab else "�")
     return "".join(out)
+
+
+class _StreamDecoder:
+    """Incremental token→text decoding for streaming: a multi-byte UTF-8
+    character split across byte-level tokens must not surface as
+    replacement characters mid-stream (the non-stream path decodes the
+    whole sequence at once and gets this for free)."""
+
+    def __init__(self) -> None:
+        import codecs
+
+        self._dec = codecs.getincrementaldecoder("utf-8")(errors="replace")
+
+    def push(self, tok: int) -> str:
+        if not 0 <= tok < TOKENIZER.vocab_size:
+            return "�"
+        return self._dec.decode(TOKENIZER.decode_bytes([tok]))
+
+    def flush(self) -> str:
+        return self._dec.decode(b"", True)
 
 
 def _usage(prompt_toks, completion_toks) -> dict:
@@ -104,11 +115,17 @@ async def chat_completions(ctx: gofr_tpu.Context):
                 "chat.completion.chunk", rid, created,
                 [_choice_delta(0, role="assistant", content="")]))
             n_out = 0
+            dec = _StreamDecoder()
             async for tok in llm.stream(ids, max_new):
                 n_out += 1
                 await stream.send(_chunk(
                     "chat.completion.chunk", rid, created,
-                    [_choice_delta(0, content=_decode([tok]))]))
+                    [_choice_delta(0, content=dec.push(tok))]))
+            tail = dec.flush()
+            if tail:
+                await stream.send(_chunk(
+                    "chat.completion.chunk", rid, created,
+                    [_choice_delta(0, content=tail)]))
             finish = "length" if n_out >= max_new else "stop"
             await stream.send(_chunk(
                 "chat.completion.chunk", rid, created,
@@ -154,16 +171,17 @@ async def completions(ctx: gofr_tpu.Context):
     if body.get("stream"):
         async with gofr_tpu.EventStream(ctx) as stream:
             n_out = 0
+            dec = _StreamDecoder()
             async for tok in llm.stream(ids, max_new):
                 n_out += 1
                 await stream.send(_chunk(
                     "text_completion", rid, created,
-                    [{"index": 0, "text": _decode([tok]),
+                    [{"index": 0, "text": dec.push(tok),
                       "finish_reason": None}]))
             finish = "length" if n_out >= max_new else "stop"
             await stream.send(_chunk(
                 "text_completion", rid, created,
-                [{"index": 0, "text": "", "finish_reason": finish}]))
+                [{"index": 0, "text": dec.flush(), "finish_reason": finish}]))
             await stream.done()
         return stream.response
 
@@ -187,12 +205,8 @@ async def models(ctx: gofr_tpu.Context):
 
 def main() -> gofr_tpu.App:
     app = gofr_tpu.new_app()
-    preset = os.environ.get("LLAMA_PRESET", "tiny")
-    cfg = PRESETS[preset]()
-    if preset == "tiny":
-        cfg.use_flash = False
-    if os.environ.get("LLAMA_KV_QUANT") == "1":
-        cfg.kv_quant = True
+    # LLAMA_PRESET / LLAMA_KV_QUANT -> config (shared with llama_server)
+    cfg = llama.config_from_env(tiny_vocab_size=TOKENIZER.vocab_size)
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     app.register_llm(
         MODEL_ID, params, cfg,

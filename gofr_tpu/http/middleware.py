@@ -120,6 +120,12 @@ def logging_middleware(logger: Logger) -> Middleware:
         span = request.get("gofr_span")
         trace_id = span.trace_id if span is not None else ""
         span_id = span.span_id if span is not None else ""
+        if trace_id:
+            # streaming handlers (EventStream) prepare their response before
+            # this middleware can touch headers; pre-stash them on the
+            # request so the stream merges them at prepare time
+            request.setdefault("gofr_response_headers", {})[
+                "X-Correlation-ID"] = trace_id
         start_str = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime())
         try:
             resp = await nxt(request)
@@ -242,6 +248,9 @@ def cors_middleware(cfg: CORSConfig, registered_methods: Callable[[], str]) -> M
         hdrs = cfg.headers(registered_methods())
         if request.method == "OPTIONS":
             return web.Response(status=HTTPStatus.OK, headers=hdrs)
+        # pre-stash for streaming handlers that prepare before we return
+        # (see EventStream): a prepared response can't take headers here
+        request.setdefault("gofr_response_headers", {}).update(hdrs)
         resp = await nxt(request)
         if not resp.prepared:
             for k, v in hdrs.items():

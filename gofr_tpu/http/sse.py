@@ -29,12 +29,21 @@ class EventStream:
 
     def __init__(self, ctx, *, headers: dict | None = None) -> None:
         self._raw_request = ctx.request.raw
+        self._logger = getattr(ctx, "logger", None)
+        # CORS / correlation-id middleware can't modify a prepared response,
+        # so they pre-stash their headers on the request for us to merge
+        stashed = {}
+        try:
+            stashed = dict(self._raw_request.get("gofr_response_headers", {}))
+        except Exception:
+            pass
         self.response = web.StreamResponse(
             status=200,
             headers={
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
+                **stashed,
                 **(headers or {}),
             },
         )
@@ -52,7 +61,13 @@ class EventStream:
             # headers + frames already went out: a fresh 500 response on
             # this connection would corrupt the wire, so surface the
             # failure as a terminal error event and suppress the exception
-            # (the handler then returns the prepared stream as normal)
+            # (the handler then returns the prepared stream as normal) —
+            # but LOG it, or the failure is invisible server-side
+            if self._logger is not None:
+                try:
+                    self._logger.errorf("error mid-SSE-stream: %r", exc)
+                except Exception:
+                    pass
             try:
                 await self.send({"error": {"message": str(exc)}},
                                 event="error")
@@ -74,7 +89,9 @@ class EventStream:
         frame = ""
         if event:
             frame += f"event: {event.splitlines()[0]}\n"
-        for line in data.split("\n") or [""]:
+        # splitlines handles \n, \r and \r\n — all SSE line terminators;
+        # an empty payload still needs its one data: line
+        for line in data.splitlines() or [""]:
             frame += f"data: {line}\n"
         frame += "\n"
         await self.response.write(frame.encode())

@@ -101,6 +101,30 @@ def llama3_8b(**kw) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
+def config_from_env(tiny_vocab_size: int | None = None) -> LlamaConfig:
+    """The examples' shared boot path: LLAMA_PRESET=tiny|1b|8b selects the
+    config (tiny disables the flash kernel and can adopt a tokenizer's
+    vocab so decoded text is always valid), LLAMA_KV_QUANT=1 turns on the
+    int8 cache. Centralized so the llama/openai servers can't drift."""
+    import os
+
+    preset = os.environ.get("LLAMA_PRESET", "tiny")
+    kv_quant = os.environ.get("LLAMA_KV_QUANT") == "1"
+    if preset == "tiny":
+        kw = {"use_flash": False, "kv_quant": kv_quant}
+        if tiny_vocab_size is not None:
+            kw["vocab_size"] = tiny_vocab_size
+        return tiny_llama(**kw)
+    if preset == "1b":
+        return LlamaConfig(
+            vocab_size=32_128, dim=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, ffn_dim=8192, max_seq_len=2048, kv_quant=kv_quant,
+        )
+    if preset == "8b":
+        return llama3_8b(kv_quant=kv_quant)
+    raise ValueError(f"unknown LLAMA_PRESET {preset!r}")
+
+
 def tiny_llama(**kw) -> LlamaConfig:
     """Test-scale config: same topology, toy widths (divisible by tp=4)."""
     defaults = dict(

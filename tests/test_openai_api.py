@@ -57,6 +57,34 @@ def test_models_and_chat_completion(run):
     run(scenario())
 
 
+def test_streaming_response_carries_cors_and_correlation_headers(run):
+    """Middleware can't touch a prepared StreamResponse; EventStream must
+    merge the pre-stashed CORS + correlation headers before prepare()."""
+    async def scenario():
+        import aiohttp
+
+        with example_env(LLM_SLOTS="2", LLM_CHUNK="2",
+                         ACCESS_CONTROL_ALLOW_ORIGIN="https://app.example"):
+            from examples.openai_server.main import main
+
+            app = main()
+            base = await _booted(app)
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(base + "/v1/chat/completions", json={
+                    "messages": [{"role": "user", "content": "x"}],
+                    "max_tokens": 2,
+                    "stream": True,
+                })
+                assert r.status == 200
+                assert r.headers.get("Access-Control-Allow-Origin") \
+                    == "https://app.example"
+                assert r.headers.get("X-Correlation-ID")
+                await r.text()
+            await app.shutdown()
+
+    run(scenario())
+
+
 def test_streaming_chat_and_completions(run):
     async def scenario():
         import aiohttp
