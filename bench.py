@@ -70,7 +70,10 @@ def main() -> None:
     from gofr_tpu.models import llama
 
     on_tpu = jax.default_backend() == "tpu"
-    kv_quant = os.environ.get("KV_QUANT") == "1"  # int8 cache (docs/tpu)
+    # int8 cache (docs/tpu); LLAMA_KV_QUANT is the documented name, the
+    # short alias is kept for muscle memory
+    kv_quant = (os.environ.get("LLAMA_KV_QUANT")
+                or os.environ.get("KV_QUANT")) == "1"
     if on_tpu:
         cfg = llama.LlamaConfig(
             vocab_size=32_128, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,

@@ -1,0 +1,44 @@
+"""CRUD auto-handlers with a verb override.
+
+Mirrors the reference's examples/using-add-rest-handlers: a dataclass
+entity becomes a full REST resource (POST/GET/GET-by-id/PUT/DELETE at
+/user), and defining ``get_all`` on the entity overrides just that verb
+while the rest stay generated.
+"""
+
+import dataclasses
+
+import gofr_tpu
+
+
+@dataclasses.dataclass
+class User:
+    id: int = dataclasses.field(default=0, metadata={"sql": "auto_increment"})
+    name: str = ""
+    age: int = 0
+    is_employed: bool = False
+
+    async def get_all(self, ctx: gofr_tpu.Context):
+        # custom verb: employed users only, hand-written SQL
+        import asyncio
+
+        return await asyncio.to_thread(
+            ctx.sql.query,
+            "SELECT id, name, age FROM user WHERE is_employed = 1",
+        )
+
+
+def main() -> gofr_tpu.App:
+    app = gofr_tpu.new_app()
+    if app.container.sql is not None:
+        app.container.sql.exec(
+            "CREATE TABLE IF NOT EXISTS user ("
+            " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+            " name TEXT NOT NULL, age INTEGER, is_employed INTEGER)"
+        )
+    app.add_rest_handlers(User)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

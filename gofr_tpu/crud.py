@@ -115,7 +115,23 @@ def register_crud_handlers(app, entity: type) -> None:
 
     def override(verb: str):
         fn = getattr(entity, verb, None)
-        return fn if callable(fn) else None
+        if not callable(fn):
+            return None
+        import inspect
+
+        params = list(inspect.signature(fn).parameters)
+        if params and params[0] == "self":
+            # instance method: def get_all(self, ctx). Bind a shell
+            # instance WITHOUT __init__ — entities may have required
+            # fields, and self here is only a method receiver.
+            async def bound(ctx, _fn=fn):
+                result = _fn(entity.__new__(entity), ctx)
+                if inspect.isawaitable(result):
+                    result = await result
+                return result
+
+            return bound
+        return fn  # staticmethod / plain function taking (ctx, ...)
 
     app.post(route, override("create") or _create_handler(entity, meta))
     app.get(route, override("get_all") or _get_all_handler(entity, meta))

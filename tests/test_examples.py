@@ -398,6 +398,40 @@ def test_sample_cmd_example():
         assert "Country: NZ" in out and "City: Akl" in out
 
 
+# ------------------------------------------------ using_add_rest_handlers
+def test_using_add_rest_handlers_example(run, tmp_path):
+    async def scenario():
+        import aiohttp
+
+        with example_env(DB_DIALECT="sqlite", DB_NAME=str(tmp_path / "u.db")):
+            from examples.using_add_rest_handlers.main import main
+
+            app = main()
+            base = await _booted(app)
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(base + "/user", json={
+                    "name": "Ada", "age": 36, "is_employed": True})
+                assert r.status == 201
+                r = await s.post(base + "/user", json={
+                    "name": "Bob", "age": 40, "is_employed": False})
+                assert r.status == 201
+                # overridden get_all: employed users only
+                r = await s.get(base + "/user")
+                rows = (await r.json())["data"]
+                assert [u["name"] for u in rows] == ["Ada"]
+                # generated verbs still work
+                r = await s.get(base + "/user/2")
+                assert (await r.json())["data"]["name"] == "Bob"
+                r = await s.put(base + "/user/2", json={
+                    "name": "Bob", "age": 41, "is_employed": True})
+                assert r.status == 200
+                r = await s.delete(base + "/user/1")
+                assert r.status == 204
+            await app.shutdown()
+
+    run(scenario())
+
+
 # --------------------------------------------------------------- mnist boot
 def test_mnist_server_example(run):
     async def scenario():
