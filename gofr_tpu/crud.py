@@ -90,6 +90,7 @@ class _EntityMeta:
     fields: list[str]
     primary_key: str
     auto_increment: bool
+    not_null: list[str]
 
 
 def scan_entity(entity: type) -> _EntityMeta:
@@ -106,6 +107,9 @@ def scan_entity(entity: type) -> _EntityMeta:
         fields=[f.name for f in fields],
         primary_key=pk.name,
         auto_increment=auto_inc,
+        # reference crud_handlers.go honors sql:"not_null" field tags
+        not_null=[f.name for f in fields
+                  if f.metadata.get("sql", "") == "not_null"],
     )
 
 
@@ -140,9 +144,19 @@ def register_crud_handlers(app, entity: type) -> None:
     app.delete(route + "/{id}", override("delete") or _delete_handler(entity, meta))
 
 
+def _check_not_null(meta: _EntityMeta, obj, *, skip: str | None = None) -> None:
+    for f in meta.not_null:
+        if f == skip:
+            continue
+        value = getattr(obj, f, None)
+        if value is None or value == "":
+            raise InvalidInput(f"field {f!r} must not be null")
+
+
 def _create_handler(entity: type, meta: _EntityMeta):
     async def create(ctx: Context) -> Any:
         obj = await ctx.bind(entity)
+        _check_not_null(meta, obj)
         fields = list(meta.fields)
         if meta.auto_increment:
             fields = fields[1:]
@@ -184,6 +198,9 @@ def _update_handler(entity: type, meta: _EntityMeta):
     async def update(ctx: Context) -> Any:
         entity_id = ctx.path_param("id")
         obj = await ctx.bind(entity)
+        # the PK comes from the path and is never written by UPDATE —
+        # don't demand it in the body
+        _check_not_null(meta, obj, skip=meta.primary_key)
         fields = [f for f in meta.fields if f != meta.primary_key]
         values = [getattr(obj, f) for f in fields]
         n = await _sql(

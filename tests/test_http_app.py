@@ -348,3 +348,40 @@ def test_method_not_allowed(run):
             await client.close()
 
     run(scenario())
+
+
+# ----------------------------------------------------- CRUD not_null tag
+def test_crud_not_null_constraint(run):
+    """sql:"not_null" field metadata rejects null/empty values on create
+    and update with a 400 (reference crud_handlers.go tag handling)."""
+
+    @dataclasses.dataclass
+    class Gadget:
+        id: int = dataclasses.field(default=0,
+                                    metadata={"sql": "auto_increment"})
+        name: str = dataclasses.field(default="",
+                                      metadata={"sql": "not_null"})
+        note: str = ""
+
+    async def scenario():
+        app = make_app()
+        app.container.sql.exec(
+            "CREATE TABLE gadget (id INTEGER PRIMARY KEY AUTOINCREMENT,"
+            " name TEXT NOT NULL, note TEXT)")
+        app.add_rest_handlers(Gadget)
+        client = await client_for(app)
+        try:
+            r = await client.post("/gadget", json={"note": "no name"})
+            assert r.status == 400
+            body = await r.json()
+            assert "name" in body["error"]["message"]
+
+            r = await client.post("/gadget", json={"name": "ok"})
+            assert r.status == 201
+
+            r = await client.put("/gadget/1", json={"name": "", "note": "x"})
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    run(scenario())
