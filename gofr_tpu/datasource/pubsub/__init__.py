@@ -5,9 +5,10 @@ The reference treats a broker message as a transport Request
 Kafka message feeds the same handler signature) and ships Kafka/Google/MQTT/
 NATS/EventHub clients. In-image we provide: an in-process broker (asyncio
 queues with consumer-group fan-out semantics), a Redis-lists broker riding
-our RESP client, and from-scratch wire-protocol Kafka (kafka.py), MQTT
-3.1.1 (mqtt.py) and core-NATS (nats.py) clients; google/eventhub remain
-UnavailableDriverError (their cloud SDKs don't ship in this image).
+our RESP client, from-scratch wire-protocol Kafka (kafka.py), MQTT 3.1.1
+(mqtt.py) and core-NATS (nats.py) clients, a Google Pub/Sub REST driver
+(google.py, emulator-compatible), and an Event Hubs driver (eventhub.py,
+native SAS-signed REST send + injected AMQP receive).
 
 Commit semantics mirror the reference's subscriber runtime: a message is
 committed only after its handler succeeds (reference subscriber.go:72-75).
@@ -18,8 +19,6 @@ from __future__ import annotations
 import asyncio
 import json
 from typing import Any, Protocol, runtime_checkable
-
-from .. import UnavailableDriverError
 
 __all__ = ["Message", "PubSub", "InProcessBroker", "RedisListBroker", "new_pubsub"]
 

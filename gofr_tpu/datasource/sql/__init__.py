@@ -5,8 +5,8 @@ db.go:68-339): dialect-aware connection building, every statement wrapped with
 a duration log + ``app_sql_stats`` histogram, transactions, a reflection
 ``select`` helper binding rows into dataclasses (bind.go), health check with
 connection stats, and a background reconnect loop. sqlite (stdlib) is the
-always-available dialect; mysql/postgres raise UnavailableDriverError unless
-their client libraries exist.
+embedded dialect; postgres and mysql ride the from-scratch wire-protocol
+clients (pgwire.py, mywire.py) — no external driver libraries anywhere.
 
 All blocking DB work runs on a single worker thread per connection so the
 asyncio event loop never blocks and sqlite's same-thread rule is honored.
@@ -22,8 +22,6 @@ import threading
 import time
 import typing
 from typing import Any, Sequence
-
-from .. import UnavailableDriverError
 
 __all__ = ["SQL", "Tx", "new_sql", "QueryLog"]
 
