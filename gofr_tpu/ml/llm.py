@@ -57,6 +57,7 @@ class LLMServer:
         self._logger = logger
         self._metrics = metrics
         self._idle_wait = idle_wait_s
+        self._idle_backoff = idle_wait_s
         self._admit_window = admit_window_s
         self._requests: _queue.Queue[_Request | None] = _queue.Queue()
         self._waiting: list[_Request] = []
@@ -85,10 +86,20 @@ class LLMServer:
             else:
                 self.gen.drain()
                 self._finish_dead_slots()
-                try:  # idle: block briefly for the next request
-                    req = self._requests.get(timeout=self._idle_wait)
+                try:  # idle: block briefly for the next request, backing
+                    # off toward 50 ms so an idle server doesn't spin at
+                    # hundreds of wakeups/s (admission latency cost is at
+                    # most one backoff interval, well under a prefill)
+                    req = self._requests.get(timeout=self._idle_backoff)
                 except _queue.Empty:
+                    # floor keeps idle_wait_s=0 from spinning; ceiling never
+                    # clamps below a caller's own (larger) configured wait
+                    self._idle_backoff = min(
+                        max(self._idle_backoff * 2, 0.001),
+                        max(0.05, self._idle_wait),
+                    )
                     continue
+                self._idle_backoff = self._idle_wait
                 if req is None:
                     return
                 self._waiting.append(req)
