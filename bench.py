@@ -1,4 +1,5 @@
-"""Benchmark: continuous-batching decode throughput on the local accelerator.
+"""Benchmark: served LLM throughput through the real gRPC path, plus the
+raw continuous-batching decode loop for roofline context.
 
 Prints ONE JSON line. The workload is the per-chip share of BASELINE.md
 config #4 (Llama-3-8B, TP=8, >= 2000 tok/s aggregate): one chip running a
@@ -6,16 +7,22 @@ config #4 (Llama-3-8B, TP=8, >= 2000 tok/s aggregate): one chip running a
 ``vs_baseline`` is therefore value / 2000 — each chip of the TP=8 system
 must sustain the full aggregate token rate on its 1/8 model shard.
 
-Also reports achieved HBM bandwidth and MFU (r1 VERDICT asked for both so
-bandwidth regressions are visible), plus steady-state per-request prefill
-time with compile excluded. The full five-config BASELINE suite lives in
-bench/ (this file stays the driver's single-line entry point).
+The HEADLINE value is measured through the serving stack — gRPC
+server-streaming into LLMServer admission into chunked decode — at 64
+concurrent streams x 256 new tokens (bench/config4_llama.py, run as a
+subprocess first so its HBM is free before the raw loop allocates). The
+raw Generator loop then supplies step time, achieved HBM bandwidth, and
+MFU in ``detail.raw_loop``. If the serving subprocess fails the raw number
+becomes the headline with ``serving_path: "failed"`` so the bench line
+never goes missing.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import jax
@@ -65,9 +72,34 @@ def _measure_achievable_bw() -> float:
     return best
 
 
+def _served_result() -> dict | None:
+    """Run the serving-path bench (config #4) in a fresh subprocess and
+    return its parsed JSON line. A subprocess keeps the served model's HBM
+    fully released before the raw loop allocates its own."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "bench", "config4_llama.py")],
+            capture_output=True, text=True, timeout=900,
+            cwd=os.path.join(here, "bench"),
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return parsed
+    return None
+
+
 def main() -> None:
     from gofr_tpu.ml.generate import Generator
     from gofr_tpu.models import llama
+
+    served = _served_result()
 
     on_tpu = jax.default_backend() == "tpu"
     # int8 cache (docs/tpu); LLAMA_KV_QUANT is the documented name, the
@@ -136,32 +168,46 @@ def main() -> None:
     peak_flops, peak_bw = _chip_spec()
     mfu = flops / step_s / peak_flops
 
+    raw_loop = {
+        "decode_tok_per_s": round(tok_per_s, 1),
+        "slots": slots,
+        "kv_quant": kv_quant,
+        "decode_steps": steps,
+        "step_ms": round(1000 * step_s, 2),
+        "hbm_gbps": round(hbm_gbps, 1),
+        "hbm_utilization_vs_spec": round(hbm_gbps * 1e9 / peak_bw, 3),
+        # plain streaming matvec on the same device, for context: this
+        # virtualized device delivers a fraction of the public spec, and
+        # decode meets or beats the simple-kernel rate — i.e. decode is
+        # at the device's practical bandwidth ceiling, not leaving 5x
+        # on the table as the vs-spec number alone would suggest
+        # (null off-TPU: nothing measured there)
+        "streaming_ref_gbps": round(streaming_ref_bw / 1e9, 1)
+        if streaming_ref_bw else None,
+        "mfu": round(mfu, 4),
+        "prefill_each_ms": round(1000 * prefill_each_s, 1),
+        "params_m": round(n_params / 1e6),
+    }
+
+    if served is not None:
+        value = served["value"]
+        detail = dict(served.get("detail") or {})
+        detail["serving_path"] = "grpc_streaming"
+        metric = "served_tok_per_s_per_chip_1b_proxy"
+    else:  # serving subprocess failed: raw loop keeps the line alive
+        value = round(tok_per_s, 1)
+        detail = {"serving_path": "failed"}
+        metric = "decode_tok_per_s_per_chip_1b_proxy"
+    detail["raw_loop"] = raw_loop
+    detail["backend"] = jax.default_backend()
+    detail["device"] = jax.devices()[0].device_kind
+
     print(json.dumps({
-        "metric": "decode_tok_per_s_per_chip_1b_proxy",
-        "value": round(tok_per_s, 1),
+        "metric": metric,
+        "value": value,
         "unit": "tok/s",
-        "vs_baseline": round(tok_per_s / 2000.0, 3),
-        "detail": {
-            "backend": jax.default_backend(),
-            "device": jax.devices()[0].device_kind,
-            "slots": slots,
-            "kv_quant": kv_quant,
-            "decode_steps": steps,
-            "step_ms": round(1000 * step_s, 2),
-            "hbm_gbps": round(hbm_gbps, 1),
-            "hbm_utilization_vs_spec": round(hbm_gbps * 1e9 / peak_bw, 3),
-            # plain streaming matvec on the same device, for context: this
-            # virtualized device delivers a fraction of the public spec, and
-            # decode meets or beats the simple-kernel rate — i.e. decode is
-            # at the device's practical bandwidth ceiling, not leaving 5x
-            # on the table as the vs-spec number alone would suggest
-            # (null off-TPU: nothing measured there)
-            "streaming_ref_gbps": round(streaming_ref_bw / 1e9, 1)
-            if streaming_ref_bw else None,
-            "mfu": round(mfu, 4),
-            "prefill_each_ms": round(1000 * prefill_each_s, 1),
-            "params_m": round(n_params / 1e6),
-        },
+        "vs_baseline": round(value / 2000.0, 3),
+        "detail": detail,
     }))
 
 

@@ -1,10 +1,21 @@
 """BASELINE config #4 serving surface: Llama chat, gRPC server-streaming,
-continuous batching — p50 TTFT under N concurrent streams + aggregate tok/s.
+continuous batching — aggregate tok/s THROUGH the serving path + TTFT.
 
-The north-star target is TTFT < 200 ms at >= 8 concurrent streams. Raw
-per-chip decode throughput (the >= 2000 tok/s half of the target) is measured
-by bench.py on the bare Generator; this config measures the full transport
-path: gRPC stream -> LLMServer admission -> chunked decode -> token frames.
+Three phases, all in one run so the numbers share the same tunnel weather:
+
+  0. tunnel probe  — p50 of an empty jitted round-trip (dispatch + D2H):
+                     the mechanical floor the dev tunnel imposes on every
+                     wire latency; directly-attached chips remove it.
+  A. TTFT          — 8 concurrent streams, short generations: p50 wire
+                     TTFT, server-side TTFT (enqueue -> first token) from
+                     the app_llm_ttft_seconds histogram delta, and the
+                     decomposition wire = server + tunnel floor.
+  B. throughput    — BENCH_STREAMS (default 64) concurrent gRPC streams,
+                     BENCH_MAX_NEW (default 256) new tokens each, slots
+                     sized to match: aggregate tok/s over the full window,
+                     counted at the CLIENT after gRPC framing — the number
+                     the north-star >= 2000 tok/s target is about.
+
 LLAMA_PRESET=1b on TPU by default (the 8B/8-chip per-chip share), tiny on CPU.
 """
 
@@ -19,6 +30,41 @@ import numpy as np
 from common import boot, configure_free_ports, emit, percentile, run
 
 
+async def _metrics_ttft(ports) -> tuple[float, float]:
+    """(sum_seconds, count) of the server-side TTFT histogram."""
+    import aiohttp
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.get(f"http://127.0.0.1:{ports['METRICS_PORT']}/metrics")
+            text = await r.text()
+        tot = cnt = 0.0
+        for line in text.splitlines():
+            if line.startswith("app_llm_ttft_seconds_sum"):
+                tot = float(line.rsplit(" ", 1)[1])
+            elif line.startswith("app_llm_ttft_seconds_count"):
+                cnt = float(line.rsplit(" ", 1)[1])
+        return tot, cnt
+    except Exception:
+        return 0.0, 0.0
+
+
+def _tunnel_rtt_ms(samples: int = 12) -> float:
+    """p50 of a minimal dispatch + device->host fetch round-trip."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.float32)
+    np.asarray(f(x))  # compile outside the timed window
+    times = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        times.append(time.perf_counter() - t0)
+    return percentile(times, 50) * 1e3
+
+
 async def main() -> None:
     import asyncio
 
@@ -29,13 +75,16 @@ async def main() -> None:
     import jax
 
     on_tpu = jax.default_backend() == "tpu"
+    streams = int(os.environ.get("BENCH_STREAMS", "64" if on_tpu else "8"))
+    max_new = int(os.environ.get("BENCH_MAX_NEW", "256" if on_tpu else "16"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128" if on_tpu else "8"))
     if on_tpu:
         os.environ.setdefault("LLAMA_PRESET", "1b")
-        os.environ.setdefault("LLM_SLOTS", "32")
-        os.environ.setdefault("LLM_CHUNK", "8")
-    streams = int(os.environ.get("BENCH_STREAMS", "8"))
-    max_new = int(os.environ.get("BENCH_MAX_NEW", "64" if on_tpu else "16"))
-    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128" if on_tpu else "8"))
+        # slots sized to the stream count so phase B decodes every stream
+        # in ONE program per chunk (128 slots x 1024 seq is the HBM limit)
+        os.environ.setdefault("LLM_SLOTS", str(min(max(streams, 8), 128)))
+        os.environ.setdefault("LLM_CHUNK", "16")
+    slots = int(os.environ.get("LLM_SLOTS", "0")) or None
 
     from examples.llama_server.main import main as build_app
 
@@ -52,72 +101,88 @@ async def main() -> None:
     rng = np.random.default_rng(0)
     vocab_hi = 200
 
-    def req():
+    def req(n_new: int):
         return {
             "prompt_ids": rng.integers(1, vocab_hi, (prompt_len,)).tolist(),
-            "max_new_tokens": max_new,
+            "max_new_tokens": n_new,
         }
 
-    # warmup: compile prefill + decode before timing
-    async for _ in generate(req()):
-        break
+    # warmup: compile prefill + decode (all admission shapes) before timing
+    async for _ in generate(req(4)):
+        pass
 
-    ttfts: list[float] = []
+    # ---- phase 0: tunnel floor ------------------------------------------
+    rtt_ms = _tunnel_rtt_ms()
+
+    # ---- phase A: TTFT at moderate load ---------------------------------
+    ttft_streams = int(os.environ.get("BENCH_TTFT_STREAMS", "8"))
+    sum0, cnt0 = await _metrics_ttft(ports)
+
+    async def ttft_stream(out: list[float]):
+        t0 = time.perf_counter()
+        async for _ in generate(req(16)):
+            out.append(time.perf_counter() - t0)
+            break  # TTFT only; cancel the rest of the stream
+
+    wire_ttfts: list[float] = []
+    await asyncio.gather(*[ttft_stream(wire_ttfts) for _ in range(ttft_streams)])
+    sum1, cnt1 = await _metrics_ttft(ports)
+    server_ttft_ms = (round(1e3 * (sum1 - sum0) / (cnt1 - cnt0), 1)
+                      if cnt1 > cnt0 else None)
+    p50_ttft_ms = percentile(wire_ttfts, 50) * 1e3
+
+    await asyncio.sleep(0.3)  # let cancelled slots reap before phase B
+
+    # ---- phase B: aggregate throughput at high concurrency --------------
     token_counts: list[int] = []
+    herd_ttfts: list[float] = []
 
     async def one_stream():
         t0 = time.perf_counter()
         first = None
         count = 0
-        async for frame in generate(req()):
+        async for _ in generate(req(max_new)):
             if first is None:
                 first = time.perf_counter() - t0
             count += 1
-        ttfts.append(first if first is not None else float("nan"))
+        herd_ttfts.append(first if first is not None else float("nan"))
         token_counts.append(count)
 
+    sum2, cnt2 = await _metrics_ttft(ports)
     t_start = time.perf_counter()
     await asyncio.gather(*[one_stream() for _ in range(streams)])
     elapsed = time.perf_counter() - t_start
-
-    # server-side TTFT (enqueue -> first token emitted) from the framework's
-    # own histogram: the part the serving stack controls. The wire number
-    # additionally carries the dev-tunnel's ~100 ms D2H round-trip and a
-    # grpc-aio poller artifact; on directly-attached chips wire ~= server.
-    server_ttft_ms = None
-    try:
-        import aiohttp
-
-        async with aiohttp.ClientSession() as s:
-            r = await s.get(f"http://127.0.0.1:{ports['METRICS_PORT']}/metrics")
-            text = await r.text()
-        tot = cnt = 0.0
-        for line in text.splitlines():
-            if line.startswith("app_llm_ttft_seconds_sum"):
-                tot = float(line.rsplit(" ", 1)[1])
-            elif line.startswith("app_llm_ttft_seconds_count"):
-                cnt = float(line.rsplit(" ", 1)[1])
-        if cnt:
-            server_ttft_ms = round(1e3 * tot / cnt, 1)
-    except Exception:
-        pass
-
+    sum3, cnt3 = await _metrics_ttft(ports)
 
     await channel.close()
     await app.shutdown()
 
-    p50_ttft_ms = percentile(ttfts, 50) * 1e3
     agg_tok_s = sum(token_counts) / elapsed
     emit(
-        "llama_serving_p50_ttft_ms", p50_ttft_ms, "ms", None,
+        "llama_served_tok_per_s", agg_tok_s, "tok/s", 2000.0,
         {
-            "target_ms": 200,
-            "ttft_ok": bool(p50_ttft_ms < 200),
-            "server_ttft_avg_ms": server_ttft_ms,
-            "p99_ttft_ms": round(percentile(ttfts, 99) * 1e3, 1),
-            "aggregate_tok_per_s": round(agg_tok_s, 1),
             "streams": streams,
             "max_new_tokens": max_new,
+            "prompt_len": prompt_len,
+            "slots": slots,  # None = server default (env unset, CPU path)
+            "elapsed_s": round(elapsed, 2),
+            "total_tokens": sum(token_counts),
+            # TTFT decomposition (phase A, moderate load):
+            #   wire p50 = server work + tunnel dispatch/D2H floor
+            "p50_ttft_ms": round(p50_ttft_ms, 1),
+            "p99_ttft_ms": round(percentile(wire_ttfts, 99) * 1e3, 1),
+            "server_ttft_avg_ms": server_ttft_ms,
+            "tunnel_rtt_p50_ms": round(rtt_ms, 1),
+            "ttft_minus_tunnel_ms": round(p50_ttft_ms - rtt_ms, 1),
+            "ttft_ok": bool(p50_ttft_ms < 200),
+            "ttft_streams": ttft_streams,
+            "target_ttft_ms": 200,
+            # thundering-herd TTFT (phase B: all streams at t=0, admission
+            # waves of admit_cap) — queueing, not per-request serving work
+            "herd_p50_ttft_ms": round(percentile(herd_ttfts, 50) * 1e3, 1),
+            "herd_server_ttft_avg_ms": (
+                round(1e3 * (sum3 - sum2) / (cnt3 - cnt2), 1)
+                if cnt3 > cnt2 else None),
             "preset": os.environ.get("LLAMA_PRESET", "tiny"),
             "backend": jax.default_backend(),
             "config": 4,

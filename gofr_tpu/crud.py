@@ -100,16 +100,18 @@ def scan_entity(entity: type) -> _EntityMeta:
     if not fields:
         raise InvalidInput(f"entity {entity.__name__} has no fields")
     pk = fields[0]
-    auto_inc = pk.metadata.get("sql", "") == "auto_increment"
+
+    def tags(f):  # reference parseSQLTag splits comma-separated tags
+        return {t.strip() for t in f.metadata.get("sql", "").split(",")}
+
     return _EntityMeta(
         name=entity.__name__,
         table=snake_case(entity.__name__),
         fields=[f.name for f in fields],
         primary_key=pk.name,
-        auto_increment=auto_inc,
+        auto_increment="auto_increment" in tags(pk),
         # reference crud_handlers.go honors sql:"not_null" field tags
-        not_null=[f.name for f in fields
-                  if f.metadata.get("sql", "") == "not_null"],
+        not_null=[f.name for f in fields if "not_null" in tags(f)],
     )
 
 
@@ -148,8 +150,8 @@ def _check_not_null(meta: _EntityMeta, obj, *, skip: str | None = None) -> None:
     for f in meta.not_null:
         if f == skip:
             continue
-        value = getattr(obj, f, None)
-        if value is None or value == "":
+        # reference crud_handlers.go:195 rejects only nil, not empty strings
+        if getattr(obj, f, None) is None:
             raise InvalidInput(f"field {f!r} must not be null")
 
 

@@ -39,7 +39,22 @@ def ensure_loop_session(current, timeout_s: float):
         return current
     if current is not None and not current.closed:
         try:
-            current._connector.close()
+            connector = getattr(current, "connector", None)
+            # detach() (public API) marks the session closed so its
+            # __del__ stays quiet; the connector can't be awaited — its
+            # loop is dead — so drive its close() as far as it goes
+            # without a loop and abandon it at the first real suspend.
+            if hasattr(current, "detach"):
+                current.detach()
+            if connector is not None:
+                result = connector.close()
+                if asyncio.iscoroutine(result):
+                    try:
+                        result.send(None)
+                    except BaseException:
+                        pass  # StopIteration (done) or teardown error
+                    finally:
+                        result.close()
         except Exception:
             pass
     session = aiohttp.ClientSession(

@@ -70,11 +70,20 @@ class ObjectId:
 _EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
 
 
+class Int64(int):
+    """Force BSON int64 encoding even for small values (e.g. getMore cursor
+    ids, which the server rejects as 'wrong type int' when sent as int32)."""
+
+    __slots__ = ()
+
+
 def _encode_value(name: bytes, value: Any) -> bytes:
     if isinstance(value, bool):  # before int: bool is an int subclass
         return b"\x08" + name + b"\x00" + (b"\x01" if value else b"\x00")
     if isinstance(value, float):
         return b"\x01" + name + b"\x00" + struct.pack("<d", value)
+    if isinstance(value, Int64):
+        return b"\x12" + name + b"\x00" + struct.pack("<q", value)
     if isinstance(value, int):
         if -(2**31) <= value < 2**31:
             return b"\x10" + name + b"\x00" + struct.pack("<i", value)
@@ -96,7 +105,7 @@ def _encode_value(name: bytes, value: Any) -> bytes:
     if isinstance(value, _dt.datetime):
         if value.tzinfo is None:
             value = value.replace(tzinfo=_dt.timezone.utc)
-        ms = int((value - _EPOCH).total_seconds() * 1000)
+        ms = (value - _EPOCH) // _dt.timedelta(milliseconds=1)
         return b"\x09" + name + b"\x00" + struct.pack("<q", ms)
     if value is None:
         return b"\x0a" + name + b"\x00"
@@ -264,7 +273,7 @@ class MongoWire:
         cursor = reply["cursor"]
         docs = list(cursor.get("firstBatch", []))
         while cursor.get("id"):
-            reply = await self._command({"getMore": cursor["id"],
+            reply = await self._command({"getMore": Int64(cursor["id"]),
                                          "collection": collection,
                                          "$db": self.database})
             cursor = reply["cursor"]

@@ -317,13 +317,38 @@ class Generator:
                     f"prompt length {n} out of range (1..{self.max_seq - 1})")
             prepped.append((ids, n, max_new, callback))
 
+        free = sum(1 for s in self.slots if not s.live)
+        if len(prepped) > free:
+            raise RuntimeError(
+                f"no free generation slot ({len(prepped)} requested, "
+                f"{free} free)")
+
         out: list[int] = []
+        slots: list[int] = []
+        try:
+            return self._admit_waves(prepped, out)
+        except Exception:
+            # An admission raising means the CALLER sees the whole batch
+            # fail — so no slot from this call may stay admitted, or it
+            # would decode to max_new_tokens for a consumer that was told
+            # "error" and can never cancel it.
+            dead = set(out)
+            for j in dead:
+                self.slots[j].live = False
+            if dead:
+                self._pending_first = collections.deque(
+                    s for s in self._pending_first if s not in dead)
+            raise
+
+    def _admit_waves(self, prepped, out: list[int]) -> list[int]:
         for start in range(0, len(prepped), self._admit_cap):
             wave = prepped[start:start + self._admit_cap]
             slots = []
             for _ in wave:
                 i = self.free_slot()
-                if i is None:
+                if i is None:  # unreachable after the capacity pre-check
+                    for j in slots:
+                        self.slots[j].live = False
                     raise RuntimeError("no free generation slot")
                 slots.append(i)
                 self.slots[i].live = True  # reserve within this wave
@@ -340,28 +365,33 @@ class Generator:
                 lens[row] = n
                 valid[row] = True
                 slot_arr[row] = slots[row]
-            with self._mesh_ctx():
-                if b == 1:
-                    logits, self.cache = self._prefill_into(
-                        self.params, jnp.asarray(tokens),
-                        jnp.asarray(lens), self.cache,
-                        jnp.int32(slots[0]),
-                    )
-                    self._tok_dev = self._post_prefill(
-                        self._tok_dev, logits, self._prefill_key,
-                        jnp.uint32(self._n_requests), jnp.int32(slots[0]),
-                    )
-                else:
-                    logits, self.cache = self._prefill_many(
-                        self.params, jnp.asarray(tokens), jnp.asarray(lens),
-                        self.cache, jnp.asarray(slot_arr),
-                        jnp.asarray(valid),
-                    )
-                    self._tok_dev = self._post_prefill_many(
-                        self._tok_dev, logits, self._prefill_key,
-                        jnp.uint32(self._n_requests), jnp.asarray(slot_arr),
-                        jnp.asarray(valid),
-                    )
+            try:
+                with self._mesh_ctx():
+                    if b == 1:
+                        logits, self.cache = self._prefill_into(
+                            self.params, jnp.asarray(tokens),
+                            jnp.asarray(lens), self.cache,
+                            jnp.int32(slots[0]),
+                        )
+                        self._tok_dev = self._post_prefill(
+                            self._tok_dev, logits, self._prefill_key,
+                            jnp.uint32(self._n_requests), jnp.int32(slots[0]),
+                        )
+                    else:
+                        logits, self.cache = self._prefill_many(
+                            self.params, jnp.asarray(tokens), jnp.asarray(lens),
+                            self.cache, jnp.asarray(slot_arr),
+                            jnp.asarray(valid),
+                        )
+                        self._tok_dev = self._post_prefill_many(
+                            self._tok_dev, logits, self._prefill_key,
+                            jnp.uint32(self._n_requests), jnp.asarray(slot_arr),
+                            jnp.asarray(valid),
+                        )
+            except Exception:
+                for j in slots:  # unwind this wave's reservations
+                    self.slots[j].live = False
+                raise
             self._n_requests += len(wave)
             for slot, (ids, n, max_new, callback) in zip(slots, wave):
                 self._pending_first.append(slot)

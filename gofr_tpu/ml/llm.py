@@ -267,6 +267,14 @@ class LLMServer:
         out_q: asyncio.Queue = asyncio.Queue()
         req = _Request(prompt_ids, max_new_tokens, out_q, loop)
         self._requests.put(req)
+        if self._closed:
+            # close() may have drained the queue before our put landed —
+            # never park on a queue nobody reads (TOCTOU with close()).
+            # If the flush DID see the request it only pushed an error
+            # into out_q, which we're abandoning; mark cancelled so the
+            # serving thread reaps it if it was somehow admitted.
+            req.cancelled = True
+            raise RuntimeError("llm server is closed")
         try:
             while True:
                 item = await out_q.get()
@@ -304,3 +312,10 @@ class LLMServer:
             self._closed = True
             self._requests.put(None)
             self._thread.join(timeout=5)
+            # catch requests that raced past the serving thread's final
+            # flush: wake their consumers instead of stranding them. Only
+            # once the thread is really gone — if join timed out (stuck
+            # compile/dispatch), flushing here would mutate _active/_waiting
+            # under the live thread; its own finally-flush runs on exit.
+            if not self._thread.is_alive():
+                self._flush_on_close()

@@ -352,15 +352,18 @@ def test_method_not_allowed(run):
 
 # ----------------------------------------------------- CRUD not_null tag
 def test_crud_not_null_constraint(run):
-    """sql:"not_null" field metadata rejects null/empty values on create
-    and update with a 400 (reference crud_handlers.go tag handling)."""
+    """sql:"not_null" field metadata rejects null (None) values on create
+    and update with a 400 — and ONLY null: the reference
+    (crud_handlers.go:195) rejects nil, so empty strings pass through.
+    Comma-separated tags ("auto_increment,not_null") must also parse, per
+    the reference's parseSQLTag."""
 
     @dataclasses.dataclass
     class Gadget:
-        id: int = dataclasses.field(default=0,
-                                    metadata={"sql": "auto_increment"})
-        name: str = dataclasses.field(default="",
-                                      metadata={"sql": "not_null"})
+        id: int | None = dataclasses.field(
+            default=None, metadata={"sql": "auto_increment,index"})
+        name: str | None = dataclasses.field(default=None,
+                                             metadata={"sql": "not_null"})
         note: str = ""
 
     async def scenario():
@@ -379,7 +382,11 @@ def test_crud_not_null_constraint(run):
             r = await client.post("/gadget", json={"name": "ok"})
             assert r.status == 201
 
+            # empty string is NOT null — reference lets it through
             r = await client.put("/gadget/1", json={"name": "", "note": "x"})
+            assert r.status == 200
+
+            r = await client.put("/gadget/1", json={"name": None, "note": "x"})
             assert r.status == 400
         finally:
             await client.close()
