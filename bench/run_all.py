@@ -20,6 +20,8 @@ CONFIGS = [
     "config3_bert.py",
     "config4_llama.py",
     "config5_sdxl.py",
+    "config6_compute.py",
+    "config7_longcontext.py",
 ]
 
 

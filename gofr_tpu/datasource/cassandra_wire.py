@@ -2,23 +2,27 @@
 
 Upgrades the injected-session Cassandra wrapper (datasource/cassandra.py)
 to a real native client — the reference bundles gocql
-(pkg/gofr/datasource/cassandra/cassandra.go); here the binary protocol is
-implemented directly:
+(pkg/gofr/datasource/cassandra/cassandra.go, cassandra_batch.go); here the
+binary protocol is implemented directly:
 
 - **Framing**: 9-byte header (version 0x04/0x84, flags, int16 stream,
   opcode, int32 length), big-endian body primitives ([string],
-  [long string], [string map], [bytes], [option]).
-- **Handshake**: STARTUP {CQL_VERSION: 3.0.0} → READY (AUTHENTICATE is
-  reported as a clear unsupported-auth error — point authenticated
-  clusters at the injected-session wrapper).
-- **QUERY**: long-string CQL + consistency ONE + no-values flag;
-  parameters are interpolated client-side with CQL quoting (the same
-  approach as the SQL wire dialects — correct value serialization in the
-  VALUES flag needs PREPARE metadata, which simple statements don't).
-- **RESULT**: Void / SetKeyspace / SchemaChange / Rows with the global-
-  tables-spec metadata layout; row values decode by column type id
+  [long string], [string map], [bytes], [short bytes], [option]).
+- **Handshake**: STARTUP {CQL_VERSION: 3.0.0} → READY, or AUTHENTICATE →
+  AUTH_RESPONSE (SASL PLAIN, PasswordAuthenticator) → AUTH_SUCCESS.
+- **PREPARE / EXECUTE**: statements with parameters are prepared once per
+  connection (cached by CQL text) and executed with values serialized to
+  the bind-marker types from the Prepared metadata — values travel as
+  protocol-level [bytes], never interpolated into the statement, so user
+  input cannot alter the CQL (the r2 injection surface is gone).
+- **QUERY**: parameterless statements ride the simple path.
+- **Paging**: both paths request ``page_size`` and follow
+  ``has_more_pages``/paging-state until the result set is complete.
+- **BATCH**: prepared-statement batch (type LOGGED), one frame.
+- **RESULT**: Void / SetKeyspace / SchemaChange / Rows / Prepared with the
+  global-tables-spec metadata layout; row values decode by column type id
   (ascii/varchar, int/bigint/smallint/tinyint, boolean, double/float,
-  timestamp, uuid, list/set/map of the above).
+  timestamp, uuid, blob, list/set/map of the above).
 """
 
 from __future__ import annotations
@@ -39,8 +43,21 @@ _OP_READY = 0x02
 _OP_AUTHENTICATE = 0x03
 _OP_QUERY = 0x07
 _OP_RESULT = 0x08
+_OP_PREPARE = 0x09
+_OP_EXECUTE = 0x0A
+_OP_BATCH = 0x0D
+_OP_AUTH_CHALLENGE = 0x0E
+_OP_AUTH_RESPONSE = 0x0F
+_OP_AUTH_SUCCESS = 0x10
 
 _CONSISTENCY_ONE = 0x0001
+# query-parameter flag bits (protocol v4 §4.1.4)
+_FLAG_VALUES = 0x01
+_FLAG_PAGE_SIZE = 0x04
+_FLAG_PAGING_STATE = 0x08
+# Rows-metadata flag bits (§4.2.5.2)
+_ROWS_GLOBAL_SPEC = 0x0001
+_ROWS_HAS_MORE = 0x0002
 _EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
 
 
@@ -65,36 +82,71 @@ def _string_map(m: dict[str, str]) -> bytes:
     return out
 
 
-def quote_value(v: Any) -> str:
-    """CQL literal for client-side interpolation."""
+def _short_bytes(b: bytes) -> bytes:
+    return struct.pack(">H", len(b)) + b
+
+
+def _bytes_value(b: bytes | None) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+def _encode_cql(tid: int, param: Any, v: Any) -> bytes | None:
+    """Serialize a bind value to the column type from Prepared metadata —
+    the inverse of _decode_cql. Returns None for NULL (sent as length -1)."""
     if v is None:
-        return "NULL"
-    if isinstance(v, bool):
-        return "true" if v else "false"
-    if isinstance(v, (int, float)):
-        return str(v)
-    if isinstance(v, _uuid.UUID):
-        return str(v)
-    if isinstance(v, (bytes, bytearray)):
-        return "0x" + bytes(v).hex()
-    if isinstance(v, _dt.datetime):
-        return str(int((v - (_EPOCH if v.tzinfo else _EPOCH.replace(tzinfo=None)))
-                       .total_seconds() * 1000))
-    return "'" + str(v).replace("'", "''") + "'"
-
-
-def interpolate(stmt: str, params: Sequence | None) -> str:
-    if not params:
-        return stmt
-    parts = stmt.split("?")
-    if len(parts) - 1 != len(params):
-        raise CassandraWireError(
-            f"statement has {len(parts) - 1} placeholders, got {len(params)} params")
-    out = [parts[0]]
-    for p, tail in zip(params, parts[1:]):
-        out.append(quote_value(p))
-        out.append(tail)
-    return "".join(out)
+        return None
+    if tid in (0x0001, 0x000D):            # ascii / varchar
+        return str(v).encode()
+    if tid == 0x0002:                      # bigint
+        return struct.pack(">q", int(v))
+    if tid == 0x0003:                      # blob
+        return bytes(v)
+    if tid == 0x0004:                      # boolean
+        return b"\x01" if v else b"\x00"
+    if tid == 0x0007:                      # double
+        return struct.pack(">d", float(v))
+    if tid == 0x0008:                      # float
+        return struct.pack(">f", float(v))
+    if tid == 0x0009:                      # int
+        return struct.pack(">i", int(v))
+    if tid == 0x000B:                      # timestamp (ms)
+        if isinstance(v, _dt.datetime):
+            if v.tzinfo is None:
+                v = v.replace(tzinfo=_dt.timezone.utc)
+            ms = (v - _EPOCH) // _dt.timedelta(milliseconds=1)
+        else:
+            ms = int(v)
+        return struct.pack(">q", ms)
+    if tid in (0x000C, 0x000F):            # uuid / timeuuid
+        u = v if isinstance(v, _uuid.UUID) else _uuid.UUID(str(v))
+        return u.bytes
+    if tid == 0x000E:                      # varint
+        n = int(v)
+        length = max(1, (n.bit_length() + 8) // 8)
+        return n.to_bytes(length, "big", signed=True)
+    if tid == 0x0013:                      # smallint
+        return struct.pack(">h", int(v))
+    if tid == 0x0014:                      # tinyint
+        return struct.pack(">b", int(v))
+    if tid in (0x0020, 0x0022):            # list / set
+        sub_tid, sub_param = param
+        out = struct.pack(">i", len(v))
+        for item in v:
+            out += _bytes_value(_encode_cql(sub_tid, sub_param, item))
+        return out
+    if tid == 0x0021:                      # map
+        (ktid, kparam), (vtid, vparam) = param
+        out = struct.pack(">i", len(v))
+        for key, val in v.items():
+            out += _bytes_value(_encode_cql(ktid, kparam, key))
+            out += _bytes_value(_encode_cql(vtid, vparam, val))
+        return out
+    if isinstance(v, (bytes, bytearray)):  # unknown type: raw passthrough
+        return bytes(v)
+    raise CassandraWireError(
+        f"cannot serialize {type(v).__name__} for CQL type 0x{tid:04x}")
 
 
 class _Reader:
@@ -186,10 +238,14 @@ class CassandraWire:
 
     def __init__(self, *, host: str = "localhost", port: int = 9042,
                  keyspace: str | None = None, timeout: float = 10.0,
-                 logger=None, metrics=None) -> None:
+                 username: str | None = None, password: str | None = None,
+                 page_size: int = 5000, logger=None, metrics=None) -> None:
         self.host = host
         self.port = port
         self.keyspace = keyspace
+        self.username = username
+        self.password = password
+        self.page_size = page_size
         self._timeout = timeout
         self._logger = logger
         self._metrics = metrics
@@ -198,6 +254,8 @@ class CassandraWire:
         self._stream = 0
         self._lock = asyncio.Lock()
         self._loop: Any = None  # loop owning the connection + lock
+        # per-connection prepared-statement cache: cql -> (id, bind specs)
+        self._prepared: dict[str, tuple[bytes, list]] = {}
 
     # -- provider contract -----------------------------------------------------
     def use_logger(self, logger) -> None:
@@ -247,37 +305,54 @@ class CassandraWire:
     async def _ensure(self) -> None:
         if self._writer is not None and not self._writer.is_closing():
             return
+        self._prepared.clear()  # prepared ids don't outlive the connection
         self._reader, self._writer = await asyncio.wait_for(
             asyncio.open_connection(self.host, self.port), self._timeout)
+        try:
+            await self._handshake()
+        except BaseException:
+            # never leave a half-handshaken socket installed: a retry would
+            # early-return above and send queries on an unauthenticated
+            # connection
+            self._writer.close()
+            self._reader = self._writer = None
+            raise
+
+    async def _handshake(self) -> None:
         await self._send_frame(_OP_STARTUP,
                                _string_map({"CQL_VERSION": "3.0.0"}))
         opcode, _ = await self._recv_frame()
         if opcode == _OP_AUTHENTICATE:
-            raise CassandraWireError(
-                "cluster requires SASL auth — use the injected-session "
-                "wrapper (datasource/cassandra.py) for authenticated clusters")
-        if opcode != _OP_READY:
+            # SASL PLAIN (PasswordAuthenticator): authzid NUL user NUL pass
+            if self.username is None:
+                raise CassandraWireError(
+                    "cluster requires authentication — pass username/password")
+            token = b"\x00" + self.username.encode() + b"\x00" \
+                + (self.password or "").encode()
+            await self._send_frame(_OP_AUTH_RESPONSE, _bytes_value(token))
+            opcode, _ = await self._recv_frame()
+            if opcode == _OP_AUTH_CHALLENGE:
+                raise CassandraWireError(
+                    "multi-step SASL mechanisms are not supported "
+                    "(PasswordAuthenticator completes in one round)")
+            if opcode != _OP_AUTH_SUCCESS:
+                raise CassandraWireError(
+                    f"authentication failed (opcode {opcode})")
+        elif opcode != _OP_READY:
             raise CassandraWireError(f"unexpected handshake opcode {opcode}")
         if self.keyspace:
             await self._query_raw(f'USE "{self.keyspace}"')
 
-    async def _query_raw(self, cql: str) -> list[dict]:
-        body = (_long_string(cql)
-                + struct.pack(">H", _CONSISTENCY_ONE)
-                + b"\x00")  # flags: no values, no paging
-        await self._send_frame(_OP_QUERY, body)
-        opcode, payload = await self._recv_frame()
-        if opcode != _OP_RESULT:
-            raise CassandraWireError(f"unexpected result opcode {opcode}")
+    def _parse_rows(self, payload: bytes) -> tuple[list[dict], bytes | None]:
+        """RESULT body -> (rows, paging_state or None)."""
         r = _Reader(payload)
         kind = r.int32()
         if kind != 2:                      # Void / SetKeyspace / SchemaChange
-            return []
+            return [], None
         flags = r.int32()
         n_cols = r.int32()
-        if flags & 0x0002:                 # has_more_pages: paging state
-            r.bytes_()
-        global_spec = bool(flags & 0x0001)
+        paging_state = r.bytes_() if flags & _ROWS_HAS_MORE else None
+        global_spec = bool(flags & _ROWS_GLOBAL_SPEC)
         if global_spec:
             r.string(); r.string()         # keyspace, table
         cols: list[tuple[str, int, Any]] = []
@@ -294,15 +369,111 @@ class CassandraWire:
             for name, tid, param in cols:
                 row[name] = _decode_cql(tid, param, r.bytes_())
             rows.append(row)
-        return rows
+        return rows, paging_state
+
+    def _query_params(self, values: list[bytes | None] | None,
+                      paging_state: bytes | None) -> bytes:
+        """<consistency><flags>[values][page_size][paging_state] (§4.1.4)."""
+        flags = _FLAG_PAGE_SIZE
+        if values is not None:
+            flags |= _FLAG_VALUES
+        if paging_state is not None:
+            flags |= _FLAG_PAGING_STATE
+        body = struct.pack(">HB", _CONSISTENCY_ONE, flags)
+        if values is not None:
+            body += struct.pack(">H", len(values))
+            for raw in values:
+                body += _bytes_value(raw)
+        body += struct.pack(">i", self.page_size)
+        if paging_state is not None:
+            body += _bytes_value(paging_state)
+        return body
+
+    async def _request_rows(self, opcode: int, prefix: bytes,
+                            values: list[bytes | None] | None) -> list[dict]:
+        """Send QUERY/EXECUTE and follow paging until exhausted."""
+        rows: list[dict] = []
+        paging_state = None
+        while True:
+            await self._send_frame(
+                opcode, prefix + self._query_params(values, paging_state))
+            op, payload = await self._recv_frame()
+            if op != _OP_RESULT:
+                raise CassandraWireError(f"unexpected result opcode {op}")
+            page, paging_state = self._parse_rows(payload)
+            rows.extend(page)
+            if paging_state is None:
+                return rows
+
+    async def _query_raw(self, cql: str) -> list[dict]:
+        return await self._request_rows(_OP_QUERY, _long_string(cql), None)
+
+    async def _prepare(self, cql: str) -> tuple[bytes, list]:
+        """PREPARE once per connection; returns (statement id, bind specs
+        [(name, tid, param)]) from the Prepared result's metadata."""
+        cached = self._prepared.get(cql)
+        if cached is not None:
+            return cached
+        await self._send_frame(_OP_PREPARE, _long_string(cql))
+        opcode, payload = await self._recv_frame()
+        if opcode != _OP_RESULT:
+            raise CassandraWireError(f"unexpected prepare opcode {opcode}")
+        r = _Reader(payload)
+        if r.int32() != 4:                 # kind = Prepared
+            raise CassandraWireError("PREPARE did not return a Prepared result")
+        stmt_id = r.take(r.uint16())
+        flags = r.int32()
+        n_cols = r.int32()
+        pk_count = r.int32()
+        for _ in range(pk_count):          # v4: partition-key bind indices
+            r.uint16()
+        global_spec = bool(flags & _ROWS_GLOBAL_SPEC)
+        if global_spec:
+            r.string(); r.string()
+        specs: list[tuple[str, int, Any]] = []
+        for _ in range(n_cols):
+            if not global_spec:
+                r.string(); r.string()
+            name = r.string()
+            tid, param = r.option()
+            specs.append((name, tid, param))
+        self._prepared[cql] = (stmt_id, specs)
+        return stmt_id, specs
+
+    def _bind(self, specs: list, params: Sequence) -> list[bytes | None]:
+        if len(specs) != len(params):
+            raise CassandraWireError(
+                f"statement has {len(specs)} bind markers, "
+                f"got {len(params)} params")
+        out = []
+        for (name, tid, tparam), value in zip(specs, params):
+            try:
+                out.append(_encode_cql(tid, tparam, value))
+            except CassandraWireError:
+                raise
+            except Exception as exc:  # int(object()) etc: typed bind error
+                raise CassandraWireError(
+                    f"cannot bind {type(value).__name__} to column "
+                    f"{name!r} (CQL type 0x{tid:04x}): {exc}") from exc
+        return out
+
+    async def _execute(self, cql: str, params: Sequence) -> list[dict]:
+        stmt_id, specs = await self._prepare(cql)
+        return await self._request_rows(
+            _OP_EXECUTE, _short_bytes(stmt_id), self._bind(specs, params))
 
     # -- public surface (parity with datasource/cassandra.py) ------------------
     async def query(self, stmt: str, params: Sequence | None = None) -> list:
+        """Parameterized statements are PREPAREd and EXECUTEd with values as
+        protocol-level [bytes] — user input never enters the CQL text."""
         start = time.perf_counter()
         self._adopt_loop()
         async with self._lock:
             await self._ensure()
-            rows = await self._query_raw(interpolate(stmt, params))
+            if params:
+                rows = await self._execute(stmt, params)
+            else:
+                rows = await self._query_raw(stmt)
         self._observe("query", start, stmt)
         return rows
 
@@ -311,19 +482,33 @@ class CassandraWire:
         self._adopt_loop()
         async with self._lock:
             await self._ensure()
-            await self._query_raw(interpolate(stmt, params))
+            if params:
+                await self._execute(stmt, params)
+            else:
+                await self._query_raw(stmt)
         self._observe("exec", start, stmt)
 
     async def batch_exec(self,
                          stmts: Sequence[tuple[str, Sequence | None]]) -> None:
-        # sequential under one lock hold: matches the wrapper's logged-batch
-        # semantics closely enough for unauthenticated simple statements
+        """LOGGED batch in one BATCH frame: every statement prepared, values
+        bound at protocol level (reference cassandra_batch.go role)."""
         start = time.perf_counter()
         self._adopt_loop()
         async with self._lock:
             await self._ensure()
+            body = struct.pack(">BH", 0, len(stmts))  # type LOGGED, count
             for stmt, params in stmts:
-                await self._query_raw(interpolate(stmt, params))
+                stmt_id, specs = await self._prepare(stmt)
+                values = self._bind(specs, params or [])
+                body += b"\x01" + _short_bytes(stmt_id)  # kind 1: by id
+                body += struct.pack(">H", len(values))
+                for raw in values:
+                    body += _bytes_value(raw)
+            body += struct.pack(">HB", _CONSISTENCY_ONE, 0)
+            await self._send_frame(_OP_BATCH, body)
+            opcode, _ = await self._recv_frame()
+            if opcode != _OP_RESULT:
+                raise CassandraWireError(f"unexpected batch opcode {opcode}")
         self._observe("batch", start, f"{len(stmts)} statements")
 
     def _observe(self, op: str, start: float, stmt: str) -> None:
@@ -352,6 +537,7 @@ class CassandraWire:
             return {"status": "DOWN", "details": {"error": str(exc)[:200]}}
 
     async def close(self) -> None:
+        self._prepared.clear()
         if self._writer is not None:
             self._writer.close()
             try:

@@ -135,12 +135,21 @@ class Generator:
             from ..parallel import NamedSharding
             from ..parallel import P as _P
 
-            kv_sh = NamedSharding(mesh, _P(None, "dp", "sp", None, None))
+            if getattr(cfg, "kv_quant", False):
+                # int8 layout (models/llama.init_cache): flat values
+                # [L, B, S, KV*D], seq-MINOR scales [L, B, KV, S]
+                specs = {"k": _P(None, "dp", "sp", None),
+                         "v": _P(None, "dp", "sp", None),
+                         "k_scale": _P(None, "dp", None, "sp"),
+                         "v_scale": _P(None, "dp", None, "sp"),
+                         "len": _P("dp")}
+            else:
+                specs = {"k": _P(None, "dp", "sp", None, None),
+                         "v": _P(None, "dp", "sp", None, None),
+                         "len": _P("dp")}
             self.cache = {
-                "k": jax.device_put(self.cache["k"], kv_sh),
-                "v": jax.device_put(self.cache["v"], kv_sh),
-                "len": jax.device_put(self.cache["len"],
-                                      NamedSharding(mesh, _P("dp"))),
+                key: jax.device_put(arr, NamedSharding(mesh, specs[key]))
+                for key, arr in self.cache.items()
             }
         self.slots = [_Slot() for _ in range(batch_slots)]
         # two independent streams: decode keys fold the step counter,

@@ -82,10 +82,9 @@ class LlamaConfig:
             raise ValueError(f"unknown attn_impl {attn_impl!r}")
         self.attn_impl = attn_impl
         # int8 KV cache (ops.quantize_kv): halves decode's KV HBM traffic —
-        # the serving roofline at large slot counts. Not combined with
-        # sequence-parallel decode (the sp combine reads fp shards).
-        if kv_quant and self.sequence_parallel:
-            raise ValueError("kv_quant is not supported with ring/ulysses")
+        # the serving roofline at large slot counts. Composes with
+        # sequence-parallel decode: each sp shard dequantizes its own
+        # int8 slice before the pmax/psum combine (parallel/ring.py).
         self.kv_quant = kv_quant
 
     @property
@@ -274,10 +273,17 @@ def _decode_layer(cfg: LlamaConfig, x, lp, cos, sin, arrays, layer,
             "v_scale": arrays["v_scale"].at[
                 layer, rows[:, None], kv_idx, pos[:, None]].set(v_sc),
         }
-        o = cached_decode_attention(
-            q, arrays["k"], arrays["v"], pos + 1, layer=layer,
-            use_kernel=cfg.use_flash,
-            k_scale=arrays["k_scale"], v_scale=arrays["v_scale"])
+        if cfg.sequence_parallel and mesh is not None:
+            from ..parallel.ring import sp_decode_attention
+
+            o = sp_decode_attention(
+                q, arrays["k"], arrays["v"], pos + 1, mesh, layer=layer,
+                k_scale=arrays["k_scale"], v_scale=arrays["v_scale"])
+        else:
+            o = cached_decode_attention(
+                q, arrays["k"], arrays["v"], pos + 1, layer=layer,
+                use_kernel=cfg.use_flash,
+                k_scale=arrays["k_scale"], v_scale=arrays["v_scale"])
     else:
         arrays = {
             "k": arrays["k"].at[layer, rows, pos].set(k[:, 0]),
@@ -336,12 +342,6 @@ def init_cache(cfg: LlamaConfig, batch: int, max_seq: int | None = None) -> dict
     S = max_seq or cfg.max_seq_len
     shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_dim)
     if cfg.kv_quant:
-        # re-check at the point of use: the constructor guard can be
-        # bypassed by post-hoc attribute assignment (cfg.kv_quant = True),
-        # and the quantized decode branch skips sp attention entirely —
-        # silently attending over one shard's keys
-        if cfg.sequence_parallel:
-            raise ValueError("kv_quant is not supported with ring/ulysses")
         # int8 values are stored FLAT, [L, B, S, KV*D]: int8's VMEM tile is
         # (32, 128), so a [block_s, KV, D] slab with KV=8 sublanes pads 4x
         # (which made int8 SLOWER than bf16); the flat [block_s, KV*D] slab

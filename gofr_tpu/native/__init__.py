@@ -46,9 +46,27 @@ def build_and_load(source_name: str, lib_stem: str, extra_flags: tuple = ()):
                 os.replace(so_path + ".tmp", so_path)  # atomic publish
             except (OSError, subprocess.SubprocessError):
                 return None
+            _sweep_stale(pkg_dir, lib_stem, keep=so_path)
         try:
             lib = ctypes.CDLL(so_path)
         except OSError:
             return None
         _LIBS[so_path] = lib
         return lib
+
+
+def _sweep_stale(pkg_dir: str, lib_stem: str, *, keep: str) -> None:
+    """Remove superseded hash-suffixed builds of ``lib_stem`` — each source
+    edit mints a new digest, and without this the package directory
+    accumulates one dead .so per edit. Only called right after a fresh
+    build, so anything else with the stem is stale by definition (never
+    loaded into this process: _LIBS is keyed by exact path)."""
+    prefix = f"{lib_stem}-"
+    for name in os.listdir(pkg_dir):
+        path = os.path.join(pkg_dir, name)
+        if (name.startswith(prefix) and name.endswith(".so")
+                and path != keep):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass  # parallel test runner may have swept it already

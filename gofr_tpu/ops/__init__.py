@@ -251,21 +251,50 @@ def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_diff(q, k, v, kv_len, causal, q_offset, block_q, block_k):
+    """Differentiable wrapper over the Pallas kernel: the kernel has no JVP
+    rule (pallas_call + program_id cannot be traced by autodiff), so the
+    backward pass recomputes attention with the XLA reference path and
+    takes ITS vjp — flash forward speed, standard-attention backward. The
+    logits matrix does materialize during backward; training long
+    sequences pairs this with LlamaConfig(remat=True)."""
+    from .flash_attention import flash_attention_tpu
+
+    return flash_attention_tpu(q, k, v, kv_len, causal=causal,
+                               q_offset=q_offset, block_q=block_q,
+                               block_k=block_k)
+
+
+def _flash_diff_fwd(q, k, v, kv_len, causal, q_offset, block_q, block_k):
+    out = _flash_diff(q, k, v, kv_len, causal, q_offset, block_q, block_k)
+    return out, (q, k, v, kv_len)
+
+
+def _flash_diff_bwd(causal, q_offset, block_q, block_k, res, g):
+    q, k, v, kv_len = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: attention(q, k, v, causal=causal, q_offset=q_offset,
+                                  kv_len=kv_len), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
 def flash_attention(q, k, v, *, causal: bool = True, q_offset=0, kv_len=None,
                     block_q: int = 256, block_k: int = 256):
     """Fused attention: Pallas kernel on TPU, reference path elsewhere.
 
     The kernel (ops/flash_attention.py) streams K/V blocks through VMEM with
     an online softmax so the [Tq, Tk] logits matrix never materializes in
-    HBM — the standard memory-bound win for long sequences.
+    HBM — the standard memory-bound win for long sequences. Differentiable
+    (training uses it too): see _flash_diff for the backward story.
     """
     tq, tk = q.shape[1], k.shape[1]
     bq, bk = min(block_q, tq), min(block_k, tk)
     if _on_tpu() and tq >= 128 and tq % bq == 0 and tk % bk == 0:
-        from .flash_attention import flash_attention_tpu
-
-        return flash_attention_tpu(
-            q, k, v, kv_len, causal=causal, q_offset=q_offset,
-            block_q=block_q, block_k=block_k,
-        )
+        return _flash_diff(q, k, v, kv_len, causal, q_offset, block_q,
+                           block_k)
     return attention(q, k, v, causal=causal, q_offset=q_offset, kv_len=kv_len)

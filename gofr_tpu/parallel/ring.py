@@ -106,7 +106,7 @@ def ring_attention(q, k, v, mesh, kv_len=None, *, causal: bool = True,
 # -- sequence-parallel decode -------------------------------------------------
 
 def _sp_decode_local(q, k_cache, v_cache, kv_len, layer, *, axis_name: str,
-                     n_rep: int):
+                     n_rep: int, k_scale=None, v_scale=None):
     """Per-shard decode-attention body: this device holds a [.., S/sp, ..]
     slice of the KV cache; q (one token per row) is replicated along sp.
 
@@ -115,13 +115,34 @@ def _sp_decode_local(q, k_cache, v_cache, kv_len, layer, *, axis_name: str,
     row max) and two ``psum``s (rescaled numerator and denominator) — the
     decode-time analogue of ring attention, except a single query needs no
     rotation: the combine is one collective round over ICI.
+
+    int8 caches (``k_scale``/``v_scale`` given) arrive FLAT
+    [.., S_loc, KV*D] with seq-minor [.., KV, S_loc] scales
+    (models/llama.init_cache layout); each shard dequantizes only its own
+    slice — the fp cache never exists anywhere, so kv_quant's HBM saving
+    composes with the sp sharding instead of fighting it.
     """
     idx = jax.lax.axis_index(axis_name)
-    if k_cache.ndim == 5:  # stacked [L, B, S_loc, KV, D], traced layer index
-        k_cache = jax.lax.dynamic_index_in_dim(k_cache, layer, 0,
-                                               keepdims=False)
-        v_cache = jax.lax.dynamic_index_in_dim(v_cache, layer, 0,
-                                               keepdims=False)
+    quantized = k_scale is not None
+    if k_cache.ndim == (4 if quantized else 5):
+        # stacked [L, ...] caches with a traced layer index
+        take = lambda a: jax.lax.dynamic_index_in_dim(a, layer, 0,
+                                                      keepdims=False)
+        k_cache, v_cache = take(k_cache), take(v_cache)
+        if quantized:
+            k_scale, v_scale = take(k_scale), take(v_scale)
+    if quantized:
+        from ..ops import dequantize_kv
+
+        b, s_loc, _ = k_cache.shape
+        kv = k_scale.shape[1]
+        unflat = lambda a: a.reshape(b, s_loc, kv, -1)
+        # [B, KV, S_loc] scales -> [B, S_loc, KV] to align with values;
+        # XLA fuses the dequant into the attention einsum below
+        k_cache = dequantize_kv(unflat(k_cache),
+                                k_scale.transpose(0, 2, 1), q.dtype)
+        v_cache = dequantize_kv(unflat(v_cache),
+                                v_scale.transpose(0, 2, 1), q.dtype)
     b, s_loc, kv, d = k_cache.shape
     scale = d ** -0.5
     qg = (q.reshape(b, kv, n_rep, d).astype(jnp.float32) * scale)
@@ -142,21 +163,51 @@ def _sp_decode_local(q, k_cache, v_cache, kv_len, layer, *, axis_name: str,
 
 
 def sp_decode_attention(q, k_cache, v_cache, kv_len, mesh, *, layer=None,
-                        batch_axis: str = "dp", seq_axis: str = "sp"):
+                        batch_axis: str = "dp", seq_axis: str = "sp",
+                        k_scale=None, v_scale=None):
     """Decode attention over a KV cache whose sequence axis is sharded along
     ``sp`` (stacked [L, B, S, KV, D] cache with traced ``layer``, or
     per-layer [B, S, KV, D]). q: [B, 1, H, D] grouped-query token; returns
     [B, 1, H, D], replicated along sp.
 
+    int8 caches pass ``k_scale``/``v_scale``: values are flat
+    [L?, B, S, KV*D] (S still the sp axis), scales [L?, B, KV, S] shard
+    along their seq-minor last axis.
+
     This is what lets the Generator serve contexts longer than one chip's
     HBM: the cache rides P(None, dp, sp, None, None) and each decode step
     pays one pmax+psum round instead of an all-gather of the cache.
     """
-    stacked = k_cache.ndim == 5
-    n_rep = q.shape[2] // k_cache.shape[3 if stacked else 2]
-    cache_spec = (P(None, batch_axis, seq_axis, None, None) if stacked
-                  else P(batch_axis, seq_axis, None, None))
+    quantized = k_scale is not None
+    stacked = k_cache.ndim == (4 if quantized else 5)
+    if quantized:
+        kv_heads = k_scale.shape[2 if stacked else 1]
+        cache_spec = (P(None, batch_axis, seq_axis, None) if stacked
+                      else P(batch_axis, seq_axis, None))
+        scale_spec = (P(None, batch_axis, None, seq_axis) if stacked
+                      else P(batch_axis, None, seq_axis))
+    else:
+        kv_heads = k_cache.shape[3 if stacked else 2]
+        cache_spec = (P(None, batch_axis, seq_axis, None, None) if stacked
+                      else P(batch_axis, seq_axis, None, None))
+        scale_spec = None
+    n_rep = q.shape[2] // kv_heads
     q_spec = P(batch_axis, None, None, None)
+    layer_arr = jnp.asarray(0 if layer is None else layer, jnp.int32)
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+
+    if quantized:
+        def fn(q, k, v, kv_len, layer, k_sc, v_sc):
+            return _sp_decode_local(q, k, v, kv_len, layer,
+                                    axis_name=seq_axis, n_rep=n_rep,
+                                    k_scale=k_sc, v_scale=v_sc)
+
+        return jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(q_spec, cache_spec, cache_spec, P(batch_axis), P(),
+                      scale_spec, scale_spec),
+            out_specs=q_spec, check_vma=False,
+        )(q, k_cache, v_cache, kv_len, layer_arr, k_scale, v_scale)
 
     def fn(q, k, v, kv_len, layer):
         return _sp_decode_local(q, k, v, kv_len, layer, axis_name=seq_axis,
@@ -166,5 +217,4 @@ def sp_decode_attention(q, k_cache, v_cache, kv_len, mesh, *, layer=None,
         fn, mesh=mesh,
         in_specs=(q_spec, cache_spec, cache_spec, P(batch_axis), P()),
         out_specs=q_spec, check_vma=False,
-    )(q, k_cache, v_cache, jnp.asarray(kv_len, jnp.int32),
-      jnp.asarray(0 if layer is None else layer, jnp.int32))
+    )(q, k_cache, v_cache, kv_len, layer_arr)

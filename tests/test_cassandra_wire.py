@@ -1,6 +1,7 @@
 """Native Cassandra v4 driver against an in-process fake speaking the real
-binary protocol: 9-byte frames, STARTUP/READY handshake, QUERY frames with
-long-string CQL, and typed Rows RESULT bodies."""
+binary protocol: 9-byte frames, STARTUP/READY (or AUTHENTICATE/SASL)
+handshake, QUERY/PREPARE/EXECUTE/BATCH frames, typed Rows RESULT bodies,
+and multi-page results via paging state."""
 
 import asyncio
 import datetime as dt
@@ -10,11 +11,12 @@ import uuid
 import pytest
 
 from gofr_tpu.datasource.cassandra_wire import (CassandraWire,
-                                                CassandraWireError,
-                                                interpolate, quote_value)
+                                                CassandraWireError)
 from gofr_tpu.testutil import get_free_port
 
-_OP_STARTUP, _OP_READY, _OP_QUERY, _OP_RESULT, _OP_ERROR = 1, 2, 7, 8, 0
+_OP_ERROR, _OP_STARTUP, _OP_READY, _OP_AUTHENTICATE = 0, 1, 2, 3
+_OP_QUERY, _OP_RESULT, _OP_PREPARE, _OP_EXECUTE, _OP_BATCH = 7, 8, 9, 10, 13
+_OP_AUTH_RESPONSE, _OP_AUTH_SUCCESS = 15, 16
 
 
 def _string(s: str) -> bytes:
@@ -28,11 +30,14 @@ def _bytes(b: bytes | None) -> bytes:
     return struct.pack(">i", len(b)) + b
 
 
-def rows_result(cols, rows) -> bytes:
+def rows_result(cols, rows, paging_state: bytes | None = None) -> bytes:
     """cols: [(name, type_id)]; rows: list of lists of raw bytes|None."""
+    flags = 0x0001 | (0x0002 if paging_state is not None else 0)
     out = struct.pack(">i", 2)                     # kind = Rows
-    out += struct.pack(">i", 0x0001)               # flags: global tables spec
+    out += struct.pack(">i", flags)
     out += struct.pack(">i", len(cols))
+    if paging_state is not None:
+        out += _bytes(paging_state)
     out += _string("ks") + _string("tbl")
     for name, tid in cols:
         out += _string(name) + struct.pack(">H", tid)
@@ -43,10 +48,67 @@ def rows_result(cols, rows) -> bytes:
     return out
 
 
+def prepared_result(stmt_id: bytes, bind_cols) -> bytes:
+    """kind=Prepared: id + bind metadata [(name, tid)] + empty result meta."""
+    out = struct.pack(">i", 4)
+    out += struct.pack(">H", len(stmt_id)) + stmt_id
+    out += struct.pack(">i", 0x0001)               # flags: global tables spec
+    out += struct.pack(">i", len(bind_cols))
+    out += struct.pack(">i", 0)                    # pk_count (v4)
+    out += _string("ks") + _string("tbl")
+    for name, tid in bind_cols:
+        out += _string(name) + struct.pack(">H", tid)
+    # result metadata: no flags, 0 columns
+    out += struct.pack(">i", 0) + struct.pack(">i", 0)
+    return out
+
+
+def _parse_query_params(body: bytes, off: int):
+    """<consistency><flags>[values][page_size][paging_state]"""
+    consistency, flags = struct.unpack_from(">HB", body, off)
+    off += 3
+    values = None
+    if flags & 0x01:
+        n = struct.unpack_from(">H", body, off)[0]
+        off += 2
+        values = []
+        for _ in range(n):
+            ln = struct.unpack_from(">i", body, off)[0]
+            off += 4
+            if ln < 0:
+                values.append(None)
+            else:
+                values.append(body[off:off + ln])
+                off += ln
+    page_size = None
+    if flags & 0x04:
+        page_size = struct.unpack_from(">i", body, off)[0]
+        off += 4
+    paging_state = None
+    if flags & 0x08:
+        ln = struct.unpack_from(">i", body, off)[0]
+        off += 4
+        paging_state = body[off:off + ln]
+        off += ln
+    return consistency, values, page_size, paging_state
+
+
 class FakeCassandra:
-    def __init__(self):
+    """Speaks enough CQL v4 to exercise the client: configurable auth,
+    prepared statements with typed bind metadata, paged results."""
+
+    def __init__(self, *, auth: tuple[str, str] | None = None):
         self.queries: list[str] = []
+        self.prepares: list[str] = []
+        self.executes: list[tuple[bytes, list]] = []  # (stmt_id, values)
+        self.batches: list[list[tuple[bytes, list]]] = []
+        self.auth_tokens: list[bytes] = []
         self.result_body = struct.pack(">i", 1)    # Void by default
+        # cql -> (stmt_id, [(name, tid)]) the fake will hand out on PREPARE
+        self.preparable: dict[str, tuple[bytes, list]] = {}
+        # paging_state (or None for page 0) -> rows_result body
+        self.pages: dict[bytes | None, bytes] = {}
+        self.auth = auth
         self.port = get_free_port()
         self._server = None
 
@@ -61,6 +123,11 @@ class FakeCassandra:
         except (TimeoutError, asyncio.TimeoutError):
             pass
 
+    def _result_for(self, paging_state):
+        if self.pages:
+            return self.pages[paging_state]
+        return self.result_body
+
     async def _serve(self, reader, writer):
         try:
             while True:
@@ -71,18 +138,74 @@ class FakeCassandra:
                 body = await reader.readexactly(length) if length else b""
 
                 if opcode == _OP_STARTUP:
-                    reply_op, reply = _OP_READY, b""
+                    if self.auth is not None:
+                        reply_op = _OP_AUTHENTICATE
+                        reply = _string(
+                            "org.apache.cassandra.auth.PasswordAuthenticator")
+                    else:
+                        reply_op, reply = _OP_READY, b""
+                elif opcode == _OP_AUTH_RESPONSE:
+                    n = struct.unpack(">i", body[:4])[0]
+                    token = body[4:4 + n]
+                    self.auth_tokens.append(token)
+                    user, pw = self.auth
+                    if token == b"\x00" + user.encode() + b"\x00" + pw.encode():
+                        reply_op, reply = _OP_AUTH_SUCCESS, _bytes(None)
+                    else:
+                        reply_op = _OP_ERROR
+                        reply = struct.pack(">i", 0x0100) + _string("bad creds")
                 elif opcode == _OP_QUERY:
                     n = struct.unpack(">i", body[:4])[0]
                     cql = body[4:4 + n].decode()
-                    consistency = struct.unpack(">H", body[4 + n:6 + n])[0]
-                    assert consistency == 0x0001
+                    _, values, page_size, paging_state = _parse_query_params(
+                        body, 4 + n)
+                    assert values is None, "simple QUERY must not carry values"
+                    assert page_size is not None, "client must request paging"
                     self.queries.append(cql)
                     if cql.startswith("SYNTAX"):
                         reply_op = _OP_ERROR
                         reply = struct.pack(">i", 0x2000) + _string("bad query")
                     else:
-                        reply_op, reply = _OP_RESULT, self.result_body
+                        reply_op = _OP_RESULT
+                        reply = self._result_for(paging_state)
+                elif opcode == _OP_PREPARE:
+                    n = struct.unpack(">i", body[:4])[0]
+                    cql = body[4:4 + n].decode()
+                    self.prepares.append(cql)
+                    stmt_id, bind_cols = self.preparable[cql]
+                    reply_op = _OP_RESULT
+                    reply = prepared_result(stmt_id, bind_cols)
+                elif opcode == _OP_EXECUTE:
+                    n = struct.unpack(">H", body[:2])[0]
+                    stmt_id = body[2:2 + n]
+                    _, values, page_size, paging_state = _parse_query_params(
+                        body, 2 + n)
+                    assert page_size is not None
+                    self.executes.append((stmt_id, values))
+                    reply_op = _OP_RESULT
+                    reply = self._result_for(paging_state)
+                elif opcode == _OP_BATCH:
+                    btype, count = struct.unpack(">BH", body[:3])
+                    assert btype == 0  # LOGGED
+                    off = 3
+                    items = []
+                    for _ in range(count):
+                        kind = body[off]; off += 1
+                        assert kind == 1  # prepared id
+                        n = struct.unpack_from(">H", body, off)[0]; off += 2
+                        stmt_id = body[off:off + n]; off += n
+                        nvals = struct.unpack_from(">H", body, off)[0]; off += 2
+                        vals = []
+                        for _ in range(nvals):
+                            ln = struct.unpack_from(">i", body, off)[0]
+                            off += 4
+                            if ln < 0:
+                                vals.append(None)
+                            else:
+                                vals.append(body[off:off + ln]); off += ln
+                        items.append((stmt_id, vals))
+                    self.batches.append(items)
+                    reply_op, reply = _OP_RESULT, struct.pack(">i", 1)
                 else:
                     raise AssertionError(f"unexpected opcode {opcode}")
                 writer.write(struct.pack(">BBhBi", 0x84, 0, stream, reply_op,
@@ -101,31 +224,30 @@ async def _pair(keyspace=None):
     return fake, db
 
 
-# ----------------------------------------------------------------- pure logic
-def test_quote_and_interpolate():
-    assert quote_value(None) == "NULL"
-    assert quote_value(True) == "true"
-    assert quote_value(7) == "7"
-    assert quote_value("o'neil") == "'o''neil'"
-    assert quote_value(b"\x01\xff") == "0x01ff"
-    u = uuid.uuid4()
-    assert quote_value(u) == str(u)
-    assert interpolate("SELECT * FROM t WHERE a = ? AND b = ?", [1, "x"]) \
-        == "SELECT * FROM t WHERE a = 1 AND b = 'x'"
-    with pytest.raises(CassandraWireError):
-        interpolate("SELECT ?", [1, 2])
-
-
 # ------------------------------------------------------------------- protocol
-def test_handshake_use_keyspace_and_exec(run):
+def test_handshake_use_keyspace_and_prepared_exec(run):
+    """Parameterized exec rides PREPARE + EXECUTE: values travel as typed
+    protocol [bytes] (int32, varchar), never inside the CQL text —
+    reference parity with gocql bound statements (cassandra.go)."""
+
     async def scenario():
         fake, db = await _pair(keyspace="app")
+        stmt = "INSERT INTO users (id, name) VALUES (?, ?)"
+        fake.preparable[stmt] = (b"\x11\x22",
+                                 [("id", 0x0009), ("name", 0x000D)])
         try:
-            await db.exec("INSERT INTO users (id, name) VALUES (?, ?)",
-                          [1, "ada"])
-            assert fake.queries[0] == 'USE "app"'
-            assert fake.queries[1] == \
-                "INSERT INTO users (id, name) VALUES (1, 'ada')"
+            await db.exec(stmt, [1, "o'neil; DROP TABLE users"])
+            assert fake.queries == ['USE "app"']   # CQL text never varies
+            assert fake.prepares == [stmt]
+            stmt_id, values = fake.executes[0]
+            assert stmt_id == b"\x11\x22"
+            assert values == [struct.pack(">i", 1),
+                              b"o'neil; DROP TABLE users"]
+
+            # second exec reuses the cached prepared id — no new PREPARE
+            await db.exec(stmt, [2, "bob"])
+            assert fake.prepares == [stmt]
+            assert fake.executes[1][1][0] == struct.pack(">i", 2)
         finally:
             await db.close()
             await fake.stop()
@@ -186,19 +308,137 @@ def test_collection_types_decode(run):
 def test_server_error_and_batch(run):
     async def scenario():
         fake, db = await _pair()
+        fake.preparable["INSERT A (x) VALUES (?)"] = (
+            b"\xaa", [("x", 0x000D)])
+        fake.preparable["INSERT B (n) VALUES (?)"] = (
+            b"\xbb", [("n", 0x0002)])
         try:
             try:
                 await db.query("SYNTAX ERROR HERE")
                 raise AssertionError("expected CassandraWireError")
             except CassandraWireError as exc:
                 assert "bad query" in str(exc)
-            await db.batch_exec([("INSERT 1", None), ("INSERT ?", ["x"])])
-            assert fake.queries[-2:] == ["INSERT 1", "INSERT 'x'"]
+            await db.batch_exec([("INSERT A (x) VALUES (?)", ["x"]),
+                                 ("INSERT B (n) VALUES (?)", [7])])
+            # one LOGGED BATCH frame, both statements by prepared id
+            assert fake.batches == [[(b"\xaa", [b"x"]),
+                                     (b"\xbb", [struct.pack(">q", 7)])]]
         finally:
             await db.close()
             await fake.stop()
 
     run(scenario())
+
+
+def test_result_paging(run):
+    """A result larger than page_size is fetched page by page via paging
+    state until has_more_pages clears (reference: gocql PageSize/Iter)."""
+
+    async def scenario():
+        fake, db = await _pair()
+        cols = [("n", 0x0009)]
+        mk = lambda lo, hi: [[struct.pack(">i", i)] for i in range(lo, hi)]
+        fake.pages = {
+            None: rows_result(cols, mk(0, 3), paging_state=b"PG1"),
+            b"PG1": rows_result(cols, mk(3, 6), paging_state=b"PG2"),
+            b"PG2": rows_result(cols, mk(6, 8)),
+        }
+        try:
+            rows = await db.query("SELECT n FROM t")
+            assert [r["n"] for r in rows] == list(range(8))
+            # three page fetches of the same statement
+            assert fake.queries == ["SELECT n FROM t"] * 3
+        finally:
+            await db.close()
+            await fake.stop()
+
+    run(scenario())
+
+
+def test_execute_paging(run):
+    async def scenario():
+        fake, db = await _pair()
+        stmt = "SELECT n FROM t WHERE k = ?"
+        fake.preparable[stmt] = (b"\x77", [("k", 0x0009)])
+        cols = [("n", 0x0009)]
+        mk = lambda lo, hi: [[struct.pack(">i", i)] for i in range(lo, hi)]
+        fake.pages = {
+            None: rows_result(cols, mk(0, 2), paging_state=b"S"),
+            b"S": rows_result(cols, mk(2, 4)),
+        }
+        try:
+            rows = await db.query(stmt, [5])
+            assert [r["n"] for r in rows] == [0, 1, 2, 3]
+            assert len(fake.executes) == 2  # page 0 + page 1, same id
+            assert fake.executes[0][0] == fake.executes[1][0] == b"\x77"
+        finally:
+            await db.close()
+            await fake.stop()
+
+    run(scenario())
+
+
+def test_password_authenticator(run):
+    """AUTHENTICATE -> AUTH_RESPONSE (SASL PLAIN) -> AUTH_SUCCESS; wrong
+    or missing credentials surface as clear errors (reference:
+    gocql PasswordAuthenticator)."""
+
+    async def scenario():
+        fake = FakeCassandra(auth=("app", "s3cret"))
+        await fake.start()
+        ok = CassandraWire(host="127.0.0.1", port=fake.port,
+                           username="app", password="s3cret")
+        try:
+            await ok.exec("CREATE TABLE t (x int PRIMARY KEY)")
+            assert fake.auth_tokens == [b"\x00app\x00s3cret"]
+            assert fake.queries == ["CREATE TABLE t (x int PRIMARY KEY)"]
+        finally:
+            await ok.close()
+
+        bad = CassandraWire(host="127.0.0.1", port=fake.port,
+                            username="app", password="wrong")
+        with pytest.raises(CassandraWireError, match="bad creds"):
+            await bad.exec("SELECT 1")
+        await bad.close()
+
+        anon = CassandraWire(host="127.0.0.1", port=fake.port)
+        with pytest.raises(CassandraWireError, match="username"):
+            await anon.exec("SELECT 1")
+        # the half-handshaken socket must NOT be reused: a retry on the
+        # same instance re-fails cleanly instead of silently querying the
+        # unauthenticated connection
+        n_queries = len(fake.queries)
+        with pytest.raises(CassandraWireError, match="username"):
+            await anon.exec("SELECT 1")
+        assert len(fake.queries) == n_queries
+        await anon.close()
+        await fake.stop()
+
+    run(scenario())
+
+
+def test_encode_cql_types():
+    from gofr_tpu.datasource.cassandra_wire import _encode_cql
+
+    assert _encode_cql(0x0009, None, 7) == struct.pack(">i", 7)
+    assert _encode_cql(0x0002, None, 2**40) == struct.pack(">q", 2**40)
+    assert _encode_cql(0x000D, None, "hi") == b"hi"
+    assert _encode_cql(0x0004, None, True) == b"\x01"
+    assert _encode_cql(0x0007, None, 2.5) == struct.pack(">d", 2.5)
+    assert _encode_cql(0x0009, None, None) is None
+    u = uuid.uuid4()
+    assert _encode_cql(0x000C, None, u) == u.bytes
+    when = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+    ms = int(when.timestamp() * 1000)
+    assert _encode_cql(0x000B, None, when) == struct.pack(">q", ms)
+    # list<int>
+    enc = _encode_cql(0x0020, (0x0009, None), [1, 2])
+    assert enc == (struct.pack(">i", 2)
+                   + struct.pack(">i", 4) + struct.pack(">i", 1)
+                   + struct.pack(">i", 4) + struct.pack(">i", 2))
+    assert _encode_cql(0x000E, None, -1) == b"\xff"
+    with pytest.raises((CassandraWireError, TypeError)):
+        _encode_cql(0x0009, None, object())
 
 
 def test_health_check(run):

@@ -79,9 +79,14 @@ def test_generator_end_to_end_with_kv_quant():
     assert all(0 <= t < cfg.vocab_size for t in out)
 
 
-def test_kv_quant_rejected_with_sequence_parallel():
-    with pytest.raises(ValueError):
-        llama.tiny_llama(attn_impl="ring", kv_quant=True)
+def test_kv_quant_composes_with_sequence_parallel():
+    """r2 VERDICT #4: int8 cache + ring/ulysses must compose (the e2e
+    equivalence lives in test_long_context_serving)."""
+    cfg = llama.tiny_llama(attn_impl="ring", kv_quant=True)
+    assert cfg.kv_quant and cfg.sequence_parallel
+    cache = llama.init_cache(cfg, batch=2, max_seq=32)
+    assert cache["k"].dtype.name == "int8"
+    assert "k_scale" in cache
 
 
 def test_decode_kernel_quantized_interpret():

@@ -113,3 +113,81 @@ def test_forward_with_ring_matches_dense(setup):
         )(params, jnp.asarray(toks), jnp.asarray(lens))
     np.testing.assert_allclose(np.asarray(got)[:, :27], np.asarray(want)[:, :27],
                                atol=2e-4, rtol=2e-4)
+
+
+# ----------------------------------------------- int8 cache x sequence parallel
+
+def test_sp_decode_attention_quantized_matches_fp():
+    """kv_quant composes with sp: each shard dequantizes its own int8
+    slice before the pmax/psum combine; result matches dense fp attention
+    within int8 tolerance (r2 VERDICT #4: the two long-context flagship
+    features must not be mutually exclusive)."""
+    from gofr_tpu.ops import gqa_decode_attention, quantize_kv
+    from gofr_tpu.parallel.ring import sp_decode_attention
+
+    mesh = _mesh_sp2()
+    rng = np.random.default_rng(7)
+    B, S, KV, R, D, L = 2, 32, 2, 3, 8, 2
+    H = KV * R
+    q = rng.normal(size=(B, 1, H, D)).astype(np.float32)
+    k = rng.normal(size=(L, B, S, KV, D)).astype(np.float32)
+    v = rng.normal(size=(L, B, S, KV, D)).astype(np.float32)
+    lens = np.array([7, 29], np.int32)
+
+    kq, k_sc = quantize_kv(jnp.asarray(k))
+    vq, v_sc = quantize_kv(jnp.asarray(v))
+    # init_cache layout: flat [L, B, S, KV*D] values, [L, B, KV, S] scales
+    flat = lambda a: a.reshape(L, B, S, KV * D)
+    seq_minor = lambda s: s.transpose(0, 1, 3, 2)
+
+    for layer in (0, 1):
+        want = gqa_decode_attention(jnp.asarray(q), jnp.asarray(k[layer]),
+                                    jnp.asarray(v[layer]),
+                                    kv_len=jnp.asarray(lens))
+        got = sp_decode_attention(
+            jnp.asarray(q), flat(kq), flat(vq), jnp.asarray(lens), mesh,
+            layer=jnp.int32(layer),
+            k_scale=seq_minor(k_sc), v_scale=seq_minor(v_sc))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=0.05, rtol=0.05)
+
+
+def test_sp_generator_kv_quant_matches_unsharded_quant(setup):
+    """End-to-end: a ring-sp Generator with the int8 cache produces the
+    same tokens as the unsharded int8 path — and decodes far enough
+    (10 prompt + 30 new > 32 = S/sp) to cross the shard boundary, so late
+    tokens attend over keys living on BOTH sp shards (r2 VERDICT weak #8:
+    the 4-token dryrun never left shard 0)."""
+    cfg, params, prompt = setup
+    want = _generate(_cfg(kv_quant=True), params, prompt, n=30)
+
+    sp_cfg = _cfg(attn_impl="ring", kv_quant=True)
+    got = _generate(sp_cfg, params, prompt, mesh=_mesh_sp2(), n=30)
+    assert got == want
+    assert len(got) == 30
+
+
+def test_sp_generator_fp_long_decode_crosses_shard_boundary(setup):
+    """fp sp decode also crosses the 32-position shard boundary."""
+    cfg, params, prompt = setup
+    want = _generate(cfg, params, prompt, n=30)
+    got = _generate(_cfg(attn_impl="ring"), params, prompt,
+                    mesh=_mesh_sp2(), n=30)
+    assert got == want
+
+
+def test_sp_quantized_cache_shardings(setup):
+    """int8 sp cache: flat values shard S (axis 2), seq-minor scales
+    shard S (axis 3)."""
+    cfg, params, prompt = setup
+    mesh = _mesh_sp2()
+    gen = Generator(params, _cfg(attn_impl="ring", kv_quant=True),
+                    batch_slots=2, max_seq=64, prefill_buckets=(16,),
+                    mesh=mesh, chunk=2)
+    assert tuple(gen.cache["k"].sharding.spec) == (None, "dp", "sp", None)
+    assert tuple(gen.cache["k_scale"].sharding.spec) == (None, "dp", None, "sp")
+    gen.add_request(prompt, max_new_tokens=8)
+    gen.step()
+    gen.drain()
+    assert tuple(gen.cache["k"].sharding.spec)[2] == "sp"
+    assert tuple(gen.cache["k_scale"].sharding.spec)[3] == "sp"

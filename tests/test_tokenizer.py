@@ -60,3 +60,37 @@ def test_empty_and_edge_cases(tok):
     assert tok.decode([]) == ""
     one = tok.encode("a")
     assert len(one) == 1
+
+
+def test_stale_native_builds_swept():
+    """Rebuilding a native lib (new source digest) removes superseded
+    hash-suffixed .so files for the same stem — the package dir must hold
+    at most one binary per target (r2 hygiene finding)."""
+    import os
+    import shutil
+
+    import gofr_tpu.native as native
+
+    pkg_dir = os.path.dirname(os.path.abspath(native.__file__))
+    src = os.path.join(pkg_dir, "_test_sweep.cpp")
+    shutil.copyfile(os.path.join(pkg_dir, "bpe.cpp"), src)
+    stale = os.path.join(pkg_dir, "libgofrsweeptest-00stale00.so")
+    # a different stem sharing the prefix must NOT be swept
+    other = os.path.join(pkg_dir, "libgofrsweeptest_other-11keep11.so")
+    try:
+        for p in (stale, other):
+            with open(p, "wb") as f:
+                f.write(b"stale")
+        lib = native.build_and_load("_test_sweep.cpp", "libgofrsweeptest")
+        assert lib is not None, "g++ build failed — toolchain is baked in"
+        remaining = [n for n in os.listdir(pkg_dir)
+                     if n.startswith("libgofrsweeptest-") and n.endswith(".so")]
+        assert len(remaining) == 1, remaining
+        assert not os.path.exists(stale)
+        assert os.path.exists(other)
+    finally:
+        for name in os.listdir(pkg_dir):
+            if name.startswith("libgofrsweeptest") and name.endswith(".so"):
+                os.unlink(os.path.join(pkg_dir, name))
+        if os.path.exists(src):
+            os.unlink(src)
