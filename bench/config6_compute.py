@@ -30,7 +30,7 @@ import time
 
 import numpy as np
 
-from common import emit, run
+from common import emit
 
 # bf16 peak FLOP/s per chip by device kind (public specs)
 _PEAK = {
@@ -41,14 +41,17 @@ _PEAK = {
 }
 
 
-def _peak_flops() -> float:
+def _peak_flops() -> tuple[float, bool]:
+    """(bf16 peak FLOP/s, assumed) — ``assumed`` marks an unlisted device
+    kind falling back to the v5e figure, so MFU gates can't silently pass
+    against the wrong roofline."""
     import jax
 
     kind = jax.devices()[0].device_kind.lower()
     for key, val in _PEAK.items():
         if key in kind:
-            return val
-    return 197e12
+            return val, False
+    return 197e12, True
 
 
 def _timed_scan(fn, init, length: int, *consts) -> float:
@@ -127,7 +130,7 @@ def main() -> None:
     from gofr_tpu.models import llama
 
     on_tpu = jax.default_backend() == "tpu"
-    peak = _peak_flops()
+    peak, peak_assumed = _peak_flops()
 
     if on_tpu:
         cfg = llama.LlamaConfig(
@@ -208,6 +211,7 @@ def main() -> None:
             "prefill_batch": [pf_batch, pf_seq],
             "prefill_tflops": round(pf_flops / t_prefill / 1e12, 1),
             "peak_tflops": round(peak / 1e12, 1),
+            "peak_assumed": peak_assumed,
             "params_m": round(n_params / 1e6),
             **train_detail,
             "flash_vs_xla": ab,

@@ -62,7 +62,12 @@ _EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
 
 
 class CassandraWireError(Exception):
-    pass
+    def __init__(self, message: str, code: int | None = None) -> None:
+        super().__init__(message)
+        self.code = code
+
+
+_ERR_UNPREPARED = 0x2500
 
 
 def _string(s: str) -> bytes:
@@ -102,6 +107,10 @@ def _encode_cql(tid: int, param: Any, v: Any) -> bytes | None:
     if tid == 0x0002:                      # bigint
         return struct.pack(">q", int(v))
     if tid == 0x0003:                      # blob
+        # bytes(int) would silently produce n zero bytes — reject non-buffers.
+        if not isinstance(v, (bytes, bytearray, memoryview)):
+            raise CassandraWireError(
+                f"cannot serialize {type(v).__name__} as blob (want bytes)")
         return bytes(v)
     if tid == 0x0004:                      # boolean
         return b"\x01" if v else b"\x00"
@@ -289,7 +298,8 @@ class CassandraWire:
         if opcode == _OP_ERROR:
             r = _Reader(body)
             code = r.int32()
-            raise CassandraWireError(f"server error 0x{code:04x}: {r.string()}")
+            raise CassandraWireError(
+                f"server error 0x{code:04x}: {r.string()}", code=code)
         return opcode, body
 
     def _adopt_loop(self) -> None:
@@ -459,8 +469,18 @@ class CassandraWire:
 
     async def _execute(self, cql: str, params: Sequence) -> list[dict]:
         stmt_id, specs = await self._prepare(cql)
-        return await self._request_rows(
-            _OP_EXECUTE, _short_bytes(stmt_id), self._bind(specs, params))
+        try:
+            return await self._request_rows(
+                _OP_EXECUTE, _short_bytes(stmt_id), self._bind(specs, params))
+        except CassandraWireError as exc:
+            # The server may evict prepared ids (LRU); re-prepare and retry
+            # once, as the reference's gocql driver does on UNPREPARED.
+            if exc.code != _ERR_UNPREPARED:
+                raise
+            self._prepared.pop(cql, None)
+            stmt_id, specs = await self._prepare(cql)
+            return await self._request_rows(
+                _OP_EXECUTE, _short_bytes(stmt_id), self._bind(specs, params))
 
     # -- public surface (parity with datasource/cassandra.py) ------------------
     async def query(self, stmt: str, params: Sequence | None = None) -> list:
@@ -496,20 +516,33 @@ class CassandraWire:
         self._adopt_loop()
         async with self._lock:
             await self._ensure()
-            body = struct.pack(">BH", 0, len(stmts))  # type LOGGED, count
-            for stmt, params in stmts:
-                stmt_id, specs = await self._prepare(stmt)
-                values = self._bind(specs, params or [])
-                body += b"\x01" + _short_bytes(stmt_id)  # kind 1: by id
-                body += struct.pack(">H", len(values))
-                for raw in values:
-                    body += _bytes_value(raw)
-            body += struct.pack(">HB", _CONSISTENCY_ONE, 0)
-            await self._send_frame(_OP_BATCH, body)
-            opcode, _ = await self._recv_frame()
-            if opcode != _OP_RESULT:
-                raise CassandraWireError(f"unexpected batch opcode {opcode}")
+            try:
+                await self._batch_once(stmts)
+            except CassandraWireError as exc:
+                # Same UNPREPARED recovery as _execute: drop every cached id
+                # in the batch, re-prepare, and retry the whole frame once.
+                if exc.code != _ERR_UNPREPARED:
+                    raise
+                for stmt, _ in stmts:
+                    self._prepared.pop(stmt, None)
+                await self._batch_once(stmts)
         self._observe("batch", start, f"{len(stmts)} statements")
+
+    async def _batch_once(self,
+                          stmts: Sequence[tuple[str, Sequence | None]]) -> None:
+        body = struct.pack(">BH", 0, len(stmts))  # type LOGGED, count
+        for stmt, params in stmts:
+            stmt_id, specs = await self._prepare(stmt)
+            values = self._bind(specs, params or [])
+            body += b"\x01" + _short_bytes(stmt_id)  # kind 1: by id
+            body += struct.pack(">H", len(values))
+            for raw in values:
+                body += _bytes_value(raw)
+        body += struct.pack(">HB", _CONSISTENCY_ONE, 0)
+        await self._send_frame(_OP_BATCH, body)
+        opcode, _ = await self._recv_frame()
+        if opcode != _OP_RESULT:
+            raise CassandraWireError(f"unexpected batch opcode {opcode}")
 
     def _observe(self, op: str, start: float, stmt: str) -> None:
         dur = time.perf_counter() - start

@@ -27,6 +27,8 @@ __all__ = [
     "SpanContext",
     "Tracer",
     "NoopTracer",
+    "OTLPHTTPExporter",
+    "ZipkinJSONExporter",
     "new_tracer",
     "current_span",
     "parse_traceparent",
@@ -166,14 +168,37 @@ class ConsoleExporter(SpanExporter):
                 self._logger.debug("span", **line)
 
 
-class ZipkinJSONExporter(SpanExporter):
-    """POSTs batches of Zipkin-v2 JSON spans to an HTTP collector."""
+class _HTTPJSONExporter(SpanExporter):
+    """Shared POST-JSON-batch machinery for HTTP span collectors."""
 
     def __init__(self, url: str, service_name: str, logger=None, timeout: float = 5.0) -> None:
         self.url = url
         self.service_name = service_name
         self._logger = logger
         self._timeout = timeout
+
+    def encode(self, spans: list[Span]) -> Any:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def export(self, spans: list[Span]) -> None:
+        import urllib.request
+
+        body = json.dumps(self.encode(spans)).encode()
+        req = urllib.request.Request(
+            self.url, data=body, headers={"Content-Type": "application/json"}, method="POST"
+        )
+        try:
+            urllib.request.urlopen(req, timeout=self._timeout).close()
+        except Exception as exc:  # collector being down must never break serving
+            if self._logger is not None:
+                # warn, not debug: a misconfigured collector URL otherwise
+                # drops every span with no visible signal
+                log = getattr(self._logger, "warn", self._logger.debug)
+                log(f"trace export to {self.url} failed: {exc}")
+
+
+class ZipkinJSONExporter(_HTTPJSONExporter):
+    """POSTs batches of Zipkin-v2 JSON spans to an HTTP collector."""
 
     def _encode(self, s: Span) -> dict:
         out: dict[str, Any] = {
@@ -192,18 +217,91 @@ class ZipkinJSONExporter(SpanExporter):
             out["tags"]["error"] = s.status_message or "true"
         return {k: v for k, v in out.items() if v is not None}
 
-    def export(self, spans: list[Span]) -> None:
-        import urllib.request
+    def encode(self, spans: list[Span]) -> list[dict]:
+        return [self._encode(s) for s in spans]
 
-        body = json.dumps([self._encode(s) for s in spans]).encode()
-        req = urllib.request.Request(
-            self.url, data=body, headers={"Content-Type": "application/json"}, method="POST"
-        )
-        try:
-            urllib.request.urlopen(req, timeout=self._timeout).close()
-        except Exception as exc:  # collector being down must never break serving
-            if self._logger is not None:
-                self._logger.debug(f"trace export failed: {exc}")
+
+_OTLP_KIND = {"INTERNAL": 1, "SERVER": 2, "CLIENT": 3, "PRODUCER": 4, "CONSUMER": 5}
+_OTLP_STATUS = {"UNSET": 0, "OK": 1, "ERROR": 2}
+
+
+def _otlp_any_value(value: Any) -> dict:
+    """Encode a Python value as an OTLP AnyValue (typed union, JSON mapping).
+
+    Per the OTLP/JSON encoding rules, 64-bit ints travel as decimal strings.
+    """
+    if isinstance(value, bool):
+        return {"boolValue": value}
+    if isinstance(value, int):
+        return {"intValue": str(value)}
+    if isinstance(value, float):
+        return {"doubleValue": value}
+    return {"stringValue": str(value)}
+
+
+def _otlp_attrs(attrs: Mapping[str, Any]) -> list[dict]:
+    return [{"key": str(k), "value": _otlp_any_value(v)} for k, v in attrs.items()]
+
+
+class OTLPHTTPExporter(_HTTPJSONExporter):
+    """POSTs OTLP/HTTP JSON trace batches to a collector's ``/v1/traces``.
+
+    The reference selects an OTLP exporter via TRACE_EXPORTER
+    (pkg/gofr/gofr.go:481-495); this is the equivalent for any standard
+    OpenTelemetry collector (and Jaeger >= 1.35, which ingests OTLP natively).
+    Spans are encoded with the OTLP JSON mapping: hex trace/span ids, unix-nano
+    timestamps as strings, typed attribute values, numeric kind/status enums.
+    """
+
+    def __init__(self, url: str, service_name: str, logger=None, timeout: float = 5.0) -> None:
+        # Accept either a collector base URL or the full signal path.
+        if not url.rstrip("/").endswith("/v1/traces"):
+            url = url.rstrip("/") + "/v1/traces"
+        super().__init__(url, service_name, logger, timeout)
+
+    def _encode_span(self, s: Span) -> dict:
+        end = s.end_time or s.start_time
+        out: dict[str, Any] = {
+            "traceId": s.trace_id,
+            "spanId": s.span_id,
+            "name": s.name,
+            "kind": _OTLP_KIND.get(s.kind, 1),
+            "startTimeUnixNano": str(int(s.start_time * 1e9)),
+            "endTimeUnixNano": str(int(end * 1e9)),
+            "attributes": _otlp_attrs(s.attributes),
+            "status": {"code": _OTLP_STATUS.get(s.status_code, 0)},
+        }
+        if s.parent_span_id:
+            out["parentSpanId"] = s.parent_span_id
+        if s.status_message:
+            out["status"]["message"] = s.status_message
+        if s.events:
+            out["events"] = [
+                {
+                    "timeUnixNano": str(int(ts * 1e9)),
+                    "name": name,
+                    "attributes": _otlp_attrs(attrs),
+                }
+                for ts, name, attrs in s.events
+            ]
+        return out
+
+    def encode(self, spans: list[Span]) -> dict:
+        return {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": _otlp_attrs({"service.name": self.service_name})
+                    },
+                    "scopeSpans": [
+                        {
+                            "scope": {"name": "gofr_tpu.tracing"},
+                            "spans": [self._encode_span(s) for s in spans],
+                        }
+                    ],
+                }
+            ]
+        }
 
 
 class _BatchProcessor:
@@ -330,10 +428,19 @@ def new_tracer(config, logger=None) -> Tracer:
         ratio = 1.0
     service = config.get_or_default("APP_NAME", "gofr-app")
     exporter: SpanExporter | None = None
-    if exporter_name in ("zipkin", "gofr", "otlp", "jaeger") and url:
+    if exporter_name in ("otlp", "jaeger") and url:
+        # Jaeger >= 1.35 ingests OTLP natively; the reference's dedicated
+        # Jaeger exporter (gofr.go:481-495) maps to the same collector role.
+        # A TRACER_URL that names a Zipkin ingest path keeps the Zipkin
+        # format — posting OTLP at /api/v2/spans would 404 every batch.
+        if "/api/v2/spans" in url:
+            exporter = ZipkinJSONExporter(url, service, logger)
+        else:
+            exporter = OTLPHTTPExporter(url, service, logger)
+    elif exporter_name in ("zipkin", "gofr") and url:
         exporter = ZipkinJSONExporter(url, service, logger)
-        if logger is not None:
-            logger.infof("exporting traces to %s at %s", exporter_name, url)
     elif exporter_name == "console":
         exporter = ConsoleExporter(logger)
+    if isinstance(exporter, _HTTPJSONExporter) and logger is not None:
+        logger.infof("exporting traces to %s at %s", exporter_name, exporter.url)
     return Tracer(service, exporter, ratio)

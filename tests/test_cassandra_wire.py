@@ -108,6 +108,9 @@ class FakeCassandra:
         self.preparable: dict[str, tuple[bytes, list]] = {}
         # paging_state (or None for page 0) -> rows_result body
         self.pages: dict[bytes | None, bytes] = {}
+        # stmt ids the server has "evicted": next EXECUTE gets UNPREPARED once
+        self.evicted: set[bytes] = set()
+        self.evicted_batch_ids: set[bytes] = set()
         self.auth = auth
         self.port = get_free_port()
         self._server = None
@@ -182,8 +185,14 @@ class FakeCassandra:
                         body, 2 + n)
                     assert page_size is not None
                     self.executes.append((stmt_id, values))
-                    reply_op = _OP_RESULT
-                    reply = self._result_for(paging_state)
+                    if stmt_id in self.evicted:
+                        self.evicted.discard(stmt_id)
+                        reply_op = _OP_ERROR
+                        reply = struct.pack(">i", 0x2500) + _string(
+                            "unprepared") + _bytes(stmt_id)
+                    else:
+                        reply_op = _OP_RESULT
+                        reply = self._result_for(paging_state)
                 elif opcode == _OP_BATCH:
                     btype, count = struct.unpack(">BH", body[:3])
                     assert btype == 0  # LOGGED
@@ -204,8 +213,16 @@ class FakeCassandra:
                             else:
                                 vals.append(body[off:off + ln]); off += ln
                         items.append((stmt_id, vals))
-                    self.batches.append(items)
-                    reply_op, reply = _OP_RESULT, struct.pack(">i", 1)
+                    evicted = [sid for sid, _ in items
+                               if sid in self.evicted_batch_ids]
+                    if evicted:
+                        self.evicted_batch_ids.difference_update(evicted)
+                        reply_op = _OP_ERROR
+                        reply = struct.pack(">i", 0x2500) + _string(
+                            "unprepared") + _bytes(evicted[0])
+                    else:
+                        self.batches.append(items)
+                        reply_op, reply = _OP_RESULT, struct.pack(">i", 1)
                 else:
                     raise AssertionError(f"unexpected opcode {opcode}")
                 writer.write(struct.pack(">BBhBi", 0x84, 0, stream, reply_op,
@@ -253,6 +270,64 @@ def test_handshake_use_keyspace_and_prepared_exec(run):
             await fake.stop()
 
     run(scenario())
+
+
+def test_unprepared_reprepare_retry(run):
+    """A server-evicted prepared id (UNPREPARED 0x2500) is transparently
+    re-prepared and retried once, as the reference's gocql driver does —
+    a long-lived connection must not be permanently broken by server LRU."""
+
+    async def scenario():
+        fake, db = await _pair()
+        stmt = "SELECT name FROM users WHERE id = ?"
+        fake.preparable[stmt] = (b"\xaa\xbb", [("id", 0x0009)])
+        try:
+            await db.query(stmt, [1])
+            assert fake.prepares == [stmt]
+            fake.evicted.add(b"\xaa\xbb")     # server forgets the statement
+            await db.query(stmt, [2])         # must succeed transparently
+            assert fake.prepares == [stmt, stmt]
+            # failed execute + retried execute both carried the bound value
+            assert fake.executes[-1][1] == [struct.pack(">i", 2)]
+        finally:
+            await db.close()
+            await fake.stop()
+
+    run(scenario())
+
+
+def test_batch_unprepared_reprepare_retry(run):
+    """batch_exec gets the same UNPREPARED recovery as _execute: stale ids
+    are dropped, re-prepared, and the whole frame retried once."""
+
+    async def scenario():
+        fake, db = await _pair()
+        stmt = "INSERT INTO t (id) VALUES (?)"
+        fake.preparable[stmt] = (b"\xcc\xdd", [("id", 0x0009)])
+        try:
+            await db.batch_exec([(stmt, [1]), (stmt, [2])])
+            assert fake.prepares == [stmt]
+            fake.evicted_batch_ids.add(b"\xcc\xdd")
+            await db.batch_exec([(stmt, [3])])
+            assert fake.prepares == [stmt, stmt]
+            assert fake.batches[-1] == [(b"\xcc\xdd",
+                                         [struct.pack(">i", 3)])]
+        finally:
+            await db.close()
+            await fake.stop()
+
+    run(scenario())
+
+
+def test_blob_bind_rejects_non_bytes():
+    """bytes(7) would silently write seven zero bytes; binding a non-buffer
+    to a blob column must be a typed bind error instead."""
+    from gofr_tpu.datasource.cassandra_wire import _encode_cql
+
+    with pytest.raises(CassandraWireError, match="blob"):
+        _encode_cql(0x0003, None, 7)
+    assert _encode_cql(0x0003, None, b"\x00\x01") == b"\x00\x01"
+    assert _encode_cql(0x0003, None, bytearray(b"xy")) == b"xy"
 
 
 def test_typed_rows_decode(run):

@@ -1,0 +1,155 @@
+"""Span exporter tests: OTLP/HTTP JSON and Zipkin-v2 wire formats.
+
+The reference selects its trace exporter from TRACE_EXPORTER
+(pkg/gofr/gofr.go:481-520: otlp, jaeger, zipkin, gofr). These tests pin the
+OTLP/JSON mapping against a live capture server so a standard OpenTelemetry
+collector can ingest this framework's spans.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.tracing import (
+    OTLPHTTPExporter,
+    Span,
+    SpanContext,
+    ZipkinJSONExporter,
+    new_tracer,
+)
+
+
+def _make_span(**kw) -> Span:
+    ctx = SpanContext("0af7651916cd43dd8448eb211c80319c", "b7ad6b7169203331", True)
+    defaults = dict(
+        name="GET /orders",
+        context=ctx,
+        parent_span_id="00f067aa0ba902b7",
+        kind="SERVER",
+        start_time=1753860000.0,
+        end_time=1753860000.125,
+    )
+    defaults.update(kw)
+    return Span(**defaults)
+
+
+class _Capture(BaseHTTPRequestHandler):
+    received: list[tuple[str, dict]] = []
+
+    def do_POST(self):  # noqa: N802
+        body = self.rfile.read(int(self.headers["Content-Length"]))
+        _Capture.received.append((self.path, json.loads(body)))
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def capture_server():
+    _Capture.received = []
+    srv = HTTPServer(("127.0.0.1", 0), _Capture)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}", _Capture.received
+    srv.shutdown()
+
+
+def test_otlp_export_payload_shape(capture_server):
+    url, received = capture_server
+    exp = OTLPHTTPExporter(url, "orders-svc")
+    span = _make_span()
+    span.attributes = {"http.status_code": 200, "http.route": "/orders", "cache.hit": True}
+    span.events.append((1753860000.05, "db.query", {"rows": 3}))
+    span.status_code = "OK"
+    exp.export([span])
+
+    assert len(received) == 1
+    path, payload = received[0]
+    assert path == "/v1/traces"
+
+    rs = payload["resourceSpans"]
+    assert len(rs) == 1
+    res_attrs = {a["key"]: a["value"] for a in rs[0]["resource"]["attributes"]}
+    assert res_attrs["service.name"] == {"stringValue": "orders-svc"}
+
+    spans = rs[0]["scopeSpans"][0]["spans"]
+    assert len(spans) == 1
+    s = spans[0]
+    # OTLP/JSON mapping: hex ids, string unix-nano, numeric enums, typed attrs.
+    assert s["traceId"] == "0af7651916cd43dd8448eb211c80319c"
+    assert s["spanId"] == "b7ad6b7169203331"
+    assert s["parentSpanId"] == "00f067aa0ba902b7"
+    assert s["kind"] == 2  # SPAN_KIND_SERVER
+    assert s["startTimeUnixNano"] == str(int(1753860000.0 * 1e9))
+    assert s["endTimeUnixNano"] == str(int(1753860000.125 * 1e9))
+    assert s["status"] == {"code": 1}  # STATUS_CODE_OK
+    attrs = {a["key"]: a["value"] for a in s["attributes"]}
+    assert attrs["http.status_code"] == {"intValue": "200"}
+    assert attrs["http.route"] == {"stringValue": "/orders"}
+    assert attrs["cache.hit"] == {"boolValue": True}
+    ev = s["events"][0]
+    assert ev["name"] == "db.query"
+    assert {a["key"]: a["value"] for a in ev["attributes"]}["rows"] == {"intValue": "3"}
+
+
+def test_otlp_error_status_and_url_normalization(capture_server):
+    url, received = capture_server
+    # Full signal path given explicitly must not be doubled.
+    exp = OTLPHTTPExporter(url + "/v1/traces", "svc")
+    span = _make_span(kind="CLIENT")
+    span.status_code = "ERROR"
+    span.status_message = "boom"
+    exp.export([span])
+    path, payload = received[0]
+    assert path == "/v1/traces"
+    s = payload["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    assert s["kind"] == 3  # SPAN_KIND_CLIENT
+    assert s["status"] == {"code": 2, "message": "boom"}
+
+
+def test_zipkin_export_payload_shape(capture_server):
+    url, received = capture_server
+    exp = ZipkinJSONExporter(url + "/api/v2/spans", "svc")
+    exp.export([_make_span()])
+    path, payload = received[0]
+    assert path == "/api/v2/spans"
+    assert payload[0]["traceId"] == "0af7651916cd43dd8448eb211c80319c"
+    assert payload[0]["duration"] == 125000
+
+
+@pytest.mark.parametrize(
+    "name,cls",
+    [("otlp", OTLPHTTPExporter), ("jaeger", OTLPHTTPExporter), ("zipkin", ZipkinJSONExporter)],
+)
+def test_new_tracer_exporter_selection(name, cls):
+    cfg = MapConfig({"TRACE_EXPORTER": name, "TRACER_URL": "http://localhost:4318"})
+    tracer = new_tracer(cfg)
+    try:
+        assert isinstance(tracer._processor._exporter, cls)
+    finally:
+        tracer.shutdown()
+
+
+def test_new_tracer_jaeger_with_zipkin_path_keeps_zipkin_format():
+    """A TRACER_URL naming a Zipkin ingest path must keep the Zipkin codec —
+    posting OTLP at /api/v2/spans would 404 (and silently drop) every batch."""
+    cfg = MapConfig(
+        {"TRACE_EXPORTER": "jaeger", "TRACER_URL": "http://jaeger:9411/api/v2/spans"}
+    )
+    tracer = new_tracer(cfg)
+    try:
+        assert isinstance(tracer._processor._exporter, ZipkinJSONExporter)
+    finally:
+        tracer.shutdown()
+
+
+def test_new_tracer_no_url_no_exporter():
+    tracer = new_tracer(MapConfig({"TRACE_EXPORTER": "otlp"}))
+    assert tracer._processor is None
