@@ -1,36 +1,52 @@
 """Multipart file-upload binding.
 
-Mirrors the reference's examples/using-file-bind: a multipart form with a
-zip upload plus scalar fields binds to a dataclass — the zip field arrives
-as parsed archive contents (fileutil.Zip), scalars coerce to their
-annotated types.
+Mirrors the reference's examples/using-file-bind (multipart_file_bind.go):
+a multipart form binds to a dataclass whose annotations pick the file
+representation — ``fileutil.Zip`` fields arrive as parsed archives,
+``UploadedFile`` fields carry filename/content-type/bytes, ``bytes`` fields
+get raw content, and scalars coerce to their annotated types.
 """
 
 import dataclasses
 
 import gofr_tpu
-from gofr_tpu.fileutil import Zip
+from gofr_tpu import UploadedFile, Zip
 
 
 @dataclasses.dataclass
 class UploadData:
     name: str = ""
-    hello: bytes = b""  # raw uploaded file field
+    # the form field is called "hello"; bind it here as a parsed zip
+    archive: Zip | None = dataclasses.field(
+        default=None, metadata={"file": "hello"})
+
+
+@dataclasses.dataclass
+class RawUpload:
+    hello: UploadedFile | None = None
 
 
 async def upload(ctx: gofr_tpu.Context):
     data = await ctx.bind(UploadData)
-    out = {"name": data.name, "hello_bytes": len(data.hello)}
-    # a .zip upload can be cracked open in-memory
-    if data.hello[:2] == b"PK":
-        z = Zip.from_bytes(data.hello)
-        out["zip_entries"] = sorted(z.files)
+    out = {"name": data.name}
+    if data.archive is not None:
+        out["zip_entries"] = sorted(data.archive.files)
     return out
+
+
+async def upload_meta(ctx: gofr_tpu.Context):
+    data = await ctx.bind(RawUpload)
+    f = data.hello
+    if f is None:
+        raise gofr_tpu.errors.MissingParam("hello")
+    return {"filename": f.filename, "content_type": f.content_type,
+            "size": f.size}
 
 
 def main() -> gofr_tpu.App:
     app = gofr_tpu.new_app()
     app.post("/upload", upload)
+    app.post("/upload-meta", upload_meta)
     return app
 
 

@@ -25,7 +25,9 @@ from .app import App, new_app
 from .cmd import CMD, new_cmd
 from .config import Config, EnvConfig, MapConfig
 from .context import Context
+from .fileutil import Zip
 from .http import errors
+from .http.request import UploadedFile
 from .http.response import File, Raw, Redirect, Response, Template
 from .http.sse import EventStream
 from .logging import Level, Logger, new_logger
@@ -52,6 +54,8 @@ __all__ = [
     "Redirect",
     "Response",
     "Template",
+    "UploadedFile",
+    "Zip",
     "errors",
     "new",
     "new_app",

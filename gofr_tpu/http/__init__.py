@@ -1,5 +1,5 @@
 """HTTP transport: request/response abstractions, responder, errors, middleware."""
 
 from . import errors  # noqa: F401
-from .request import HTTPRequest, Request  # noqa: F401
+from .request import HTTPRequest, Request, UploadedFile  # noqa: F401
 from .response import File, Raw, Redirect, Response, Template  # noqa: F401

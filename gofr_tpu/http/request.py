@@ -5,19 +5,44 @@ Bind/HostName/Params (pkg/gofr/http/request.go:29-79) — implemented by HTTP,
 CLI, and pub/sub transports so one handler signature serves all three. This
 module provides the protocol plus the aiohttp-backed HTTP implementation with
 content-type-switched ``bind`` (JSON / form-urlencoded / multipart / raw
-bytes, reference pkg/gofr/http/request.go Bind + form_data_binder.go).
+bytes, reference pkg/gofr/http/request.go Bind + form_data_binder.go) and
+typed multipart file-field reflection (multipart_file_bind.go: struct fields
+declared as ``file.Zip`` / ``multipart.FileHeader`` receive parsed uploads).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
+import types
 import typing
 from typing import Any, Mapping, Protocol, runtime_checkable
 
+from ..fileutil import Zip
 from .errors import InvalidInput
 
-__all__ = ["Request", "HTTPRequest"]
+__all__ = ["Request", "HTTPRequest", "UploadedFile"]
+
+
+@dataclasses.dataclass
+class UploadedFile:
+    """An uploaded multipart file: the ``multipart.FileHeader`` analogue.
+
+    Declaring a dataclass field as ``UploadedFile`` binds metadata + content;
+    declaring it as ``fileutil.Zip`` binds the parsed archive; ``bytes``
+    binds the raw content (reference multipart_file_bind.go:1-276).
+    """
+
+    filename: str
+    content_type: str
+    content: bytes
+
+    @property
+    def size(self) -> int:
+        return len(self.content)
+
+    def zip(self) -> Zip:
+        return Zip.from_bytes(self.content)
 
 
 @runtime_checkable
@@ -31,13 +56,40 @@ class Request(Protocol):
 
 def _coerce(value: Any, annot: Any) -> Any:
     """Best-effort coercion of a parsed value into an annotated field type."""
-    origin = typing.get_origin(annot)
-    if annot in (None, Any) or value is None:
+    if value is None:
         return value
-    if origin is typing.Union or origin is getattr(typing, "UnionType", None):
+    origin = typing.get_origin(annot)
+    if origin is typing.Union or origin is getattr(types, "UnionType", None):
         args = [a for a in typing.get_args(annot) if a is not type(None)]
         if len(args) == 1:
             return _coerce(value, args[0])
+        return value
+    if isinstance(value, UploadedFile):
+        # typed file-field reflection (reference multipart_file_bind.go);
+        # an un-annotated target keeps the historical raw-bytes shape
+        if annot is UploadedFile:
+            return value
+        if annot is Zip:
+            try:
+                return value.zip()
+            except Exception as exc:
+                raise InvalidInput(
+                    f"field expects a zip archive, got {value.filename!r}: {exc}")
+        if annot in (None, Any, bytes):
+            return value.content
+        if annot is str:
+            try:
+                return value.content.decode()
+            except UnicodeDecodeError:
+                raise InvalidInput(
+                    f"uploaded file {value.filename!r} is not valid text")
+        raise InvalidInput(
+            f"cannot bind uploaded file {value.filename!r} to {annot}")
+    if annot in (Zip, UploadedFile):
+        # a plain form value where a file part was declared is client error
+        raise InvalidInput(
+            f"field expects an uploaded file, got {type(value).__name__}")
+    if annot in (None, Any):
         return value
     try:
         if annot is bool and isinstance(value, str):
@@ -63,6 +115,8 @@ def bind_to_model(data: Mapping[str, Any], model: type) -> Any:
             raise InvalidInput(str(exc))
     obj = model()
     for k, v in data.items():
+        if isinstance(v, UploadedFile):
+            v = v.content  # plain classes keep the historical raw-bytes shape
         if hasattr(obj, k) or not hasattr(obj, "__slots__"):
             setattr(obj, k, v)
     return obj
@@ -129,9 +183,17 @@ class HTTPRequest:
             post = await self.raw.post()
             data = {}
             for k, v in post.items():
-                # aiohttp FileField for uploaded files; keep bytes + filename
                 if hasattr(v, "file"):
-                    data[k] = v.file.read()
+                    content = v.file.read()
+                    if model is None:
+                        # untyped bind keeps the historical raw-bytes shape
+                        data[k] = content
+                    else:
+                        data[k] = UploadedFile(
+                            getattr(v, "filename", "") or "",
+                            getattr(v, "content_type", "") or "",
+                            content,
+                        )
                 else:
                     data[k] = v
         elif ctype == "application/octet-stream":
@@ -142,4 +204,11 @@ class HTTPRequest:
             return data
         if not isinstance(data, Mapping):
             raise InvalidInput("request body must be a JSON object to bind a model")
+        if dataclasses.is_dataclass(model) and isinstance(data, dict):
+            # ``metadata={"file": "form-field"}`` aliases a field to a
+            # differently-named upload (the reference's `file:"name"` tag)
+            for f in dataclasses.fields(model):
+                alias = f.metadata.get("file")
+                if alias and alias in data and f.name not in data:
+                    data[f.name] = data.pop(alias)
         return bind_to_model(data, model)

@@ -325,6 +325,18 @@ def test_using_file_bind_example(run):
                 data = (await r.json())["data"]
                 assert data["name"] == "bundle"
                 assert data["zip_entries"] == ["a.txt", "b/c.txt"]
+
+                # UploadedFile fields bind filename/content-type metadata
+                form2 = aiohttp.FormData()
+                form2.add_field("hello", buf.getvalue(),
+                                filename="hello.zip",
+                                content_type="application/zip")
+                r = await s.post(base + "/upload-meta", data=form2)
+                assert r.status == 201, await r.text()
+                meta = (await r.json())["data"]
+                assert meta["filename"] == "hello.zip"
+                assert meta["content_type"] == "application/zip"
+                assert meta["size"] == len(buf.getvalue())
             await app.shutdown()
 
     run(scenario())

@@ -392,3 +392,144 @@ def test_crud_not_null_constraint(run):
             await client.close()
 
     run(scenario())
+
+
+# -------------------------------------------------- typed multipart binding
+def test_multipart_typed_file_binding(run):
+    """Typed file-field reflection (reference multipart_file_bind.go):
+    Zip fields get parsed archives, UploadedFile gets metadata + bytes,
+    bytes/str get content, and a metadata file-alias renames the field."""
+    import io
+    import zipfile
+
+    import aiohttp
+
+    from gofr_tpu import UploadedFile, Zip
+
+    @dataclasses.dataclass
+    class Typed:
+        name: str = ""
+        count: int = 0
+        archive: Zip | None = dataclasses.field(
+            default=None, metadata={"file": "bundle"})
+        doc: UploadedFile | None = None
+        raw: bytes = b""
+        text: str = ""
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("inner/x.txt", "zipped")
+
+    async def scenario():
+        app = make_app()
+        captured = {}
+
+        async def upload(ctx):
+            captured["data"] = await ctx.bind(Typed)
+            return "ok"
+
+        async def untyped(ctx):
+            captured["untyped"] = await ctx.bind()
+            return "ok"
+
+        app.post("/typed", upload)
+        app.post("/untyped", untyped)
+        client = await client_for(app)
+        try:
+            form = aiohttp.FormData()
+            form.add_field("name", "pkg")
+            form.add_field("count", "7")
+            form.add_field("bundle", buf.getvalue(),
+                           filename="b.zip", content_type="application/zip")
+            form.add_field("doc", b"doc-bytes",
+                           filename="d.bin",
+                           content_type="application/octet-stream")
+            form.add_field("raw", b"\x00\x01",
+                           filename="r.bin",
+                           content_type="application/octet-stream")
+            form.add_field("text", "hello text".encode(),
+                           filename="t.txt", content_type="text/plain")
+            r = await client.post("/typed", data=form)
+            assert r.status == 201, await r.text()
+            d = captured["data"]
+            assert d.name == "pkg" and d.count == 7
+            assert d.archive.files == {"inner/x.txt": b"zipped"}
+            assert isinstance(d.doc, UploadedFile)
+            assert (d.doc.filename, d.doc.content_type, d.doc.size) == (
+                "d.bin", "application/octet-stream", 9)
+            assert d.raw == b"\x00\x01"
+            assert d.text == "hello text"
+
+            # untyped bind keeps the historical raw-bytes shape
+            form2 = aiohttp.FormData()
+            form2.add_field("f", b"abc", filename="f.bin")
+            form2.add_field("k", "v")
+            r = await client.post("/untyped", data=form2)
+            assert r.status == 201
+            assert captured["untyped"] == {"f": b"abc", "k": "v"}
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+def test_multipart_bad_zip_is_invalid_input(run):
+    import aiohttp
+
+    from gofr_tpu import Zip
+
+    @dataclasses.dataclass
+    class WantsZip:
+        archive: Zip | None = None
+
+    async def scenario():
+        app = make_app()
+
+        async def upload(ctx):
+            await ctx.bind(WantsZip)
+            return "ok"
+
+        app.post("/z", upload)
+        client = await client_for(app)
+        try:
+            form = aiohttp.FormData()
+            form.add_field("archive", b"not a zip", filename="a.zip")
+            r = await client.post("/z", data=form)
+            assert r.status == 400
+            assert "zip" in (await r.json())["error"]["message"].lower()
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+def test_multipart_plain_value_on_file_field_is_400(run):
+    import aiohttp
+
+    from gofr_tpu import Zip
+
+    @dataclasses.dataclass
+    class WantsZip:
+        archive: Zip | None = None
+
+    async def scenario():
+        app = make_app()
+
+        async def upload(ctx):
+            await ctx.bind(WantsZip)
+            return "ok"
+
+        app.post("/z2", upload)
+        client = await client_for(app)
+        try:
+            # 'archive' sent as a plain text field, not a file part
+            form = aiohttp.FormData()
+            form.add_field("archive", "just text")
+            r = await client.post("/z2", data=form)
+            assert r.status == 400
+            msg = (await r.json())["error"]["message"]
+            assert "uploaded file" in msg
+        finally:
+            await client.close()
+
+    run(scenario())
