@@ -17,9 +17,16 @@ implements the binary protocol directly over asyncio streams:
 Delivery semantics mirror the reference subscriber runtime: messages carry
 a committer that advances the group offset only after the handler
 succeeds (reference subscriber.go:72-75); nack re-queues locally for
-at-least-once redelivery. Single-broker routing (the bootstrap broker is
-the leader for every partition) — the multi-node leader map is out of
-scope, as the reference's writer also pins one transport.
+at-least-once redelivery.
+
+Routing is metadata-driven across a multi-broker cluster (the role of
+segmentio's broker discovery, reference kafka.go:56-271): Metadata maps
+each partition to its leader node, produce/fetch/list-offsets frames go to
+that leader's connection, and NOT_LEADER/LEADER_NOT_AVAILABLE/
+UNKNOWN_TOPIC errors invalidate the topic's leader map and retry once
+after a refresh — so broker failover heals without restarting the client.
+Group-offset RPCs (OffsetCommit/OffsetFetch v0) ride the bootstrap
+connection, as any v0 broker serves them.
 """
 
 from __future__ import annotations
@@ -43,6 +50,12 @@ class KafkaProtocolError(KafkaError):
     def __init__(self, api: str, code: int) -> None:
         super().__init__(f"{api}: kafka error code {code}")
         self.code = code
+
+
+# leadership moved or metadata is stale: refresh the leader map and retry
+# (3 = UNKNOWN_TOPIC_OR_PARTITION, 5 = LEADER_NOT_AVAILABLE,
+#  6 = NOT_LEADER_FOR_PARTITION)
+_RETRIABLE = frozenset({3, 5, 6})
 
 
 # -- wire codec ----------------------------------------------------------------
@@ -197,20 +210,27 @@ class _Conn:
 
     async def request(self, api_key: int, api_version: int, body: bytes) -> Reader:
         async with self._lock:
-            await self._ensure()
-            self._corr += 1
-            corr = self._corr
-            header = (Writer().int16(api_key).int16(api_version)
-                      .int32(corr).string(self.client_id).build())
-            frame = header + body
-            self._writer.write(struct.pack(">i", len(frame)) + frame)
-            await self._writer.drain()
-            size_raw = await self._reader.readexactly(4)
-            (size,) = struct.unpack(">i", size_raw)
-            payload = await self._reader.readexactly(size)
+            try:
+                await self._ensure()
+                self._corr += 1
+                corr = self._corr
+                header = (Writer().int16(api_key).int16(api_version)
+                          .int32(corr).string(self.client_id).build())
+                frame = header + body
+                self._writer.write(struct.pack(">i", len(frame)) + frame)
+                await self._writer.drain()
+                size_raw = await self._reader.readexactly(4)
+                (size,) = struct.unpack(">i", size_raw)
+                payload = await self._reader.readexactly(size)
+            except Exception:
+                # a half-done exchange poisons correlation state; drop the
+                # socket so the next request redials cleanly
+                self.close()
+                raise
             r = Reader(payload)
             got = r.int32()
             if got != corr:
+                self.close()
                 raise KafkaError(f"correlation mismatch: sent {corr} got {got}")
             return r
 
@@ -249,6 +269,7 @@ class Kafka:
                  logger=None, metrics=None) -> None:
         host, _, port = broker.partition(":")
         self.broker = broker
+        self._client_id = client_id
         self._conn = _Conn(host or "localhost", int(port or 9092), client_id)
         self.group_id = group_id
         self.offset_start = offset_start
@@ -257,7 +278,11 @@ class Kafka:
         self._logger = logger
         self._metrics = metrics
         self._readers: dict[str, _TopicReader] = {}
-        self._meta_cache: dict[str, list[int]] = {}
+        # cluster view from Metadata: node id -> (host, port), and
+        # topic -> {partition -> leader node id}
+        self._brokers: dict[int, tuple[str, int]] = {}
+        self._leaders: dict[str, dict[int, int]] = {}
+        self._node_conns: dict[int, _Conn] = {}
         self._rr = 0
         self.stats = {"published": 0, "consumed": 0, "committed": 0,
                       "errors": 0}
@@ -293,27 +318,55 @@ class Kafka:
 
         def part(x: Reader):
             perr, pid = x.int16(), x.int32()
-            x.int32()  # leader
+            leader = x.int32()
             x.array(lambda y: y.int32())  # replicas
             x.array(lambda y: y.int32())  # isr
-            return perr, pid
+            return perr, pid, leader
 
         def topic(x: Reader):
             terr, name = x.int16(), x.string()
             parts = x.array(part)
-            return name, terr, [pid for _, pid in parts]
+            return name, terr, {pid: leader for _, pid, leader in parts}
 
-        tops = {name: (terr, pids) for name, terr, pids in r.array(topic)}
+        tops = {name: (terr, leaders) for name, terr, leaders in r.array(topic)}
+        self._brokers = {nid: (host, port) for nid, host, port in brokers}
         return {"brokers": brokers, "topics": tops}
 
+    async def _refresh(self, topic: str) -> dict[int, int]:
+        """Fetch topic metadata and rebuild its partition->leader map."""
+        meta = await self._metadata([topic])
+        terr, leaders = meta["topics"].get(topic, (3, {}))
+        if terr != 0 or not leaders:
+            raise KafkaProtocolError(f"metadata {topic}", terr or 3)
+        self._leaders[topic] = leaders
+        return leaders
+
+    def _invalidate(self, topic: str) -> None:
+        self._leaders.pop(topic, None)
+
     async def _partitions(self, topic: str) -> list[int]:
-        if topic not in self._meta_cache:
-            meta = await self._metadata([topic])
-            terr, pids = meta["topics"].get(topic, (3, []))
-            if terr not in (0,) or not pids:
-                raise KafkaProtocolError(f"metadata {topic}", terr or 3)
-            self._meta_cache[topic] = sorted(pids)
-        return self._meta_cache[topic]
+        leaders = self._leaders.get(topic)
+        if leaders is None:
+            leaders = await self._refresh(topic)
+        return sorted(leaders)
+
+    async def _leader_conn(self, topic: str, pid: int) -> _Conn:
+        """Connection to the partition leader; the bootstrap connection is
+        reused when the leader's advertised address matches it (or when the
+        node id is missing from the broker list)."""
+        leaders = self._leaders.get(topic)
+        if leaders is None:
+            leaders = await self._refresh(topic)
+        node = leaders.get(pid, -1)
+        addr = self._brokers.get(node)
+        if addr is None or addr == (self._conn.host, self._conn.port):
+            return self._conn
+        conn = self._node_conns.get(node)
+        if conn is None or (conn.host, conn.port) != addr:
+            if conn is not None:
+                conn.close()  # node moved to a new address
+            conn = self._node_conns[node] = _Conn(*addr, self._client_id)
+        return conn
 
     # -- produce ---------------------------------------------------------------
     async def publish(self, topic: str, message: bytes | str,
@@ -325,38 +378,64 @@ class Kafka:
             pids = await self._partitions(topic)
             pid = pids[self._rr % len(pids)]  # round-robin like the writer
             self._rr += 1
-            mset = encode_message_set([(key, message)])
-            body = (Writer().int16(1).int32(5000)  # acks=1, timeout
-                    .array([topic], lambda w, t: (
-                        w.string(t).array([pid], lambda w2, p: (
-                            w2.int32(p).bytes_(mset)))))
-                    .build())
-            r = await self._conn.request(0, 0, body)
-
-            def p_resp(x: Reader):
-                pid_, err = x.int32(), x.int16()
-                x.int64()  # base offset
-                return pid_, err
-
-            for _t, parts in r.array(lambda x: (x.string(), x.array(p_resp))):
-                for _pid, err in parts:
-                    if err:
-                        raise KafkaProtocolError(f"produce {topic}", err)
+            await self._with_leader_retry(
+                topic, lambda: self._produce_to_leader(topic, pid, key, message))
         except Exception:
             self.stats["errors"] += 1
             raise
         self.stats["published"] += 1
         self._count("app_pubsub_publish_success_count", topic)
 
+    async def _with_leader_retry(self, topic: str, fn):
+        """Run a leader-routed RPC; on a stale-leadership signal — the
+        retriable protocol codes OR a dead socket (leader crashed) —
+        refresh the leader map from Metadata and retry exactly once."""
+        try:
+            return await fn()
+        except KafkaProtocolError as exc:
+            if exc.code not in _RETRIABLE:
+                raise
+        except (OSError, EOFError):
+            pass  # asyncio.IncompleteReadError is an EOFError
+        self._invalidate(topic)
+        return await fn()
+
+    async def _produce_to_leader(self, topic: str, pid: int,
+                                 key: bytes | None, message: bytes) -> None:
+        conn = await self._leader_conn(topic, pid)
+        mset = encode_message_set([(key, message)])
+        body = (Writer().int16(1).int32(5000)  # acks=1, timeout
+                .array([topic], lambda w, t: (
+                    w.string(t).array([pid], lambda w2, p: (
+                        w2.int32(p).bytes_(mset)))))
+                .build())
+        r = await conn.request(0, 0, body)
+
+        def p_resp(x: Reader):
+            pid_, err = x.int32(), x.int16()
+            x.int64()  # base offset
+            return pid_, err
+
+        for _t, parts in r.array(lambda x: (x.string(), x.array(p_resp))):
+            for _pid, err in parts:
+                if err:
+                    raise KafkaProtocolError(f"produce {topic}", err)
+
     # -- offsets ---------------------------------------------------------------
     async def _list_offset(self, topic: str, pid: int, earliest: bool) -> int:
+        return await self._with_leader_retry(
+            topic, lambda: self._list_offset_once(topic, pid, earliest))
+
+    async def _list_offset_once(self, topic: str, pid: int,
+                                earliest: bool) -> int:
         ts = -2 if earliest else -1
+        conn = await self._leader_conn(topic, pid)
         body = (Writer().int32(-1)
                 .array([topic], lambda w, t: (
                     w.string(t).array([pid], lambda w2, p: (
                         w2.int32(p).int64(ts).int32(1)))))
                 .build())
-        r = await self._conn.request(2, 0, body)
+        r = await conn.request(2, 0, body)
 
         def p(x: Reader):
             pid_, err = x.int32(), x.int16()
@@ -415,16 +494,29 @@ class Kafka:
         return offsets
 
     async def _fetch_once(self, topic: str, reader: _TopicReader) -> int:
-        """One Fetch across the topic's partitions; enqueue decoded
-        messages, advance local offsets. Returns message count."""
-        parts = sorted(reader.offsets.items())
-        body = (Writer().int32(-1).int32(self._fetch_wait).int32(1)
-                .array([topic], lambda w, t: (
-                    w.string(t).array(parts, lambda w2, po: (
-                        w2.int32(po[0]).int64(po[1]).int32(self._fetch_bytes)))))
-                .build())
-        r = await self._conn.request(1, 0, body)
+        """One Fetch per partition leader (concurrently when partitions
+        span brokers); enqueue decoded messages, advance local offsets.
+        Partitions whose leadership moved mid-fetch are skipped this round
+        and the leader map refreshed. Returns message count."""
+        by_conn: dict[_Conn, list[tuple[int, int]]] = {}
+        for pid, off in sorted(reader.offsets.items()):
+            conn = await self._leader_conn(topic, pid)
+            by_conn.setdefault(conn, []).append((pid, off))
+
+        async def fetch_from(conn: _Conn, plist: list[tuple[int, int]]):
+            body = (Writer().int32(-1).int32(self._fetch_wait).int32(1)
+                    .array([topic], lambda w, t: (
+                        w.string(t).array(plist, lambda w2, po: (
+                            w2.int32(po[0]).int64(po[1])
+                            .int32(self._fetch_bytes)))))
+                    .build())
+            return await conn.request(1, 0, body)
+
+        results = await asyncio.gather(
+            *(fetch_from(c, pl) for c, pl in by_conn.items()),
+            return_exceptions=True)
         n = 0
+        stale = False
 
         def p(x: Reader):
             pid, err = x.int32(), x.int16()
@@ -432,16 +524,31 @@ class Kafka:
             mset = x.bytes_() or b""
             return pid, err, mset
 
-        for _t, presps in r.array(lambda x: (x.string(), x.array(p))):
-            for pid, err, mset in presps:
-                if err:
-                    raise KafkaProtocolError(f"fetch {topic}", err)
-                for offset, key, value in decode_message_set(mset):
-                    if offset < reader.offsets[pid]:
-                        continue  # v0 resends from segment starts
-                    reader.offsets[pid] = offset + 1
-                    reader.queue.put_nowait((pid, offset, key, value))
-                    n += 1
+        for conn, r in zip(by_conn, results):
+            if isinstance(r, (OSError, EOFError)):
+                conn.close()  # leader died: refresh and pick up next round
+                stale = True
+                continue
+            if isinstance(r, BaseException):
+                raise r
+            for _t, presps in r.array(lambda x: (x.string(), x.array(p))):
+                for pid, err, mset in presps:
+                    if err in _RETRIABLE:
+                        stale = True
+                        continue
+                    if err:
+                        raise KafkaProtocolError(f"fetch {topic}", err)
+                    for offset, key, value in decode_message_set(mset):
+                        if offset < reader.offsets[pid]:
+                            continue  # v0 resends from segment starts
+                        reader.offsets[pid] = offset + 1
+                        reader.queue.put_nowait((pid, offset, key, value))
+                        n += 1
+        if stale:
+            self._invalidate(topic)
+            # an errored fetch returns immediately (no broker-side
+            # long-poll); don't hammer Metadata+Fetch during an election
+            await asyncio.sleep(self._fetch_wait / 1000)
         return n
 
     async def subscribe(self, topic: str) -> Message:
@@ -486,7 +593,7 @@ class Kafka:
         for _t, err in r.array(lambda x: (x.string(), x.int16())):
             if err and err != 36:  # 36 = already exists
                 raise KafkaProtocolError(f"create_topic {name}", err)
-        self._meta_cache.pop(name, None)
+        self._invalidate(name)
 
     async def delete_topic_async(self, name: str) -> None:
         body = (Writer().array([name], lambda w, t: w.string(t))
@@ -495,7 +602,7 @@ class Kafka:
         for _t, err in r.array(lambda x: (x.string(), x.int16())):
             if err and err != 3:  # 3 = unknown topic
                 raise KafkaProtocolError(f"delete_topic {name}", err)
-        self._meta_cache.pop(name, None)
+        self._invalidate(name)
         self._readers.pop(name, None)
 
     def create_topic(self, name: str) -> None:
@@ -528,6 +635,9 @@ class Kafka:
 
     def close(self) -> None:
         self._conn.close()
+        for conn in self._node_conns.values():
+            conn.close()
+        self._node_conns.clear()
 
 
 def _run_sync(coro):

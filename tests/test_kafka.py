@@ -378,3 +378,305 @@ def test_health_down_when_unreachable(run):
 
     health = run(scenario())
     assert health["status"] == "DOWN"
+
+
+# ------------------------------------------------------- multi-broker cluster
+class _ClusterNode:
+    """One broker of a FakeCluster: serves v0 frames, only accepts
+    produce/fetch for partitions it leads (else NOT_LEADER code 6)."""
+
+    def __init__(self, node_id: int, cluster: "FakeCluster"):
+        self.node_id = node_id
+        self.cluster = cluster
+        self.server = None
+        self.port = None
+        self.apis: list[int] = []      # api keys seen on this node's socket
+        self.not_leader_hits = 0
+        self._writers: set = set()
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._serve, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        # force-close live client sockets: wait_closed() would otherwise
+        # block on connections the client under test still holds open
+        for w in list(self._writers):
+            w.close()
+        await self.server.wait_closed()
+
+    async def _serve(self, reader, writer):
+        self._writers.add(writer)
+        try:
+            while True:
+                raw = await reader.readexactly(4)
+                (size,) = struct.unpack(">i", raw)
+                payload = await reader.readexactly(size)
+                r = Reader(payload)
+                api, version, corr = r.int16(), r.int16(), r.int32()
+                r.string()
+                self.apis.append(api)
+                assert version == 0
+                body = {0: self._produce, 1: self._fetch, 2: self._list_offsets,
+                        3: self._metadata, 8: self._offset_commit,
+                        9: self._offset_fetch}[api](r)
+                frame = struct.pack(">i", corr) + body
+                writer.write(struct.pack(">i", len(frame)) + frame)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    def _leads(self, topic: str, pid: int) -> bool:
+        return self.cluster.topics.get(topic, {}).get(pid) == self.node_id
+
+    def _metadata(self, r) -> bytes:
+        names = r.array(lambda x: x.string())
+        w = Writer()
+        nodes = sorted(self.cluster.nodes.items())
+        w.array(nodes, lambda w2, kv: (
+            w2.int32(kv[0]).string("127.0.0.1").int32(kv[1].port)))
+        tops = names or sorted(self.cluster.topics)
+
+        def enc_topic(w2, name):
+            leaders = self.cluster.topics.get(name)
+            w2.int16(0 if leaders else 3).string(name)
+            w2.array(sorted(leaders or {}), lambda w3, p: (
+                w3.int16(0).int32(p).int32(leaders[p])
+                .array([leaders[p]], lambda w4, x: w4.int32(x))
+                .array([leaders[p]], lambda w4, x: w4.int32(x))))
+
+        w.array(tops, enc_topic)
+        return w.build()
+
+    def _produce(self, r) -> bytes:
+        r.int16(); r.int32()  # acks, timeout
+        results: dict[str, list] = {}
+        for _ in range(r.int32()):
+            topic = r.string()
+            for _ in range(r.int32()):
+                pid = r.int32()
+                mset = r.bytes_() or b""
+                if not self._leads(topic, pid):
+                    self.not_leader_hits += 1
+                    results.setdefault(topic, []).append((pid, 6, -1))
+                    continue
+                log = self.cluster.logs.setdefault((topic, pid), [])
+                base = len(log)
+                for _off, key, value in decode_message_set(mset):
+                    log.append((key, value))
+                results.setdefault(topic, []).append((pid, 0, base))
+        w = Writer()
+        w.array(sorted(results.items()), lambda w2, kv: (
+            w2.string(kv[0]).array(kv[1], lambda w3, p: (
+                w3.int32(p[0]).int16(p[1]).int64(p[2])))))
+        return w.build()
+
+    def _fetch(self, r) -> bytes:
+        r.int32(); r.int32(); r.int32()  # replica, max wait, min bytes
+        results: dict[str, list] = {}
+        for _ in range(r.int32()):
+            topic = r.string()
+            for _ in range(r.int32()):
+                pid, off = r.int32(), r.int64()
+                r.int32()
+                if not self._leads(topic, pid):
+                    self.not_leader_hits += 1
+                    results.setdefault(topic, []).append((pid, 6, -1, b""))
+                    continue
+                log = self.cluster.logs.get((topic, pid), [])
+                enc = Writer()
+                for i, (key, value) in enumerate(log[off:]):
+                    body = (Writer().int8(0).int8(0).bytes_(key)
+                            .bytes_(value).build())
+                    crc = zlib.crc32(body) & 0xFFFFFFFF
+                    msg = struct.pack(">I", crc) + body
+                    enc.int64(off + i).int32(len(msg)).raw(msg)
+                results.setdefault(topic, []).append(
+                    (pid, 0, len(log), enc.build()))
+        w = Writer()
+        w.array(sorted(results.items()), lambda w2, kv: (
+            w2.string(kv[0]).array(kv[1], lambda w3, p: (
+                w3.int32(p[0]).int16(p[1]).int64(p[2]).bytes_(p[3])))))
+        return w.build()
+
+    def _list_offsets(self, r) -> bytes:
+        r.int32()
+        results: dict[str, list] = {}
+        for _ in range(r.int32()):
+            topic = r.string()
+            for _ in range(r.int32()):
+                pid, ts = r.int32(), r.int64()
+                r.int32()
+                log = self.cluster.logs.get((topic, pid), [])
+                results.setdefault(topic, []).append(
+                    (pid, 0 if ts == -2 else len(log)))
+        w = Writer()
+        w.array(sorted(results.items()), lambda w2, kv: (
+            w2.string(kv[0]).array(kv[1], lambda w3, p: (
+                w3.int32(p[0]).int16(0)
+                .array([p[1]], lambda w4, o: w4.int64(o))))))
+        return w.build()
+
+    def _offset_commit(self, r) -> bytes:
+        group = r.string()
+        out: dict[str, list] = {}
+        for _ in range(r.int32()):
+            topic = r.string()
+            for _ in range(r.int32()):
+                pid, off = r.int32(), r.int64()
+                r.string()
+                self.cluster.group_offsets[(group, topic, pid)] = off
+                out.setdefault(topic, []).append(pid)
+        w = Writer()
+        w.array(sorted(out.items()), lambda w2, kv: (
+            w2.string(kv[0]).array(kv[1],
+                                   lambda w3, p: w3.int32(p).int16(0))))
+        return w.build()
+
+    def _offset_fetch(self, r) -> bytes:
+        group = r.string()
+        out: dict[str, list] = {}
+        for _ in range(r.int32()):
+            topic = r.string()
+            for _ in range(r.int32()):
+                pid = r.int32()
+                off = self.cluster.group_offsets.get((group, topic, pid), -1)
+                out.setdefault(topic, []).append((pid, off))
+        w = Writer()
+        w.array(sorted(out.items()), lambda w2, kv: (
+            w2.string(kv[0]).array(kv[1], lambda w3, p: (
+                w3.int32(p[0]).int64(p[1]).string("").int16(0)))))
+        return w.build()
+
+
+class FakeCluster:
+    """Two+ fake brokers sharing one log store and a partition->leader map."""
+
+    def __init__(self):
+        self.topics: dict[str, dict[int, int]] = {}   # topic -> pid -> node
+        self.logs: dict[tuple[str, int], list] = {}
+        self.group_offsets: dict[tuple, int] = {}
+        self.nodes: dict[int, _ClusterNode] = {}
+
+    async def start(self, n: int = 2):
+        for node_id in range(1, n + 1):
+            node = _ClusterNode(node_id, self)
+            await node.start()
+            self.nodes[node_id] = node
+
+    async def stop(self):
+        for node in self.nodes.values():
+            await node.stop()
+
+
+def test_multibroker_produce_routes_to_partition_leader(run):
+    """Produce frames land on each partition's leader broker, discovered
+    from Metadata — not on the bootstrap connection (reference
+    kafka.go:56-271 broker-discovery role)."""
+
+    async def scenario():
+        cluster = FakeCluster()
+        await cluster.start(2)
+        cluster.topics["orders"] = {0: 1, 1: 2}
+        k = Kafka(f"127.0.0.1:{cluster.nodes[1].port}")
+        try:
+            await asyncio.wait_for(k.publish("orders", b"m0"), 5)  # rr -> pid 0
+            await asyncio.wait_for(k.publish("orders", b"m1"), 5)  # rr -> pid 1
+            assert cluster.logs[("orders", 0)] == [(None, b"m0")]
+            assert cluster.logs[("orders", 1)] == [(None, b"m1")]
+            # node 2's socket really served the pid-1 produce
+            assert 0 in cluster.nodes[2].apis
+            assert cluster.nodes[1].not_leader_hits == 0
+            assert cluster.nodes[2].not_leader_hits == 0
+        finally:
+            k.close()
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_multibroker_not_leader_refreshes_and_retries(run):
+    """A leadership move makes the old leader answer NOT_LEADER (6); the
+    client refreshes its leader map from Metadata and retries once, so the
+    publish succeeds on the new leader without surfacing an error."""
+
+    async def scenario():
+        cluster = FakeCluster()
+        await cluster.start(2)
+        cluster.topics["orders"] = {0: 1, 1: 2}
+        k = Kafka(f"127.0.0.1:{cluster.nodes[1].port}")
+        try:
+            await asyncio.wait_for(k.publish("orders", b"m0"), 5)  # pid 0 @ n1
+            await asyncio.wait_for(k.publish("orders", b"m1"), 5)  # pid 1 @ n2
+            cluster.topics["orders"][0] = 2  # leadership moves to node 2
+            await asyncio.wait_for(k.publish("orders", b"m2"), 5)  # pid 0
+            assert cluster.nodes[1].not_leader_hits == 1
+            assert cluster.logs[("orders", 0)] == [(None, b"m0"), (None, b"m2")]
+        finally:
+            k.close()
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_multibroker_consume_spans_leaders_and_survives_moves(run):
+    """Subscribe fetches each partition from its own leader (concurrently),
+    and a mid-stream leadership move only costs one refresh round."""
+
+    async def scenario():
+        cluster = FakeCluster()
+        await cluster.start(2)
+        cluster.topics["orders"] = {0: 1, 1: 2}
+        cluster.logs[("orders", 0)] = [(None, b"a0")]
+        cluster.logs[("orders", 1)] = [(None, b"b0")]
+        k = Kafka(f"127.0.0.1:{cluster.nodes[1].port}", group_id="g",
+                  offset_start="earliest")
+        try:
+            got = set()
+            for _ in range(2):
+                msg = await asyncio.wait_for(k.subscribe("orders"), 5)
+                got.add(bytes(msg.value))
+                msg.commit()
+            assert got == {b"a0", b"b0"}
+
+            cluster.topics["orders"][1] = 1  # pid 1 moves to node 1
+            cluster.logs[("orders", 1)].append((None, b"b1"))
+            msg = await asyncio.wait_for(k.subscribe("orders"), 5)
+            assert bytes(msg.value) == b"b1"
+            # the old leader refused at least one stale fetch
+            assert cluster.nodes[2].not_leader_hits >= 1
+        finally:
+            k.close()
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_multibroker_dead_leader_heals_via_metadata(run):
+    """A crashed leader (socket refused, not a protocol error) also
+    invalidates the leader map: the client refreshes from the bootstrap
+    broker and retries on the new leader."""
+
+    async def scenario():
+        cluster = FakeCluster()
+        await cluster.start(2)
+        cluster.topics["orders"] = {0: 1, 1: 2}
+        k = Kafka(f"127.0.0.1:{cluster.nodes[1].port}")
+        try:
+            await asyncio.wait_for(k.publish("orders", b"m0"), 5)  # pid 0 @ n1
+            await asyncio.wait_for(k.publish("orders", b"m1"), 5)  # pid 1 @ n2
+            # node 2 dies; its partition fails over to node 1
+            await cluster.nodes[2].stop()
+            cluster.topics["orders"][1] = 1
+            await asyncio.wait_for(k.publish("orders", b"m2"), 5)  # pid 0
+            await asyncio.wait_for(k.publish("orders", b"m3"), 5)  # pid 1
+            assert cluster.logs[("orders", 1)] == [(None, b"m1"), (None, b"m3")]
+        finally:
+            k.close()
+            await cluster.stop()
+
+    run(scenario())
