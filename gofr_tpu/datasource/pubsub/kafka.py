@@ -222,9 +222,11 @@ class _Conn:
                 size_raw = await self._reader.readexactly(4)
                 (size,) = struct.unpack(">i", size_raw)
                 payload = await self._reader.readexactly(size)
-            except Exception:
+            except BaseException:
                 # a half-done exchange poisons correlation state; drop the
-                # socket so the next request redials cleanly
+                # socket so the next request redials cleanly. BaseException:
+                # a wait_for cancellation between write and read must also
+                # not leave the response buffered for the next caller.
                 self.close()
                 raise
             r = Reader(payload)
@@ -326,18 +328,30 @@ class Kafka:
         def topic(x: Reader):
             terr, name = x.int16(), x.string()
             parts = x.array(part)
-            return name, terr, {pid: leader for _, pid, leader in parts}
+            return name, terr, parts
 
-        tops = {name: (terr, leaders) for name, terr, leaders in r.array(topic)}
+        tops = {name: (terr, parts) for name, terr, parts in r.array(topic)}
         self._brokers = {nid: (host, port) for nid, host, port in brokers}
         return {"brokers": brokers, "topics": tops}
 
     async def _refresh(self, topic: str) -> dict[int, int]:
-        """Fetch topic metadata and rebuild its partition->leader map."""
+        """Fetch topic metadata and rebuild its partition->leader map.
+
+        Mid-election partitions (per-partition error, or leader == -1) fail
+        the refresh instead of being cached — routing them anywhere would
+        burn retries against brokers that must answer NOT_LEADER."""
         meta = await self._metadata([topic])
-        terr, leaders = meta["topics"].get(topic, (3, {}))
-        if terr != 0 or not leaders:
+        terr, parts = meta["topics"].get(topic, (3, []))
+        if terr != 0 or not parts:
             raise KafkaProtocolError(f"metadata {topic}", terr or 3)
+        for perr, pid, leader in parts:
+            if perr in _RETRIABLE or leader < 0:
+                raise KafkaProtocolError(
+                    f"metadata {topic} partition {pid}", perr or 5)
+            if perr:
+                raise KafkaProtocolError(
+                    f"metadata {topic} partition {pid}", perr)
+        leaders = {pid: leader for _, pid, leader in parts}
         self._leaders[topic] = leaders
         return leaders
 
@@ -546,9 +560,11 @@ class Kafka:
                         n += 1
         if stale:
             self._invalidate(topic)
-            # an errored fetch returns immediately (no broker-side
-            # long-poll); don't hammer Metadata+Fetch during an election
-            await asyncio.sleep(self._fetch_wait / 1000)
+            if n == 0:
+                # an errored fetch returns immediately (no broker-side
+                # long-poll); don't hammer Metadata+Fetch during an
+                # election. With messages in hand, deliver them first.
+                await asyncio.sleep(self._fetch_wait / 1000)
         return n
 
     async def subscribe(self, topic: str) -> Message:

@@ -1,0 +1,322 @@
+"""Multi-host serving topology: a front-end process owning the HTTP/gRPC
+ports, backed by an N-process ``jax.distributed`` mesh running the model.
+
+This is SURVEY §7's hardest-part #3 (who owns the serving port vs who runs
+the mesh — the reference has no analogue; its "distributed" story is
+microservice RPC, pkg/gofr/service/). The topology here:
+
+- **Model workers** (one OS process per host) form the ``jax.distributed``
+  mesh; every rank runs the same lock-step SPMD decode program over a
+  ``(dp=hosts, tp=local-chips)`` mesh, so tensor-parallel shards ride ICI
+  and the dp axis crosses hosts over DCN.
+- **Rank 0** additionally listens on a TCP "model port" with
+  length-prefixed JSON frames. It is the only rank the front-end talks to.
+- Each request is **broadcast** from rank 0 to all ranks
+  (``multihost_utils.broadcast_one_to_all`` — the same collective fabric
+  the compute uses), then every rank executes the identical jitted
+  prefill + decode steps; greedy sampling is deterministic, so all ranks
+  stay in lock-step without further coordination. Rank 0 streams each
+  token frame back to the front-end as it is produced.
+- The **front-end** is an ordinary gofr app (HTTP/SSE/gRPC) holding a
+  ``MultiHostLLMClient``; it never touches jax, so serving latency is
+  isolated from mesh work and the front-end can run on a CPU-only box.
+
+Shutdown: a ``stop`` frame makes rank 0 broadcast op=0; every rank exits
+its loop. A front-end disconnect only returns rank 0 to accept().
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import struct
+from typing import Any, AsyncIterator, Iterable
+
+__all__ = ["MultiHostWorker", "MultiHostLLMClient", "send_frame", "recv_frame"]
+
+_OP_STOP = 0
+_OP_GENERATE = 1
+
+
+# -- framed JSON over a socket (sync side: worker rank 0) ---------------------
+
+def send_frame(sock: socket.socket, obj: Any) -> None:
+    raw = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(raw)) + raw)
+
+
+def recv_frame(sock: socket.socket) -> Any | None:
+    """None on EOF."""
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (size,) = struct.unpack(">I", header)
+    body = _recv_exact(sock, size)
+    if body is None:
+        return None
+    return json.loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class MultiHostWorker:
+    """One rank of the serving mesh. ``run()`` blocks for the process
+    lifetime; rank 0 also serves the model port."""
+
+    def __init__(self, process_id: int, num_processes: int,
+                 coordinator: str, *, port: int = 0, cfg=None, seed: int = 0,
+                 prompt_bucket: int = 32, logger=None) -> None:
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.coordinator = coordinator
+        self.port = port
+        self.seed = seed
+        self.prompt_bucket = prompt_bucket
+        self._cfg = cfg
+        self._logger = logger
+
+    # -- mesh + model setup ----------------------------------------------------
+    def _setup(self):
+        import jax
+        import numpy as np
+
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator,
+            num_processes=self.num_processes,
+            process_id=self.process_id,
+        )
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding
+
+        from .. import parallel as par
+        from ..models import llama
+        from ..parallel import P
+
+        cfg = self._cfg or llama.config_from_env()
+        # dp spans processes (DCN), tp spans each host's local chips (ICI)
+        local = jax.local_device_count()
+        devices = np.array(jax.devices()).reshape(self.num_processes, local)
+        mesh = Mesh(devices, ("dp", "tp"))
+        self.mesh = mesh
+        self.cfg = cfg
+        self.batch = self.num_processes  # one row per dp shard
+
+        params = llama.init_params(cfg, jax.random.PRNGKey(self.seed))
+        specs = par.specs_from_rules(params, llama.SHARDING_RULES)
+        self.params = par.shard_params(params, specs, mesh)
+
+        self._data_spec = NamedSharding(mesh, P("dp", None))
+        self._row_spec = NamedSharding(mesh, P("dp"))
+
+        def prefill_fn(p, toks, lens, cache):
+            logits, cache = llama.prefill(p, toks, lens, cfg, cache)
+            # argmax stays inside jit: eager ops on non-fully-addressable
+            # global arrays are rejected in multi-controller mode
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        def decode_fn(p, tok, cache):
+            logits, cache = llama.decode_step(p, tok, cache, cfg)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        self._prefill = jax.jit(prefill_fn)
+        self._decode = jax.jit(decode_fn)
+        self._init_cache = lambda: llama.init_cache(cfg, self.batch)
+        self._jnp = jnp
+        self._np = np
+        self._jax = jax
+
+    # -- request broadcast -----------------------------------------------------
+    def _broadcast(self, cmd) -> "Any":
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(
+            cmd, is_source=self.process_id == 0)
+
+    def _cmd_array(self, op: int, tokens: Iterable[int] = (),
+                   max_new: int = 0):
+        np = self._np
+        tokens = list(tokens)[: self.prompt_bucket]
+        arr = np.zeros(3 + self.prompt_bucket, np.int32)
+        arr[0], arr[1], arr[2] = op, len(tokens), max_new
+        arr[3:3 + len(tokens)] = tokens
+        return arr
+
+    # -- the lock-step generate program ---------------------------------------
+    def _local0(self, arr) -> int:
+        """First element of this process's addressable shard — rank 0's
+        shard of a dp-sharded [B] array is global row 0."""
+        shard = arr.addressable_shards[0]
+        return int(self._np.asarray(shard.data).ravel()[0])
+
+    def _generate(self, tokens: list[int], max_new: int, sink=None) -> None:
+        """All ranks run this with identical arguments; only rank 0 has a
+        ``sink`` socket to stream tokens into."""
+        np, jax = self._np, self._jax
+        n = len(tokens)
+        local_batch = self.batch // self.num_processes
+        local = np.zeros((local_batch, self.prompt_bucket), np.int32)
+        local[:, :n] = tokens  # every dp row serves the same request
+        toks = jax.make_array_from_process_local_data(
+            self._data_spec, local, (self.batch, self.prompt_bucket))
+        lens = jax.make_array_from_process_local_data(
+            self._row_spec, np.full((local_batch,), n, np.int32),
+            (self.batch,))
+        with self.mesh:
+            tok, cache = self._prefill(self.params, toks, lens,
+                                       self._init_cache())
+            for _ in range(max_new - 1):
+                if sink is not None:
+                    send_frame(sink, {"token": self._local0(tok)})
+                tok, cache = self._decode(self.params, tok, cache)
+            if sink is not None:
+                send_frame(sink, {"token": self._local0(tok)})
+                send_frame(sink, {"done": True})
+
+    # -- main loops ------------------------------------------------------------
+    def run(self) -> None:
+        self._setup()
+        if self.process_id == 0:
+            self._run_rank0()
+        else:
+            self._run_follower()
+
+    def _run_follower(self) -> None:
+        while True:
+            cmd = self._np.asarray(self._broadcast(self._cmd_array(_OP_STOP)))
+            op, n, max_new = int(cmd[0]), int(cmd[1]), int(cmd[2])
+            if op == _OP_STOP:
+                return
+            self._generate([int(t) for t in cmd[3:3 + n]], max_new)
+
+    def _run_rank0(self) -> None:
+        server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        server.bind(("0.0.0.0", self.port))
+        server.listen(4)
+        self.port = server.getsockname()[1]
+        # the launcher scrapes this line to find the model port
+        print(f"MODEL_PORT {self.port}", flush=True)
+        try:
+            while True:
+                conn, _ = server.accept()
+                if not self._serve_conn(conn):
+                    return  # stop was requested
+        finally:
+            server.close()
+
+    def _serve_conn(self, conn: socket.socket) -> bool:
+        """Serve one front-end connection; False means shut down."""
+        try:
+            while True:
+                req = recv_frame(conn)
+                if req is None:
+                    return True  # front-end went away; accept the next one
+                if req.get("op") == "stop":
+                    self._broadcast(self._cmd_array(_OP_STOP))
+                    send_frame(conn, {"stopped": True})
+                    return False
+                tokens = [int(t) for t in req.get("tokens", [])]
+                max_new = max(1, int(req.get("max_new", 16)))
+                if not tokens or len(tokens) > self.prompt_bucket:
+                    send_frame(conn, {
+                        "error": f"prompt must be 1..{self.prompt_bucket} tokens"})
+                    continue
+                cmd = self._np.asarray(
+                    self._broadcast(self._cmd_array(_OP_GENERATE, tokens,
+                                                    max_new)))
+                self._generate([int(t) for t in cmd[3:3 + int(cmd[1])]],
+                               int(cmd[2]), sink=conn)
+        except (ConnectionResetError, BrokenPipeError):
+            return True
+        finally:
+            conn.close()
+
+
+class MultiHostLLMClient:
+    """Front-end side: asyncio client for rank 0's model port.
+
+    One in-flight request at a time per connection (the mesh is lock-step
+    anyway); a lock serializes callers. The front-end app holds one of
+    these per model-worker deployment."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host, self.port = host, port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._lock = asyncio.Lock()
+
+    async def _ensure(self) -> None:
+        if self._writer is None or self._writer.is_closing():
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port)
+
+    async def _send(self, obj: Any) -> None:
+        raw = json.dumps(obj).encode()
+        self._writer.write(struct.pack(">I", len(raw)) + raw)
+        await self._writer.drain()
+
+    async def _recv(self) -> Any:
+        header = await self._reader.readexactly(4)
+        (size,) = struct.unpack(">I", header)
+        return json.loads(await self._reader.readexactly(size))
+
+    async def stream(self, prompt_ids: Iterable[int],
+                     max_new: int) -> AsyncIterator[int]:
+        """Yield generated token ids as the mesh produces them."""
+        async with self._lock:
+            await self._ensure()
+            finished = False
+            try:
+                await self._send({"op": "generate",
+                                  "tokens": list(prompt_ids),
+                                  "max_new": max_new})
+                while True:
+                    frame = await self._recv()
+                    if "error" in frame:
+                        finished = True
+                        raise RuntimeError(frame["error"])
+                    if frame.get("done"):
+                        finished = True
+                        return
+                    yield int(frame["token"])
+            finally:
+                if not finished:
+                    # abandoned mid-stream (consumer disconnect): the worker
+                    # keeps writing this generation's frames, so drop the
+                    # socket — a later request must not read stale tokens
+                    await self.close()
+
+    async def generate(self, prompt_ids: Iterable[int],
+                       max_new: int) -> list[int]:
+        return [tok async for tok in self.stream(prompt_ids, max_new)]
+
+    async def shutdown_workers(self) -> None:
+        """Stop the whole mesh (all ranks exit)."""
+        async with self._lock:
+            await self._ensure()
+            await self._send({"op": "stop"})
+            await self._recv()  # {"stopped": true}
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+    async def health_check(self) -> dict:
+        try:
+            await self._ensure()
+            return {"status": "UP",
+                    "details": {"model_addr": f"{self.host}:{self.port}"}}
+        except OSError as exc:
+            return {"status": "DOWN",
+                    "details": {"model_addr": f"{self.host}:{self.port}",
+                                "error": str(exc)[:200]}}

@@ -166,3 +166,155 @@ def test_two_process_dcn_collectives(tmp_path):
     for out in outs:
         assert "total=576.0" in out
         assert "psum_sum=576.0" in out
+
+
+# --------------------------------------------------- serving topology (§7 #3)
+_SERVE_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from gofr_tpu.ml.multihost import MultiHostWorker
+from gofr_tpu.models import llama
+import jax.numpy as jnp
+
+pid, coord, port = int(sys.argv[1]), sys.argv[2], int(sys.argv[3])
+cfg = llama.tiny_llama(use_flash=False, dtype=jnp.float32)
+MultiHostWorker(pid, 2, coord, port=port if pid == 0 else 0, cfg=cfg,
+                prompt_bucket=16).run()
+print(f"OK proc={pid}", flush=True)
+"""
+
+
+def _reference_greedy(prompt, max_new):
+    """Single-process greedy decode with the same seed: the multi-host
+    mesh must reproduce it exactly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gofr_tpu.models import llama
+
+    cfg = llama.tiny_llama(use_flash=False, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    toks = np.zeros((1, 16), np.int32)
+    toks[0, :len(prompt)] = prompt
+    lens = np.array([len(prompt)], np.int32)
+
+    prefill = jax.jit(lambda p, t, l, c: llama.prefill(p, t, l, cfg, c))
+    decode = jax.jit(lambda p, t, c: llama.decode_step(p, t, c, cfg))
+    logits, cache = prefill(params, toks, lens, llama.init_cache(cfg, 1))
+    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    out = [int(tok[0])]
+    for _ in range(max_new - 1):
+        logits, cache = decode(params, tok, cache)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        out.append(int(tok[0]))
+    return out
+
+
+def test_multihost_serving_topology(tmp_path, run):
+    """SURVEY §7 hardest-part #3: a front-end process owns the HTTP port
+    and streams tokens over SSE while a 2-process jax.distributed mesh
+    (dp=2 x tp=4 virtual devices) runs the model. Tokens must arrive
+    incrementally across the process boundary and match a single-process
+    greedy decode bit-for-bit."""
+    import asyncio
+    import json as _json
+
+    worker = tmp_path / "serve_worker.py"
+    worker.write_text(_SERVE_WORKER)
+    coord = f"127.0.0.1:{get_free_port()}"
+    model_port = get_free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    logs = [open(tmp_path / f"w{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), coord, str(model_port)],
+            stdout=logs[i], stderr=subprocess.STDOUT, env=env, cwd=repo,
+        )
+        for i in range(2)
+    ]
+
+    prompt = [5, 9, 2, 7]
+    max_new = 8
+
+    async def scenario():
+        import aiohttp
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from gofr_tpu.app import App
+        from gofr_tpu.config import MapConfig
+        from gofr_tpu.http.sse import EventStream
+        from gofr_tpu.ml.multihost import MultiHostLLMClient
+
+        llm = MultiHostLLMClient("127.0.0.1", model_port)
+        # wait for rank 0 to open the model port (jax.distributed init +
+        # first CPU compiles take a while)
+        deadline = asyncio.get_running_loop().time() + 120
+        while True:
+            try:
+                await llm._ensure()
+                break
+            except OSError:
+                if any(p.poll() is not None for p in procs):
+                    raise AssertionError("a worker died during startup")
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError("rank 0 never opened the model port")
+                await asyncio.sleep(0.5)
+
+        # the front-end gofr app: SSE /generate backed by the mesh client
+        app = App(config=MapConfig({"APP_NAME": "frontend"}))
+
+        async def gen(ctx):
+            ids = [int(x) for x in ctx.param("ids").split(",")]
+            n = int(ctx.param("n") or "8")
+            async with EventStream(ctx) as stream:
+                async for tok in llm.stream(ids, n):
+                    await stream.send({"token": tok})
+                await stream.done()
+            return stream.response
+
+        app.get("/generate", gen)
+        server = TestServer(app._build_http_app())
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            ids = ",".join(map(str, prompt))
+            events = []
+            async with client.get(f"/generate?ids={ids}&n={max_new}") as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if line.startswith("data:") and line[5:].strip() != "[DONE]":
+                        events.append(_json.loads(line[5:]))
+            tokens = [e["token"] for e in events if "token" in e]
+            assert len(tokens) == max_new
+            assert tokens == _reference_greedy(prompt, max_new)
+
+            # a second request reuses the live mesh (no re-init)
+            toks2 = await llm.generate([3, 1], 4)
+            assert toks2 == _reference_greedy([3, 1], 4)
+
+            await llm.shutdown_workers()
+        finally:
+            await llm.close()
+            await client.close()
+
+    try:
+        run(scenario())
+        for i, p in enumerate(procs):
+            assert p.wait(timeout=30) == 0, f"worker {i} exited non-zero"
+            logs[i].seek(0)
+            assert f"OK proc={i}" in logs[i].read()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
