@@ -6,18 +6,95 @@ are local fakes — an in-memory sqlite SQL (the reference itself uses pure-Go
 sqlite as a real-but-local dialect, SURVEY §4), a dict-backed Redis fake, the
 in-process pub/sub broker, an in-memory KV store — plus a ``Mocks`` handle for
 seeding and asserting on them. No sockets, no services, deterministic.
+
+Expectation discipline mirrors sql_mock.go:97-105: expectations declared via
+``mocks.expect_*`` are matched in declaration order as the code under test
+calls the fakes (scripted returns/errors override the fake's real behavior),
+and ``mocks.verify()`` — called automatically by the ``mock_container``
+context manager — fails the test if any expectation was never consumed.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import contextlib
+from dataclasses import dataclass, field
 from typing import Any
 
 from ..config import MapConfig
 from ..logging import Logger, Level
 from . import Container
 
-__all__ = ["new_mock_container", "Mocks", "FakeRedis"]
+__all__ = ["new_mock_container", "mock_container", "Mocks", "FakeRedis"]
+
+_UNSET = object()
+
+
+@dataclass
+class _Expectation:
+    target: str
+    method: str
+    args: tuple
+    returns: Any = _UNSET
+    error: BaseException | None = None
+    consumed: bool = False
+
+    def __str__(self) -> str:
+        args = ", ".join(repr(a) for a in self.args)
+        return f"{self.target}.{self.method}({args})"
+
+
+class ExpectationRegistry:
+    """Ordered expectations over the container's fakes (sql_mock.go role)."""
+
+    def __init__(self) -> None:
+        self._pending: list[_Expectation] = []
+
+    def add(self, target: str, method: str, args: tuple,
+            returns: Any = _UNSET, error: BaseException | None = None) -> None:
+        self._pending.append(_Expectation(target, method, args, returns, error))
+
+    @staticmethod
+    def _arg_match(expected: Any, actual: Any) -> bool:
+        """Exact match, except string expectations match as a prefix — the
+        role of sqlmock's regexp query matching (an expectation for
+        "SELECT * FROM users" matches the call's full statement)."""
+        if expected == actual:
+            return True
+        return (isinstance(expected, str) and isinstance(actual, str)
+                and actual.startswith(expected))
+
+    def consume(self, target: str, method: str, args: tuple) -> _Expectation | None:
+        """First unconsumed expectation whose (target, method, arg-prefix)
+        matches this call; None means the call is unscripted (the fake's
+        real behavior runs)."""
+        for exp in self._pending:
+            if exp.consumed or exp.target != target or exp.method != method:
+                continue
+            if len(args) >= len(exp.args) and all(
+                    self._arg_match(e, a) for e, a in zip(exp.args, args)):
+                exp.consumed = True
+                return exp
+        return None
+
+    def unconsumed(self) -> list[_Expectation]:
+        return [e for e in self._pending if not e.consumed]
+
+    def verify(self) -> None:
+        left = self.unconsumed()
+        if left:
+            lines = "\n  ".join(str(e) for e in left)
+            raise AssertionError(
+                f"{len(left)} mock expectation(s) never consumed:\n  {lines}")
+
+
+_COMMAND_VERBS = {name: name for name in (
+    "ping", "get", "set", "delete", "exists", "incr", "decr", "expire",
+    "ttl", "setnx", "mset", "mget", "keys", "flushdb", "flushall",
+    "hset", "hget", "hgetall", "hdel", "hexists",
+    "lpush", "rpush", "lpop", "rpop", "llen", "lrange",
+    "sadd", "srem", "smembers", "sismember",
+)}
+_COMMAND_VERBS["del"] = "delete"
 
 
 class FakeRedis:
@@ -27,6 +104,7 @@ class FakeRedis:
         self.store: dict[str, Any] = {}
         self.hashes: dict[str, dict[str, str]] = {}
         self.lists: dict[str, list] = {}
+        self.sets: dict[str, set] = {}
 
     def connect(self) -> None:
         pass
@@ -59,6 +137,46 @@ class FakeRedis:
     def expire(self, key: str, seconds: int) -> int:
         return 1 if key in self.store else 0
 
+    def decr(self, key: str) -> int:
+        val = int(self.store.get(key, "0")) - 1
+        self.store[key] = str(val)
+        return val
+
+    def setnx(self, key: str, value: Any) -> int:
+        if key in self.store:
+            return 0
+        self.store[key] = str(value)
+        return 1
+
+    def mset(self, *pairs: Any) -> str:
+        for k, v in zip(pairs[::2], pairs[1::2]):
+            self.store[str(k)] = str(v)
+        return "OK"
+
+    def mget(self, *keys: str) -> list[str | None]:
+        return [self.store.get(k) for k in keys]
+
+    def ttl(self, key: str) -> int:
+        # the fake never expires keys; -1 = exists without ttl, -2 = absent
+        return -1 if key in self.store else -2
+
+    def keys(self, pattern: str = "*") -> list[str]:
+        import fnmatch
+
+        everything = (set(self.store) | set(self.hashes) | set(self.lists)
+                      | set(self.sets))
+        return sorted(k for k in everything if fnmatch.fnmatch(k, pattern))
+
+    def flushdb(self) -> str:
+        self.store.clear()
+        self.hashes.clear()
+        self.lists.clear()
+        self.sets.clear()
+        return "OK"
+
+    flushall = flushdb
+
+    # -- hashes ---------------------------------------------------------------
     def hset(self, key: str, field: str, value: Any) -> int:
         self.hashes.setdefault(key, {})[field] = str(value)
         return 1
@@ -69,15 +187,57 @@ class FakeRedis:
     def hgetall(self, key: str) -> dict[str, str]:
         return dict(self.hashes.get(key, {}))
 
+    def hdel(self, key: str, *fields: str) -> int:
+        h = self.hashes.get(key, {})
+        return sum(1 for f in fields if h.pop(f, None) is not None)
+
+    def hexists(self, key: str, field: str) -> int:
+        return 1 if field in self.hashes.get(key, {}) else 0
+
+    # -- lists ----------------------------------------------------------------
     def lpush(self, key: str, *values: Any) -> int:
         lst = self.lists.setdefault(key, [])
         for v in values:
             lst.insert(0, str(v))
         return len(lst)
 
+    def rpush(self, key: str, *values: Any) -> int:
+        lst = self.lists.setdefault(key, [])
+        lst.extend(str(v) for v in values)
+        return len(lst)
+
     def rpop(self, key: str) -> str | None:
         lst = self.lists.get(key)
         return lst.pop() if lst else None
+
+    def lpop(self, key: str) -> str | None:
+        lst = self.lists.get(key)
+        return lst.pop(0) if lst else None
+
+    def llen(self, key: str) -> int:
+        return len(self.lists.get(key, []))
+
+    def lrange(self, key: str, start: int, stop: int) -> list[str]:
+        lst = self.lists.get(key, [])
+        stop = len(lst) if stop == -1 else stop + 1
+        return lst[start:stop]
+
+    # -- sets -----------------------------------------------------------------
+    def sadd(self, key: str, *members: Any) -> int:
+        s = self.sets.setdefault(key, set())
+        added = sum(1 for m in members if str(m) not in s)
+        s.update(str(m) for m in members)
+        return added
+
+    def srem(self, key: str, *members: Any) -> int:
+        s = self.sets.get(key, set())
+        return sum(1 for m in members if str(m) in s and (s.remove(str(m)) or True))
+
+    def smembers(self, key: str) -> set[str]:
+        return set(self.sets.get(key, set()))
+
+    def sismember(self, key: str, member: Any) -> int:
+        return 1 if str(member) in self.sets.get(key, set()) else 0
 
     def pipeline(self):
         return _FakePipeline(self)
@@ -85,7 +245,15 @@ class FakeRedis:
     tx_pipeline = pipeline
 
     def command(self, *args: Any) -> Any:
-        raise NotImplementedError(f"FakeRedis does not implement {args[0]}")
+        """Generic verb dispatch, like the RESP client: ``command("SADD",
+        "k", "v")`` routes to ``sadd``. An explicit verb map (not getattr)
+        so lifecycle methods and attributes can never be invoked as
+        commands, and RESP names that differ (DEL) still resolve."""
+        verb = str(args[0]).lower()
+        method = _COMMAND_VERBS.get(verb)
+        if method is None:
+            raise NotImplementedError(f"FakeRedis does not implement {args[0]}")
+        return getattr(self, method)(*args[1:])
 
     def health_check(self) -> dict:
         return {"status": "UP", "details": {"backend": "fake"}}
@@ -129,6 +297,33 @@ class _FakePipeline:
         self._ops = []
 
 
+_REDIS_INTERCEPTED = (
+    "get", "set", "delete", "exists", "incr", "decr", "expire", "ttl",
+    "hset", "hget", "hgetall", "hdel", "lpush", "rpush", "rpop", "lpop",
+    "sadd", "srem", "smembers", "sismember", "mget", "mset", "command",
+)
+_SQL_INTERCEPTED = ("query", "query_row", "select", "exec", "exec_last_id")
+
+
+def _intercept(obj: Any, target: str, methods: tuple[str, ...],
+               registry: ExpectationRegistry) -> None:
+    """Route each call through the registry: a matching expectation may
+    script the return/error; otherwise the fake's real behavior runs."""
+    for name in methods:
+        real = getattr(obj, name)
+
+        def wrapper(*args: Any, __name: str = name, __real=real, **kw: Any):
+            exp = registry.consume(target, __name, args)
+            if exp is not None:
+                if exp.error is not None:
+                    raise exp.error
+                if exp.returns is not _UNSET:
+                    return exp.returns
+            return __real(*args, **kw)
+
+        setattr(obj, name, wrapper)
+
+
 @dataclass
 class Mocks:
     sql: Any
@@ -136,6 +331,27 @@ class Mocks:
     kv: Any
     pubsub: Any
     ml: Any = None
+    expectations: ExpectationRegistry = field(default_factory=ExpectationRegistry)
+
+    # -- expectation shims (reference sql_mock.go ExpectSelect et al.) --------
+    def expect_sql(self, method: str, *args: Any,
+                   returns: Any = _UNSET, error: BaseException | None = None) -> None:
+        self.expectations.add("sql", method, args, returns, error)
+
+    def expect_sql_select(self, query: str, rows: list,
+                          error: BaseException | None = None) -> None:
+        """Script the result of ``sql.query(query, ...)`` (ExpectSelect)."""
+        self.expectations.add("sql", "query", (query,),
+                              rows if error is None else _UNSET, error)
+
+    def expect_redis(self, method: str, *args: Any,
+                     returns: Any = _UNSET, error: BaseException | None = None) -> None:
+        self.expectations.add("redis", method, args, returns, error)
+
+    def verify(self) -> None:
+        """Fail if any declared expectation was never consumed
+        (reference sql_mock.go:97-105 cleanup assertion)."""
+        self.expectations.verify()
 
 
 def new_mock_container(config: dict[str, str] | None = None) -> tuple[Container, Mocks]:
@@ -154,4 +370,20 @@ def new_mock_container(config: dict[str, str] | None = None) -> tuple[Container,
         sql=container.sql, redis=container.redis, kv=container.kv,
         pubsub=container.pubsub,
     )
+    _intercept(container.redis, "redis", _REDIS_INTERCEPTED, mocks.expectations)
+    _intercept(container.sql, "sql", _SQL_INTERCEPTED, mocks.expectations)
     return container, mocks
+
+
+@contextlib.contextmanager
+def mock_container(config: dict[str, str] | None = None):
+    """``with mock_container() as (container, mocks):`` — verifies all
+    expectations were consumed on successful exit (the reference asserts
+    this in the test-cleanup hook, sql_mock.go:97-105)."""
+    container, mocks = new_mock_container(config)
+    try:
+        yield container, mocks
+    except BaseException:
+        raise  # the test already failed; don't mask it with verify noise
+    else:
+        mocks.verify()

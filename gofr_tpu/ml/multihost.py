@@ -170,16 +170,27 @@ class MultiHostWorker:
         lens = jax.make_array_from_process_local_data(
             self._row_spec, np.full((local_batch,), n, np.int32),
             (self.batch,))
+        def emit(obj) -> None:
+            # LOCK-STEP INVARIANT: a dead front-end socket must never abort
+            # the decode loop early — ranks 1..N-1 are running all max_new
+            # steps, and rank 0 quitting mid-loop would pair mismatched
+            # collectives across hosts. Stop writing; keep computing.
+            nonlocal sink
+            if sink is None:
+                return
+            try:
+                send_frame(sink, obj)
+            except OSError:
+                sink = None
+
         with self.mesh:
             tok, cache = self._prefill(self.params, toks, lens,
                                        self._init_cache())
             for _ in range(max_new - 1):
-                if sink is not None:
-                    send_frame(sink, {"token": self._local0(tok)})
+                emit({"token": self._local0(tok)})
                 tok, cache = self._decode(self.params, tok, cache)
-            if sink is not None:
-                send_frame(sink, {"token": self._local0(tok)})
-                send_frame(sink, {"done": True})
+            emit({"token": self._local0(tok)})
+            emit({"done": True})
 
     # -- main loops ------------------------------------------------------------
     def run(self) -> None:
@@ -220,12 +231,19 @@ class MultiHostWorker:
                 req = recv_frame(conn)
                 if req is None:
                     return True  # front-end went away; accept the next one
+                if not isinstance(req, dict):
+                    send_frame(conn, {"error": "frame must be an object"})
+                    continue
                 if req.get("op") == "stop":
                     self._broadcast(self._cmd_array(_OP_STOP))
                     send_frame(conn, {"stopped": True})
                     return False
-                tokens = [int(t) for t in req.get("tokens", [])]
-                max_new = max(1, int(req.get("max_new", 16)))
+                try:
+                    tokens = [int(t) for t in req.get("tokens", [])]
+                    max_new = max(1, int(req.get("max_new", 16)))
+                except (TypeError, ValueError):
+                    send_frame(conn, {"error": "tokens/max_new must be ints"})
+                    continue
                 if not tokens or len(tokens) > self.prompt_bucket:
                     send_frame(conn, {
                         "error": f"prompt must be 1..{self.prompt_bucket} tokens"})
@@ -235,7 +253,10 @@ class MultiHostWorker:
                                                     max_new)))
                 self._generate([int(t) for t in cmd[3:3 + int(cmd[1])]],
                                int(cmd[2]), sink=conn)
-        except (ConnectionResetError, BrokenPipeError):
+        except Exception:
+            # one bad connection (malformed frame, reset socket) must never
+            # take rank 0 down — the followers would block in broadcast
+            # forever with no stop frame ever sent
             return True
         finally:
             conn.close()
@@ -271,7 +292,16 @@ class MultiHostLLMClient:
 
     async def stream(self, prompt_ids: Iterable[int],
                      max_new: int) -> AsyncIterator[int]:
-        """Yield generated token ids as the mesh produces them."""
+        """Yield generated token ids as the mesh produces them.
+
+        The connection lock is held for the life of the generator. If you
+        may exit the loop early (``break``), wrap the call in
+        ``contextlib.aclosing`` so the lock releases deterministically
+        rather than at garbage collection::
+
+            async with aclosing(llm.stream(ids, n)) as toks:
+                async for tok in toks: ...
+        """
         async with self._lock:
             await self._ensure()
             finished = False
@@ -313,7 +343,10 @@ class MultiHostLLMClient:
 
     async def health_check(self) -> dict:
         try:
-            await self._ensure()
+            # under the lock: racing a stream()'s _ensure would clobber
+            # the shared reader/writer pair with a second connection
+            async with self._lock:
+                await self._ensure()
             return {"status": "UP",
                     "details": {"model_addr": f"{self.host}:{self.port}"}}
         except OSError as exc:

@@ -1,0 +1,127 @@
+"""Mock-container expectation discipline + FakeRedis command coverage.
+
+Reference: container.NewMockContainer consumed-expectation asserts
+(pkg/gofr/container/sql_mock.go:97-105) and the gomock-backed datasource
+mocks (mock_container.go:46-151).
+"""
+
+import pytest
+
+from gofr_tpu.container.mock import FakeRedis, mock_container, new_mock_container
+
+
+# ------------------------------------------------------------ FakeRedis ops
+def test_fake_redis_struct_commands():
+    r = FakeRedis()
+    assert r.setnx("k", "1") == 1 and r.setnx("k", "2") == 0
+    assert r.incr("n") == 1 and r.decr("n") == 0
+    r.mset("a", 1, "b", 2)
+    assert r.mget("a", "b", "missing") == ["1", "2", None]
+    assert r.ttl("a") == -1 and r.ttl("nope") == -2
+
+    r.rpush("l", "x", "y")
+    r.lpush("l", "w")
+    assert r.lrange("l", 0, -1) == ["w", "x", "y"]
+    assert r.llen("l") == 3
+    assert r.lpop("l") == "w" and r.rpop("l") == "y"
+
+    r.hset("h", "f", "v")
+    assert r.hexists("h", "f") == 1
+    assert r.hdel("h", "f") == 1 and r.hexists("h", "f") == 0
+
+    assert r.sadd("s", "m1", "m2") == 2
+    assert r.sadd("s", "m1") == 0
+    assert r.sismember("s", "m1") == 1
+    assert r.smembers("s") == {"m1", "m2"}
+    assert r.srem("s", "m1", "zz") == 1
+
+    assert r.keys("*") == sorted(["k", "n", "a", "b", "l", "h", "s"])
+    assert r.flushdb() == "OK"
+    assert r.keys("*") == []
+
+
+def test_fake_redis_generic_command_dispatch():
+    r = FakeRedis()
+    assert r.command("SADD", "s", "x") == 1
+    assert r.command("SMEMBERS", "s") == {"x"}
+    assert r.command("LPUSH", "l", "a") == 1
+    r.set("k", "v")
+    assert r.command("DEL", "k") == 1  # RESP name differs from the method
+    with pytest.raises(NotImplementedError):
+        r.command("XADD", "stream", "*")
+    # lifecycle methods and attributes are not dispatchable as commands
+    with pytest.raises(NotImplementedError):
+        r.command("CLOSE")
+    with pytest.raises(NotImplementedError):
+        r.command("STORE")
+
+
+# ----------------------------------------------------- expectation registry
+def test_scripted_redis_expectation_overrides_fake():
+    container, mocks = new_mock_container()
+    mocks.expect_redis("get", "greeting", returns="scripted")
+    assert container.redis.get("greeting") == "scripted"
+    # consumed: the next call falls through to the real fake (empty store)
+    assert container.redis.get("greeting") is None
+    mocks.verify()
+
+
+def test_sql_expectation_error_injection():
+    container, mocks = new_mock_container()
+    mocks.expect_sql("query", "SELECT", error=RuntimeError("db down"))
+    with pytest.raises(RuntimeError, match="db down"):
+        container.sql.query("SELECT 1")
+    mocks.verify()
+
+
+def test_expect_sql_select_scripts_rows():
+    container, mocks = new_mock_container()
+    rows = [{"id": 1, "name": "ada"}]
+    mocks.expect_sql_select("SELECT * FROM users", rows)
+    assert container.sql.query("SELECT * FROM users") == rows
+    mocks.verify()
+
+
+def test_unconsumed_expectation_fails_verify():
+    _, mocks = new_mock_container()
+    mocks.expect_redis("get", "never-touched", returns="x")
+    with pytest.raises(AssertionError, match="never-touched"):
+        mocks.verify()
+
+
+def test_mock_container_ctx_verifies_on_exit():
+    with pytest.raises(AssertionError, match="never consumed"):
+        with mock_container() as (container, mocks):
+            mocks.expect_redis("set", "k", returns="OK")
+            # handler under test never calls set -> cleanup must fail
+
+    # consumed expectations exit cleanly
+    with mock_container() as (container, mocks):
+        mocks.expect_redis("set", "k", returns="OK")
+        assert container.redis.set("k", "v") == "OK"
+
+
+def test_mock_container_ctx_does_not_mask_test_failures():
+    with pytest.raises(ValueError, match="real failure"):
+        with mock_container() as (_, mocks):
+            mocks.expect_redis("get", "k", returns="x")
+            raise ValueError("real failure")
+
+
+def test_expectations_flow_through_pipeline():
+    container, mocks = new_mock_container()
+    mocks.expect_redis("set", "a", returns="SCRIPTED")
+    out = container.redis.pipeline().set("a", "1").get("a").exec()
+    assert out[0] == "SCRIPTED"
+    assert out[1] is None  # scripted set never touched the store
+    mocks.verify()
+
+
+def test_unscripted_calls_use_real_fake_behavior():
+    container, mocks = new_mock_container()
+    container.redis.set("k", "v")
+    assert container.redis.get("k") == "v"
+    container.sql.exec("CREATE TABLE t (id INTEGER)")
+    container.sql.exec("INSERT INTO t VALUES (1)")
+    assert container.sql.query("SELECT id FROM t") == [{"id": 1}]
+    mocks.verify()  # no expectations declared: vacuously green
