@@ -86,3 +86,23 @@ def emit(metric: str, value: float, unit: str, target: float | None,
 
 def run(main_coro: Awaitable[None]) -> None:
     asyncio.run(main_coro)
+
+
+def tunnel_rtt_ms(samples: int = 12) -> float:
+    """p50 of a minimal dispatch + device->host fetch round-trip: the
+    mechanical floor the dev tunnel imposes on every wire latency;
+    directly-attached chips remove it. Shared by the config benches so
+    each run records its own tunnel weather."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.float32)
+    np.asarray(f(x))  # compile outside the timed window
+    times = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        times.append(time.perf_counter() - t0)
+    return percentile(times, 50) * 1e3

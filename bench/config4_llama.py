@@ -27,7 +27,7 @@ import time
 
 import numpy as np
 
-from common import boot, configure_free_ports, emit, percentile, run
+from common import boot, configure_free_ports, emit, percentile, run, tunnel_rtt_ms
 
 
 async def _metrics_ttft(ports) -> tuple[float, float]:
@@ -47,22 +47,6 @@ async def _metrics_ttft(ports) -> tuple[float, float]:
         return tot, cnt
     except Exception:
         return 0.0, 0.0
-
-
-def _tunnel_rtt_ms(samples: int = 12) -> float:
-    """p50 of a minimal dispatch + device->host fetch round-trip."""
-    import jax
-    import jax.numpy as jnp
-
-    f = jax.jit(lambda x: x + 1)
-    x = jnp.zeros((8,), jnp.float32)
-    np.asarray(f(x))  # compile outside the timed window
-    times = []
-    for _ in range(samples):
-        t0 = time.perf_counter()
-        np.asarray(f(x))
-        times.append(time.perf_counter() - t0)
-    return percentile(times, 50) * 1e3
 
 
 async def main() -> None:
@@ -112,7 +96,7 @@ async def main() -> None:
         pass
 
     # ---- phase 0: tunnel floor ------------------------------------------
-    rtt_ms = _tunnel_rtt_ms()
+    rtt_ms = tunnel_rtt_ms()
 
     # ---- phase A: TTFT at moderate load ---------------------------------
     ttft_streams = int(os.environ.get("BENCH_TTFT_STREAMS", "8"))

@@ -54,24 +54,27 @@ class ExpectationRegistry:
         self._pending.append(_Expectation(target, method, args, returns, error))
 
     @staticmethod
-    def _arg_match(expected: Any, actual: Any) -> bool:
-        """Exact match, except string expectations match as a prefix — the
-        role of sqlmock's regexp query matching (an expectation for
-        "SELECT * FROM users" matches the call's full statement)."""
+    def _arg_match(expected: Any, actual: Any, *, prefix: bool) -> bool:
+        """Exact match; SQL statement args additionally match as a prefix —
+        the role of sqlmock's regexp query matching (an expectation for
+        "SELECT * FROM users" matches the call's full statement). Redis
+        keys stay exact so expect("get", "k") can't swallow get("kind")."""
         if expected == actual:
             return True
-        return (isinstance(expected, str) and isinstance(actual, str)
-                and actual.startswith(expected))
+        return (prefix and isinstance(expected, str)
+                and isinstance(actual, str) and actual.startswith(expected))
 
     def consume(self, target: str, method: str, args: tuple) -> _Expectation | None:
         """First unconsumed expectation whose (target, method, arg-prefix)
         matches this call; None means the call is unscripted (the fake's
         real behavior runs)."""
+        prefix = target == "sql"
         for exp in self._pending:
             if exp.consumed or exp.target != target or exp.method != method:
                 continue
             if len(args) >= len(exp.args) and all(
-                    self._arg_match(e, a) for e, a in zip(exp.args, args)):
+                    self._arg_match(e, a, prefix=prefix)
+                    for e, a in zip(exp.args, args)):
                 exp.consumed = True
                 return exp
         return None
@@ -287,9 +290,13 @@ class _FakePipeline:
         out = []
         for op in self._ops:
             name, *args = op
-            # raw commands arrive verb-first ("HSET", key, field, value) —
-            # dispatch to the lowercase method like the RESP client would
-            out.append(getattr(self._redis, name.lower())(*args))
+            # raw commands arrive verb-first ("HSET", key, field, value):
+            # route through command() so the verb map's aliasing and
+            # attribute-safety apply to pipelined ops too
+            if name.lower() in ("set", "get", "delete"):
+                out.append(getattr(self._redis, name.lower())(*args))
+            else:
+                out.append(self._redis.command(name, *args))
         self._ops = []
         return out
 
@@ -297,11 +304,9 @@ class _FakePipeline:
         self._ops = []
 
 
-_REDIS_INTERCEPTED = (
-    "get", "set", "delete", "exists", "incr", "decr", "expire", "ttl",
-    "hset", "hget", "hgetall", "hdel", "lpush", "rpush", "rpop", "lpop",
-    "sadd", "srem", "smembers", "sismember", "mget", "mset", "command",
-)
+# every dispatchable verb is interceptable — derived so the two surfaces
+# (what command() can reach, what expectations can script) cannot drift
+_REDIS_INTERCEPTED = tuple(sorted(set(_COMMAND_VERBS.values()))) + ("command",)
 _SQL_INTERCEPTED = ("query", "query_row", "select", "exec", "exec_last_id")
 
 

@@ -256,7 +256,15 @@ class MultiHostWorker:
         except Exception:
             # one bad connection (malformed frame, reset socket) must never
             # take rank 0 down — the followers would block in broadcast
-            # forever with no stop frame ever sent
+            # forever with no stop frame ever sent. Loud, not silent: a
+            # _generate failure here means the mesh may be desynced.
+            import traceback
+
+            if self._logger is not None:
+                self._logger.errorf("model-port connection failed: %s",
+                                    traceback.format_exc())
+            else:
+                traceback.print_exc()
             return True
         finally:
             conn.close()
@@ -342,13 +350,18 @@ class MultiHostLLMClient:
             self._writer = None
 
     async def health_check(self) -> dict:
+        up = {"status": "UP",
+              "details": {"model_addr": f"{self.host}:{self.port}"}}
+        # a live connection answers without the lock — stream() holds it
+        # for a whole generation, and a probe must not block behind that
+        if self._writer is not None and not self._writer.is_closing():
+            return up
         try:
             # under the lock: racing a stream()'s _ensure would clobber
             # the shared reader/writer pair with a second connection
             async with self._lock:
                 await self._ensure()
-            return {"status": "UP",
-                    "details": {"model_addr": f"{self.host}:{self.port}"}}
+            return up
         except OSError as exc:
             return {"status": "DOWN",
                     "details": {"model_addr": f"{self.host}:{self.port}",

@@ -117,6 +117,32 @@ def test_expectations_flow_through_pipeline():
     mocks.verify()
 
 
+def test_redis_expectations_match_keys_exactly():
+    """expect("get", "k") must not swallow get("kind") — prefix matching
+    is a SQL-statement affordance only."""
+    container, mocks = new_mock_container()
+    mocks.expect_redis("get", "k", returns="scripted")
+    assert container.redis.get("kind") is None      # unrelated key untouched
+    assert container.redis.get("k") == "scripted"
+    mocks.verify()
+
+
+def test_pipeline_command_verbs_use_alias_map():
+    container, mocks = new_mock_container()
+    container.redis.set("k", "v")
+    out = container.redis.pipeline().command("DEL", "k").exec()
+    assert out == [1]
+    with pytest.raises(NotImplementedError):
+        container.redis.pipeline().command("STORE").exec()
+
+
+def test_all_dispatchable_verbs_are_interceptable():
+    container, mocks = new_mock_container()
+    mocks.expect_redis("setnx", "lock", returns=0)
+    assert container.redis.setnx("lock", "owner") == 0  # scripted, not fake
+    mocks.verify()
+
+
 def test_unscripted_calls_use_real_fake_behavior():
     container, mocks = new_mock_container()
     container.redis.set("k", "v")
