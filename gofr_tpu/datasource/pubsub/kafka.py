@@ -7,12 +7,18 @@ subscribe counters, reader stats in health). No Kafka client library ships
 in this image, so — like the RESP/NATS clients in this package — this
 implements the binary protocol directly over asyncio streams:
 
-- Metadata v0 (api 3) for partition discovery and health
-- Produce v0 (api 0, acks=1) with CRC-framed v0 message sets
-- Fetch v0 (api 1) with server-side long-poll (max_wait)
-- ListOffsets v0 (api 2) for earliest/latest start positions
-- OffsetCommit/OffsetFetch v0 (apis 8/9) for consumer-group offsets
-- CreateTopics/DeleteTopics v0 (apis 19/20)
+- ApiVersions (api 18) probed once per broker connection; each API then
+  negotiates the highest version both sides speak, so the client works
+  against KRaft brokers (Kafka >= 4.0, which removed the v0 frames per
+  KIP-896) AND pre-ApiVersions brokers (probe fails -> v0 everywhere)
+- Metadata v4|v0 (api 3) for partition-leader discovery and health
+- Produce v3|v0 (api 0, acks=1): v2 record batches (kafka_records.py,
+  CRC32C + zigzag varints) or CRC-framed v0 message sets
+- Fetch v4|v0 (api 1) with server-side long-poll (max_wait); record sets
+  decode by magic byte, so down-converted legacy batches still parse
+- ListOffsets v1|v0 (api 2) for earliest/latest start positions
+- OffsetCommit v2|v0 / OffsetFetch v1|v0 (apis 8/9) for group offsets
+- CreateTopics v2|v0 / DeleteTopics v1|v0 (apis 19/20)
 
 Delivery semantics mirror the reference subscriber runtime: messages carry
 a committer that advances the group offset only after the handler
@@ -25,8 +31,9 @@ each partition to its leader node, produce/fetch/list-offsets frames go to
 that leader's connection, and NOT_LEADER/LEADER_NOT_AVAILABLE/
 UNKNOWN_TOPIC errors invalidate the topic's leader map and retry once
 after a refresh — so broker failover heals without restarting the client.
-Group-offset RPCs (OffsetCommit/OffsetFetch v0) ride the bootstrap
-connection, as any v0 broker serves them.
+Group-offset RPCs route to the group's coordinator broker (FindCoordinator
+v1|v0, with NOT_COORDINATOR/LOAD_IN_PROGRESS re-resolve + retry);
+pre-coordinator brokers fall back to the bootstrap connection.
 """
 
 from __future__ import annotations
@@ -38,6 +45,8 @@ import zlib
 from typing import Any
 
 from . import Message
+from .kafka_records import (decode_records, encode_record_batch,
+                            next_fetch_offset)
 
 __all__ = ["Kafka", "KafkaError", "KafkaProtocolError"]
 
@@ -56,6 +65,11 @@ class KafkaProtocolError(KafkaError):
 # (3 = UNKNOWN_TOPIC_OR_PARTITION, 5 = LEADER_NOT_AVAILABLE,
 #  6 = NOT_LEADER_FOR_PARTITION)
 _RETRIABLE = frozenset({3, 5, 6})
+
+# the group coordinator moved or is loading: re-resolve and retry
+# (14 = COORDINATOR_LOAD_IN_PROGRESS, 15 = COORDINATOR_NOT_AVAILABLE,
+#  16 = NOT_COORDINATOR)
+_COORD_RETRIABLE = frozenset({14, 15, 16})
 
 
 # -- wire codec ----------------------------------------------------------------
@@ -157,9 +171,24 @@ def encode_message_set(values: list[tuple[bytes | None, bytes]]) -> bytes:
     return out.build()
 
 
+def decode_record_set(data: bytes) -> list[tuple[int, bytes | None, bytes]]:
+    """Fetch responses carry either v0/v1 message sets or v2 record
+    batches depending on broker version and topic format; byte 16 is the
+    magic in both layouts, so dispatch on it. Corruption surfaces as
+    KafkaError on both paths (same contract callers already handle)."""
+    if len(data) >= 17 and data[16] >= 2:
+        try:
+            return decode_records(data)
+        except (ValueError, struct.error, IndexError) as exc:
+            raise KafkaError(f"bad record batch: {exc}") from exc
+    return decode_message_set(data)
+
+
 def decode_message_set(data: bytes) -> list[tuple[int, bytes | None, bytes]]:
-    """Parse a v0 message set into (offset, key, value); a trailing
-    partial message (broker truncation at max_bytes) is dropped."""
+    """Parse a v0/v1 message set into (offset, key, value); a trailing
+    partial message (broker truncation at max_bytes) is dropped. Magic 1
+    (message format 0.10.x, still served by 0.11-3.x brokers that do not
+    up-convert old topics) adds a timestamp between attributes and key."""
     out: list[tuple[int, bytes | None, bytes]] = []
     r = Reader(data)
     while r.remaining() >= 12:
@@ -171,11 +200,15 @@ def decode_message_set(data: bytes) -> list[tuple[int, bytes | None, bytes]]:
         crc = m.int32() & 0xFFFFFFFF
         body_start = m._o
         magic = m.int8()
-        m.int8()  # attributes (compression unsupported: magic-0 plain only)
+        attrs = m.int8()
+        if magic not in (0, 1):
+            raise KafkaError(f"unsupported message magic {magic}")
+        if attrs & 0x07:
+            raise KafkaError("compressed message sets are not supported")
+        if magic == 1:
+            m.int64()  # timestamp
         key = m.bytes_()
         value = m.bytes_()
-        if magic != 0:
-            raise KafkaError(f"unsupported message magic {magic}")
         if zlib.crc32(m._d[body_start:]) & 0xFFFFFFFF != crc:
             raise KafkaError(f"crc mismatch at offset {offset}")
         out.append((offset, key, value or b""))
@@ -198,6 +231,31 @@ class _Conn:
         self._writer: asyncio.StreamWriter | None = None
         self._corr = 0
         self._lock = asyncio.Lock()
+        self.api_versions: dict[int, tuple[int, int]] | None = None
+
+    async def versions(self) -> dict[int, tuple[int, int]]:
+        """Broker's supported (min, max) per api key, probed once with
+        ApiVersions. An empty dict means the probe failed (a pre-0.10
+        broker closes the connection on the unknown request) — the client
+        then speaks v0 everywhere, and the next request redials."""
+        if self.api_versions is None:
+            try:
+                r = await self.request(18, 0, b"")
+                err = r.int16()
+                if err:
+                    self.api_versions = {}
+                else:
+                    self.api_versions = {
+                        key: (lo, hi)
+                        for key, lo, hi in r.array(
+                            lambda x: (x.int16(), x.int16(), x.int16()))
+                    }
+            except (KafkaError, OSError, EOFError):
+                self.api_versions = {}
+        return self.api_versions
+
+    async def max_version(self, api_key: int) -> int:
+        return (await self.versions()).get(api_key, (0, 0))[1]
 
     @property
     def connected(self) -> bool:
@@ -240,6 +298,9 @@ class _Conn:
         if self._writer is not None:
             self._writer.close()
             self._writer = None
+        # re-probe after a redial: a transient failure during the
+        # ApiVersions exchange must not downgrade the broker to v0 forever
+        self.api_versions = None
 
 
 # -- client --------------------------------------------------------------------
@@ -285,6 +346,7 @@ class Kafka:
         self._brokers: dict[int, tuple[str, int]] = {}
         self._leaders: dict[str, dict[int, int]] = {}
         self._node_conns: dict[int, _Conn] = {}
+        self._coord_conn: _Conn | None = None
         self._rr = 0
         self.stats = {"published": 0, "consumed": 0, "committed": 0,
                       "errors": 0}
@@ -314,9 +376,30 @@ class Kafka:
 
     # -- metadata --------------------------------------------------------------
     async def _metadata(self, topics: list[str] | None = None) -> dict:
-        body = Writer().array(topics or [], lambda w, t: w.string(t)).build()
-        r = await self._conn.request(3, 0, body)
-        brokers = r.array(lambda x: (x.int32(), x.string(), x.int32()))
+        # Kafka 4.0 (KIP-896) removed Metadata v0-v3; negotiate up to v4
+        v = 4 if await self._conn.max_version(3) >= 4 else 0
+        w = Writer()
+        if v >= 1 and topics is None:
+            w.int32(-1)  # v1+: null array = ALL topics (empty means none)
+        else:
+            w.array(topics or [], lambda w1, t: w1.string(t))
+        if v >= 4:
+            w.int8(0)  # allow_auto_topic_creation: false
+        r = await self._conn.request(3, v, w.build())
+        if v >= 3:
+            r.int32()  # throttle_time_ms
+
+        def broker(x: Reader):
+            nid, host, port = x.int32(), x.string(), x.int32()
+            if v >= 1:
+                x.string()  # rack (nullable)
+            return nid, host, port
+
+        brokers = r.array(broker)
+        if v >= 2:
+            r.string()  # cluster_id (nullable)
+        if v >= 1:
+            r.int32()   # controller_id
 
         def part(x: Reader):
             perr, pid = x.int16(), x.int32()
@@ -327,6 +410,8 @@ class Kafka:
 
         def topic(x: Reader):
             terr, name = x.int16(), x.string()
+            if v >= 1:
+                x.int8()  # is_internal
             parts = x.array(part)
             return name, terr, parts
 
@@ -417,17 +502,25 @@ class Kafka:
     async def _produce_to_leader(self, topic: str, pid: int,
                                  key: bytes | None, message: bytes) -> None:
         conn = await self._leader_conn(topic, pid)
-        mset = encode_message_set([(key, message)])
-        body = (Writer().int16(1).int32(5000)  # acks=1, timeout
-                .array([topic], lambda w, t: (
-                    w.string(t).array([pid], lambda w2, p: (
-                        w2.int32(p).bytes_(mset)))))
-                .build())
-        r = await conn.request(0, 0, body)
+        v = 3 if await conn.max_version(0) >= 3 else 0
+        if v == 3:  # modern path: v2 record batch (KRaft brokers need it)
+            mset = encode_record_batch([(key, message)],
+                                       int(time.time() * 1000))
+            w = Writer().string(None)  # transactional_id
+        else:
+            mset = encode_message_set([(key, message)])
+            w = Writer()
+        w.int16(1).int32(5000)  # acks=1, timeout
+        w.array([topic], lambda w1, t: (
+            w1.string(t).array([pid], lambda w2, p: (
+                w2.int32(p).bytes_(mset)))))
+        r = await conn.request(0, v, w.build())
 
         def p_resp(x: Reader):
             pid_, err = x.int32(), x.int16()
             x.int64()  # base offset
+            if v >= 2:
+                x.int64()  # log_append_time
             return pid_, err
 
         for _t, parts in r.array(lambda x: (x.string(), x.array(p_resp))):
@@ -444,16 +537,27 @@ class Kafka:
                                 earliest: bool) -> int:
         ts = -2 if earliest else -1
         conn = await self._leader_conn(topic, pid)
+        v = 1 if await conn.max_version(2) >= 1 else 0  # v0 gone in 4.0
+
+        def enc_part(w2: Writer, p: int) -> None:
+            w2.int32(p).int64(ts)
+            if v == 0:
+                w2.int32(1)  # max_num_offsets (v0 only)
+
         body = (Writer().int32(-1)
                 .array([topic], lambda w, t: (
-                    w.string(t).array([pid], lambda w2, p: (
-                        w2.int32(p).int64(ts).int32(1)))))
+                    w.string(t).array([pid], enc_part)))
                 .build())
-        r = await conn.request(2, 0, body)
+        r = await conn.request(2, v, body)
 
         def p(x: Reader):
             pid_, err = x.int32(), x.int16()
-            offs = x.array(lambda y: y.int64())
+            if v >= 1:
+                x.int64()  # timestamp
+                off = x.int64()
+                offs = [off]
+            else:
+                offs = x.array(lambda y: y.int64())
             if err:
                 raise KafkaProtocolError(f"list_offsets {topic}", err)
             return offs[0] if offs else 0
@@ -462,17 +566,74 @@ class Kafka:
             return parts[0]
         return 0
 
+    # -- group coordinator -----------------------------------------------------
+    async def _find_coordinator(self) -> _Conn:
+        """Connection to the group's coordinator broker. OffsetCommit v2 /
+        OffsetFetch v1 are coordinator-routed (only v0 was served by any
+        broker); pre-coordinator brokers just use the bootstrap."""
+        if self._coord_conn is not None:
+            return self._coord_conn
+        if (await self._conn.versions()).get(10) is None:
+            self._coord_conn = self._conn
+            return self._conn
+        v = 1 if await self._conn.max_version(10) >= 1 else 0
+        w = Writer().string(self.group_id)
+        if v >= 1:
+            w.int8(0)  # key_type: group (v1 generalizes to txn coordinators)
+        r = await self._conn.request(10, v, w.build())
+        if v >= 1:
+            r.int32()  # throttle_time_ms
+        err = r.int16()
+        if v >= 1:
+            r.string()  # error_message (nullable)
+        nid, host, port = r.int32(), r.string(), r.int32()
+        if err:
+            raise KafkaProtocolError("find_coordinator", err)
+        if (host, port) == (self._conn.host, self._conn.port):
+            conn = self._conn
+        else:
+            conn = self._node_conns.get(nid)
+            if conn is None or (conn.host, conn.port) != (host, port):
+                conn = self._node_conns[nid] = _Conn(host, port,
+                                                     self._client_id)
+        self._coord_conn = conn
+        return conn
+
+    async def _with_coordinator_retry(self, fn):
+        """Re-resolve the coordinator and retry once on a moved/loading
+        coordinator or a dead coordinator socket."""
+        try:
+            return await fn()
+        except KafkaProtocolError as exc:
+            if exc.code not in _COORD_RETRIABLE:
+                raise
+        except (OSError, EOFError):
+            pass
+        self._coord_conn = None
+        await asyncio.sleep(0.05)
+        return await fn()
+
     async def _fetch_committed(self, topic: str, pid: int) -> int:
+        return await self._with_coordinator_retry(
+            lambda: self._fetch_committed_once(topic, pid))
+
+    async def _fetch_committed_once(self, topic: str, pid: int) -> int:
+        # v1 reads broker-stored offsets (v0 meant ZooKeeper; gone in 4.0);
+        # the wire layout is identical in both directions
+        conn = await self._find_coordinator()
+        v = 1 if await conn.max_version(9) >= 1 else 0
         body = (Writer().string(self.group_id)
                 .array([topic], lambda w, t: (
                     w.string(t).array([pid], lambda w2, p: w2.int32(p))))
                 .build())
-        r = await self._conn.request(9, 0, body)
+        r = await conn.request(9, v, body)
 
         def p(x: Reader):
             pid_, off = x.int32(), x.int64()
             x.string()  # metadata
-            x.int16()   # error (unknown-offset returns -1 offset, code 0)
+            err = x.int16()  # unknown-offset is -1 offset with code 0
+            if err:
+                raise KafkaProtocolError(f"offset_fetch {topic}", err)
             return off
 
         for _t, parts in r.array(lambda x: (x.string(), x.array(p))):
@@ -480,19 +641,30 @@ class Kafka:
         return -1
 
     async def _commit(self, topic: str, pid: int, offset: int) -> None:
-        body = (Writer().string(self.group_id)
-                .array([topic], lambda w, t: (
-                    w.string(t).array([(pid, offset)], lambda w2, po: (
-                        w2.int32(po[0]).int64(po[1]).string("")))))
-                .build())
-        r = await self._conn.request(8, 0, body)
+        await self._with_coordinator_retry(
+            lambda: self._commit_once(topic, pid, offset))
+        self.stats["committed"] += 1
+
+    async def _commit_once(self, topic: str, pid: int, offset: int) -> None:
+        # v2 is the 4.0-compatible floor; standalone (non-group-protocol)
+        # consumers pass generation -1 / empty member id
+        conn = await self._find_coordinator()
+        v = 2 if await conn.max_version(8) >= 2 else 0
+        w = Writer().string(self.group_id)
+        if v >= 1:
+            w.int32(-1).string("")  # generation_id, member_id
+        if v >= 2:
+            w.int64(-1)             # retention_time: broker default
+        w.array([topic], lambda w1, t: (
+            w1.string(t).array([(pid, offset)], lambda w2, po: (
+                w2.int32(po[0]).int64(po[1]).string("")))))
+        r = await conn.request(8, v, w.build())
         for _t, parts in r.array(
                 lambda x: (x.string(), x.array(
                     lambda y: (y.int32(), y.int16())))):
             for _pid, err in parts:
                 if err:
                     raise KafkaProtocolError(f"offset_commit {topic}", err)
-        self.stats["committed"] += 1
 
     # -- consume ---------------------------------------------------------------
     async def _start_offsets(self, topic: str) -> dict[int, int]:
@@ -518,46 +690,62 @@ class Kafka:
             by_conn.setdefault(conn, []).append((pid, off))
 
         async def fetch_from(conn: _Conn, plist: list[tuple[int, int]]):
-            body = (Writer().int32(-1).int32(self._fetch_wait).int32(1)
-                    .array([topic], lambda w, t: (
-                        w.string(t).array(plist, lambda w2, po: (
-                            w2.int32(po[0]).int64(po[1])
-                            .int32(self._fetch_bytes)))))
-                    .build())
-            return await conn.request(1, 0, body)
+            """-> [(pid, err, record_set)] from one leader, any version."""
+            v = 4 if await conn.max_version(1) >= 4 else 0
+            w = Writer().int32(-1).int32(self._fetch_wait).int32(1)
+            if v >= 4:
+                w.int32(self._fetch_bytes)  # response-wide max_bytes (v3+)
+                w.int8(0)                   # isolation: read_uncommitted
+            w.array([topic], lambda w1, t: (
+                w1.string(t).array(plist, lambda w2, po: (
+                    w2.int32(po[0]).int64(po[1]).int32(self._fetch_bytes)))))
+            r = await conn.request(1, v, w.build())
+            if v >= 1:
+                r.int32()  # throttle_time_ms
+
+            def p(x: Reader):
+                pid, err = x.int32(), x.int16()
+                x.int64()  # high watermark
+                if v >= 4:
+                    x.int64()  # last stable offset
+                    x.array(lambda y: (y.int64(), y.int64()))  # aborted txns
+                return pid, err, x.bytes_() or b""
+
+            out: list[tuple[int, int, bytes]] = []
+            for _t, presps in r.array(lambda x: (x.string(), x.array(p))):
+                out.extend(presps)
+            return out
 
         results = await asyncio.gather(
             *(fetch_from(c, pl) for c, pl in by_conn.items()),
             return_exceptions=True)
         n = 0
         stale = False
-
-        def p(x: Reader):
-            pid, err = x.int32(), x.int16()
-            x.int64()  # high watermark
-            mset = x.bytes_() or b""
-            return pid, err, mset
-
-        for conn, r in zip(by_conn, results):
-            if isinstance(r, (OSError, EOFError)):
+        for conn, presps in zip(by_conn, results):
+            if isinstance(presps, (OSError, EOFError)):
                 conn.close()  # leader died: refresh and pick up next round
                 stale = True
                 continue
-            if isinstance(r, BaseException):
-                raise r
-            for _t, presps in r.array(lambda x: (x.string(), x.array(p))):
-                for pid, err, mset in presps:
-                    if err in _RETRIABLE:
-                        stale = True
-                        continue
-                    if err:
-                        raise KafkaProtocolError(f"fetch {topic}", err)
-                    for offset, key, value in decode_message_set(mset):
-                        if offset < reader.offsets[pid]:
-                            continue  # v0 resends from segment starts
-                        reader.offsets[pid] = offset + 1
-                        reader.queue.put_nowait((pid, offset, key, value))
-                        n += 1
+            if isinstance(presps, BaseException):
+                raise presps
+            for pid, err, mset in presps:
+                if err in _RETRIABLE:
+                    stale = True
+                    continue
+                if err:
+                    raise KafkaProtocolError(f"fetch {topic}", err)
+                for offset, key, value in decode_record_set(mset):
+                    if offset < reader.offsets[pid]:
+                        continue  # brokers resend from segment starts
+                    reader.offsets[pid] = offset + 1
+                    reader.queue.put_nowait((pid, offset, key, value))
+                    n += 1
+                # a v2 batch can yield zero data records (transaction
+                # control markers); still advance past it or this fetch
+                # would repeat at full RPC rate forever
+                nxt = next_fetch_offset(mset)
+                if nxt is not None and nxt > reader.offsets[pid]:
+                    reader.offsets[pid] = nxt
         if stale:
             self._invalidate(topic)
             if n == 0:
@@ -600,21 +788,36 @@ class Kafka:
     # -- admin -----------------------------------------------------------------
     async def create_topic_async(self, name: str, partitions: int = 1,
                                  replication: int = 1) -> None:
-        body = (Writer().array([name], lambda w, t: (
-                    w.string(t).int32(partitions).int16(replication)
-                    .array([], lambda *_: None)
-                    .array([], lambda *_: None)))
-                .int32(5000).build())
-        r = await self._conn.request(19, 0, body)
-        for _t, err in r.array(lambda x: (x.string(), x.int16())):
+        v = 2 if await self._conn.max_version(19) >= 2 else 0
+        w = Writer().array([name], lambda w1, t: (
+            w1.string(t).int32(partitions).int16(replication)
+            .array([], lambda *_: None)
+            .array([], lambda *_: None)))
+        w.int32(5000)
+        if v >= 1:
+            w.int8(0)  # validate_only: false
+        r = await self._conn.request(19, v, w.build())
+        if v >= 2:
+            r.int32()  # throttle_time_ms
+
+        def t_resp(x: Reader):
+            tname, err = x.string(), x.int16()
+            if v >= 1:
+                x.string()  # error_message (nullable)
+            return tname, err
+
+        for _t, err in r.array(t_resp):
             if err and err != 36:  # 36 = already exists
                 raise KafkaProtocolError(f"create_topic {name}", err)
         self._invalidate(name)
 
     async def delete_topic_async(self, name: str) -> None:
+        v = 1 if await self._conn.max_version(20) >= 1 else 0
         body = (Writer().array([name], lambda w, t: w.string(t))
                 .int32(5000).build())
-        r = await self._conn.request(20, 0, body)
+        r = await self._conn.request(20, v, body)
+        if v >= 1:
+            r.int32()  # throttle_time_ms
         for _t, err in r.array(lambda x: (x.string(), x.int16())):
             if err and err != 3:  # 3 = unknown topic
                 raise KafkaProtocolError(f"delete_topic {name}", err)
@@ -654,6 +857,7 @@ class Kafka:
         for conn in self._node_conns.values():
             conn.close()
         self._node_conns.clear()
+        self._coord_conn = None
 
 
 def _run_sync(coro):

@@ -18,19 +18,33 @@ from gofr_tpu.datasource.pubsub.kafka import (
     Reader,
     Writer,
     decode_message_set,
+    decode_record_set,
     encode_message_set,
+)
+from gofr_tpu.datasource.pubsub.kafka_records import (
+    crc32c,
+    decode_records,
+    decode_varint,
+    encode_record_batch,
+    encode_varint,
 )
 
 
 class FakeBroker:
-    """Single-node in-memory Kafka speaking protocol v0 frames."""
+    """Single-node in-memory Kafka speaking protocol v0 frames; with
+    ``modern=True`` it also advertises ApiVersions and speaks Produce v3 /
+    Fetch v4 with v2 record batches, like a KRaft broker."""
 
-    def __init__(self):
+    def __init__(self, *, modern: bool = False):
         self.topics: dict[str, dict[int, list[tuple[bytes | None, bytes]]]] = {}
         self.group_offsets: dict[tuple[str, str, int], int] = {}
         self.server = None
         self.port = None
+        self.modern = modern
         self.requests: list[int] = []  # api keys seen, for assertions
+        self.versioned: list[tuple[int, int]] = []  # (api, version) seen
+        # fault injection: next N group RPCs answer NOT_COORDINATOR (16)
+        self.not_coordinator_times = 0
 
     async def start(self):
         self.server = await asyncio.start_server(self._serve, "127.0.0.1", 0)
@@ -50,6 +64,7 @@ class FakeBroker:
                 api, version, corr = r.int16(), r.int16(), r.int32()
                 r.string()  # client id
                 self.requests.append(api)
+                self.versioned.append((api, version))
                 body = await self._dispatch(api, version, r)
                 frame = struct.pack(">i", corr) + body
                 writer.write(struct.pack(">i", len(frame)) + frame)
@@ -59,7 +74,32 @@ class FakeBroker:
         finally:
             writer.close()
 
+    # a KRaft broker's floor after KIP-896: no v0 anywhere we speak
+    MODERN_VERSIONS = {0: (3, 3), 1: (4, 4), 2: (1, 1), 3: (4, 4),
+                      8: (2, 2), 9: (1, 1), 10: (1, 1), 18: (0, 0),
+                      19: (2, 2), 20: (1, 1)}
+
     async def _dispatch(self, api, version, r) -> bytes:
+        if self.modern:
+            if api == 18:
+                w = Writer()
+                w.int16(0)
+                w.array(sorted(self.MODERN_VERSIONS.items()),
+                        lambda w2, kv: (w2.int16(kv[0]).int16(kv[1][0])
+                                        .int16(kv[1][1])))
+                return w.build()
+            lo, hi = self.MODERN_VERSIONS.get(api, (0, 0))
+            assert lo <= version <= hi, \
+                f"modern fake: api {api} v{version} outside [{lo},{hi}]"
+            if api == 1:
+                return await self._fetch(r, version=version)
+            if api == 10:
+                return self._find_coordinator(r, version=version)
+            return {
+                0: self._produce, 2: self._list_offsets, 3: self._metadata,
+                8: self._offset_commit, 9: self._offset_fetch,
+                19: self._create_topics, 20: self._delete_topics,
+            }[api](r, version=version)
         assert version == 0, f"fake only speaks v0, got v{version} for api {api}"
         if api == 1:
             return await self._fetch(r)
@@ -70,15 +110,37 @@ class FakeBroker:
         }[api](r)
 
     # -- per-api handlers ------------------------------------------------------
-    def _metadata(self, r) -> bytes:
-        names = r.array(lambda x: x.string())
+    def _metadata(self, r, version: int = 0) -> bytes:
+        n = r.int32()
+        if n < 0:
+            assert version >= 1, "null topic array needs metadata v1+"
+            names = None  # null = all topics
+        else:
+            names = [r.string() for _ in range(n)]
+        if version >= 4:
+            r.int8()  # allow_auto_topic_creation
         w = Writer()
-        w.array([(1, "127.0.0.1", self.port)],
-                lambda w2, b: w2.int32(b[0]).string(b[1]).int32(b[2]))
-        tops = names or sorted(self.topics)
+        if version >= 3:
+            w.int32(0)  # throttle_time_ms
+
+        def enc_broker(w2, b):
+            w2.int32(b[0]).string(b[1]).int32(b[2])
+            if version >= 1:
+                w2.string(None)  # rack
+
+        w.array([(1, "127.0.0.1", self.port)], enc_broker)
+        if version >= 2:
+            w.string("fake-cluster")
+        if version >= 1:
+            w.int32(1)  # controller_id
+        tops = sorted(self.topics) if names is None else (
+            names or sorted(self.topics))
+
         def enc_topic(w2, name):
             known = name in self.topics
             w2.int16(0 if known else 3).string(name)
+            if version >= 1:
+                w2.int8(0)  # is_internal
             pids = sorted(self.topics.get(name, {}))
             w2.array(pids, lambda w3, p: (
                 w3.int16(0).int32(p).int32(1)
@@ -87,7 +149,9 @@ class FakeBroker:
         w.array(tops, enc_topic)
         return w.build()
 
-    def _produce(self, r) -> bytes:
+    def _produce(self, r, version: int = 0) -> bytes:
+        if version >= 3:
+            r.string()  # transactional_id
         acks, _timeout = r.int16(), r.int32()
         results = []
         for _ in range(r.int32()):
@@ -97,22 +161,34 @@ class FakeBroker:
                 mset = r.bytes_() or b""
                 log = self.topics[topic][pid]
                 base = len(log)
-                for _off, key, value in decode_message_set(mset):
+                decoded = (decode_records(mset) if version >= 3
+                           else decode_message_set(mset))
+                for _off, key, value in decoded:
                     log.append((key, value))
                 results.append((topic, pid, 0, base))
         w = Writer()
         by_topic: dict[str, list] = {}
         for topic, pid, err, base in results:
             by_topic.setdefault(topic, []).append((pid, err, base))
+
+        def enc_part(w3, p):
+            w3.int32(p[0]).int16(p[1]).int64(p[2])
+            if version >= 2:
+                w3.int64(-1)  # log_append_time
+
         w.array(sorted(by_topic.items()), lambda w2, kv: (
-            w2.string(kv[0]).array(kv[1], lambda w3, p: (
-                w3.int32(p[0]).int16(p[1]).int64(p[2])))))
+            w2.string(kv[0]).array(kv[1], enc_part)))
+        if version >= 1:
+            w.int32(0)  # throttle_time_ms
         return w.build()
 
-    async def _fetch(self, r) -> bytes:
+    async def _fetch(self, r, version: int = 0) -> bytes:
         r.int32()  # replica
         max_wait = r.int32()
         r.int32()  # min bytes
+        if version >= 4:
+            r.int32()  # response max bytes
+            r.int8()   # isolation level
         reqs = []
         for _ in range(r.int32()):
             topic = r.string()
@@ -128,12 +204,16 @@ class FakeBroker:
                 break
             await asyncio.sleep(0.01)
         w = Writer()
+        if version >= 1:
+            w.int32(0)  # throttle_time_ms
         by_topic: dict[str, list] = {}
         for topic, pid, off in reqs:
             log = self.topics.get(topic, {}).get(pid, [])
             msgs = log[off:]
             mset = b""
-            if msgs:
+            if msgs and version >= 4:
+                mset = encode_record_batch(msgs, 0, base_offset=off)
+            elif msgs:
                 enc = Writer()
                 for i, (key, value) in enumerate(msgs):
                     body = (Writer().int8(0).int8(0).bytes_(key)
@@ -143,32 +223,66 @@ class FakeBroker:
                     enc.int64(off + i).int32(len(msg)).raw(msg)
                 mset = enc.build()
             by_topic.setdefault(topic, []).append((pid, 0, len(log), mset))
+
+        def enc_part(w3, p):
+            w3.int32(p[0]).int16(p[1]).int64(p[2])
+            if version >= 4:
+                w3.int64(p[2])  # last stable offset
+                w3.array([], lambda *_: None)  # aborted transactions
+            w3.bytes_(p[3])
+
         w.array(sorted(by_topic.items()), lambda w2, kv: (
-            w2.string(kv[0]).array(kv[1], lambda w3, p: (
-                w3.int32(p[0]).int16(p[1]).int64(p[2]).bytes_(p[3])))))
+            w2.string(kv[0]).array(kv[1], enc_part)))
         return w.build()
 
-    def _list_offsets(self, r) -> bytes:
+    def _list_offsets(self, r, version: int = 0) -> bytes:
         r.int32()
         reqs = []
         for _ in range(r.int32()):
             topic = r.string()
             for _ in range(r.int32()):
                 pid, ts = r.int32(), r.int64()
-                r.int32()
+                if version == 0:
+                    r.int32()  # max_num_offsets
                 log = self.topics.get(topic, {}).get(pid, [])
                 reqs.append((topic, pid, 0 if ts == -2 else len(log)))
         w = Writer()
         by_topic: dict[str, list] = {}
         for topic, pid, off in reqs:
             by_topic.setdefault(topic, []).append((pid, off))
+
+        def enc_part(w3, p):
+            w3.int32(p[0]).int16(0)
+            if version >= 1:
+                w3.int64(-1).int64(p[1])  # timestamp, offset
+            else:
+                w3.array([p[1]], lambda w4, o: w4.int64(o))
+
         w.array(sorted(by_topic.items()), lambda w2, kv: (
-            w2.string(kv[0]).array(kv[1], lambda w3, p: (
-                w3.int32(p[0]).int16(0).array([p[1]], lambda w4, o: w4.int64(o))))))
+            w2.string(kv[0]).array(kv[1], enc_part)))
         return w.build()
 
-    def _offset_commit(self, r) -> bytes:
+    def _find_coordinator(self, r, version: int = 0) -> bytes:
+        r.string()  # group id / key
+        if version >= 1:
+            assert r.int8() == 0  # key_type: group
+        w = Writer()
+        if version >= 1:
+            w.int32(0)  # throttle_time_ms
+        w.int16(0)
+        if version >= 1:
+            w.string(None)  # error_message
+        w.int32(1).string("127.0.0.1").int32(self.port)
+        return w.build()
+
+    def _offset_commit(self, r, version: int = 0) -> bytes:
         group = r.string()
+        if version >= 1:
+            gen = r.int32()
+            member = r.string()
+            assert gen == -1 and member == "", "standalone consumer expected"
+        if version >= 2:
+            r.int64()  # retention_time
         out = []
         for _ in range(r.int32()):
             topic = r.string()
@@ -185,25 +299,29 @@ class FakeBroker:
             w2.string(kv[0]).array(kv[1], lambda w3, p: w3.int32(p).int16(0))))
         return w.build()
 
-    def _offset_fetch(self, r) -> bytes:
+    def _offset_fetch(self, r, version: int = 0) -> bytes:
         group = r.string()
+        err = 0
+        if self.not_coordinator_times > 0:
+            self.not_coordinator_times -= 1
+            err = 16  # NOT_COORDINATOR
         out = []
         for _ in range(r.int32()):
             topic = r.string()
             for _ in range(r.int32()):
                 pid = r.int32()
                 off = self.group_offsets.get((group, topic, pid), -1)
-                out.append((topic, pid, off))
+                out.append((topic, pid, -1 if err else off))
         w = Writer()
         by_topic: dict[str, list] = {}
         for topic, pid, off in out:
             by_topic.setdefault(topic, []).append((pid, off))
         w.array(sorted(by_topic.items()), lambda w2, kv: (
             w2.string(kv[0]).array(kv[1], lambda w3, p: (
-                w3.int32(p[0]).int64(p[1]).string("").int16(0)))))
+                w3.int32(p[0]).int64(p[1]).string("").int16(err)))))
         return w.build()
 
-    def _create_topics(self, r) -> bytes:
+    def _create_topics(self, r, version: int = 0) -> bytes:
         out = []
         for _ in range(r.int32()):
             name = r.string()
@@ -217,11 +335,21 @@ class FakeBroker:
                 self.topics[name] = {p: [] for p in range(nparts)}
                 out.append((name, 0))
         r.int32()  # timeout
+        if version >= 1:
+            r.int8()  # validate_only
         w = Writer()
-        w.array(out, lambda w2, t: w2.string(t[0]).int16(t[1]))
+        if version >= 2:
+            w.int32(0)  # throttle_time_ms
+
+        def enc(w2, t):
+            w2.string(t[0]).int16(t[1])
+            if version >= 1:
+                w2.string(None)  # error_message
+
+        w.array(out, enc)
         return w.build()
 
-    def _delete_topics(self, r) -> bytes:
+    def _delete_topics(self, r, version: int = 0) -> bytes:
         names = r.array(lambda x: x.string())
         r.int32()
         out = []
@@ -229,6 +357,8 @@ class FakeBroker:
             out.append((name, 0 if name in self.topics else 3))
             self.topics.pop(name, None)
         w = Writer()
+        if version >= 1:
+            w.int32(0)  # throttle_time_ms
         w.array(out, lambda w2, t: w2.string(t[0]).int16(t[1]))
         return w.build()
 
@@ -678,5 +808,191 @@ def test_multibroker_dead_leader_heals_via_metadata(run):
         finally:
             k.close()
             await cluster.stop()
+
+    run(scenario())
+
+
+# ----------------------------------------------------- v2 record batches
+def test_crc32c_check_value():
+    # the Castagnoli check value (RFC 3720 appendix / iSCSI test vector)
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0
+
+
+def test_varint_zigzag_roundtrip():
+    for v in (0, 1, -1, 63, -64, 64, 300, -300, 2**31, -(2**31), 2**62):
+        data = encode_varint(v)
+        got, off = decode_varint(data, 0)
+        assert got == v and off == len(data)
+
+
+def test_record_batch_roundtrip():
+    msgs = [(b"k0", b"v0"), (None, b"v1"), (b"k2", b"")]
+    batch = encode_record_batch(msgs, 1_700_000_000_000, base_offset=7)
+    got = decode_records(batch)
+    assert got == [(7, b"k0", b"v0"), (8, None, b"v1"), (9, b"k2", b"")]
+    # concatenated batches parse as one stream
+    two = batch + encode_record_batch([(None, b"v3")], 0, base_offset=10)
+    assert [o for o, _, _ in decode_records(two)] == [7, 8, 9, 10]
+    # a truncated trailing batch is dropped, not an error
+    assert decode_records(two[:-3])[:3] == got
+
+
+def test_record_batch_crc_rejected():
+    batch = bytearray(encode_record_batch([(b"k", b"v")], 0))
+    batch[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="crc"):
+        decode_records(bytes(batch))
+
+
+def test_decode_record_set_dispatches_on_magic():
+    legacy = encode_message_set([(b"k", b"v")])
+    modern = encode_record_batch([(b"k", b"v")], 0)
+    assert decode_record_set(legacy) == [(0, b"k", b"v")]
+    assert decode_record_set(modern) == [(0, b"k", b"v")]
+
+
+def test_modern_broker_negotiates_v3_produce_v4_fetch(run):
+    """Against a broker advertising ApiVersions, publish rides Produce v3
+    with a v2 record batch and subscribe rides Fetch v4 — the path KRaft
+    brokers (Kafka >= 4.0, v0 message format removed) require."""
+
+    async def scenario():
+        b = FakeBroker(modern=True)
+        await b.start()
+        b.topics["orders"] = {0: []}
+        k = Kafka(f"127.0.0.1:{b.port}", group_id="g",
+                  offset_start="earliest")
+        try:
+            await asyncio.wait_for(k.publish("orders", b"m0", key=b"kk"), 5)
+            await asyncio.wait_for(k.publish("orders", b"m1"), 5)
+            assert b.topics["orders"][0] == [(b"kk", b"m0"), (None, b"m1")]
+            assert (18, 0) in b.versioned      # ApiVersions probed
+            assert (0, 3) in b.versioned       # Produce v3
+            assert (0, 0) not in b.versioned   # never fell back
+
+            got = []
+            for _ in range(2):
+                msg = await asyncio.wait_for(k.subscribe("orders"), 5)
+                got.append((msg.metadata.get("key"), bytes(msg.value)))
+                msg.commit()
+            assert got == [("kk", b"m0"), (None, b"m1")]
+            assert (1, 4) in b.versioned       # Fetch v4
+        finally:
+            k.close()
+            await b.stop()
+
+    run(scenario())
+
+
+def test_legacy_broker_falls_back_to_v0(run):
+    """A pre-ApiVersions broker closes the connection on the probe; the
+    client marks it v0-only, redials, and the publish still lands."""
+
+    async def scenario():
+        b = FakeBroker()  # legacy: KeyError on api 18 kills the conn
+        await b.start()
+        b.topics["orders"] = {0: []}
+        k = Kafka(f"127.0.0.1:{b.port}")
+        try:
+            await asyncio.wait_for(k.publish("orders", b"m0"), 5)
+            assert b.topics["orders"][0] == [(None, b"m0")]
+            assert (0, 0) in b.versioned       # v0 produce after fallback
+        finally:
+            k.close()
+            await b.stop()
+
+    run(scenario())
+
+
+def test_modern_broker_full_surface(run):
+    """Every negotiated API against the KRaft-floor fake: admin, metadata
+    (null topic array), offset resume via commit v2 / fetch v1, health."""
+
+    async def scenario():
+        b = FakeBroker(modern=True)
+        await b.start()
+        k = Kafka(f"127.0.0.1:{b.port}", group_id="g",
+                  offset_start="earliest")
+        try:
+            await k.create_topic_async("orders", partitions=2)
+            assert (19, 2) in b.versioned
+            assert sorted(b.topics["orders"]) == [0, 1]
+
+            for i in range(4):
+                await asyncio.wait_for(k.publish("orders", f"m{i}".encode()), 5)
+            assert (3, 4) in b.versioned       # metadata negotiated up
+
+            got = set()
+            for _ in range(4):
+                msg = await asyncio.wait_for(k.subscribe("orders"), 5)
+                got.add(bytes(msg.value))
+                msg.commit()
+            assert got == {b"m0", b"m1", b"m2", b"m3"}
+            assert (2, 1) in b.versioned       # list_offsets v1
+            deadline = asyncio.get_running_loop().time() + 3
+            while (8, 2) not in b.versioned:   # commits ride background tasks
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.02)
+
+            # a new consumer in the same group resumes after the committed
+            # offsets (offset_fetch v1), so only a fresh message arrives
+            k2 = Kafka(f"127.0.0.1:{b.port}", group_id="g")
+            await asyncio.wait_for(k.publish("orders", b"m4"), 5)
+            msg = await asyncio.wait_for(k2.subscribe("orders"), 5)
+            assert bytes(msg.value) == b"m4"
+            assert (9, 1) in b.versioned       # offset_fetch v1
+            k2.close()
+
+            health = await k.health_check_async()
+            assert health["status"] == "UP"
+            await k.delete_topic_async("orders")
+            assert (20, 1) in b.versioned
+            assert "orders" not in b.topics
+            # the fake never saw a v0 frame on any negotiated API
+            assert not [vv for vv in b.versioned
+                        if vv[1] == 0 and vv[0] != 18]
+        finally:
+            k.close()
+            await b.stop()
+
+    run(scenario())
+
+
+def test_magic1_message_set_decodes():
+    """Fetch v4 against 0.11-3.x brokers can return magic-1 (0.10 format)
+    sets for old topics — they must parse, not raise."""
+    body = (Writer().int8(1).int8(0).int64(1_700_000_000_000)
+            .bytes_(b"k").bytes_(b"v").build())
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    msg = struct.pack(">I", crc) + body
+    mset = Writer().int64(5).int32(len(msg)).raw(msg).build()
+    assert decode_message_set(mset) == [(5, b"k", b"v")]
+    assert decode_record_set(mset) == [(5, b"k", b"v")]
+
+
+def test_not_coordinator_resolves_and_retries(run):
+    """A moved coordinator (NOT_COORDINATOR on OffsetFetch) triggers one
+    FindCoordinator re-resolve + retry instead of silently resetting the
+    consumer to latest/earliest."""
+
+    async def scenario():
+        b = FakeBroker(modern=True)
+        await b.start()
+        b.topics["orders"] = {0: []}
+        b.group_offsets[("g", "orders", 0)] = 1
+        b.topics["orders"][0] = [(None, b"old"), (None, b"new")]
+        b.not_coordinator_times = 1
+        k = Kafka(f"127.0.0.1:{b.port}", group_id="g",
+                  offset_start="earliest")
+        try:
+            msg = await asyncio.wait_for(k.subscribe("orders"), 5)
+            # resumed from the COMMITTED offset (1): the error did not
+            # silently fall back to earliest (which would yield b"old")
+            assert bytes(msg.value) == b"new"
+            assert b.versioned.count((10, 1)) == 2  # re-resolved once
+        finally:
+            k.close()
+            await b.stop()
 
     run(scenario())

@@ -116,3 +116,34 @@ def test_topic_matches_no_cross_matching(a, b):
     if a != b and len(a.split("/")) == len(b.split("/")):
         # exact filters only match their own topic
         assert not topic_matches(a, b)
+
+
+# --------------------------------------------------- kafka v2 record batches
+from gofr_tpu.datasource.pubsub.kafka_records import (  # noqa: E402
+    decode_records,
+    decode_varint,
+    encode_record_batch,
+    encode_varint,
+)
+
+
+@given(st.integers(min_value=-(2**62), max_value=2**62))
+def test_kafka_varint_roundtrip(v):
+    data = encode_varint(v)
+    got, off = decode_varint(data, 0)
+    assert got == v and off == len(data)
+
+
+@given(
+    st.lists(
+        st.tuples(st.one_of(st.none(), st.binary(max_size=16)),
+                  st.binary(max_size=64)),
+        min_size=1, max_size=8),
+    st.integers(min_value=0, max_value=2**40),
+    st.integers(min_value=0, max_value=2**31),
+)
+@settings(max_examples=100)
+def test_kafka_record_batch_roundtrip(msgs, ts, base):
+    batch = encode_record_batch(msgs, ts, base_offset=base)
+    got = decode_records(batch)
+    assert got == [(base + i, k, v) for i, (k, v) in enumerate(msgs)]
