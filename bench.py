@@ -106,23 +106,24 @@ def main() -> None:
     # short alias is kept for muscle memory
     kv_quant = (os.environ.get("LLAMA_KV_QUANT")
                 or os.environ.get("KV_QUANT")) == "1"
+    w8 = os.environ.get("LLAMA_W8") == "1"
     if on_tpu:
         cfg = llama.LlamaConfig(
             vocab_size=32_128, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
-            ffn_dim=8192, max_seq_len=2048, kv_quant=kv_quant,
+            ffn_dim=8192, max_seq_len=2048, kv_quant=kv_quant, w8=w8,
         )
         # slots swept at 64/96/128/160/192: throughput rises to 160 slots
         # (8.2k tok/s) but 192 OOMs the 16 GB HBM; 128 keeps margin
         slots, chunk, n_chunks, prompt_len, max_seq = 128, 16, 16, 128, 1024
     else:  # CPU smoke fallback so the bench never hard-fails
-        cfg = llama.tiny_llama(use_flash=False, kv_quant=kv_quant)
+        cfg = llama.tiny_llama(use_flash=False, kv_quant=kv_quant, w8=w8)
         slots, chunk, n_chunks, prompt_len, max_seq = 4, 4, 4, 8, 64
 
     # probe BEFORE the model + KV cache occupy HBM: the 1 GiB probe at peak
     # residency could OOM and lose the whole run's results
     streaming_ref_bw = _measure_achievable_bw() if on_tpu else None
 
-    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    params = llama.params_from_config(cfg)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
     gen = Generator(params, cfg, batch_slots=slots, max_seq=max_seq,
                     prefill_buckets=(prompt_len,), chunk=chunk)

@@ -205,9 +205,10 @@ async def models(ctx: gofr_tpu.Context):
 
 def main() -> gofr_tpu.App:
     app = gofr_tpu.new_app()
-    # LLAMA_PRESET / LLAMA_KV_QUANT -> config (shared with llama_server)
+    # LLAMA_PRESET / LLAMA_KV_QUANT / LLAMA_W8 -> config (shared with
+    # llama_server)
     cfg = llama.config_from_env(tiny_vocab_size=TOKENIZER.vocab_size)
-    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    params = llama.params_from_config(cfg)
     app.register_llm(
         MODEL_ID, params, cfg,
         batch_slots=int(os.environ.get("LLM_SLOTS", "4")),

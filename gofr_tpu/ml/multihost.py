@@ -102,6 +102,7 @@ class MultiHostWorker:
         from ..parallel import P
 
         cfg = self._cfg or llama.config_from_env()
+        # config_from_env honors LLAMA_W8; params_from_config applies it
         # dp spans processes (DCN), tp spans each host's local chips (ICI)
         local = jax.local_device_count()
         devices = np.array(jax.devices()).reshape(self.num_processes, local)
@@ -110,7 +111,7 @@ class MultiHostWorker:
         self.cfg = cfg
         self.batch = self.num_processes  # one row per dp shard
 
-        params = llama.init_params(cfg, jax.random.PRNGKey(self.seed))
+        params = llama.params_from_config(cfg, seed=self.seed)
         specs = par.specs_from_rules(params, llama.SHARDING_RULES)
         self.params = par.shard_params(params, specs, mesh)
 

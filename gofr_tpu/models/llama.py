@@ -36,7 +36,6 @@ from ..ops import (
     repeat_kv,
     rms_norm,
     rope_table,
-    swiglu,
 )
 from ..parallel import P, constrain
 
@@ -60,6 +59,7 @@ class LlamaConfig:
         remat: bool = False,
         attn_impl: str = "auto",
         kv_quant: bool = False,
+        w8: bool = False,
     ) -> None:
         self.vocab_size = vocab_size
         self.dim = dim
@@ -86,6 +86,9 @@ class LlamaConfig:
         # sequence-parallel decode: each sp shard dequantizes its own
         # int8 slice before the pmax/psum combine (parallel/ring.py).
         self.kv_quant = kv_quant
+        # int8 weights (quantize_weights): halves the OTHER half of
+        # decode's HBM traffic — the per-step weight sweep
+        self.w8 = w8
 
     @property
     def sequence_parallel(self) -> bool:
@@ -100,17 +103,31 @@ def llama3_8b(**kw) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
+def params_from_config(cfg: "LlamaConfig", seed: int = 0) -> dict:
+    """Init params honoring the config's serving knobs — the one place
+    that consumes ``cfg.w8``, so every boot path (examples, bench,
+    multi-host workers) gets quantized weights without repeating the
+    step."""
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    if cfg.w8:
+        params = quantize_weights(params)
+    return params
+
+
 def config_from_env(tiny_vocab_size: int | None = None) -> LlamaConfig:
     """The examples' shared boot path: LLAMA_PRESET=tiny|1b|8b selects the
     config (tiny disables the flash kernel and can adopt a tokenizer's
     vocab so decoded text is always valid), LLAMA_KV_QUANT=1 turns on the
-    int8 cache. Centralized so the llama/openai servers can't drift."""
+    int8 cache, LLAMA_W8=1 turns on int8 weights (pair with
+    params_from_config, which applies the quantization). Centralized so
+    the llama/openai servers can't drift."""
     import os
 
     preset = os.environ.get("LLAMA_PRESET", "tiny")
     kv_quant = os.environ.get("LLAMA_KV_QUANT") == "1"
+    w8 = os.environ.get("LLAMA_W8") == "1"
     if preset == "tiny":
-        kw = {"use_flash": False, "kv_quant": kv_quant}
+        kw = {"use_flash": False, "kv_quant": kv_quant, "w8": w8}
         if tiny_vocab_size is not None:
             kw["vocab_size"] = tiny_vocab_size
         return tiny_llama(**kw)
@@ -118,9 +135,10 @@ def config_from_env(tiny_vocab_size: int | None = None) -> LlamaConfig:
         return LlamaConfig(
             vocab_size=32_128, dim=2048, n_layers=16, n_heads=16,
             n_kv_heads=8, ffn_dim=8192, max_seq_len=2048, kv_quant=kv_quant,
+            w8=w8,
         )
     if preset == "8b":
-        return llama3_8b(kv_quant=kv_quant)
+        return llama3_8b(kv_quant=kv_quant, w8=w8)
     raise ValueError(f"unknown LLAMA_PRESET {preset!r}")
 
 
@@ -135,8 +153,14 @@ def tiny_llama(**kw) -> LlamaConfig:
 
 
 # Megatron-style TP over the canonical mesh. Leading axis of every layer
-# weight is the stacked n_layers axis (never sharded).
+# weight is the stacked n_layers axis (never sharded). The ``/s`` rules
+# (first match wins) cover int8-quantized weights' per-out-channel scales:
+# column-parallel outputs shard the scale over tp, row-parallel outputs
+# are full-width so their scales replicate.
 SHARDING_RULES = (
+    (r"layers/(wq|wk|wv|w_gate|w_up)/s", P(None, "tp")),
+    (r"layers/(wo|w_down)/s", P(None, None)),
+    (r"lm_head/s", P("tp")),
     (r"layers/(wq|wk|wv|w_gate|w_up)", P(None, None, "tp")),  # column parallel
     (r"layers/(wo|w_down)", P(None, "tp", None)),             # row parallel
     (r"layers/(attn_norm|mlp_norm)", P(None)),
@@ -148,7 +172,11 @@ SHARDING_RULES = (
 # FSDP variant: weights additionally sharded over the fsdp axis (ZeRO-3
 # style — GSPMD all-gathers each layer's weights just-in-time inside the
 # scan and reduce-scatters its grads). Combine with tp for 2D sharding.
+# The /s rules keep a quantized (serving-only) tree shardable here too.
 SHARDING_RULES_FSDP = (
+    (r"layers/(wq|wk|wv|w_gate|w_up)/s", P(None, "tp")),
+    (r"layers/(wo|w_down)/s", P(None, "fsdp")),
+    (r"lm_head/s", P("tp")),
     (r"layers/(wq|wk|wv|w_gate|w_up)", P(None, "fsdp", "tp")),
     (r"layers/(wo|w_down)", P(None, "tp", "fsdp")),
     (r"layers/(attn_norm|mlp_norm)", P(None)),
@@ -190,6 +218,51 @@ def init_params(cfg: LlamaConfig, key) -> dict:
     }
 
 
+_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_weights(params: dict) -> dict:
+    """Serving-time int8 weight quantization (w8a16, LLAMA_W8=1).
+
+    Every layer matmul weight and the lm_head become {"q": int8,
+    "s": f32 per-out-channel} (ops.quantize_weight); norms and the embed
+    gather stay fp. Decode at large slot counts is weight-bandwidth-bound,
+    so halving weight bytes per step is a direct throughput lever —
+    composes with the int8 KV cache (kv_quant), which covers the other
+    half of decode's HBM traffic. Quantized params are serving-only (not
+    trainable; checkpoints should store the fp weights).
+    """
+    from ..ops import quantize_weight
+
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in _QUANT_KEYS:
+        q, s = quantize_weight(layers[name])
+        layers[name] = {"q": q, "s": s}
+    out["layers"] = layers
+    q, s = quantize_weight(params["lm_head"])
+    out["lm_head"] = {"q": q, "s": s}
+    return out
+
+
+def _mm(x, w):
+    """x @ w for plain or int8-quantized ({"q": int8, "s": f32}) weights.
+
+    The per-output-channel scale commutes out of the contraction, so HBM
+    streams the int8 tensor and the widening convert fuses into the MXU
+    operand read (ops.quantize_weight). Serving-only: quantized params
+    are not trainable.
+    """
+    if isinstance(w, dict):
+        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w
+
+
+def _swiglu(x, lp):
+    g = jax.nn.silu(_mm(x, lp["w_gate"]))
+    return _mm(g * _mm(x, lp["w_up"]), lp["w_down"])
+
+
 def _layer(cfg: LlamaConfig, x, lp, cos, sin, *, kv_len=None, full_seq=True,
            mesh=None):
     """One full-sequence decoder block (training / prefill).
@@ -198,9 +271,9 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, *, kv_len=None, full_seq=True,
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"]).reshape(b, s, H, hd)
-    k = (h @ lp["wk"]).reshape(b, s, KV, hd)
-    v = (h @ lp["wv"]).reshape(b, s, KV, hd)
+    q = _mm(h, lp["wq"]).reshape(b, s, H, hd)
+    k = _mm(h, lp["wk"]).reshape(b, s, KV, hd)
+    v = _mm(h, lp["wv"]).reshape(b, s, KV, hd)
     q = constrain(q, P("dp", None, "tp", None))
     k = constrain(k, P("dp", None, "tp", None))
     q = apply_rope(q, cos, sin)
@@ -222,12 +295,10 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, *, kv_len=None, full_seq=True,
         o = attention(q, kf, vf, causal=True, kv_len=kv_len)
 
     o = o.reshape(b, s, H * hd)
-    x = x + constrain(o @ lp["wo"], P("dp", "sp", None))
+    x = x + constrain(_mm(o, lp["wo"]), P("dp", "sp", None))
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    x = x + constrain(
-        swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]), P("dp", "sp", None)
-    )
+    x = x + constrain(_swiglu(h, lp), P("dp", "sp", None))
     return x, k, v
 
 
@@ -248,9 +319,9 @@ def _decode_layer(cfg: LlamaConfig, x, lp, cos, sin, arrays, layer,
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"]).reshape(b, 1, H, hd)
-    k = (h @ lp["wk"]).reshape(b, 1, KV, hd)
-    v = (h @ lp["wv"]).reshape(b, 1, KV, hd)
+    q = _mm(h, lp["wq"]).reshape(b, 1, H, hd)
+    k = _mm(h, lp["wk"]).reshape(b, 1, KV, hd)
+    v = _mm(h, lp["wv"]).reshape(b, 1, KV, hd)
     q = constrain(q, P("dp", None, "tp", None))
     k = constrain(k, P("dp", None, "tp", None))
     q = apply_rope(q, cos, sin)
@@ -301,11 +372,10 @@ def _decode_layer(cfg: LlamaConfig, x, lp, cos, sin, arrays, layer,
                                         layer=layer,
                                         use_kernel=cfg.use_flash)
 
-    x = x + constrain(o.reshape(b, 1, H * hd) @ lp["wo"], P("dp", "sp", None))
+    x = x + constrain(_mm(o.reshape(b, 1, H * hd), lp["wo"]),
+                      P("dp", "sp", None))
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    x = x + constrain(
-        swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]), P("dp", "sp", None)
-    )
+    x = x + constrain(_swiglu(h, lp), P("dp", "sp", None))
     return x, arrays
 
 
@@ -332,7 +402,7 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
         body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)
     return constrain(logits, P("dp", "sp", None))
 
 
@@ -387,7 +457,7 @@ def prefill(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
     # gather each row's last valid position, then project only that row
     rows = jnp.arange(b)
     last = x[rows, seq_lens - 1]  # [B, D]
-    logits = (last @ params["lm_head"]).astype(jnp.float32)
+    logits = _mm(last, params["lm_head"]).astype(jnp.float32)
 
     S_max = cache["k"].shape[2]
     pad = S_max - s
@@ -493,7 +563,7 @@ def decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
     (x, arrays, _), _ = jax.lax.scan(
         body, (x, arrays0, jnp.int32(0)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    logits = _mm(x[:, 0], params["lm_head"]).astype(jnp.float32)
     # cap len at capacity: rows past the end keep decoding garbage (their
     # cache writes are dropped as out-of-bounds) but never index OOB.
     S_max = cache["k"].shape[2]

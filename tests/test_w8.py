@@ -1,0 +1,113 @@
+"""int8 weight quantization (w8a16, LLAMA_W8=1).
+
+Decode at large slot counts is weight-bandwidth-bound; quantize_weights
+halves the per-step weight sweep. These tests pin the math (the per-out-
+channel scale must commute out of the contraction), the parity with an
+explicitly dequantized model, and that the quantized tree shards over tp.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu import parallel as par
+from gofr_tpu.ml.generate import Generator
+from gofr_tpu.models import llama
+from gofr_tpu.ops import quantize_weight
+
+
+def _cfg(**kw):
+    return llama.tiny_llama(use_flash=False, dtype=jnp.float32, **kw)
+
+
+def test_quantize_weight_commutes_out_of_matmul():
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(16, 24)).astype(np.float32)
+    x = rng.normal(size=(3, 16)).astype(np.float32)
+    q, s = quantize_weight(jnp.asarray(w))
+    assert q.dtype == jnp.int8 and s.shape == (24,)
+    got = (x @ np.asarray(q, np.float32)) * np.asarray(s)
+    want = x @ (np.asarray(q, np.float32) * np.asarray(s)[None, :])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # reconstruction error bounded by one quantization step per channel
+    recon = np.asarray(q, np.float32) * np.asarray(s)[None, :]
+    assert np.all(np.abs(recon - w) <= np.asarray(s)[None, :] * 0.5 + 1e-6)
+
+
+def test_quantized_tree_shape_and_stacked_scales():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    qp = llama.quantize_weights(params)
+    wq = qp["layers"]["wq"]
+    assert wq["q"].dtype == jnp.int8
+    assert wq["q"].shape == params["layers"]["wq"].shape
+    assert wq["s"].shape == (cfg.n_layers, cfg.n_heads * cfg.head_dim)
+    assert qp["lm_head"]["s"].shape == (cfg.vocab_size,)
+    # norms and embed stay fp
+    assert qp["layers"]["attn_norm"].dtype == jnp.float32
+    assert qp["embed"].dtype == params["embed"].dtype
+
+
+def test_w8_forward_matches_dequantized_model():
+    """The w8 path must equal running the FP code on explicitly
+    dequantized weights — quantization error is in the weights, never in
+    the compute path."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    qp = llama.quantize_weights(params)
+
+    deq = dict(params)
+    deq["layers"] = dict(params["layers"])
+    for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        w = qp["layers"][name]
+        deq["layers"][name] = (w["q"].astype(jnp.float32)
+                               * w["s"][:, None, :])
+    deq["lm_head"] = (qp["lm_head"]["q"].astype(jnp.float32)
+                      * qp["lm_head"]["s"][None, :])
+
+    toks = np.arange(24, dtype=np.int32)[None, :] % cfg.vocab_size
+    got = llama.forward(qp, jnp.asarray(toks), cfg)
+    want = llama.forward(deq, jnp.asarray(toks), cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-3, rtol=2e-3)
+    # and the quantized logits stay close to the fp model's
+    fp = llama.forward(params, jnp.asarray(toks), cfg)
+    assert np.mean(np.abs(np.asarray(got) - np.asarray(fp))) < 0.1
+
+
+def test_w8_generator_decodes():
+    """End-to-end serving: prefill + chunked decode on quantized weights,
+    composed with the int8 KV cache."""
+    cfg = _cfg(w8=True, kv_quant=True)
+    params = llama.quantize_weights(
+        llama.init_params(cfg, jax.random.PRNGKey(0)))
+    gen = Generator(params, cfg, batch_slots=2, max_seq=64,
+                    prefill_buckets=(16,), chunk=4)
+    toks = gen.generate(np.arange(1, 9, dtype=np.int32), max_new_tokens=12)
+    assert len(toks) == 12
+    assert all(0 <= t < cfg.vocab_size for t in toks)
+
+
+def test_w8_shards_over_tp_mesh():
+    """Quantized weights + scales take the declared tp shardings and the
+    sharded forward matches the unsharded one."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    qp = llama.quantize_weights(params)
+    mesh = par.make_mesh(par.MeshConfig(dp=2, tp=4))
+    specs = par.specs_from_rules(qp, llama.SHARDING_RULES)
+    assert tuple(specs["layers"]["wq"]["q"]) == (None, None, "tp")
+    assert tuple(specs["layers"]["wq"]["s"]) == (None, "tp")
+    assert tuple(specs["layers"]["wo"]["s"]) == (None, None)
+    assert tuple(specs["lm_head"]["s"]) == ("tp",)
+    sharded = par.shard_params(qp, specs, mesh)
+
+    toks = np.arange(16, dtype=np.int32)[None, :] % cfg.vocab_size
+    want = llama.forward(qp, jnp.asarray(toks), cfg)
+    with mesh:
+        got = jax.jit(lambda p, t: llama.forward(p, t, cfg))(
+            sharded, jnp.asarray(toks))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=2e-4)
