@@ -20,7 +20,20 @@ import asyncio
 import json
 from typing import Any, Protocol, runtime_checkable
 
-__all__ = ["Message", "PubSub", "InProcessBroker", "RedisListBroker", "new_pubsub"]
+__all__ = ["Message", "PubSub", "InProcessBroker", "RedisListBroker",
+           "new_pubsub", "run_sync"]
+
+
+def run_sync(coro):
+    """Run a coroutine from sync context (admin/health called outside the
+    loop, e.g. migrations); inside a running loop use the *_async variant."""
+    import asyncio
+
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return asyncio.run(coro)
+    raise RuntimeError("use the *_async variant inside the event loop")
 
 
 class Message:
@@ -212,6 +225,8 @@ def new_pubsub(backend: str, config, logger=None, metrics=None):
         broker = config.get_or_default("PUBSUB_BROKER", "localhost:4222")
         host, _, port = broker.partition(":")
         return NATS(host or "localhost", int(port or 4222),
+                    jetstream=config.get("NATS_JETSTREAM") == "1",
+                    durable=config.get_or_default("CONSUMER_ID", "gofr"),
                     logger=logger, metrics=metrics)
     if backend == "kafka":
         from .kafka import Kafka

@@ -44,7 +44,7 @@ import time
 import zlib
 from typing import Any
 
-from . import Message
+from . import Message, run_sync as _run_sync
 from .kafka_records import (decode_records, encode_record_batch,
                             next_fetch_offset)
 
@@ -825,10 +825,18 @@ class Kafka:
         self._readers.pop(name, None)
 
     def create_topic(self, name: str) -> None:
-        _run_sync(self.create_topic_async(name))
+        _run_sync(self._admin_then_close(self.create_topic_async(name)))
 
     def delete_topic(self, name: str) -> None:
-        _run_sync(self.delete_topic_async(name))
+        _run_sync(self._admin_then_close(self.delete_topic_async(name)))
+
+    async def _admin_then_close(self, coro) -> None:
+        # sync admin runs in a throwaway asyncio.run loop: sockets dialed
+        # there must not survive into the app's real loop
+        try:
+            await coro
+        finally:
+            self.close()
 
     # -- health ----------------------------------------------------------------
     async def health_check_async(self) -> dict:
@@ -858,13 +866,3 @@ class Kafka:
             conn.close()
         self._node_conns.clear()
         self._coord_conn = None
-
-
-def _run_sync(coro):
-    """Run a coroutine from sync context (admin/health called outside the
-    loop, e.g. migrations); inside a running loop, schedule and wait."""
-    try:
-        asyncio.get_running_loop()
-    except RuntimeError:
-        return asyncio.run(coro)
-    raise RuntimeError("use the *_async variant inside the event loop")

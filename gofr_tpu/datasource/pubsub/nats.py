@@ -1,13 +1,22 @@
-"""NATS pub/sub backend: a from-scratch client for the core NATS protocol.
+"""NATS pub/sub backend: a from-scratch client for the NATS protocol.
 
 Reference: separate module over nats.go/JetStream with connection, stream
-and subscription managers (SURVEY §2.8, datasource/pubsub/nats). No Python
-NATS client ships in this image, and core NATS is a simple text protocol
-(INFO/CONNECT/PUB/SUB/MSG/PING/PONG), so — like the RESP client in
-datasource/redis — this implements the wire protocol directly over asyncio
-streams. JetStream persistence is out of scope; delivery semantics here
-are core-NATS at-most-once (commit/nack are no-ops, as with the
-reference's core-NATS mode).
+and subscription managers (SURVEY §2.8, datasource/pubsub/nats:
+client.go:17-70). No Python NATS client ships in this image, and NATS is
+a simple text protocol (INFO/CONNECT/PUB/SUB/MSG/HMSG/PING/PONG), so —
+like the RESP client in datasource/redis — this implements the wire
+protocol directly over asyncio streams.
+
+Two delivery modes, matching the reference's split:
+
+- core NATS (default): at-most-once, commit/nack are no-ops;
+- JetStream (``jetstream=True`` / env NATS_JETSTREAM=1): durable streams
+  + explicit-ack pull consumers over the ``$JS.API.*`` request subjects —
+  publish awaits the stream ack, subscribe fetches via CONSUMER.MSG.NEXT,
+  and the Message's commit/nack map to +ACK/-NAK on the delivery's reply
+  subject, giving the subscriber runtime's commit-on-success semantics
+  at-least-once persistence (the reference's StreamManager/
+  SubscriptionManager roles).
 """
 
 from __future__ import annotations
@@ -17,9 +26,14 @@ import json
 import time
 from typing import Any
 
-from . import Message
+from . import Message, run_sync as _run_sync
 
 __all__ = ["NATS", "NATSError"]
+
+# JetStream API error codes tolerated as "already in the desired state"
+_JS_STREAM_EXISTS = 10058
+_JS_STREAM_MISSING = 10059
+_JS_CONSUMER_EXISTS = 10013
 
 
 class NATSError(Exception):
@@ -30,8 +44,14 @@ class NATS:
     """PubSub-protocol implementation over one NATS connection."""
 
     def __init__(self, host: str = "localhost", port: int = 4222, *,
-                 name: str = "gofr-tpu", logger=None, metrics=None) -> None:
+                 name: str = "gofr-tpu", jetstream: bool = False,
+                 durable: str = "gofr", js_timeout: float = 5.0,
+                 logger=None, metrics=None) -> None:
         self.host, self.port, self.name = host, port, name
+        self.jetstream = jetstream
+        # durable consumer names cannot contain '.'
+        self.durable = durable.replace(".", "_") or "gofr"
+        self._js_timeout = js_timeout
         self._logger = logger
         self._metrics = metrics
         self._reader: asyncio.StreamReader | None = None
@@ -43,6 +63,8 @@ class NATS:
         self._server_info: dict = {}
         self._lock = asyncio.Lock()
         self._connected = False
+        self._streams: set[str] = set()     # streams known to exist
+        self._consumers: set[str] = set()   # topics with a durable created
 
     # -- provider contract -----------------------------------------------------
     def use_logger(self, logger) -> None:
@@ -97,11 +119,31 @@ class NATS:
                     # subject sid [reply] nbytes
                     subject = parts[0].decode()
                     sid = int(parts[1])
+                    reply = parts[2].decode() if len(parts) == 4 else None
                     nbytes = int(parts[-1])
                     payload = await self._reader.readexactly(nbytes + 2)
                     q = self._queues.get(sid)
                     if q is not None:
-                        q.put_nowait((subject, payload[:-2]))
+                        q.put_nowait((subject, reply, payload[:-2], None, ""))
+                elif line.startswith(b"HMSG "):
+                    # subject sid [reply] hdr_len total_len; JetStream sends
+                    # flow/status frames (e.g. 408 pull-expired) as HMSG
+                    parts = line[5:].strip().split(b" ")
+                    subject = parts[0].decode()
+                    sid = int(parts[1])
+                    reply = parts[2].decode() if len(parts) == 5 else None
+                    hdr_len, total = int(parts[-2]), int(parts[-1])
+                    raw = await self._reader.readexactly(total + 2)
+                    headers = raw[:hdr_len]
+                    status, desc = None, ""
+                    first = headers.split(b"\r\n", 1)[0].split(b" ", 2)
+                    if len(first) >= 2 and first[1].isdigit():
+                        status = int(first[1])
+                        desc = first[2].decode() if len(first) > 2 else ""
+                    q = self._queues.get(sid)
+                    if q is not None:
+                        q.put_nowait((subject, reply, raw[hdr_len:-2],
+                                      status, desc))
                 elif line.startswith(b"PING"):
                     self._writer.write(b"PONG\r\n")
                     await self._writer.drain()
@@ -118,17 +160,107 @@ class NATS:
             except Exception:
                 pass
 
+    # -- request / reply -------------------------------------------------------
+    async def _request(self, subject: str, payload: bytes,
+                       timeout: float | None = None) -> tuple[str | None, bytes]:
+        """Core NATS request: one-shot inbox subscription, returns the
+        reply's (reply_subject, payload)."""
+        await self._ensure()
+        sid = self._next_sid
+        self._next_sid += 1
+        inbox = f"_INBOX.{self.name}.{sid}"
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[sid] = q
+        self._writer.write(
+            b"SUB %s %d\r\nUNSUB %d 1\r\nPUB %s %s %d\r\n%s\r\n"
+            % (inbox.encode(), sid, sid, subject.encode(), inbox.encode(),
+               len(payload), payload))
+        await self._writer.drain()
+        try:
+            _subj, reply, data, status, desc = await asyncio.wait_for(
+                q.get(), timeout or self._js_timeout)
+        finally:
+            self._queues.pop(sid, None)
+        return reply, data, status, desc
+
+    async def _js_api(self, op: str, obj: dict | None = None,
+                      ok_codes: tuple[int, ...] = ()) -> dict:
+        payload = json.dumps(obj).encode() if obj is not None else b""
+        try:
+            _, raw, _status, _desc = await self._request(f"$JS.API.{op}", payload)
+        except asyncio.TimeoutError:
+            raise NATSError(f"jetstream {op}: no responder (is the server "
+                            "running with JetStream enabled?)")
+        resp = json.loads(raw.decode())
+        err = resp.get("error")
+        if err and err.get("err_code") not in ok_codes:
+            raise NATSError(f"jetstream {op}: {err.get('description', err)}")
+        return resp
+
+    @staticmethod
+    def _stream_name(topic: str) -> str:
+        # stream/consumer NAMES cannot contain '.' (they are subject
+        # tokens in the $JS.API hierarchy); dotted SUBJECTS are idiomatic
+        # NATS, so the stream keeps the topic as its bound subject
+        return topic.replace(".", "_")
+
+    async def _ensure_stream(self, topic: str) -> None:
+        if topic in self._streams:
+            return
+        await self._js_api(
+            f"STREAM.CREATE.{self._stream_name(topic)}",
+            {"name": self._stream_name(topic), "subjects": [topic],
+             "retention": "limits", "storage": "file"},
+            ok_codes=(_JS_STREAM_EXISTS,))
+        self._streams.add(topic)
+
+    async def _ensure_consumer(self, topic: str) -> None:
+        if topic in self._consumers:
+            return
+        await self._ensure_stream(topic)
+        await self._js_api(
+            f"CONSUMER.DURABLE.CREATE.{self._stream_name(topic)}.{self.durable}",
+            {"stream_name": topic,
+             "config": {"durable_name": self.durable,
+                        "ack_policy": "explicit",
+                        "deliver_policy": "all"}},
+            ok_codes=(_JS_CONSUMER_EXISTS,))
+        self._consumers.add(topic)
+
+    def _ack(self, reply: str, verb: bytes) -> None:
+        if self._writer is None or reply is None:
+            return
+        self._writer.write(b"PUB %s %d\r\n%s\r\n" % (reply.encode(),
+                                                     len(verb), verb))
+
     # -- PubSub protocol -------------------------------------------------------
     async def publish(self, topic: str, message: bytes | str) -> None:
         await self._ensure()
         payload = message.encode() if isinstance(message, str) else bytes(message)
+        self._count("app_pubsub_publish_total_count", topic)
+        if self.jetstream:
+            # JetStream publish: the stream's ack (stream name + sequence)
+            # comes back on the reply inbox; no ack means not persisted
+            await self._ensure_stream(topic)
+            try:
+                _, raw, _status, _desc = await self._request(topic, payload)
+            except asyncio.TimeoutError:
+                raise NATSError(
+                    f"publish {topic}: no stream ack (stream deleted or "
+                    "server overloaded) — message not persisted")
+            resp = json.loads(raw.decode())
+            if resp.get("error"):
+                raise NATSError(f"publish {topic}: {resp['error']}")
+            return
         self._writer.write(b"PUB %s %d\r\n%s\r\n"
                            % (topic.encode(), len(payload), payload))
         await self._writer.drain()
-        self._count("app_pubsub_publish_total_count", topic)
 
     async def subscribe(self, topic: str) -> Message:
         await self._ensure()
+        self._count("app_pubsub_subscribe_total_count", topic)
+        if self.jetstream:
+            return await self._js_subscribe(topic)
         sid = self._subjects.get(topic)
         if sid is None:
             sid = self._next_sid
@@ -137,14 +269,79 @@ class NATS:
             self._queues[sid] = asyncio.Queue()
             self._writer.write(b"SUB %s %d\r\n" % (topic.encode(), sid))
             await self._writer.drain()
-        subject, payload = await self._queues[sid].get()
-        self._count("app_pubsub_subscribe_total_count", topic)
+        subject, _reply, payload, _status, _desc = await self._queues[sid].get()
         return Message(subject, payload, committer=None)
 
+    async def _js_subscribe(self, topic: str) -> Message:
+        """Pull-consumer fetch loop: request one message; an expired pull
+        (status frame on the inbox, or a client-side timeout) re-requests."""
+        await self._ensure_consumer(topic)
+        expires_ns = int(self._js_timeout * 0.8 * 1e9)
+        next_subj = (f"$JS.API.CONSUMER.MSG.NEXT."
+                     f"{self._stream_name(topic)}.{self.durable}")
+        body = json.dumps({"batch": 1, "expires": expires_ns}).encode()
+        while True:
+            try:
+                reply, payload, status, desc = await self._request(
+                    next_subj, body)
+            except asyncio.TimeoutError:
+                continue  # pull expired without a status frame
+            if status is not None:
+                if status in (404, 408):
+                    continue  # no messages / pull expired: benign, re-pull
+                # terminal (consumer deleted, 409 conflicts, ...): error
+                # out rather than re-pulling forever at wire speed
+                raise NATSError(
+                    f"jetstream pull {topic}: status {status} {desc}".strip())
+            if reply is None or not reply.startswith("$JS.ACK."):
+                continue  # stray non-JS delivery on the inbox
+            break
+
+        def committer(msg: Message) -> None:
+            self._count("app_pubsub_subscribe_success_count", topic)
+            self._ack(reply, b"+ACK")
+
+        def nacker(msg: Message) -> None:
+            self._ack(reply, b"-NAK")
+
+        return Message(topic, payload, {"ack": reply},
+                       committer=committer, nacker=nacker)
+
+    # -- admin -----------------------------------------------------------------
+    async def _admin_then_close(self, coro) -> None:
+        # sync admin runs in a throwaway asyncio.run loop: the socket and
+        # reader task dialed there must not leak into the app's real loop
+        try:
+            await coro
+        finally:
+            await self.close()
+
+    async def create_topic_async(self, name: str) -> None:
+        if self.jetstream:
+            await self._ensure_stream(name)
+
+    async def delete_topic_async(self, name: str) -> None:
+        if self.jetstream:
+            await self._js_api(f"STREAM.DELETE.{self._stream_name(name)}",
+                               ok_codes=(_JS_STREAM_MISSING,))
+            self._streams.discard(name)
+            self._consumers.discard(name)
+            return
+        sid = self._subjects.pop(name, None)
+        if sid is not None and self._writer is not None:
+            self._writer.write(b"UNSUB %d\r\n" % sid)
+            self._queues.pop(sid, None)
+
     def create_topic(self, name: str) -> None:
-        """Core NATS subjects are implicit; kept for protocol parity."""
+        """Core NATS subjects are implicit; JetStream creates the stream
+        (use the *_async variants inside a running loop)."""
+        if self.jetstream:
+            _run_sync(self._admin_then_close(self.create_topic_async(name)))
 
     def delete_topic(self, name: str) -> None:
+        if self.jetstream:
+            _run_sync(self._admin_then_close(self.delete_topic_async(name)))
+            return
         sid = self._subjects.pop(name, None)
         if sid is not None and self._writer is not None:
             self._writer.write(b"UNSUB %d\r\n" % sid)

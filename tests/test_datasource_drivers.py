@@ -422,3 +422,291 @@ def test_nats_roundtrip_and_health(run):
     assert topic == "orders"
     assert body == {"id": 7}
     assert h["status"] == "UP" and h["details"]["server"] == "mini"
+
+
+# ------------------------------------------------------------- nats jetstream
+class _MiniJetStream(_MiniNATS):
+    """_MiniNATS plus the JetStream API subjects: in-memory streams,
+    durable pull consumers with explicit ack, redelivery on -NAK."""
+
+    def __init__(self):
+        super().__init__()
+        self.streams: dict[str, list[bytes]] = {}
+        self.subject_of: dict[str, str] = {}   # bound subject -> stream name
+        # (stream, durable) -> next index to deliver
+        self.cursors: dict[tuple[str, str], int] = {}
+        # ack token -> (stream, durable, index)
+        self.pending: dict[str, tuple[str, str, int]] = {}
+        self.acked: list[str] = []
+        self._seq = 0
+        # (code, description) -> answer every MSG.NEXT with an HMSG status
+        self.pull_status: tuple[int, str] | None = None
+
+    async def _client(self, reader, writer):
+        writer.write(b'INFO {"server_name":"mini-js","jetstream":true}\r\n')
+        await writer.drain()
+        subs: dict[str, tuple[int, Any]] = {}  # inbox -> (sid, writer)
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                if line.startswith(b"CONNECT"):
+                    pass
+                elif line.startswith(b"PING"):
+                    writer.write(b"PONG\r\n")
+                    await writer.drain()
+                elif line.startswith(b"SUB "):
+                    _, subject, sid = line.split()
+                    subs[subject.decode()] = (int(sid), writer)
+                elif line.startswith(b"UNSUB"):
+                    pass  # one-shot inboxes; the client stops listening
+                elif line.startswith(b"PUB "):
+                    parts = line.split()
+                    subject = parts[1].decode()
+                    reply = parts[2].decode() if len(parts) == 4 else None
+                    nbytes = int(parts[-1])
+                    payload = (await reader.readexactly(nbytes + 2))[:-2]
+                    await self._handle_pub(subject, reply, payload, subs)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+
+    async def _reply(self, subs, inbox, payload: bytes, *,
+                     src_subject=None, reply=None):
+        ent = subs.get(inbox)
+        if ent is None:
+            return
+        sid, writer = ent
+        subject = src_subject or inbox
+        if reply:
+            writer.write(b"MSG %s %d %s %d\r\n%s\r\n"
+                         % (subject.encode(), sid, reply.encode(),
+                            len(payload), payload))
+        else:
+            writer.write(b"MSG %s %d %d\r\n%s\r\n"
+                         % (subject.encode(), sid, len(payload), payload))
+        await writer.drain()
+
+    async def _handle_pub(self, subject, reply, payload, subs):
+        import json as _json
+
+        if subject.startswith("$JS.API.STREAM.CREATE."):
+            name = subject.rsplit(".", 1)[1]
+            cfg = _json.loads(payload or b"{}")
+            if name in self.streams:
+                body = {"error": {"err_code": 10058,
+                                  "description": "stream name already in use"}}
+            else:
+                self.streams[name] = []
+                for subj in cfg.get("subjects", [name]):
+                    self.subject_of[subj] = name
+                body = {"config": {"name": name}}
+            await self._reply(subs, reply, _json.dumps(body).encode())
+        elif subject.startswith("$JS.API.STREAM.DELETE."):
+            name = subject.rsplit(".", 1)[1]
+            ok = self.streams.pop(name, None) is not None
+            body = ({"success": True} if ok else
+                    {"error": {"err_code": 10059,
+                               "description": "stream not found"}})
+            await self._reply(subs, reply, _json.dumps(body).encode())
+        elif subject.startswith("$JS.API.CONSUMER.DURABLE.CREATE."):
+            _, stream, durable = subject.rsplit(".", 2)
+            self.cursors.setdefault((stream, durable), 0)
+            await self._reply(subs, reply, _json.dumps(
+                {"config": {"durable_name": durable}}).encode())
+        elif subject.startswith("$JS.API.CONSUMER.MSG.NEXT."):
+            if self.pull_status is not None:
+                code, desc = self.pull_status
+                ent = subs.get(reply)
+                if ent is not None:
+                    sid, w = ent
+                    hdr = f"NATS/1.0 {code} {desc}\r\n\r\n".encode()
+                    w.write(b"HMSG %s %d %d %d\r\n%s\r\n"
+                            % (reply.encode(), sid, len(hdr), len(hdr), hdr))
+                    await w.drain()
+                return
+            # waiting must not block the connection's read loop: other
+            # pulls and ACKs multiplex on the same client socket
+            asyncio.get_running_loop().create_task(
+                self._pull_wait(subject, reply, payload, subs))
+        elif subject.startswith("$JS.ACK."):
+            ent = self.pending.pop(subject, None)
+            if payload == b"-NAK" and ent is not None:
+                stream, durable, idx = ent
+                # redeliver: move the cursor back to the nacked message
+                self.cursors[(stream, durable)] = min(
+                    self.cursors[(stream, durable)], idx)
+            elif payload == b"+ACK":
+                self.acked.append(subject)
+        elif subject in self.subject_of:
+            name = self.subject_of[subject]
+            self._seq += 1
+            self.streams[name].append(payload)
+            if reply:
+                await self._reply(subs, reply, _json.dumps(
+                    {"stream": name, "seq": self._seq}).encode())
+        else:
+            # core-NATS publish to a non-stream subject: no JS ack
+            for w, sid in self.subs.get(subject, []):
+                w.write(b"MSG %s %d %d\r\n%s\r\n"
+                        % (subject.encode(), sid, len(payload), payload))
+                await w.drain()
+
+    async def _pull_wait(self, subject, reply, payload, subs):
+        import json as _json
+
+        _, stream, durable = subject.rsplit(".", 2)
+        req = _json.loads(payload or b"{}")
+        expires = req.get("expires", 0) / 1e9
+        key = (stream, durable)
+        deadline = asyncio.get_running_loop().time() + expires
+        while self.cursors.get(key, 0) >= len(self.streams.get(stream, [])):
+            if asyncio.get_running_loop().time() >= deadline:
+                return  # pull expired: say nothing, client re-requests
+            await asyncio.sleep(0.01)
+        idx = self.cursors[key]
+        self.cursors[key] = idx + 1
+        ack = f"$JS.ACK.{stream}.{durable}.{idx + 1}"
+        self.pending[ack] = (stream, durable, idx)
+        await self._reply(subs, reply, self.streams[stream][idx],
+                          src_subject=stream, reply=ack)
+
+
+def test_nats_jetstream_publish_subscribe_ack(run):
+    """JetStream mode: publish awaits the stream ack; subscribe pulls via
+    a durable consumer; commit +ACKs so the message is not redelivered."""
+
+    async def scenario():
+        mini = _MiniJetStream()
+        port = await mini.start()
+        n = NATS("127.0.0.1", port, jetstream=True, durable="workers",
+                 js_timeout=2.0)
+        try:
+            await n.publish("orders", b'{"id": 1}')
+            await n.publish("orders", b'{"id": 2}')
+            assert mini.streams["orders"] == [b'{"id": 1}', b'{"id": 2}']
+
+            m1 = await asyncio.wait_for(n.subscribe("orders"), 5)
+            assert bytes(m1.value) == b'{"id": 1}'
+            m1.commit()
+            m2 = await asyncio.wait_for(n.subscribe("orders"), 5)
+            assert bytes(m2.value) == b'{"id": 2}'
+            m2.commit()
+            await asyncio.sleep(0.05)
+            assert len(mini.acked) == 2
+        finally:
+            await n.close()
+            await mini.stop()
+
+    run(scenario())
+
+
+def test_nats_jetstream_nack_redelivers(run):
+    """-NAK moves the durable's cursor back: the handler sees the same
+    message again (the subscriber runtime's at-least-once contract)."""
+
+    async def scenario():
+        mini = _MiniJetStream()
+        port = await mini.start()
+        n = NATS("127.0.0.1", port, jetstream=True, js_timeout=2.0)
+        try:
+            await n.publish("jobs", b"payload")
+            m = await asyncio.wait_for(n.subscribe("jobs"), 5)
+            m.nack()
+            await asyncio.sleep(0.05)
+            m2 = await asyncio.wait_for(n.subscribe("jobs"), 5)
+            assert bytes(m2.value) == b"payload"
+            m2.commit()
+        finally:
+            await n.close()
+            await mini.stop()
+
+    run(scenario())
+
+
+def test_nats_jetstream_pull_waits_for_publish(run):
+    """A pending pull (no messages yet) delivers as soon as one lands —
+    the long-poll role of Kafka's fetch max_wait."""
+
+    async def scenario():
+        mini = _MiniJetStream()
+        port = await mini.start()
+        n = NATS("127.0.0.1", port, jetstream=True, js_timeout=2.0)
+        pub = NATS("127.0.0.1", port, jetstream=True, js_timeout=2.0)
+        try:
+            await n.create_topic_async("lazy")
+            sub_task = asyncio.create_task(n.subscribe("lazy"))
+            await asyncio.sleep(0.1)
+            await pub.publish("lazy", b"late")
+            msg = await asyncio.wait_for(sub_task, 5)
+            assert bytes(msg.value) == b"late"
+        finally:
+            await n.close()
+            await pub.close()
+            await mini.stop()
+
+    run(scenario())
+
+
+def test_nats_jetstream_stream_admin(run):
+    async def scenario():
+        mini = _MiniJetStream()
+        port = await mini.start()
+        n = NATS("127.0.0.1", port, jetstream=True, js_timeout=2.0)
+        try:
+            await n.create_topic_async("t1")
+            await n.create_topic_async("t1")  # exists-ok
+            assert "t1" in mini.streams
+            await n.delete_topic_async("t1")
+            assert "t1" not in mini.streams
+            await n.delete_topic_async("t1")  # missing-ok
+        finally:
+            await n.close()
+            await mini.stop()
+
+    run(scenario())
+
+
+def test_nats_jetstream_dotted_subjects(run):
+    """Dotted subjects are idiomatic NATS; stream/consumer NAMES cannot
+    contain '.' — the client sanitizes the name but keeps the subject."""
+
+    async def scenario():
+        mini = _MiniJetStream()
+        port = await mini.start()
+        n = NATS("127.0.0.1", port, jetstream=True, js_timeout=2.0)
+        try:
+            await n.publish("orders.created", b"x")
+            assert "orders_created" in mini.streams       # sanitized name
+            msg = await asyncio.wait_for(n.subscribe("orders.created"), 5)
+            assert bytes(msg.value) == b"x"
+            msg.commit()
+        finally:
+            await n.close()
+            await mini.stop()
+
+    run(scenario())
+
+
+def test_nats_jetstream_terminal_status_raises(run):
+    """A terminal pull status (e.g. 409 consumer deleted) must surface as
+    NATSError, not re-pull forever at wire speed."""
+    from gofr_tpu.datasource.pubsub.nats import NATSError
+
+    async def scenario():
+        mini = _MiniJetStream()
+        mini.pull_status = (409, "Consumer Deleted")
+        port = await mini.start()
+        n = NATS("127.0.0.1", port, jetstream=True, js_timeout=2.0)
+        try:
+            await n.create_topic_async("t")
+            try:
+                await asyncio.wait_for(n.subscribe("t"), 5)
+                raise AssertionError("expected NATSError")
+            except NATSError as exc:
+                assert "409" in str(exc)
+        finally:
+            await n.close()
+            await mini.stop()
+
+    run(scenario())
