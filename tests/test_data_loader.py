@@ -1,0 +1,159 @@
+"""Training input pipeline: prefetching, sharded placement, determinism."""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu import parallel as par
+from gofr_tpu.ml.data import DataLoader, csv_source, jsonl_source
+from gofr_tpu.parallel import P
+
+
+def _range_source(n):
+    def gen():
+        for i in range(n):
+            yield {"x": np.full((4,), i, np.float32), "y": np.int32(i)}
+    return gen
+
+
+def test_batches_are_static_and_remainder_dropped():
+    dl = DataLoader(_range_source(10), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 2  # 10 // 4, remainder dropped for static shapes
+    assert batches[0]["x"].shape == (4, 4)
+    assert [int(v) for v in np.asarray(batches[0]["y"])] == [0, 1, 2, 3]
+    assert [int(v) for v in np.asarray(batches[1]["y"])] == [4, 5, 6, 7]
+
+
+def test_shuffle_is_seeded_and_complete():
+    def ys(seed):
+        dl = DataLoader(_range_source(16), batch_size=4,
+                        shuffle_buffer=8, seed=seed)
+        return [int(v) for b in dl for v in np.asarray(b["y"])]
+
+    a, b, c = ys(1), ys(1), ys(2)
+    assert a == b                      # deterministic for a seed
+    assert a != c                      # different seed, different order
+    assert sorted(a) == list(range(16))  # a permutation, nothing lost
+    assert a != list(range(16))        # actually shuffled
+
+
+def test_repeat_reshuffles_each_epoch():
+    dl = DataLoader(_range_source(8), batch_size=4, shuffle_buffer=8,
+                    seed=3, repeat=True)
+    it = iter(dl)
+    epoch1 = [int(v) for _ in range(2) for v in np.asarray(next(it)["y"])]
+    epoch2 = [int(v) for _ in range(2) for v in np.asarray(next(it)["y"])]
+    assert sorted(epoch1) == sorted(epoch2) == list(range(8))
+    assert epoch1 != epoch2  # epoch-seeded reshuffle
+
+
+def test_sharded_placement_on_mesh():
+    mesh = par.make_mesh(par.MeshConfig(dp=8))
+    dl = DataLoader(_range_source(16), batch_size=8, mesh=mesh,
+                    spec=P("dp"))
+    batch = next(iter(dl))
+    assert tuple(batch["x"].sharding.spec) == ("dp",)
+    # a dp-sharded batch feeds a jitted step directly
+    with mesh:
+        total = jax.jit(lambda b: jnp.sum(b["x"]))(batch)
+    assert float(total) == float(sum(i * 4 for i in range(8)))
+
+
+def test_transform_and_scalar_records():
+    dl = DataLoader(lambda: iter(range(6)), batch_size=3,
+                    transform=lambda i: {"v": np.float32(i * 2)})
+    batches = list(dl)
+    assert [float(x) for x in np.asarray(batches[0]["v"])] == [0.0, 2.0, 4.0]
+
+
+def test_producer_error_surfaces_in_consumer():
+    def bad():
+        yield {"x": np.zeros(2)}
+        raise RuntimeError("corrupt shard")
+
+    dl = DataLoader(bad, batch_size=1)
+    it = iter(dl)
+    next(it)
+    try:
+        next(it)
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError as exc:
+        assert "corrupt shard" in str(exc)
+
+
+def test_jsonl_and_csv_sources(tmp_path):
+    p = tmp_path / "d.jsonl"
+    p.write_text("\n".join(json.dumps({"a": i}) for i in range(4)) + "\n")
+    dl = DataLoader(jsonl_source(str(p)), batch_size=2,
+                    transform=lambda r: {"a": np.int32(r["a"])})
+    assert [int(v) for b in dl for v in np.asarray(b["a"])] == [0, 1, 2, 3]
+
+    c = tmp_path / "d.csv"
+    c.write_text("a,b\n1,x\n2,y\n")
+    rows = list(csv_source(str(c))())
+    assert rows == [{"a": "1", "b": "x"}, {"a": "2", "b": "y"}]
+
+
+def test_jsonl_source_over_filesystem(tmp_path):
+    """File-store integration: the same source reads through a mounted
+    FileSystem (local here; FTP/SFTP/S3 share the contract)."""
+    from gofr_tpu.datasource.file import LocalFileSystem
+
+    p = tmp_path / "fs.jsonl"
+    p.write_text('{"a": 7}\n{"a": 8}\n')
+    fs = LocalFileSystem()
+    dl = DataLoader(jsonl_source(str(p), filesystem=fs), batch_size=2,
+                    transform=lambda r: {"a": np.int32(r["a"])})
+    assert [int(v) for b in dl for v in np.asarray(b["a"])] == [7, 8]
+
+
+def test_train_step_consumes_loader():
+    """End-to-end: loader -> sharded batches -> make_train_step."""
+    import optax
+
+    from gofr_tpu.ml.train import make_train_step
+    from gofr_tpu.models.mlp import MLP
+
+    mesh = par.make_mesh(par.MeshConfig(dp=8))
+    model = MLP(sizes=(4, 8, 2), seed=0)
+
+    def loss_fn(p, x, y):
+        logits = MLP.apply(p, x)
+        return jnp.mean((logits - y) ** 2)
+
+    opt = optax.sgd(0.1)
+    step = jax.jit(make_train_step(loss_fn, opt))
+    opt_state = opt.init(model.params)
+
+    rng = np.random.default_rng(0)
+    records = [{"x": rng.normal(size=(4,)).astype(np.float32),
+                "y": rng.normal(size=(2,)).astype(np.float32)}
+               for _ in range(32)]
+    dl = DataLoader(lambda: iter(records), batch_size=16, mesh=mesh,
+                    spec=P("dp"))
+    params = model.params
+    losses = []
+    with mesh:
+        for batch in dl:
+            params, opt_state, loss = step(params, opt_state,
+                                           batch["x"], batch["y"])
+            losses.append(float(loss))
+    assert len(losses) == 2
+    assert np.isfinite(losses).all()
+
+
+def test_empty_source_with_repeat_raises():
+    """An empty source must error out, not spin a core forever while the
+    consumer hangs on an empty queue."""
+    dl = DataLoader(lambda: iter(()), batch_size=2, repeat=True)
+    it = iter(dl)
+    try:
+        next(it)
+        raise AssertionError("expected ValueError")
+    except ValueError as exc:
+        assert "no records" in str(exc)
