@@ -22,14 +22,15 @@ from common import emit
 
 
 def _decode_tok_s(kv_quant: bool, *, slots: int, ctx: int, max_seq: int,
-                  chunk: int, n_chunks: int, cfg_kw: dict) -> dict:
-    import jax
+                  chunk: int, n_chunks: int, cfg_kw: dict,
+                  w8: bool = False) -> dict:
+    import jax  # noqa: F401
 
     from gofr_tpu.ml.generate import Generator
     from gofr_tpu.models import llama
 
-    cfg = llama.LlamaConfig(**cfg_kw, kv_quant=kv_quant)
-    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    cfg = llama.LlamaConfig(**cfg_kw, kv_quant=kv_quant, w8=w8)
+    params = llama.params_from_config(cfg)
     gen = Generator(params, cfg, batch_slots=slots, max_seq=max_seq,
                     prefill_buckets=(ctx,), chunk=chunk)
     rng = np.random.default_rng(0)
@@ -79,6 +80,13 @@ def main() -> None:
                        chunk=chunk, n_chunks=n_chunks, cfg_kw=cfg_kw)
     q8 = _decode_tok_s(True, slots=slots, ctx=ctx, max_seq=max_seq,
                        chunk=chunk, n_chunks=n_chunks, cfg_kw=cfg_kw)
+    # full-int8 sweep: int8 weights AND int8 cache — decode's entire
+    # per-step HBM traffic quantized (w8 halves the weight bytes that
+    # dominate at low slot counts; kv8 halves the cache bytes that
+    # dominate at long context)
+    w8 = _decode_tok_s(True, slots=slots, ctx=ctx, max_seq=max_seq,
+                       chunk=chunk, n_chunks=n_chunks, cfg_kw=cfg_kw,
+                       w8=True)
 
     emit(
         "longcontext_int8_speedup_8k", q8["tok_per_s"] / fp["tok_per_s"],
@@ -88,6 +96,8 @@ def main() -> None:
             "slots": slots,
             "fp": fp,
             "int8": q8,
+            "int8_w8": w8,
+            "w8_speedup": round(w8["tok_per_s"] / fp["tok_per_s"], 3),
             "backend": jax.default_backend(),
             "config": 7,
         },
