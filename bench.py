@@ -26,6 +26,11 @@ import sys
 import time
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    # the TPU plugin overrides the env; honor an explicit CPU pin before
+    # any device query (a dead tunnel hangs discovery, see __graft_entry__)
+    jax.config.update("jax_platforms", "cpu")
 import numpy as np
 
 # bf16 peak FLOP/s and HBM GB/s per chip by device kind (public specs)
@@ -155,9 +160,12 @@ def main() -> None:
     step_s = elapsed / steps
 
     # per-step HBM traffic: full weight stream + the live KV prefix (the
-    # pallas decode kernel reads only valid blocks) twice (k and v)
+    # pallas decode kernel reads only valid blocks) twice (k and v).
+    # Dtype-aware: under LLAMA_W8 the weights stream as int8 (1 B/elem)
+    # plus small f32 scales, not 2 B/elem.
     avg_len = prompt_len + chunk + steps / 2
-    weight_bytes = n_params * 2
+    weight_bytes = sum(int(np.prod(p.shape)) * p.dtype.itemsize
+                       for p in jax.tree.leaves(params))
     kv_cells = 2 * cfg.n_layers * slots * avg_len * cfg.n_kv_heads
     kv_bytes = kv_cells * cfg.head_dim * (1 if kv_quant else 2)
     if kv_quant:

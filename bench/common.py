@@ -18,6 +18,14 @@ from typing import Any, Awaitable, Callable
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    # honor an explicit CPU pin before any device query: the TPU plugin
+    # overrides JAX_PLATFORMS from the env, and device discovery through
+    # a dead tunnel hangs rather than failing (see __graft_entry__)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 from gofr_tpu.testutil import get_free_port  # noqa: E402
 
 
