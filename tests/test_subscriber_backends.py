@@ -1,0 +1,109 @@
+"""The subscriber runtime's at-least-once contract, per backend.
+
+The reference commits only on handler success (subscriber.go:72-75); a
+failed handler must see the SAME message again. This is the integration
+guarantee users actually rely on, so it is pinned against every broker
+that supports redelivery: the in-proc broker, the Kafka wire client
+(local nack requeue + uncommitted offsets), and NATS JetStream (-NAK).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from gofr_tpu.container.mock import new_mock_container
+from gofr_tpu.subscriber import start_subscriber
+
+
+async def _drive_redelivery(run_container, broker_client, publish, cleanup):
+    """Publish one message; the handler fails on first delivery and the
+    loop must redeliver the identical payload."""
+    container, _ = new_mock_container()
+    container.pubsub = broker_client
+    attempts: list = []
+    task: asyncio.Task | None = None
+
+    async def handler(ctx):
+        attempts.append(await ctx.bind())
+        if len(attempts) == 1:
+            raise ValueError("transient failure")
+        task.cancel()
+
+    await publish(b'{"n": 42}')
+    task = asyncio.ensure_future(start_subscriber("t", handler, container))
+    try:
+        with pytest.raises(asyncio.CancelledError):
+            await asyncio.wait_for(asyncio.shield(task), 10)
+    finally:
+        if not task.done():
+            task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):
+            pass
+        await cleanup()
+    assert len(attempts) >= 2, attempts
+    assert attempts[0] == attempts[1] == {"n": 42}
+
+
+def test_redelivery_inproc(run):
+    from gofr_tpu.datasource.pubsub import InProcessBroker
+
+    async def scenario():
+        broker = InProcessBroker()
+        await _drive_redelivery(
+            run, broker,
+            publish=lambda m: broker.publish("t", m),
+            cleanup=_noop)
+
+    run(scenario())
+
+
+def test_redelivery_kafka(run):
+    from test_kafka import FakeBroker
+
+    from gofr_tpu.datasource.pubsub.kafka import Kafka
+
+    async def scenario():
+        fake = FakeBroker(modern=True)
+        await fake.start()
+        fake.topics["t"] = {0: []}
+        k = Kafka(f"127.0.0.1:{fake.port}", group_id="g",
+                  offset_start="earliest")
+
+        async def cleanup():
+            k.close()
+            await fake.stop()
+
+        await _drive_redelivery(run, k,
+                                publish=lambda m: k.publish("t", m),
+                                cleanup=cleanup)
+
+    run(scenario())
+
+
+def test_redelivery_nats_jetstream(run):
+    from test_datasource_drivers import _MiniJetStream
+
+    from gofr_tpu.datasource.pubsub.nats import NATS
+
+    async def scenario():
+        mini = _MiniJetStream()
+        port = await mini.start()
+        n = NATS("127.0.0.1", port, jetstream=True, js_timeout=2.0)
+
+        async def cleanup():
+            await n.close()
+            await mini.stop()
+
+        await _drive_redelivery(run, n,
+                                publish=lambda m: n.publish("t", m),
+                                cleanup=cleanup)
+
+    run(scenario())
+
+
+async def _noop():
+    return None
