@@ -996,3 +996,25 @@ def test_not_coordinator_resolves_and_retries(run):
             await b.stop()
 
     run(scenario())
+
+
+def test_control_batch_advances_next_fetch_offset():
+    """A transaction-marker (control) batch yields no data records but
+    next_fetch_offset still advances past it — the consumer must never
+    refetch the same tail forever."""
+    from gofr_tpu.datasource.pubsub.kafka_records import next_fetch_offset
+
+    batch = bytearray(encode_record_batch([(None, b"marker")], 0,
+                                          base_offset=5))
+    # flip the control bit (attributes bit 5) inside the crc-covered body,
+    # then recompute the crc so the batch stays valid
+    attrs_off = 8 + 4 + 4 + 1 + 4  # baseOffset, len, epoch, magic, crc
+    batch[attrs_off + 1] |= 0x20   # attributes int16, low byte
+    body = bytes(batch[21:])
+    struct.pack_into(">I", batch, 17, crc32c(body))
+
+    assert decode_records(bytes(batch)) == []         # no data records
+    assert next_fetch_offset(bytes(batch)) == 6        # ...but offset moves
+    # appended after a data batch, the scan keys off the LAST batch
+    data = encode_record_batch([(None, b"x")], 0, base_offset=6)
+    assert next_fetch_offset(bytes(batch) + data) == 7
