@@ -103,12 +103,38 @@ def llama3_8b(**kw) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
-def params_from_config(cfg: "LlamaConfig", seed: int = 0) -> dict:
-    """Init params honoring the config's serving knobs — the one place
-    that consumes ``cfg.w8``, so every boot path (examples, bench,
-    multi-host workers) gets quantized weights without repeating the
-    step."""
+def params_from_config(cfg: "LlamaConfig", seed: int = 0,
+                       checkpoint_dir: str | None = None) -> dict:
+    """Init or restore params honoring the config's serving knobs — the
+    one place that consumes ``cfg.w8`` and ``LLAMA_CKPT``, so every boot
+    path (examples, bench, multi-host workers) serves the same way.
+
+    ``LLAMA_CKPT=<dir>`` (or ``checkpoint_dir``) restores the latest
+    orbax checkpoint instead of random init: either a bare params tree or
+    a training state whose ``"params"`` entry matches. Quantization
+    (``w8``) applies AFTER restore — checkpoints store fp weights.
+    """
+    import os as _os
+
+    checkpoint_dir = checkpoint_dir or _os.environ.get("LLAMA_CKPT")
     params = init_params(cfg, jax.random.PRNGKey(seed))
+    if checkpoint_dir:
+        from ..ml.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(checkpoint_dir)
+        try:
+            try:
+                params = ckpt.restore(like=params)
+            except Exception:
+                # training states save {"params": ..., "opt_state": ...}
+                restored = ckpt.restore()
+                if not (isinstance(restored, dict) and "params" in restored):
+                    raise
+                params = jax.tree.map(
+                    lambda leaf, ref: jnp.asarray(leaf, ref.dtype),
+                    restored["params"], params)
+        finally:
+            ckpt.close()
     if cfg.w8:
         params = quantize_weights(params)
     return params

@@ -83,3 +83,45 @@ def test_trainer_resume(tmp_path):
     resumed_loss = t2.step(x, y)
     assert resumed_loss == pytest.approx(loss_after_4, rel=1e-6)
     ckpt.close()
+
+
+def test_serving_boots_from_checkpoint(tmp_path, monkeypatch):
+    """LLAMA_CKPT on the shared boot path: the servers serve the SAVED
+    weights, not a fresh init — including through w8 quantization and a
+    training-state layout ({"params": ...})."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gofr_tpu.ml.checkpoint import Checkpointer
+    from gofr_tpu.models import llama
+
+    cfg = llama.tiny_llama(use_flash=False, dtype=jnp.float32)
+    trained = llama.init_params(cfg, jax.random.PRNGKey(123))
+
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    ckpt.save(7, trained)
+    ckpt.close()
+
+    monkeypatch.setenv("LLAMA_CKPT", str(tmp_path / "ck"))
+    got = llama.params_from_config(cfg)
+    np.testing.assert_array_equal(np.asarray(got["embed"]),
+                                  np.asarray(trained["embed"]))
+
+    # training-state layout restores the params entry
+    ckpt2 = Checkpointer(str(tmp_path / "ck2"))
+    ckpt2.save(1, {"params": trained, "step": 1})
+    ckpt2.close()
+    monkeypatch.setenv("LLAMA_CKPT", str(tmp_path / "ck2"))
+    got2 = llama.params_from_config(cfg)
+    np.testing.assert_array_equal(np.asarray(got2["lm_head"]),
+                                  np.asarray(trained["lm_head"]))
+
+    # w8 quantizes the RESTORED weights, not a fresh init
+    cfg_w8 = llama.tiny_llama(use_flash=False, dtype=jnp.float32, w8=True)
+    q = llama.params_from_config(cfg_w8)
+    from gofr_tpu.ops import quantize_weight
+
+    want_q, want_s = quantize_weight(trained["lm_head"])
+    np.testing.assert_array_equal(np.asarray(q["lm_head"]["q"]),
+                                  np.asarray(want_q))
