@@ -22,6 +22,7 @@ CONFIGS = [
     "config5_sdxl.py",
     "config6_compute.py",
     "config7_longcontext.py",
+    "config8_speculative.py",
 ]
 
 

@@ -1,0 +1,193 @@
+"""Speculative decoding: prompt-lookup (n-gram) drafts + batched verify.
+
+Plain decode runs one token per device program, so every generated token
+pays a full weight sweep out of HBM. Speculation verifies K+1 positions
+in ONE program — the weight sweep amortizes over every accepted token —
+and with greedy sampling it is LOSSLESS: the verifier's own argmax
+decides every emitted token, so output is the greedy continuation
+regardless of draft quality; a bad draft only costs speed, never
+correctness. (Exact token-for-token equality with the single-token
+program holds under f32; in bf16 the two programs can flip near-ties —
+each is still a valid greedy chain of its own logits.)
+
+Drafts come from prompt lookup (n-gram matching against the request's own
+history) — no draft model, no extra weights, and big wins on the
+workloads serving actually sees (code edits, RAG with quoted context,
+structured output). The device side is a single jitted window program:
+write K Q/K/V rows into the cache at positions len..len+K-1, attend
+causally over cache + window (rejected-position writes are naturally
+masked: later windows overwrite them before any query can attend that
+far), return the per-position argmax. Acceptance is then a host-side
+prefix match, and "rollback" is just NOT advancing ``len`` past the
+accepted prefix.
+
+Standalone single-stream path (the Generator's continuous-batching loop
+is unchanged); greedy-only; composes with int8 weights (w8) but not the
+int8 KV cache.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["propose_lookup", "SpeculativeDecoder"]
+
+
+def propose_lookup(history: Sequence[int], k: int, max_ngram: int = 3
+                   ) -> list[int]:
+    """Draft up to ``k`` tokens by matching the longest trailing n-gram
+    against the earlier history and copying what followed it."""
+    h = list(history)
+    n_hist = len(h)
+    for n in range(min(max_ngram, n_hist - 1), 0, -1):
+        pattern = h[-n:]
+        # most recent earlier occurrence wins (local context beats global)
+        for start in range(n_hist - n - 1, -1, -1):
+            if h[start:start + n] == pattern:
+                follow = h[start + n:start + n + k]
+                if follow:
+                    return follow
+    return []
+
+
+class SpeculativeDecoder:
+    """Greedy decode for one stream with prompt-lookup speculation.
+
+    ``generate()`` emits exactly the greedy continuation (the verify
+    program's argmax chain); ``accepted``/``proposed`` report draft
+    efficiency. Requires an fp KV cache (kv_quant unsupported here).
+    """
+
+    def __init__(self, params, cfg, *, k: int = 4, max_ngram: int = 3,
+                 max_seq: int | None = None, draft_fn=None) -> None:
+        if cfg.kv_quant:
+            raise ValueError("speculative decode needs the fp KV cache")
+        import jax
+
+        from ..models import llama
+
+        self.params = params
+        self.cfg = cfg
+        self.k = k
+        self.max_ngram = max_ngram
+        self.max_seq = max_seq or cfg.max_seq_len
+        # draft_fn(history, k) -> list of up to k proposed tokens; defaults
+        # to prompt lookup. A distillation/draft-model source plugs in here.
+        self.draft_fn = draft_fn
+        self.accepted = 0
+        self.proposed = 0
+        self._llama = llama
+        self._jax = jax
+        K = k + 1
+        self._verify = jax.jit(lambda p, t, c: self._verify_window(p, t, c, K))
+        self._decode = jax.jit(
+            lambda p, t, c: llama.decode_step(p, t, c, cfg))
+
+    # -- the window program ----------------------------------------------------
+    def _verify_window(self, params, toks, cache, K: int):
+        """toks [1, K] starting at cache['len']: write K cache rows, attend
+        causally, return (greedy [K], updated cache arrays)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.llama import _mm, _swiglu
+        from ..ops import (apply_rope, attention, repeat_kv, rms_norm,
+                           rope_table)
+
+        cfg = self.cfg
+        H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        pos0 = cache["len"][0]
+        x = params["embed"][toks].astype(cfg.dtype)          # [1, K, D]
+        positions = pos0 + jnp.arange(K)[None, :]
+        cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
+
+        def body(carry, lp):
+            x, arrays, layer = carry
+            h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+            q = _mm(h, lp["wq"]).reshape(1, K, H, hd)
+            kk = _mm(h, lp["wk"]).reshape(1, K, KV, hd)
+            vv = _mm(h, lp["wv"]).reshape(1, K, KV, hd)
+            q = apply_rope(q, cos, sin)
+            kk = apply_rope(kk, cos, sin)
+            dt = arrays["k"].dtype
+            upd = lambda a, w: jax.lax.dynamic_update_slice(
+                a, w.astype(dt)[None], (layer, 0, pos0, 0, 0))
+            arrays = {"k": upd(arrays["k"], kk), "v": upd(arrays["v"], vv)}
+            k_row = jax.lax.dynamic_index_in_dim(arrays["k"], layer, 0,
+                                                 keepdims=False)
+            v_row = jax.lax.dynamic_index_in_dim(arrays["v"], layer, 0,
+                                                 keepdims=False)
+            # causal with q_offset=pos0: query i attends cache positions
+            # <= pos0+i — history plus the window prefix; stale cells past
+            # the window can never be reached
+            o = attention(q, repeat_kv(k_row, cfg.n_rep),
+                          repeat_kv(v_row, cfg.n_rep),
+                          causal=True, q_offset=pos0)
+            x = x + _mm(o.reshape(1, K, H * hd), lp["wo"])
+            h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+            x = x + _swiglu(h2, lp)
+            return (x, arrays, layer + 1), None
+
+        arrays0 = {"k": cache["k"], "v": cache["v"]}
+        (x, arrays, _), _ = jax.lax.scan(
+            body, (x, arrays0, jnp.int32(0)), params["layers"])
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        logits = _mm(x, params["lm_head"]).astype(jnp.float32)  # [1, K, V]
+        return jnp.argmax(logits[0], axis=-1).astype(jnp.int32), arrays
+
+    # -- host loop -------------------------------------------------------------
+    def generate(self, prompt_ids, max_new_tokens: int) -> list[int]:
+        jax = self._jax
+        llama = self._llama
+        cfg = self.cfg
+        np_prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        n = len(np_prompt)
+        if n == 0 or n + max_new_tokens + self.k + 1 > self.max_seq:
+            raise ValueError("prompt + max_new + draft window must fit max_seq")
+
+        cache = llama.init_cache(cfg, 1, self.max_seq)
+        toks = np.zeros((1, n), np.int32)
+        toks[0] = np_prompt
+        logits, cache = jax.jit(
+            lambda p, t, l, c: llama.prefill(p, t, l, cfg, c))(
+            self.params, toks, np.array([n], np.int32), cache)
+        first = int(np.asarray(logits)[0].argmax())
+        history = list(map(int, np_prompt)) + [first]
+        out = [first]
+        K = self.k + 1
+
+        while len(out) < max_new_tokens:
+            pos0 = int(np.asarray(cache["len"])[0])
+            if pos0 + K <= self.max_seq:
+                if self.draft_fn is not None:
+                    props = list(self.draft_fn(history, self.k))
+                else:
+                    props = propose_lookup(history, self.k, self.max_ngram)
+            else:
+                props = []
+            if len(props) == self.k:
+                window = np.asarray([[history[-1]] + props], np.int32)
+                greedy, arrays = self._verify(self.params, window, cache)
+                greedy = [int(t) for t in np.asarray(greedy)]
+                n_acc = 0
+                while n_acc < self.k and props[n_acc] == greedy[n_acc]:
+                    n_acc += 1
+                new_tokens = props[:n_acc] + [greedy[n_acc]]
+                self.proposed += self.k
+                self.accepted += n_acc
+                cache = {**arrays,
+                         "len": cache["len"] + np.int32(1 + n_acc)}
+            else:
+                tok = np.asarray([history[-1]], np.int32)
+                logits, cache = self._decode(self.params, tok, cache)
+                new_tokens = [int(np.asarray(logits)[0].argmax())]
+            take = new_tokens[:max_new_tokens - len(out)]
+            out.extend(take)
+            history.extend(take)
+        return out
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0

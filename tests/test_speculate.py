@@ -1,0 +1,106 @@
+"""Speculative decoding: losslessness, acceptance, cache integrity."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.ml.speculate import SpeculativeDecoder, propose_lookup
+from gofr_tpu.models import llama
+
+
+def _cfg(**kw):
+    return llama.tiny_llama(use_flash=False, dtype=jnp.float32,
+                            max_seq_len=128, **kw)
+
+
+def _plain_greedy(params, cfg, prompt, max_new):
+    cache = llama.init_cache(cfg, 1)
+    toks = np.asarray([prompt], np.int32)
+    lens = np.array([len(prompt)], np.int32)
+    prefill = jax.jit(lambda p, t, l, c: llama.prefill(p, t, l, cfg, c))
+    decode = jax.jit(lambda p, t, c: llama.decode_step(p, t, c, cfg))
+    logits, cache = prefill(params, toks, lens, cache)
+    tok = int(np.asarray(logits)[0].argmax())
+    out = [tok]
+    for _ in range(max_new - 1):
+        logits, cache = decode(params, np.asarray([tok], np.int32), cache)
+        tok = int(np.asarray(logits)[0].argmax())
+        out.append(tok)
+    return out
+
+
+# ------------------------------------------------------------------- drafts
+def test_propose_lookup_matches_longest_recent_ngram():
+    h = [1, 2, 3, 9, 9, 1, 2, 3]
+    assert propose_lookup(h, k=2) == [9, 9]       # trigram 1,2,3 -> followed by 9,9
+    assert propose_lookup([5, 6, 5], k=3) == [6, 5]
+    assert propose_lookup([1, 2, 3], k=2) == []   # nothing repeats
+    assert propose_lookup([7], k=2) == []
+
+
+def test_propose_lookup_prefers_most_recent_occurrence():
+    h = [1, 2, 8, 8, 1, 2, 5, 5, 1, 2]
+    assert propose_lookup(h, k=1) == [5]  # the later "1,2 -> 5" wins
+
+
+# ------------------------------------------------------------ losslessness
+@pytest.mark.parametrize("style", ["repetitive", "random"])
+def test_speculative_output_is_exactly_greedy(style):
+    """The verifier's argmax decides every token, so speculation may only
+    change SPEED — both on drafts that hit (repetitive) and drafts that
+    miss (random)."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(5)
+    if style == "repetitive":
+        phrase = rng.integers(1, cfg.vocab_size, (6,))
+        prompt = np.tile(phrase, 3).astype(np.int32)
+    else:
+        prompt = rng.integers(1, cfg.vocab_size, (18,)).astype(np.int32)
+
+    want = _plain_greedy(params, cfg, prompt, 24)
+    dec = SpeculativeDecoder(params, cfg, k=4)
+    got = dec.generate(prompt, 24)
+    assert got == want
+    assert len(got) == 24
+
+
+def test_acceptance_on_self_repeating_generation():
+    """Tiny random models often fall into loops; generated repetition must
+    feed back into the draft window (history includes generated tokens)."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(1))
+    phrase = np.arange(2, 8, dtype=np.int32)
+    prompt = np.tile(phrase, 3)
+    dec = SpeculativeDecoder(params, cfg, k=4)
+    got = dec.generate(prompt, 30)
+    assert got == _plain_greedy(params, cfg, prompt, 30)
+    assert dec.proposed > 0  # drafts were attempted on the repeated phrase
+
+
+def test_speculation_composes_with_w8():
+    cfg = _cfg(w8=True)
+    params = llama.quantize_weights(
+        llama.init_params(cfg, jax.random.PRNGKey(2)))
+    prompt = np.tile(np.arange(3, 9, dtype=np.int32), 3)
+    dec = SpeculativeDecoder(params, cfg, k=3)
+    got = dec.generate(prompt, 16)
+    assert got == _plain_greedy(params, cfg, prompt, 16)
+
+
+def test_kv_quant_rejected():
+    cfg = _cfg(kv_quant=True)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="fp KV cache"):
+        SpeculativeDecoder(params, cfg)
+
+
+def test_capacity_validation():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    dec = SpeculativeDecoder(params, cfg, k=4, max_seq=32)
+    with pytest.raises(ValueError, match="must fit"):
+        dec.generate(np.arange(1, 20, dtype=np.int32), 16)
