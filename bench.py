@@ -1,20 +1,31 @@
 """Benchmark: served LLM throughput through the real gRPC path, plus the
 raw continuous-batching decode loop for roofline context.
 
-Prints ONE JSON line. The workload is the per-chip share of BASELINE.md
-config #4 (Llama-3-8B, TP=8, >= 2000 tok/s aggregate): one chip running a
-1B-param decoder (== 8B sharded 8 ways) with continuous-batching slots.
-``vs_baseline`` is therefore value / 2000 — each chip of the TP=8 system
-must sustain the full aggregate token rate on its 1/8 model shard.
+Prints ONE JSON line — **always**, no matter what the TPU tunnel does:
+
+* Device discovery runs in a *subprocess* with a timeout first. A dead
+  axon tunnel hangs `jax.devices()` inside C code forever (BENCH_r03:
+  rc=124 with zero output); a child process hang is killable, a parent
+  hang is not. On probe failure the bench pins `JAX_PLATFORMS=cpu` and
+  still emits a (CPU smoke) line with `tpu_discovery` recording the hang.
+* A watchdog thread emits the best partial result collected so far and
+  `os._exit`s if the whole run exceeds `GOFR_BENCH_BUDGET_S` (default
+  540 s) — this fires even when the main thread is stuck in a C call,
+  which `signal.alarm` would not survive.
+
+The workload is the per-chip share of BASELINE.md config #4 (Llama-3-8B,
+TP=8, >= 2000 tok/s aggregate): one chip running a 1B-param decoder
+(== 8B sharded 8 ways) with continuous-batching slots. ``vs_baseline``
+is therefore value / 2000 — each chip of the TP=8 system must sustain
+the full aggregate token rate on its 1/8 model shard.
 
 The HEADLINE value is measured through the serving stack — gRPC
 server-streaming into LLMServer admission into chunked decode — at 64
 concurrent streams x 256 new tokens (bench/config4_llama.py, run as a
 subprocess first so its HBM is free before the raw loop allocates). The
 raw Generator loop then supplies step time, achieved HBM bandwidth, and
-MFU in ``detail.raw_loop``. If the serving subprocess fails the raw number
-becomes the headline with ``serving_path: "failed"`` so the bench line
-never goes missing.
+MFU in ``detail.raw_loop``. If the serving subprocess fails the raw
+number becomes the headline with ``serving_path: "failed"``.
 """
 
 from __future__ import annotations
@@ -23,15 +34,106 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
-import jax
+# Best result so far; the watchdog emits this verbatim if the run hangs.
+_PARTIAL: dict = {
+    "metric": "bench_diagnostic",
+    "value": 0.0,
+    "unit": "tok/s",
+    "vs_baseline": 0.0,
+    "detail": {"stage": "init"},
+}
+_DONE = threading.Event()
+_EMIT_LOCK = threading.Lock()  # exactly ONE of main/watchdog prints the line
+_CHILDREN: list = []  # live subprocesses; the watchdog kills them on exit
+_T0 = time.monotonic()
 
-if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-    # the TPU plugin overrides the env; honor an explicit CPU pin before
-    # any device query (a dead tunnel hangs discovery, see __graft_entry__)
-    jax.config.update("jax_platforms", "cpu")
-import numpy as np
+
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def _emit_final(obj: dict) -> None:
+    """Main-thread final emit: set _DONE under the lock so a watchdog that
+    just timed out can neither double-print nor os._exit mid-print."""
+    with _EMIT_LOCK:
+        _DONE.set()
+        _emit(obj)
+
+
+def _watchdog(budget_s: float) -> None:
+    if _DONE.wait(budget_s):
+        return
+    with _EMIT_LOCK:
+        if _DONE.is_set():  # main won the race and already printed
+            return
+        try:
+            _PARTIAL.setdefault("detail", {})["watchdog"] = (
+                f"budget {budget_s:.0f}s exceeded at stage "
+                f"{_PARTIAL['detail'].get('stage')}; emitting partial result"
+            )
+            _emit(_PARTIAL)
+        except Exception:
+            # main thread mutating _PARTIAL mid-dumps must not lose the
+            # line — fall back to a static diagnostic
+            print('{"metric": "bench_diagnostic", "value": 0.0, '
+                  '"unit": "tok/s", "vs_baseline": 0.0, '
+                  '"detail": {"watchdog": "budget exceeded"}}', flush=True)
+        for proc in list(_CHILDREN):  # don't orphan a serving child holding
+            try:                # HBM + ports past our own exit
+                proc.kill()
+            except Exception:
+                pass
+        os._exit(0)  # rc 0: the line above is the result
+
+
+def _last_json_line(stdout: str, required_key: str) -> dict | None:
+    """Last stdout line that parses as a JSON object with required_key —
+    the one shared contract for every bench subprocess."""
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except ValueError:  # JSONDecodeError subclasses ValueError
+            continue
+        if isinstance(parsed, dict) and required_key in parsed:
+            return parsed
+    return None
+
+
+def _run_child(argv: list[str], timeout_s: float, required_key: str,
+               cwd: str | None = None) -> dict | None:
+    """Run a subprocess, tracked so the watchdog can kill it, and return
+    its last JSON line (None on hang/failure)."""
+    try:
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True, cwd=cwd)
+    except OSError:
+        return None
+    _CHILDREN.append(proc)
+    try:
+        stdout, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return None
+    finally:
+        _CHILDREN.remove(proc)
+    return _last_json_line(stdout, required_key)
+
+
+def _probe_discovery(timeout_s: float) -> dict | None:
+    """Run jax device discovery in a child process so a dead tunnel hangs
+    the killable child, never this process. Returns the child's report or
+    None on hang/failure."""
+    code = (
+        "import json, jax\n"
+        "d = jax.devices()[0]\n"
+        "print(json.dumps({'backend': jax.default_backend(),"
+        " 'kind': d.device_kind}))\n"
+    )
+    return _run_child([sys.executable, "-c", code], timeout_s, "backend")
+
 
 # bf16 peak FLOP/s and HBM GB/s per chip by device kind (public specs)
 _CHIP_SPECS = {
@@ -42,8 +144,8 @@ _CHIP_SPECS = {
 }
 
 
-def _chip_spec() -> tuple[float, float]:
-    kind = jax.devices()[0].device_kind.lower()
+def _chip_spec(kind: str) -> tuple[float, float]:
+    kind = kind.lower()
     for key, spec in _CHIP_SPECS.items():
         if key in kind:
             return spec
@@ -57,7 +159,9 @@ def _measure_achievable_bw() -> float:
     v5e's 819 GB/s through the dev tunnel), so roofline utilization against
     the spec alone wildly understates how close decode runs to the real
     ceiling."""
+    import jax
     import jax.numpy as jnp
+    import numpy as np
 
     a = jnp.zeros((8192, 65536), jnp.bfloat16)  # 1 GiB
     x = jnp.ones((65536,), jnp.bfloat16)
@@ -77,34 +181,48 @@ def _measure_achievable_bw() -> float:
     return best
 
 
-def _served_result() -> dict | None:
+def _served_result(timeout_s: float) -> dict | None:
     """Run the serving-path bench (config #4) in a fresh subprocess and
     return its parsed JSON line. A subprocess keeps the served model's HBM
     fully released before the raw loop allocates its own."""
     here = os.path.dirname(os.path.abspath(__file__))
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(here, "bench", "config4_llama.py")],
-            capture_output=True, text=True, timeout=900,
-            cwd=os.path.join(here, "bench"),
-        )
-    except (subprocess.TimeoutExpired, OSError):
-        return None
-    for line in reversed(proc.stdout.strip().splitlines()):
-        try:
-            parsed = json.loads(line)
-        except (json.JSONDecodeError, ValueError):
-            continue
-        if isinstance(parsed, dict) and "metric" in parsed:
-            return parsed
-    return None
+    return _run_child(
+        [sys.executable, os.path.join(here, "bench", "config4_llama.py")],
+        timeout_s, "metric", cwd=os.path.join(here, "bench"))
 
 
 def main() -> None:
+    budget_s = float(os.environ.get("GOFR_BENCH_BUDGET_S", "540"))
+    threading.Thread(target=_watchdog, args=(budget_s,), daemon=True).start()
+    detail = _PARTIAL["detail"]
+
+    cpu_pinned = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    if not cpu_pinned:
+        detail["stage"] = "tpu_discovery_probe"
+        probe = _probe_discovery(min(240.0, budget_s / 2))
+        if probe is None:
+            # dead tunnel: pin cpu for this process AND children, keep going
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            cpu_pinned = True
+            detail["tpu_discovery"] = "hung_or_failed; pinned cpu"
+        else:
+            detail["tpu_discovery"] = probe
+
+    import jax
+
+    if cpu_pinned:
+        # the TPU plugin overrides the env; honor the CPU pin before any
+        # device query (a dead tunnel hangs discovery, see __graft_entry__)
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
     from gofr_tpu.ml.generate import Generator
     from gofr_tpu.models import llama
 
-    served = _served_result()
+    detail["stage"] = "served_path"
+    elapsed = time.monotonic() - _T0
+    # leave >= 180s of budget for the raw loop after the serving subprocess
+    served = _served_result(max(60.0, budget_s - elapsed - 180.0))
 
     on_tpu = jax.default_backend() == "tpu"
     # int8 cache (docs/tpu); LLAMA_KV_QUANT is the documented name, the
@@ -124,10 +242,22 @@ def main() -> None:
         cfg = llama.tiny_llama(use_flash=False, kv_quant=kv_quant, w8=w8)
         slots, chunk, n_chunks, prompt_len, max_seq = 4, 4, 4, 8, 64
 
+    if served is not None:
+        # serving result in hand: make it the emittable partial immediately
+        _PARTIAL.update(
+            metric="served_tok_per_s_per_chip_1b_proxy",
+            value=served["value"],
+            vs_baseline=round(served["value"] / 2000.0, 3),
+        )
+        detail.update(served.get("detail") or {})
+        detail["serving_path"] = "grpc_streaming"
+
+    detail["stage"] = "bw_probe"
     # probe BEFORE the model + KV cache occupy HBM: the 1 GiB probe at peak
     # residency could OOM and lose the whole run's results
     streaming_ref_bw = _measure_achievable_bw() if on_tpu else None
 
+    detail["stage"] = "raw_loop"
     params = llama.params_from_config(cfg)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
     gen = Generator(params, cfg, batch_slots=slots, max_seq=max_seq,
@@ -174,7 +304,7 @@ def main() -> None:
     # matmul FLOPs dominate: 2 * params * tokens-per-step (+ attention term)
     attn_flops = 4 * cfg.n_layers * slots * avg_len * cfg.n_heads * cfg.head_dim
     flops = 2 * n_params * slots + attn_flops
-    peak_flops, peak_bw = _chip_spec()
+    peak_flops, peak_bw = _chip_spec(jax.devices()[0].device_kind)
     mfu = flops / step_s / peak_flops
 
     raw_loop = {
@@ -200,25 +330,32 @@ def main() -> None:
 
     if served is not None:
         value = served["value"]
-        detail = dict(served.get("detail") or {})
-        detail["serving_path"] = "grpc_streaming"
         metric = "served_tok_per_s_per_chip_1b_proxy"
     else:  # serving subprocess failed: raw loop keeps the line alive
         value = round(tok_per_s, 1)
-        detail = {"serving_path": "failed"}
+        detail["serving_path"] = "failed"
         metric = "decode_tok_per_s_per_chip_1b_proxy"
     detail["raw_loop"] = raw_loop
     detail["backend"] = jax.default_backend()
     detail["device"] = jax.devices()[0].device_kind
+    detail.pop("stage", None)
 
-    print(json.dumps({
+    _emit_final({
         "metric": metric,
         "value": value,
         "unit": "tok/s",
         "vs_baseline": round(value / 2000.0, 3),
         "detail": detail,
-    }))
+    })
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as exc:  # noqa: BLE001 — the line must never go missing
+        with _EMIT_LOCK:
+            if not _DONE.is_set():
+                _DONE.set()
+                _PARTIAL["detail"]["error"] = f"{type(exc).__name__}: {exc}"
+                _emit(_PARTIAL)
+        raise

@@ -91,6 +91,10 @@ async def main() -> None:
             "max_new_tokens": n_new,
         }
 
+    def n_toks(msg) -> int:
+        # server frames one decode-chunk burst per message ({"tokens": [...]})
+        return len(msg.get("tokens", ()))
+
     # warmup: compile prefill + decode (all admission shapes) before timing
     async for _ in generate(req(4)):
         pass
@@ -125,10 +129,11 @@ async def main() -> None:
         t0 = time.perf_counter()
         first = None
         count = 0
-        async for _ in generate(req(max_new)):
-            if first is None:
+        async for msg in generate(req(max_new)):
+            got = n_toks(msg)
+            if first is None and got:
                 first = time.perf_counter() - t0
-            count += 1
+            count += got
         herd_ttfts.append(first if first is not None else float("nan"))
         token_counts.append(count)
 

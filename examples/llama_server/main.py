@@ -72,10 +72,13 @@ def main() -> gofr_tpu.App:
     svc = JSONService("llm.Chat")
 
     async def grpc_generate(request, context):
+        # one frame per decode-chunk burst, not per token: 16x fewer gRPC
+        # messages at chunk=16 with identical token latency (tokens arrive
+        # from the device in bursts anyway)
         llm = app.container.ml.llm("chat")
-        async for tok in llm.stream(request["prompt_ids"],
-                                    int(request.get("max_new_tokens", 64))):
-            yield {"token": tok}
+        async for burst in llm.stream_chunks(
+                request["prompt_ids"], int(request.get("max_new_tokens", 64))):
+            yield {"tokens": burst}
 
     svc.stream("Generate", grpc_generate)
     app.register_service(svc, impl=None)

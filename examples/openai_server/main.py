@@ -116,11 +116,14 @@ async def chat_completions(ctx: gofr_tpu.Context):
                 [_choice_delta(0, role="assistant", content="")]))
             n_out = 0
             dec = _StreamDecoder()
-            async for tok in llm.stream(ids, max_new):
-                n_out += 1
+            # one SSE chunk per decode-chunk burst (a delta may carry
+            # several tokens' text — valid OpenAI protocol, far fewer frames)
+            async for burst in llm.stream_chunks(ids, max_new):
+                n_out += len(burst)
                 await stream.send(_chunk(
                     "chat.completion.chunk", rid, created,
-                    [_choice_delta(0, content=dec.push(tok))]))
+                    [_choice_delta(0, content="".join(
+                        dec.push(t) for t in burst))]))
             tail = dec.flush()
             if tail:
                 await stream.send(_chunk(
@@ -172,11 +175,12 @@ async def completions(ctx: gofr_tpu.Context):
         async with gofr_tpu.EventStream(ctx) as stream:
             n_out = 0
             dec = _StreamDecoder()
-            async for tok in llm.stream(ids, max_new):
-                n_out += 1
+            async for burst in llm.stream_chunks(ids, max_new):
+                n_out += len(burst)
                 await stream.send(_chunk(
                     "text_completion", rid, created,
-                    [{"index": 0, "text": dec.push(tok),
+                    [{"index": 0,
+                      "text": "".join(dec.push(t) for t in burst),
                       "finish_reason": None}]))
             finish = "length" if n_out >= max_new else "stop"
             await stream.send(_chunk(

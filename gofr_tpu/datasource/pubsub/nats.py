@@ -220,7 +220,7 @@ class NATS:
         await self._ensure_stream(topic)
         await self._js_api(
             f"CONSUMER.DURABLE.CREATE.{self._stream_name(topic)}.{self.durable}",
-            {"stream_name": topic,
+            {"stream_name": self._stream_name(topic),
              "config": {"durable_name": self.durable,
                         "ack_policy": "explicit",
                         "deliver_policy": "all"}},

@@ -84,6 +84,8 @@ class SpeculativeDecoder:
         self._verify = jax.jit(lambda p, t, c: self._verify_window(p, t, c, K))
         self._decode = jax.jit(
             lambda p, t, c: llama.decode_step(p, t, c, cfg))
+        self._prefill = jax.jit(
+            lambda p, t, l, c: llama.prefill(p, t, l, cfg, c))
 
     # -- the window program ----------------------------------------------------
     def _verify_window(self, params, toks, cache, K: int):
@@ -139,7 +141,6 @@ class SpeculativeDecoder:
 
     # -- host loop -------------------------------------------------------------
     def generate(self, prompt_ids, max_new_tokens: int) -> list[int]:
-        jax = self._jax
         llama = self._llama
         cfg = self.cfg
         np_prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
@@ -150,8 +151,7 @@ class SpeculativeDecoder:
         cache = llama.init_cache(cfg, 1, self.max_seq)
         toks = np.zeros((1, n), np.int32)
         toks[0] = np_prompt
-        logits, cache = jax.jit(
-            lambda p, t, l, c: llama.prefill(p, t, l, cfg, c))(
+        logits, cache = self._prefill(
             self.params, toks, np.array([n], np.int32), cache)
         first = int(np.asarray(logits)[0].argmax())
         history = list(map(int, np_prompt)) + [first]
@@ -187,6 +187,12 @@ class SpeculativeDecoder:
             out.extend(take)
             history.extend(take)
         return out
+
+    def reset_counters(self) -> None:
+        """Zero the accepted/proposed tallies (e.g. after a warm-up run so
+        ``acceptance_rate`` reflects only the measured window)."""
+        self.accepted = 0
+        self.proposed = 0
 
     @property
     def acceptance_rate(self) -> float:

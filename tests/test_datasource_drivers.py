@@ -511,6 +511,16 @@ class _MiniJetStream(_MiniNATS):
             await self._reply(subs, reply, _json.dumps(body).encode())
         elif subject.startswith("$JS.API.CONSUMER.DURABLE.CREATE."):
             _, stream, durable = subject.rsplit(".", 2)
+            cfg = _json.loads(payload or b"{}")
+            # real nats-server rejects a body stream_name that disagrees
+            # with the subject token (JSStreamMismatchErr) — enforce it so
+            # the fake catches subject/body drift the way a broker would
+            if cfg.get("stream_name", stream) != stream:
+                await self._reply(subs, reply, _json.dumps(
+                    {"error": {"err_code": 10074,
+                               "description": "expected stream does not "
+                                              "match"}}).encode())
+                return
             self.cursors.setdefault((stream, durable), 0)
             await self._reply(subs, reply, _json.dumps(
                 {"config": {"durable_name": durable}}).encode())

@@ -153,9 +153,9 @@ def test_generator_interleaved_requests(setup):
 
     gen = Generator(params, cfg, batch_slots=2, max_seq=32, prefill_buckets=(8,))
     streamed: dict[int, list[int]] = {}
-    sa = gen.add_request([3, 1, 4], 8, callback=lambda i, t: streamed.setdefault(i, []).append(t))
+    sa = gen.add_request([3, 1, 4], 8, callback=lambda i, toks: streamed.setdefault(i, []).extend(toks))
     gen.step(); gen.step()
-    sb = gen.add_request([2, 7], 4, callback=lambda i, t: streamed.setdefault(i, []).append(t))
+    sb = gen.add_request([2, 7], 4, callback=lambda i, toks: streamed.setdefault(i, []).extend(toks))
     while gen.n_live:
         gen.step()
     assert streamed[sa] == expect_a

@@ -46,6 +46,28 @@ def test_generate_and_stream_agree(model, run):
     assert streamed == expect
 
 
+def test_stream_chunks_bursts(model, run):
+    """stream_chunks yields one list per decode-chunk burst: the first is
+    the TTFT mini-chunk's [first_token], bursts are bounded by the chunk
+    size, and the concatenation equals the token-level stream."""
+    cfg, params = model
+    expect = _expected(params, cfg, [3, 1, 4], 7)
+
+    async def scenario():
+        server = LLMServer(Generator(params, cfg, batch_slots=2, max_seq=64,
+                                     prefill_buckets=(8,), chunk=3))
+        try:
+            return [b async for b in server.stream_chunks([3, 1, 4], 7)]
+        finally:
+            server.close()
+
+    bursts = run(scenario())
+    assert all(isinstance(b, list) and b for b in bursts)
+    assert len(bursts[0]) == 1                  # mini-chunk first token
+    assert max(len(b) for b in bursts) <= 3     # never beyond chunk
+    assert [t for b in bursts for t in b] == expect
+
+
 def test_concurrent_requests_beyond_slots(model, run):
     """6 concurrent requests over 2 slots: all finish, each correct."""
     cfg, params = model
