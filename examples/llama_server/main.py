@@ -64,6 +64,9 @@ def main() -> gofr_tpu.App:
         max_seq=min(cfg.max_seq_len, 1024),
         chunk=int(os.environ.get("LLM_CHUNK", "4")),
         sampler=Sampler(temperature=float(os.environ.get("LLM_TEMPERATURE", "0"))),
+        # LLM_SPEC_K>0: device-resident prompt-lookup speculation inside
+        # the continuous-batching chunk (greedy-only, lossless)
+        spec_k=int(os.environ.get("LLM_SPEC_K", "0")),
     )
 
     app.post("/generate", generate)

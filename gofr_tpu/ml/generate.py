@@ -110,7 +110,9 @@ class Generator:
     def __init__(self, params: Any, cfg, *, batch_slots: int = 8,
                  max_seq: int = 2048, sampler: Sampler | None = None,
                  eos_id: int | None = None, prefill_buckets=(128, 512, 2048),
-                 seed: int = 0, mesh=None, chunk: int = 1) -> None:
+                 seed: int = 0, mesh=None, chunk: int = 1,
+                 shard_cache: bool = False, spec_k: int = 0,
+                 spec_ngram: int = 3) -> None:
         import contextlib
 
         from ..models import llama
@@ -128,13 +130,34 @@ class Generator:
             b for b in sorted(prefill_buckets) if b <= max_seq
         ) or (max_seq,)
         self.mesh = mesh
-        self.cache = llama.init_cache(cfg, batch_slots, max_seq)
-        if mesh is not None and getattr(cfg, "sequence_parallel", False):
+        self._repl = None  # replicated sharding for host-visible outputs
+        if shard_cache:
+            # Multi-controller serving (ml/multihost.py): slots shard over
+            # dp, kv heads over tp (matching SHARDING_RULES so decode never
+            # reshards), and every array the host reads is explicitly
+            # replicated. The cache is created INSIDE jit with out_shardings
+            # — an eagerly-created array would be process-local and cannot
+            # feed a global SPMD program.
+            if mesh is None:
+                raise ValueError("shard_cache requires a mesh")
+            from ..parallel import NamedSharding
+            from ..parallel import P as _P
+
+            specs = self._serving_cache_specs()
+            self.cache = jax.jit(
+                lambda: llama.init_cache(cfg, batch_slots, max_seq),
+                out_shardings={
+                    key: NamedSharding(mesh, s) for key, s in specs.items()
+                },
+            )()
+            self._repl = NamedSharding(mesh, _P())
+        elif mesh is not None and getattr(cfg, "sequence_parallel", False):
             # long-context serving: KV cache sequence axis sharded over sp,
             # decode attention combines shards via pmax/psum (ring.py)
             from ..parallel import NamedSharding
             from ..parallel import P as _P
 
+            self.cache = llama.init_cache(cfg, batch_slots, max_seq)
             if getattr(cfg, "kv_quant", False):
                 # int8 layout (models/llama.init_cache): flat values
                 # [L, B, S, KV*D], seq-MINOR scales [L, B, KV, S]
@@ -151,20 +174,26 @@ class Generator:
                 key: jax.device_put(arr, NamedSharding(mesh, specs[key]))
                 for key, arr in self.cache.items()
             }
+        else:
+            self.cache = llama.init_cache(cfg, batch_slots, max_seq)
         self.slots = [_Slot() for _ in range(batch_slots)]
         # two independent streams: decode keys fold the step counter,
         # prefill keys fold a request counter — no collisions between the
-        # two or between back-to-back add_request calls.
+        # two or between back-to-back add_request calls. Keys live as HOST
+        # numpy values: under multi-controller an eagerly-created device key
+        # would be process-local; a host value is replicated by contract
+        # (every rank derives the identical key from the shared seed).
         root = jax.random.PRNGKey(seed)
-        self._base_key = jax.random.fold_in(root, 0)
-        self._prefill_key = jax.random.fold_in(root, 1)
+        self._base_key = np.asarray(jax.random.fold_in(root, 0))
+        self._prefill_key = np.asarray(jax.random.fold_in(root, 1))
         self._n_requests = 0
-        self._tok_dev = jnp.zeros((batch_slots,), jnp.int32)  # device-resident
+        self._tok_dev = self._repl_zeros((batch_slots,))  # device-resident
         self._inflight: collections.deque = collections.deque()  # [chunk, B] arrays
         self._pending_first: collections.deque = collections.deque()  # (slot, dev scalar)
         self.steps = 0
 
         sampler_cfg = self.sampler
+        host_visible = self._host_visible
 
         def make_chunk_fn(n_chunk: int):
             def chunk_fn(params, tok, cache, step0, base_key):
@@ -188,7 +217,8 @@ class Generator:
                 (tok, cache), toks = jax.lax.scan(
                     body, (tok, cache), jnp.arange(n_chunk)
                 )
-                return jnp.concatenate([tok_in[None], toks], axis=0), tok, cache
+                block = jnp.concatenate([tok_in[None], toks], axis=0)
+                return host_visible(block), host_visible(tok), cache
 
             # donate the cache: in-place KV update on device, no copy per step
             return jax.jit(chunk_fn, donate_argnums=(2,))
@@ -210,7 +240,7 @@ class Generator:
             prefill cost was <1 ms (r1 BENCH prefill mystery)."""
             key = jax.random.fold_in(prefill_key, n_req)
             first = _sample_impl(logits, key, sampler_cfg)[0]
-            return tok_dev.at[slot].set(first)
+            return host_visible(tok_dev.at[slot].set(first))
 
         self._post_prefill = jax.jit(post_prefill, donate_argnums=(0,))
         self._prefill_into = jax.jit(
@@ -231,7 +261,7 @@ class Generator:
                 cur = tok_dev[slots[i]]
                 tok_dev = tok_dev.at[slots[i]].set(
                     jnp.where(valid[i], firsts[i], cur))
-            return tok_dev
+            return host_visible(tok_dev)
 
         self._post_prefill_many = jax.jit(post_prefill_many,
                                           donate_argnums=(0,))
@@ -245,6 +275,208 @@ class Generator:
         # rows — a little extra MXU work instead of a fresh compile.
         self._admit_cap = min(8, batch_slots)
 
+        # -- speculative decoding (device-resident prompt lookup) ----------
+        self.spec_k = int(spec_k)
+        self.spec_ngram = int(spec_ngram)
+        self._tokens_dev = None
+        # draft efficiency: emitted / windows - 1 == avg accepted per window
+        self.spec_windows = 0
+        self.spec_emitted = 0
+        if self.spec_k > 0:
+            self._init_spec()
+
+    def _init_spec(self) -> None:
+        """Speculative decoding INSIDE the continuous-batching loop:
+        prompt-lookup drafting, the K+1-token verify window
+        (llama.decode_window), acceptance, and per-slot history all live in
+        the jitted chunk program. ml/speculate.py's single-stream loop pays
+        a host round-trip per window (drafts from host history, acceptance
+        on host) — ~100 ms each through the remote tunnel, which would
+        erase the speedup; device-resident speculation preserves the
+        one-dispatch-deep async pipeline, so it composes with continuous
+        batching for free. Greedy verify is LOSSLESS: every emitted token
+        is the verifier's own argmax chain — a bad draft costs speed,
+        never correctness. One "window" replaces one decode step and emits
+        1..K+1 tokens for the same weight sweep out of HBM."""
+        llama = self._m
+        cfg = self.cfg
+        mesh = self.mesh
+        if self.sampler.temperature > 0:
+            raise ValueError("speculative decode is greedy-only")
+        if getattr(cfg, "kv_quant", False):
+            raise ValueError("speculative decode needs the fp KV cache")
+        K = self.spec_k
+        hist_cap = self.max_seq + K + 2
+        self._hist_cap = hist_cap
+        B = self.batch_slots
+        self._tokens_dev = self._repl_zeros((B, hist_cap))
+        host_visible = self._host_visible
+
+        ngrams = tuple(range(min(self.spec_ngram, 3), 0, -1))
+
+        def draft_row(td_row, h):
+            """Longest-trailing-n-gram lookup over one row's history
+            (td_row [hist_cap], h = history length): find the most recent
+            earlier occurrence of the trailing n-gram and copy the K tokens
+            that followed it. All masked integer compares — O(hist_cap)
+            VPU work, invisible next to the layer matmuls."""
+            idx = jnp.arange(hist_cap)
+            candidates = []
+            for n in ngrams:
+                pat = jax.lax.dynamic_slice(
+                    td_row, (jnp.maximum(h - n, 0),), (n,))
+                # follow token must exist INSIDE history; this also
+                # excludes the trailing pattern matching itself
+                m = (idx + n) <= (h - 1)
+                for i in range(n):
+                    m &= jnp.take(td_row, idx + i, mode="clip") == pat[i]
+                candidates.append((jnp.max(jnp.where(m, idx, -1)), n))
+            start = jnp.int32(-1)
+            npick = jnp.int32(0)
+            for j, n in candidates:  # longest n with a match wins
+                take = (start < 0) & (j >= 0)
+                start = jnp.where(take, j, start)
+                npick = jnp.where(take, jnp.int32(n), npick)
+            # no match: draft a repeat of the last token (cheap, usually
+            # rejected — the window still emits its one verified token)
+            src = jnp.where(start >= 0, start + npick, h - 1)
+            return jax.lax.dynamic_slice(td_row, (src,), (K,))
+
+        def make_spec_chunk_fn(n_windows: int):
+            def spec_chunk_fn(params, tok, cache, tokens_dev):
+                """``n_windows`` draft→verify→accept rounds. Returns
+                (input token row [B] — the firsts ride-along, as in the
+                plain chunk — emitted candidates [W, B, K+1], emit counts
+                [W, B], final carry tok, cache, tokens_dev)."""
+                tok_in = tok
+                ar = jnp.arange(K + 1)[None, :]
+                rows = jnp.arange(B)
+
+                def body(carry, _):
+                    tok, cache, td = carry
+                    h = cache["len"] + 1  # [B] history length
+                    draft = jax.vmap(draft_row)(td, h)           # [B, K]
+                    window = jnp.concatenate([tok[:, None], draft], axis=1)
+                    logits, cache = llama.decode_window(
+                        params, window, cache, cfg, mesh=mesh)
+                    greedy_t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    match = (draft == greedy_t[:, :K]).astype(jnp.int32)
+                    n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                    g_last = jnp.take_along_axis(greedy_t, n_acc[:, None], 1)
+                    draft_pad = jnp.concatenate(
+                        [draft, jnp.zeros((B, 1), jnp.int32)], axis=1)
+                    # emitted: accepted draft prefix + the verifier's own
+                    # next token at position n_acc
+                    emit = jnp.where(
+                        ar < n_acc[:, None], draft_pad,
+                        jnp.where(ar == n_acc[:, None], g_last, 0))
+                    n_emit = n_acc + 1
+                    S_max = cache["k"].shape[2]
+                    cache = {**cache,
+                             "len": jnp.minimum(cache["len"] + n_emit, S_max)}
+                    # append emitted tokens to history; rejected positions
+                    # route to hist_cap and drop
+                    widx = jnp.where(ar < n_emit[:, None],
+                                     h[:, None] + ar, hist_cap)
+                    td = td.at[rows[:, None], widx].set(emit, mode="drop")
+                    return (g_last[:, 0], cache, td), (emit, n_emit)
+
+                (tok, cache, tokens_dev), (emits, counts) = jax.lax.scan(
+                    body, (tok, cache, tokens_dev), None, length=n_windows)
+                return (host_visible(tok_in), host_visible(emits),
+                        host_visible(counts), host_visible(tok), cache,
+                        tokens_dev)
+
+            return jax.jit(spec_chunk_fn, donate_argnums=(2, 3))
+
+        self._chunk_fn = make_spec_chunk_fn(self.chunk)
+        self._mini_chunk_fn = self._chunk_fn if self.chunk == 1 \
+            else make_spec_chunk_fn(1)
+
+        def spec_post_prefill(tok_dev, tokens_dev, logits, prompt, lens,
+                              slot):
+            """Greedy first token + write prompt and first token into the
+            slot's history row (device drafting needs the full history)."""
+            length = lens[0]
+            first = jnp.argmax(logits[0]).astype(jnp.int32)
+            tok_dev = host_visible(tok_dev.at[slot].set(first))
+            bucket = prompt.shape[1]
+            arb = jnp.arange(bucket)
+            cur = jax.lax.dynamic_slice(tokens_dev, (slot, jnp.int32(0)),
+                                        (1, bucket))
+            row = jnp.where(arb[None, :] < length, prompt, cur)
+            tokens_dev = jax.lax.dynamic_update_slice(
+                tokens_dev, row, (slot, jnp.int32(0)))
+            tokens_dev = tokens_dev.at[slot, length].set(first)
+            return tok_dev, host_visible(tokens_dev)
+
+        self._spec_post_prefill = jax.jit(spec_post_prefill,
+                                          donate_argnums=(0, 1))
+
+        def spec_post_prefill_many(tok_dev, tokens_dev, logits, prompts,
+                                   lens, slots, valid):
+            firsts = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            bucket = prompts.shape[1]
+            arb = jnp.arange(bucket)
+            for i in range(slots.shape[0]):
+                tok_dev = tok_dev.at[slots[i]].set(
+                    jnp.where(valid[i], firsts[i], tok_dev[slots[i]]))
+                cur = jax.lax.dynamic_slice(
+                    tokens_dev, (slots[i], jnp.int32(0)), (1, bucket))[0]
+                row = jnp.where(valid[i] & (arb < lens[i]), prompts[i], cur)
+                tokens_dev = jax.lax.dynamic_update_slice(
+                    tokens_dev, row[None], (slots[i], jnp.int32(0)))
+                tokens_dev = tokens_dev.at[slots[i], lens[i]].set(
+                    jnp.where(valid[i], firsts[i],
+                              tokens_dev[slots[i], lens[i]]))
+            return host_visible(tok_dev), host_visible(tokens_dev)
+
+        self._spec_post_prefill_many = jax.jit(spec_post_prefill_many,
+                                               donate_argnums=(0, 1))
+
+    def _host_visible(self, x):
+        """Force replicated layout on arrays the host will read — in
+        multi-controller mode every process must hold the full value.
+        (Constant at trace time; safe inside the jitted programs.)"""
+        return (x if self._repl is None
+                else jax.lax.with_sharding_constraint(x, self._repl))
+
+    def _repl_zeros(self, shape):
+        """int32 zeros the host and every process can see: created INSIDE
+        jit with replicated out_shardings under multi-controller (an eager
+        array would be process-local), plain eager zeros otherwise."""
+        if self._repl is not None:
+            return jax.jit(lambda: jnp.zeros(shape, jnp.int32),
+                           out_shardings=self._repl)()
+        return jnp.zeros(shape, jnp.int32)
+
+    def _serving_cache_specs(self) -> dict:
+        """Cache partition specs for sharded multi-controller serving:
+        slots over dp (distinct requests per dp group — aggregate
+        throughput scales with dp), kv heads over tp (matching the
+        attention weights' Megatron split). An axis is only used when the
+        mesh has it and the dimension divides evenly; ``len`` stays
+        replicated (tiny, host-adjacent)."""
+        from ..parallel import P as _P
+
+        cfg, mesh = self.cfg, self.mesh
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        dp = "dp" if (sizes.get("dp", 1) > 1
+                      and self.batch_slots % sizes["dp"] == 0) else None
+        tp = "tp" if (sizes.get("tp", 1) > 1
+                      and cfg.n_kv_heads % sizes["tp"] == 0) else None
+        if getattr(cfg, "kv_quant", False):
+            # int8 layout: flat values [L, B, S, KV*D] (tp splits the flat
+            # axis head-contiguously), scales [L, B, KV, S]
+            return {"k": _P(None, dp, None, tp),
+                    "v": _P(None, dp, None, tp),
+                    "k_scale": _P(None, dp, tp, None),
+                    "v_scale": _P(None, dp, tp, None),
+                    "len": _P()}
+        return {"k": _P(None, dp, None, tp, None),
+                "v": _P(None, dp, None, tp, None),
+                "len": _P()}
+
     def warmup(self) -> None:
         """Compile the decode programs (full chunk + TTFT mini-chunk) and
         the prefill buckets before the first request — a lazy first-use
@@ -257,33 +489,52 @@ class Generator:
             fns.append(self._mini_chunk_fn)
         with self._mesh_ctx():
             for fn in fns:
-                _toks, self._tok_dev, self.cache = fn(
-                    self.params, self._tok_dev, self.cache,
-                    jnp.int32(0), self._base_key,
-                )
+                if self.spec_k:
+                    (_row0, _e, _c, self._tok_dev, self.cache,
+                     self._tokens_dev) = fn(self.params, self._tok_dev,
+                                            self.cache, self._tokens_dev)
+                else:
+                    _toks, self._tok_dev, self.cache = fn(
+                        self.params, self._tok_dev, self.cache,
+                        np.int32(0), self._base_key,
+                    )
             for bucket in self.prefill_buckets:
-                padded = jnp.zeros((1, bucket), jnp.int32)
+                padded = np.zeros((1, bucket), np.int32)
+                ones = np.array([1], np.int32)
                 logits, self.cache = self._prefill_into(
-                    self.params, padded, jnp.asarray([1], np.int32),
-                    self.cache, jnp.int32(0),
+                    self.params, padded, ones, self.cache, np.int32(0),
                 )
-                self._tok_dev = self._post_prefill(
-                    self._tok_dev, logits, self._prefill_key,
-                    jnp.uint32(0), jnp.int32(0),
-                )
+                if self.spec_k:
+                    self._tok_dev, self._tokens_dev = self._spec_post_prefill(
+                        self._tok_dev, self._tokens_dev, logits, padded,
+                        ones, np.int32(0),
+                    )
+                else:
+                    self._tok_dev = self._post_prefill(
+                        self._tok_dev, logits, self._prefill_key,
+                        np.uint32(0), np.int32(0),
+                    )
                 if self._admit_cap > 1:  # the wave-admission shapes too
                     b = self._admit_cap
+                    toks_b = np.zeros((b, bucket), np.int32)
+                    lens_b = np.ones((b,), np.int32)
+                    slots_b = np.zeros((b,), np.int32)
+                    dead = np.zeros((b,), bool)  # all rows masked: no writes
                     logits, self.cache = self._prefill_many(
-                        self.params, jnp.zeros((b, bucket), jnp.int32),
-                        jnp.ones((b,), jnp.int32), self.cache,
-                        jnp.zeros((b,), jnp.int32),
-                        jnp.zeros((b,), bool),  # all rows masked: no writes
+                        self.params, toks_b, lens_b, self.cache, slots_b,
+                        dead,
                     )
-                    self._tok_dev = self._post_prefill_many(
-                        self._tok_dev, logits, self._prefill_key,
-                        jnp.uint32(0), jnp.zeros((b,), jnp.int32),
-                        jnp.zeros((b,), bool),
-                    )
+                    if self.spec_k:
+                        (self._tok_dev,
+                         self._tokens_dev) = self._spec_post_prefill_many(
+                            self._tok_dev, self._tokens_dev, logits, toks_b,
+                            lens_b, slots_b, dead,
+                        )
+                    else:
+                        self._tok_dev = self._post_prefill_many(
+                            self._tok_dev, logits, self._prefill_key,
+                            np.uint32(0), slots_b, dead,
+                        )
         # a REAL device->host fetch, not block_until_ready: through remote
         # transports the latter returns before queued work has drained, and
         # the first live request's token fetch would then absorb the entire
@@ -380,25 +631,35 @@ class Generator:
                 with self._mesh_ctx():
                     if b == 1:
                         logits, self.cache = self._prefill_into(
-                            self.params, jnp.asarray(tokens),
-                            jnp.asarray(lens), self.cache,
-                            jnp.int32(slots[0]),
+                            self.params, tokens, lens, self.cache,
+                            np.int32(slots[0]),
                         )
-                        self._tok_dev = self._post_prefill(
-                            self._tok_dev, logits, self._prefill_key,
-                            jnp.uint32(self._n_requests), jnp.int32(slots[0]),
-                        )
+                        if self.spec_k:
+                            (self._tok_dev, self._tokens_dev) = \
+                                self._spec_post_prefill(
+                                    self._tok_dev, self._tokens_dev, logits,
+                                    tokens, lens, np.int32(slots[0]))
+                        else:
+                            self._tok_dev = self._post_prefill(
+                                self._tok_dev, logits, self._prefill_key,
+                                np.uint32(self._n_requests),
+                                np.int32(slots[0]),
+                            )
                     else:
                         logits, self.cache = self._prefill_many(
-                            self.params, jnp.asarray(tokens), jnp.asarray(lens),
-                            self.cache, jnp.asarray(slot_arr),
-                            jnp.asarray(valid),
+                            self.params, tokens, lens, self.cache, slot_arr,
+                            valid,
                         )
-                        self._tok_dev = self._post_prefill_many(
-                            self._tok_dev, logits, self._prefill_key,
-                            jnp.uint32(self._n_requests), jnp.asarray(slot_arr),
-                            jnp.asarray(valid),
-                        )
+                        if self.spec_k:
+                            (self._tok_dev, self._tokens_dev) = \
+                                self._spec_post_prefill_many(
+                                    self._tok_dev, self._tokens_dev, logits,
+                                    tokens, lens, slot_arr, valid)
+                        else:
+                            self._tok_dev = self._post_prefill_many(
+                                self._tok_dev, logits, self._prefill_key,
+                                np.uint32(self._n_requests), slot_arr, valid,
+                            )
             except Exception:
                 for j in slots:  # unwind this wave's reservations
                     self.slots[j].live = False
@@ -466,20 +727,28 @@ class Generator:
         mini = bool(self._pending_first)
         fn = self._mini_chunk_fn if mini else self._chunk_fn
         with self._mesh_ctx():
-            toks, self._tok_dev, self.cache = fn(
-                self.params, self._tok_dev, self.cache,
-                jnp.int32(self.steps), self._base_key,
-            )
+            if self.spec_k:
+                (row0, emits, counts, self._tok_dev, self.cache,
+                 self._tokens_dev) = fn(self.params, self._tok_dev,
+                                        self.cache, self._tokens_dev)
+                item: Any = (row0, emits, counts)
+            else:
+                toks, self._tok_dev, self.cache = fn(
+                    self.params, self._tok_dev, self.cache,
+                    np.int32(self.steps), self._base_key,
+                )
+                item = toks
         self.steps += 1 if mini else self.chunk
         try:
             # best-effort prefetch; on transports where this is itself a
             # blocking transfer (the axon tunnel) the cost is the same as
             # the np.asarray in _process, so it stays — the pipeline depth
             # below is what keeps the device busy while the host reads.
-            toks.copy_to_host_async()
+            for arr in (item if isinstance(item, tuple) else (item,)):
+                arr.copy_to_host_async()
         except Exception:
             pass
-        self._inflight.append(toks)
+        self._inflight.append(item)
         if mini:
             # TTFT: the chunk carrying new requests' first tokens is read
             # back NOW instead of lagging one dispatch — one blocking
@@ -488,12 +757,49 @@ class Generator:
             self.drain()
         else:
             while len(self._inflight) > 1:
-                self._process(np.asarray(self._inflight.popleft()))
+                self._pop_process()
 
     def drain(self) -> None:
         """Flush pending token chunks into host bookkeeping."""
         while self._inflight:
-            self._process(np.asarray(self._inflight.popleft()))
+            self._pop_process()
+
+    def _pop_process(self) -> None:
+        item = self._inflight.popleft()
+        if self.spec_k:
+            row0, emits, counts = (np.asarray(x) for x in item)
+            self._process_spec(row0, emits, counts)
+        else:
+            self._process(np.asarray(item))
+
+    def _process_spec(self, row0: np.ndarray, emits: np.ndarray,
+                      counts: np.ndarray) -> None:
+        """Apply one speculative chunk — input row [B] (resolves pending
+        firsts), emitted candidates [W, B, K+1], counts [W, B] — to slot
+        state. Each window contributes 1..K+1 tokens per live slot."""
+        self._resolve_first(row0)
+        for w in range(emits.shape[0]):
+            bursts: dict[int, list[int]] = {}
+            for i, s in enumerate(self.slots):
+                if not s.live:
+                    continue
+                self.spec_windows += 1
+                for t in range(int(counts[w, i])):
+                    tok = int(emits[w, i, t])
+                    s.tokens.append(tok)
+                    s.produced += 1
+                    self.spec_emitted += 1
+                    if self.eos_id is not None and tok == self.eos_id:
+                        s.eos_hit = True
+                    if s.callback is not None:
+                        bursts.setdefault(i, []).append(tok)
+                    self._maybe_finish(i)
+                    if not s.live:
+                        break
+            for i, burst in bursts.items():
+                cb = self.slots[i].callback
+                if cb is not None:
+                    cb(i, burst)
 
     def _process(self, toks: np.ndarray) -> None:
         """Apply one [1 input + chunk sampled, B] token block to slot

@@ -1,42 +1,60 @@
 """Multi-host serving topology: a front-end process owning the HTTP/gRPC
-ports, backed by an N-process ``jax.distributed`` mesh running the model.
+ports, backed by an N-process ``jax.distributed`` mesh running a REAL
+continuous-batching Generator in lock-step.
 
 This is SURVEY §7's hardest-part #3 (who owns the serving port vs who runs
 the mesh — the reference has no analogue; its "distributed" story is
-microservice RPC, pkg/gofr/service/). The topology here:
+microservice RPC, pkg/gofr/service/). The topology:
 
 - **Model workers** (one OS process per host) form the ``jax.distributed``
-  mesh; every rank runs the same lock-step SPMD decode program over a
-  ``(dp=hosts, tp=local-chips)`` mesh, so tensor-parallel shards ride ICI
-  and the dp axis crosses hosts over DCN.
-- **Rank 0** additionally listens on a TCP "model port" with
-  length-prefixed JSON frames. It is the only rank the front-end talks to.
-- Each request is **broadcast** from rank 0 to all ranks
-  (``multihost_utils.broadcast_one_to_all`` — the same collective fabric
-  the compute uses), then every rank executes the identical jitted
-  prefill + decode steps; greedy sampling is deterministic, so all ranks
-  stay in lock-step without further coordination. Rank 0 streams each
-  token frame back to the front-end as it is produced.
+  mesh over a ``(dp=hosts, tp=local-chips)`` grid. Every rank holds the
+  SAME ``Generator`` (ml/generate.py) built with ``shard_cache=True``:
+  KV-cache slots shard over dp — **distinct requests occupy distinct
+  slots**, so aggregate decode throughput scales with the dp axis — and
+  kv heads shard over tp to match the Megatron weight split.
+- **Lock-step command replication.** The Generator's host bookkeeping is a
+  deterministic function of the command sequence (admit/step/cancel) plus
+  the sampled token blocks, and the token blocks are forced replicated by
+  the SPMD program — so rank 0 decides, broadcasts each command
+  (``multihost_utils.broadcast_one_to_all``, the same collective fabric
+  the compute uses), and every rank replays it on its own Generator
+  replica. No rank ever waits on another's host state; idle periods are
+  bridged by NOOP heartbeats so followers never sit in a collective past
+  its timeout.
+- **Rank 0** additionally serves a TCP "model port" with length-prefixed
+  JSON frames, MULTIPLEXED: each request carries a client-chosen ``id``,
+  many generations stream concurrently (one per Generator slot), and
+  bursts ride ``{"id": n, "tokens": [...]}`` frames.
 - The **front-end** is an ordinary gofr app (HTTP/SSE/gRPC) holding a
   ``MultiHostLLMClient``; it never touches jax, so serving latency is
   isolated from mesh work and the front-end can run on a CPU-only box.
 
-Shutdown: a ``stop`` frame makes rank 0 broadcast op=0; every rank exits
-its loop. A front-end disconnect only returns rank 0 to accept().
+Failure semantics (r3 advisor): a failed device op on rank 0 broadcasts
+STOP and tears the whole mesh down rather than leaving followers parked in
+a collective that can never pair — fail fast beats a silent desync.
+
+Shutdown: a ``stop`` frame makes rank 0 broadcast STOP; every rank exits
+its loop. A front-end disconnect only cancels that connection's requests.
 """
 
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
+import queue as _queue
 import socket
 import struct
+import threading
 from typing import Any, AsyncIterator, Iterable
 
 __all__ = ["MultiHostWorker", "MultiHostLLMClient", "send_frame", "recv_frame"]
 
 _OP_STOP = 0
-_OP_GENERATE = 1
+_OP_ADMIT = 1
+_OP_STEP = 2
+_OP_CANCEL = 3
+_OP_NOOP = 4  # heartbeat: keeps followers' broadcast wait from timing out
 
 
 # -- framed JSON over a socket (sync side: worker rank 0) ---------------------
@@ -68,19 +86,60 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
     return buf
 
 
+class _Conn:
+    """One front-end connection on rank 0: reader thread + writer lock."""
+
+    __slots__ = ("sock", "lock", "alive")
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.lock = threading.Lock()
+        self.alive = True
+        # a send() stalled on a slow client's TCP backpressure would stall
+        # the lock-step drive loop past the followers' collective timeout —
+        # bound it; a timeout marks the connection dead (requests cancel)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                            struct.pack("ll", 10, 0))
+        except OSError:
+            pass
+
+    def send(self, obj: Any) -> None:
+        """Best-effort frame write; a dead socket flips ``alive`` and the
+        drive loop cancels this connection's requests on the next pass."""
+        if not self.alive:
+            return
+        try:
+            with self.lock:
+                send_frame(self.sock, obj)
+        except OSError:
+            self.alive = False
+
+
 class MultiHostWorker:
     """One rank of the serving mesh. ``run()`` blocks for the process
     lifetime; rank 0 also serves the model port."""
 
     def __init__(self, process_id: int, num_processes: int,
                  coordinator: str, *, port: int = 0, cfg=None, seed: int = 0,
-                 prompt_bucket: int = 32, logger=None) -> None:
+                 batch_slots: int | None = None, max_seq: int | None = None,
+                 prefill_buckets: tuple = (), prompt_bucket: int | None = None,
+                 chunk: int = 4, sampler=None, eos_id: int | None = None,
+                 heartbeat_s: float = 5.0, logger=None) -> None:
         self.process_id = process_id
         self.num_processes = num_processes
         self.coordinator = coordinator
         self.port = port
         self.seed = seed
-        self.prompt_bucket = prompt_bucket
+        self.chunk = chunk
+        self.sampler = sampler
+        self.eos_id = eos_id
+        self.heartbeat_s = heartbeat_s
+        self.batch_slots = batch_slots
+        self.max_seq = max_seq
+        # prompt_bucket kept as the single-bucket shorthand
+        self.prefill_buckets = tuple(prefill_buckets) or (
+            (prompt_bucket,) if prompt_bucket else (32, 128))
         self._cfg = cfg
         self._logger = logger
 
@@ -94,104 +153,87 @@ class MultiHostWorker:
             num_processes=self.num_processes,
             process_id=self.process_id,
         )
-        import jax.numpy as jnp
-        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import Mesh
 
         from .. import parallel as par
         from ..models import llama
-        from ..parallel import P
+        from .generate import Generator
 
         cfg = self._cfg or llama.config_from_env()
-        # config_from_env honors LLAMA_W8; params_from_config applies it
+        # config_from_env honors LLAMA_W8; params_from_config applies it.
         # dp spans processes (DCN), tp spans each host's local chips (ICI)
         local = jax.local_device_count()
         devices = np.array(jax.devices()).reshape(self.num_processes, local)
         mesh = Mesh(devices, ("dp", "tp"))
         self.mesh = mesh
         self.cfg = cfg
-        self.batch = self.num_processes  # one row per dp shard
-
-        params = llama.params_from_config(cfg, seed=self.seed)
-        specs = par.specs_from_rules(params, llama.SHARDING_RULES)
-        self.params = par.shard_params(params, specs, mesh)
-
-        self._data_spec = NamedSharding(mesh, P("dp", None))
-        self._row_spec = NamedSharding(mesh, P("dp"))
-
-        def prefill_fn(p, toks, lens, cache):
-            logits, cache = llama.prefill(p, toks, lens, cfg, cache)
-            # argmax stays inside jit: eager ops on non-fully-addressable
-            # global arrays are rejected in multi-controller mode
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
-
-        def decode_fn(p, tok, cache):
-            logits, cache = llama.decode_step(p, tok, cache, cfg)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
-
-        self._prefill = jax.jit(prefill_fn)
-        self._decode = jax.jit(decode_fn)
-        self._init_cache = lambda: llama.init_cache(cfg, self.batch)
-        self._jnp = jnp
         self._np = np
         self._jax = jax
 
-    # -- request broadcast -----------------------------------------------------
-    def _broadcast(self, cmd) -> "Any":
+        if self.batch_slots is None:
+            self.batch_slots = 2 * self.num_processes
+        self.max_seq = self.max_seq or min(cfg.max_seq_len, 1024)
+        self.bucket_cap = min(max(self.prefill_buckets), self.max_seq - 1)
+
+        params = llama.params_from_config(cfg, seed=self.seed)
+        specs = par.specs_from_rules(params, llama.SHARDING_RULES)
+        params = par.shard_params(params, specs, mesh)
+
+        self.gen = Generator(
+            params, cfg, batch_slots=self.batch_slots, max_seq=self.max_seq,
+            sampler=self.sampler, eos_id=self.eos_id,
+            prefill_buckets=self.prefill_buckets, seed=self.seed, mesh=mesh,
+            chunk=self.chunk, shard_cache=True)
+        # compile every program up front ON EVERY RANK — a lazy first-use
+        # compile inside the command loop would stall that rank alone
+        self.gen.warmup()
+        # fixed command-frame shape: broadcast_one_to_all requires source
+        # and followers to agree on it before the payload moves
+        self._cmd_len = 2 + self.batch_slots * (2 + self.bucket_cap)
+
+    # -- command plane ---------------------------------------------------------
+    def _broadcast(self, cmd):
         from jax.experimental import multihost_utils
 
         return multihost_utils.broadcast_one_to_all(
             cmd, is_source=self.process_id == 0)
 
-    def _cmd_array(self, op: int, tokens: Iterable[int] = (),
-                   max_new: int = 0):
+    def _zero_cmd(self):
+        return self._np.zeros((self._cmd_len,), self._np.int32)
+
+    def _encode_admit(self, wave) -> "Any":
+        """wave: [(ids, max_new)] -> command frame."""
         np = self._np
-        tokens = list(tokens)[: self.prompt_bucket]
-        arr = np.zeros(3 + self.prompt_bucket, np.int32)
-        arr[0], arr[1], arr[2] = op, len(tokens), max_new
-        arr[3:3 + len(tokens)] = tokens
-        return arr
+        cmd = self._zero_cmd()
+        cmd[0], cmd[1] = _OP_ADMIT, len(wave)
+        stride = 2 + self.bucket_cap
+        for row, (ids, max_new) in enumerate(wave):
+            base = 2 + row * stride
+            cmd[base] = max_new
+            cmd[base + 1] = len(ids)
+            cmd[base + 2:base + 2 + len(ids)] = np.asarray(ids, np.int32)
+        return cmd
 
-    # -- the lock-step generate program ---------------------------------------
-    def _local0(self, arr) -> int:
-        """First element of this process's addressable shard — rank 0's
-        shard of a dp-sharded [B] array is global row 0."""
-        shard = arr.addressable_shards[0]
-        return int(self._np.asarray(shard.data).ravel()[0])
+    def _decode_admit(self, cmd) -> list:
+        stride = 2 + self.bucket_cap
+        wave = []
+        for row in range(int(cmd[1])):
+            base = 2 + row * stride
+            max_new = int(cmd[base])
+            n = int(cmd[base + 1])
+            wave.append(([int(t) for t in cmd[base + 2:base + 2 + n]],
+                         max_new))
+        return wave
 
-    def _generate(self, tokens: list[int], max_new: int, sink=None) -> None:
-        """All ranks run this with identical arguments; only rank 0 has a
-        ``sink`` socket to stream tokens into."""
-        np, jax = self._np, self._jax
-        n = len(tokens)
-        local_batch = self.batch // self.num_processes
-        local = np.zeros((local_batch, self.prompt_bucket), np.int32)
-        local[:, :n] = tokens  # every dp row serves the same request
-        toks = jax.make_array_from_process_local_data(
-            self._data_spec, local, (self.batch, self.prompt_bucket))
-        lens = jax.make_array_from_process_local_data(
-            self._row_spec, np.full((local_batch,), n, np.int32),
-            (self.batch,))
-        def emit(obj) -> None:
-            # LOCK-STEP INVARIANT: a dead front-end socket must never abort
-            # the decode loop early — ranks 1..N-1 are running all max_new
-            # steps, and rank 0 quitting mid-loop would pair mismatched
-            # collectives across hosts. Stop writing; keep computing.
-            nonlocal sink
-            if sink is None:
-                return
-            try:
-                send_frame(sink, obj)
-            except OSError:
-                sink = None
+    def _encode_cancel(self, slots) -> "Any":
+        cmd = self._zero_cmd()
+        cmd[0], cmd[1] = _OP_CANCEL, len(slots)
+        cmd[2:2 + len(slots)] = self._np.asarray(slots, self._np.int32)
+        return cmd
 
-        with self.mesh:
-            tok, cache = self._prefill(self.params, toks, lens,
-                                       self._init_cache())
-            for _ in range(max_new - 1):
-                emit({"token": self._local0(tok)})
-                tok, cache = self._decode(self.params, tok, cache)
-            emit({"token": self._local0(tok)})
-            emit({"done": True})
+    def _apply_cancel(self, slots: Iterable[int]) -> None:
+        for slot in slots:
+            self.gen.slots[int(slot)].live = False
 
     # -- main loops ------------------------------------------------------------
     def run(self) -> None:
@@ -202,63 +244,107 @@ class MultiHostWorker:
             self._run_follower()
 
     def _run_follower(self) -> None:
+        """Replay rank 0's command stream on the local Generator replica.
+        Identical commands + replicated token blocks keep every replica's
+        slot state bit-identical, so admission decisions stay valid."""
         while True:
-            cmd = self._np.asarray(self._broadcast(self._cmd_array(_OP_STOP)))
-            op, n, max_new = int(cmd[0]), int(cmd[1]), int(cmd[2])
+            cmd = self._np.asarray(self._broadcast(self._zero_cmd()))
+            op = int(cmd[0])
             if op == _OP_STOP:
                 return
-            self._generate([int(t) for t in cmd[3:3 + n]], max_new)
+            if op == _OP_NOOP:
+                continue
+            if op == _OP_ADMIT:
+                self.gen.add_requests(
+                    [(ids, max_new, None)
+                     for ids, max_new in self._decode_admit(cmd)])
+            elif op == _OP_STEP:
+                self.gen.step()
+            elif op == _OP_CANCEL:
+                self._apply_cancel(cmd[2:2 + int(cmd[1])])
 
     def _run_rank0(self) -> None:
         server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         server.bind(("0.0.0.0", self.port))
-        server.listen(4)
+        server.listen(8)
         self.port = server.getsockname()[1]
+        self._inbox: _queue.Queue = _queue.Queue()
+        self._conns: set[_Conn] = set()
+        accept = threading.Thread(target=self._accept_loop, args=(server,),
+                                  daemon=True, name="gofr-mh-accept")
+        accept.start()
         # the launcher scrapes this line to find the model port
         print(f"MODEL_PORT {self.port}", flush=True)
         try:
-            while True:
-                conn, _ = server.accept()
-                if not self._serve_conn(conn):
-                    return  # stop was requested
+            self._drive()
+        except Exception:
+            # fail FAST (r3 advisor): a failed device op may have left
+            # followers mid-collective; a STOP broadcast is the one command
+            # that can still pair with their next wait. Continuing to serve
+            # could hang the whole mesh on a mismatched collective instead.
+            try:
+                self._broadcast(self._zero_cmd())  # op 0 == STOP
+            except Exception:
+                pass
+            raise
         finally:
             server.close()
+            for conn in list(self._conns):  # EOF every client reader
+                try:
+                    conn.sock.close()
+                except OSError:
+                    pass
 
-    def _serve_conn(self, conn: socket.socket) -> bool:
-        """Serve one front-end connection; False means shut down."""
+    def _accept_loop(self, server: socket.socket) -> None:
+        while True:
+            try:
+                sock, _ = server.accept()
+            except OSError:
+                return  # server closed: drive loop exited
+            conn = _Conn(sock)
+            self._conns.add(conn)
+            threading.Thread(target=self._read_loop, args=(conn,),
+                             daemon=True, name="gofr-mh-conn").start()
+
+    def _read_loop(self, conn: _Conn) -> None:
+        """Per-connection reader: validate frames, queue work items for the
+        drive loop (the single thread that touches the device)."""
+        stopping = False
         try:
             while True:
-                req = recv_frame(conn)
+                req = recv_frame(conn.sock)
                 if req is None:
-                    return True  # front-end went away; accept the next one
+                    break
                 if not isinstance(req, dict):
-                    send_frame(conn, {"error": "frame must be an object"})
+                    conn.send({"error": "frame must be an object"})
                     continue
-                if req.get("op") == "stop":
-                    self._broadcast(self._cmd_array(_OP_STOP))
-                    send_frame(conn, {"stopped": True})
-                    return False
+                op = req.get("op")
+                if op == "stop":
+                    # the connection must stay alive so the drive loop's
+                    # {"stopped": true} confirmation can still be written
+                    stopping = True
+                    self._inbox.put(("stop", conn, None))
+                    return
+                if op == "cancel":
+                    self._inbox.put(("cancel", conn, req.get("id")))
+                    continue
+                rid = req.get("id")
                 try:
                     tokens = [int(t) for t in req.get("tokens", [])]
                     max_new = max(1, int(req.get("max_new", 16)))
                 except (TypeError, ValueError):
-                    send_frame(conn, {"error": "tokens/max_new must be ints"})
+                    conn.send({"id": rid, "error": "tokens/max_new must be ints"})
                     continue
-                if not tokens or len(tokens) > self.prompt_bucket:
-                    send_frame(conn, {
-                        "error": f"prompt must be 1..{self.prompt_bucket} tokens"})
+                if not tokens or len(tokens) > self.bucket_cap:
+                    conn.send({"id": rid, "error":
+                               f"prompt must be 1..{self.bucket_cap} tokens"})
                     continue
-                cmd = self._np.asarray(
-                    self._broadcast(self._cmd_array(_OP_GENERATE, tokens,
-                                                    max_new)))
-                self._generate([int(t) for t in cmd[3:3 + int(cmd[1])]],
-                               int(cmd[2]), sink=conn)
+                self._inbox.put(("gen", conn, (rid, tokens, max_new)))
         except Exception:
             # one bad connection (malformed frame, reset socket) must never
-            # take rank 0 down — the followers would block in broadcast
-            # forever with no stop frame ever sent. Loud, not silent: a
-            # _generate failure here means the mesh may be desynced.
+            # take rank 0 down — but loud, not silent: a protocol bug on
+            # the model port is undiagnosable without the traceback
             import traceback
 
             if self._logger is not None:
@@ -266,102 +352,260 @@ class MultiHostWorker:
                                     traceback.format_exc())
             else:
                 traceback.print_exc()
-            return True
         finally:
-            conn.close()
+            if not stopping:
+                conn.alive = False
+                self._conns.discard(conn)
+                self._inbox.put(("bye", conn, None))
+
+    def _drive(self) -> None:
+        """The lock-step scheduler: pop work, broadcast one command, apply
+        it locally, stream results. EVERY device-touching operation happens
+        broadcast-first so followers replay the identical sequence."""
+        gen = self.gen
+        pending: list[tuple[_Conn, Any, list[int], int]] = []
+        active: dict[int, tuple[_Conn, Any]] = {}  # slot -> (conn, rid)
+
+        def finish_dead() -> None:
+            for slot, (conn, rid) in list(active.items()):
+                if not gen.slots[slot].live:
+                    conn.send({"id": rid, "done": True})
+                    gen.release(slot)
+                    del active[slot]
+
+        while True:
+            # -- collect inbox (block only when the mesh is idle) ----------
+            cancels: list[int] = []
+            busy = bool(pending) or gen.n_live > 0
+            idled = False
+            items = []
+            try:
+                if busy:  # never block while decode work is runnable
+                    items.append(self._inbox.get_nowait())
+                else:
+                    items.append(self._inbox.get(timeout=self.heartbeat_s))
+            except _queue.Empty:
+                idled = not busy
+            while True:
+                try:
+                    items.append(self._inbox.get_nowait())
+                except _queue.Empty:
+                    break
+            for kind, conn, payload in items:
+                if kind == "stop":
+                    self._broadcast(self._zero_cmd())  # STOP
+                    for slot, (c, rid) in active.items():
+                        c.send({"id": rid, "error": "server stopped"})
+                    for c, rid, _, _ in pending:
+                        c.send({"id": rid, "error": "server stopped"})
+                    conn.send({"stopped": True})
+                    return
+                if kind == "gen":
+                    rid, tokens, max_new = payload
+                    pending.append((conn, rid, tokens, max_new))
+                elif kind == "cancel":
+                    pending = [p for p in pending
+                               if not (p[0] is conn and p[1] == payload)]
+                    for slot, (c, rid) in list(active.items()):
+                        if c is conn and rid == payload:
+                            cancels.append(slot)
+                elif kind == "bye":
+                    pending = [p for p in pending if p[0] is not conn]
+                    cancels.extend(s for s, (c, _) in active.items()
+                                   if c is conn)
+            # drop requests whose connection died since queueing
+            pending = [p for p in pending if p[0].alive]
+            cancels.extend(s for s, (c, _) in active.items() if not c.alive)
+
+            # -- one broadcast + local apply per iteration -----------------
+            if cancels:
+                cancels = sorted(set(cancels))
+                self._broadcast(self._encode_cancel(cancels))
+                self._apply_cancel(cancels)
+                for slot in cancels:
+                    active.pop(slot, None)
+                    gen.release(slot)
+                continue
+            free = 0
+            if pending:
+                # settle bookkeeping BEFORE reusing slots: an in-flight
+                # chunk could finish an active slot inside add_requests'
+                # internal drain, and free_slot would then hand back a slot
+                # still mapped in ``active`` (same hazard LLMServer guards)
+                gen.drain()
+                finish_dead()
+                free = sum(1 for s in gen.slots if not s.live)
+            if pending and free:
+                wave = pending[:free]
+                pending = pending[free:]
+                self._broadcast(self._encode_admit(
+                    [(toks, max_new) for _, _, toks, max_new in wave]))
+                slots = gen.add_requests([
+                    (toks, max_new,
+                     (lambda i, burst, c=conn, r=rid: c.send(
+                         {"id": r, "tokens": burst})))
+                    for conn, rid, toks, max_new in wave
+                ])
+                for (conn, rid, _, _), slot in zip(wave, slots):
+                    active[slot] = (conn, rid)
+                finish_dead()
+            elif gen.n_live:
+                self._broadcast(self._zero_step())
+                gen.step()
+                finish_dead()
+            elif idled:
+                # heartbeat: followers re-enter broadcast within the
+                # collective timeout even when no traffic arrives
+                cmd = self._zero_cmd()
+                cmd[0] = _OP_NOOP
+                self._broadcast(cmd)
+
+    def _zero_step(self):
+        cmd = self._zero_cmd()
+        cmd[0] = _OP_STEP
+        return cmd
 
 
 class MultiHostLLMClient:
-    """Front-end side: asyncio client for rank 0's model port.
-
-    One in-flight request at a time per connection (the mesh is lock-step
-    anyway); a lock serializes callers. The front-end app holds one of
-    these per model-worker deployment."""
+    """Front-end side: asyncio client for rank 0's model port, MULTIPLEXED
+    — many concurrent ``stream()``/``generate()`` calls share one
+    connection, each tagged with a request id; a single reader task
+    dispatches frames to per-request queues. The front-end app holds one
+    of these per model-worker deployment."""
 
     def __init__(self, host: str, port: int) -> None:
         self.host, self.port = host, port
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
-        self._lock = asyncio.Lock()
+        self._reader_task: asyncio.Task | None = None
+        self._conn_lock = asyncio.Lock()
+        self._send_lock = asyncio.Lock()
+        self._ids = itertools.count(1)
+        self._streams: dict[int, asyncio.Queue] = {}
+        self._stop_waiter: asyncio.Future | None = None
 
     async def _ensure(self) -> None:
-        if self._writer is None or self._writer.is_closing():
+        async with self._conn_lock:
+            # a live connection needs BOTH a writable transport and a live
+            # dispatcher: after the worker dies, the reader task exits on
+            # EOF while the writer still looks open (first write after FIN
+            # succeeds silently) — without the task check, a new request
+            # would park on a queue nobody can ever fill
+            if (self._writer is not None and not self._writer.is_closing()
+                    and self._reader_task is not None
+                    and not self._reader_task.done()):
+                return
+            if self._writer is not None:
+                self._writer.close()
             self._reader, self._writer = await asyncio.open_connection(
                 self.host, self.port)
+            self._reader_task = asyncio.create_task(self._read_frames())
 
     async def _send(self, obj: Any) -> None:
         raw = json.dumps(obj).encode()
-        self._writer.write(struct.pack(">I", len(raw)) + raw)
-        await self._writer.drain()
+        async with self._send_lock:
+            self._writer.write(struct.pack(">I", len(raw)) + raw)
+            await self._writer.drain()
 
-    async def _recv(self) -> Any:
-        header = await self._reader.readexactly(4)
-        (size,) = struct.unpack(">I", header)
-        return json.loads(await self._reader.readexactly(size))
+    async def _read_frames(self) -> None:
+        """Single dispatcher: route each frame to its request's queue."""
+        try:
+            while True:
+                header = await self._reader.readexactly(4)
+                (size,) = struct.unpack(">I", header)
+                frame = json.loads(await self._reader.readexactly(size))
+                if not isinstance(frame, dict):
+                    continue
+                if frame.get("stopped"):
+                    if self._stop_waiter and not self._stop_waiter.done():
+                        self._stop_waiter.set_result(True)
+                    continue
+                q = self._streams.get(frame.get("id"))
+                if q is not None:
+                    q.put_nowait(frame)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            # connection died: wake every in-flight consumer with an error
+            for q in list(self._streams.values()):
+                q.put_nowait({"error": "model connection lost"})
+            if self._stop_waiter and not self._stop_waiter.done():
+                self._stop_waiter.set_result(False)
+
+    async def stream_chunks(self, prompt_ids: Iterable[int],
+                            max_new: int) -> AsyncIterator[list[int]]:
+        """Yield BURSTS of generated tokens (one list per decode-chunk
+        share, mirroring LLMServer.stream_chunks). Many calls may run
+        concurrently — each occupies one Generator slot on the mesh."""
+        await self._ensure()
+        rid = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[rid] = q
+        finished = False
+        try:
+            await self._send({"op": "generate", "id": rid,
+                              "tokens": list(prompt_ids),
+                              "max_new": max_new})
+            while True:
+                frame = await q.get()
+                if "error" in frame:
+                    finished = True
+                    raise RuntimeError(frame["error"])
+                if frame.get("done"):
+                    finished = True
+                    return
+                yield [int(t) for t in frame.get("tokens", [])]
+        finally:
+            self._streams.pop(rid, None)
+            if not finished:
+                # abandoned mid-stream: tell the mesh to free the slot
+                # instead of decoding to max_new for nobody
+                try:
+                    await self._send({"op": "cancel", "id": rid})
+                except Exception:
+                    await self.close()
 
     async def stream(self, prompt_ids: Iterable[int],
                      max_new: int) -> AsyncIterator[int]:
-        """Yield generated token ids as the mesh produces them.
-
-        The connection lock is held for the life of the generator. If you
-        may exit the loop early (``break``), wrap the call in
-        ``contextlib.aclosing`` so the lock releases deterministically
-        rather than at garbage collection::
-
-            async with aclosing(llm.stream(ids, n)) as toks:
-                async for tok in toks: ...
-        """
-        async with self._lock:
-            await self._ensure()
-            finished = False
-            try:
-                await self._send({"op": "generate",
-                                  "tokens": list(prompt_ids),
-                                  "max_new": max_new})
-                while True:
-                    frame = await self._recv()
-                    if "error" in frame:
-                        finished = True
-                        raise RuntimeError(frame["error"])
-                    if frame.get("done"):
-                        finished = True
-                        return
-                    yield int(frame["token"])
-            finally:
-                if not finished:
-                    # abandoned mid-stream (consumer disconnect): the worker
-                    # keeps writing this generation's frames, so drop the
-                    # socket — a later request must not read stale tokens
-                    await self.close()
+        """Token-at-a-time view of ``stream_chunks``."""
+        agen = self.stream_chunks(prompt_ids, max_new)
+        try:
+            async for burst in agen:
+                for tok in burst:
+                    yield tok
+        finally:
+            await agen.aclose()
 
     async def generate(self, prompt_ids: Iterable[int],
                        max_new: int) -> list[int]:
-        return [tok async for tok in self.stream(prompt_ids, max_new)]
+        out: list[int] = []
+        async for burst in self.stream_chunks(prompt_ids, max_new):
+            out.extend(burst)
+        return out
 
     async def shutdown_workers(self) -> None:
         """Stop the whole mesh (all ranks exit)."""
-        async with self._lock:
-            await self._ensure()
-            await self._send({"op": "stop"})
-            await self._recv()  # {"stopped": true}
+        await self._ensure()
+        self._stop_waiter = asyncio.get_running_loop().create_future()
+        await self._send({"op": "stop"})
+        await self._stop_waiter
 
     async def close(self) -> None:
         if self._writer is not None:
             self._writer.close()
             self._writer = None
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            self._reader_task = None
 
     async def health_check(self) -> dict:
         up = {"status": "UP",
-              "details": {"model_addr": f"{self.host}:{self.port}"}}
-        # a live connection answers without the lock — stream() holds it
-        # for a whole generation, and a probe must not block behind that
+              "details": {"model_addr": f"{self.host}:{self.port}",
+                          "in_flight": len(self._streams)}}
         if self._writer is not None and not self._writer.is_closing():
             return up
         try:
-            # under the lock: racing a stream()'s _ensure would clobber
-            # the shared reader/writer pair with a second connection
-            async with self._lock:
-                await self._ensure()
+            await self._ensure()
             return up
         except OSError as exc:
             return {"status": "DOWN",

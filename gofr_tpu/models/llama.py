@@ -597,6 +597,73 @@ def decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
     return logits, {**arrays, "len": new_len}
 
 
+def decode_window(params: dict, toks: jnp.ndarray, cache: dict,
+                  cfg: LlamaConfig, mesh=None) -> tuple[jnp.ndarray, dict]:
+    """Speculative verify window: W tokens per row, starting at each row's
+    own ``cache['len']`` — the batched continuous-batching counterpart of
+    ml/speculate.py's single-stream window program.
+
+    toks [B, W] -> (logits [B, W, V], updated cache arrays). Each row's W
+    q/k/v rows are scattered at positions len..len+W-1 (out-of-capacity
+    writes drop) and queries attend causally over prefix + window. ``len``
+    is NOT advanced here: the caller advances by 1 + accepted, so
+    "rollback" of rejected drafts is simply not advancing past them —
+    later windows overwrite the stale rows before any query can reach
+    them. Requires the fp cache (int8 kv_quant unsupported).
+    """
+    if cfg.kv_quant:
+        raise ValueError("decode_window requires the fp KV cache")
+    from ..ops import apply_rope, attention, repeat_kv, rms_norm, rope_table
+    from ..parallel import constrain
+
+    b, w = toks.shape
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    pos0 = cache["len"]                                   # [B]
+    positions = pos0[:, None] + jnp.arange(w)[None, :]    # [B, W]
+    x = params["embed"][toks].astype(cfg.dtype)           # [B, W, D]
+    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
+    rows = jnp.arange(b)
+
+    def body(carry, lp):
+        x, arrays, layer = carry
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = _mm(h, lp["wq"]).reshape(b, w, H, hd)
+        k = _mm(h, lp["wk"]).reshape(b, w, KV, hd)
+        v = _mm(h, lp["wv"]).reshape(b, w, KV, hd)
+        q = constrain(q, P("dp", None, "tp", None))
+        k = constrain(k, P("dp", None, "tp", None))
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        dt = arrays["k"].dtype
+        arrays = {
+            "k": arrays["k"].at[layer, rows[:, None], positions].set(
+                k.astype(dt), mode="drop"),
+            "v": arrays["v"].at[layer, rows[:, None], positions].set(
+                v.astype(dt), mode="drop"),
+        }
+        k_row = jax.lax.dynamic_index_in_dim(arrays["k"], layer, 0,
+                                             keepdims=False)
+        v_row = jax.lax.dynamic_index_in_dim(arrays["v"], layer, 0,
+                                             keepdims=False)
+        # per-row causal offset: query t of row i attends positions
+        # <= pos0[i]+t — its prefix plus the window so far; stale cells
+        # past the window are unreachable
+        o = attention(q, repeat_kv(k_row, cfg.n_rep),
+                      repeat_kv(v_row, cfg.n_rep),
+                      causal=True, q_offset=pos0)
+        x = x + _mm(o.reshape(b, w, H * hd), lp["wo"])
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + _swiglu(h2, lp)
+        return (x, arrays, layer + 1), None
+
+    arrays0 = {"k": cache["k"], "v": cache["v"]}
+    (x, arrays, _), _ = jax.lax.scan(
+        body, (x, arrays0, jnp.int32(0)), params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)  # [B, W, V]
+    return logits, {**arrays, "len": cache["len"]}
+
+
 def loss_fn(params: dict, tokens: jnp.ndarray, targets: jnp.ndarray,
             mask: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
     """Masked next-token cross-entropy (f32 logits)."""

@@ -105,7 +105,9 @@ def attention(
     """Reference softmax attention, BSHD layout, f32 logits.
 
     q: [B, Tq, H, D]; k, v: [B, Tk, H, D] (call repeat_kv first for GQA).
-    ``q_offset`` is the absolute position of q[0] (cache decoding);
+    ``q_offset`` is the absolute position of q[0] (cache decoding) — a
+    scalar, or a [B] vector when rows sit at different positions
+    (continuous-batching speculative windows);
     ``kv_len`` masks out cache slots beyond the valid length, per batch row.
     """
     scale = q.shape[-1] ** -0.5
@@ -118,10 +120,13 @@ def attention(
     tq, tk = q.shape[1], k.shape[1]
     mask = None
     if causal:
-        qpos = jnp.arange(tq) + q_offset
         kpos = jnp.arange(tk)
-        mask = kpos[None, :] <= qpos[:, None]  # [Tq, Tk]
-        mask = mask[None, None]
+        if getattr(q_offset, "ndim", 0) == 1:  # per-row offsets [B]
+            qpos = q_offset[:, None] + jnp.arange(tq)[None, :]  # [B, Tq]
+            mask = (kpos[None, None, :] <= qpos[:, :, None])[:, None]  # [B,1,Tq,Tk]
+        else:
+            qpos = jnp.arange(tq) + q_offset
+            mask = (kpos[None, :] <= qpos[:, None])[None, None]  # [1,1,Tq,Tk]
     if kv_len is not None:
         valid = jnp.arange(tk)[None, :] < kv_len[:, None]  # [B, Tk]
         valid = valid[:, None, None, :]

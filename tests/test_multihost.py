@@ -301,6 +301,16 @@ def test_multihost_serving_topology(tmp_path, run):
             toks2 = await llm.generate([3, 1], 4)
             assert toks2 == _reference_greedy([3, 1], 4)
 
+            # CONCURRENT DISTINCT prompts (r3 verdict: the dp axis must
+            # serve different requests, not clones): three multiplexed
+            # generations share the continuous-batching slots and each
+            # must still match its own single-process greedy decode
+            prompts = [[5, 9, 2, 7], [3, 1], [8, 6, 4]]
+            outs = await asyncio.gather(
+                *(llm.generate(p, 6) for p in prompts))
+            for p, o in zip(prompts, outs):
+                assert o == _reference_greedy(p, 6)
+
             await llm.shutdown_workers()
         finally:
             await llm.close()
