@@ -21,8 +21,21 @@ from gofr_tpu.ml.generate import Sampler
 from gofr_tpu.models import llama
 from gofr_tpu.native.tokenizer import BPETokenizer
 
-# byte-level fallback vocabulary; mount a trained one for real deployments
+# byte-level fallback vocabulary; a real vocab loads from the checkpoint
+# dir's tokenizer.json (LLAMA_CKPT) or TOKENIZER_JSON in main()
 TOKENIZER = BPETokenizer.byte_level(specials=["<eos>"])
+
+
+def _tokenizer_from_env() -> BPETokenizer:
+    tk = os.environ.get("TOKENIZER_JSON")
+    ckpt = os.environ.get("LLAMA_CKPT")
+    if not tk and ckpt and os.path.isfile(os.path.join(ckpt, "tokenizer.json")):
+        tk = os.path.join(ckpt, "tokenizer.json")
+    if tk:
+        from gofr_tpu.ml.hf_import import load_hf_tokenizer
+
+        return load_hf_tokenizer(tk)
+    return BPETokenizer.byte_level(specials=["<eos>"])
 
 
 def _prompt_ids(body) -> list[int]:
@@ -53,9 +66,11 @@ async def stream_ws(ctx: gofr_tpu.Context):
 
 
 def main() -> gofr_tpu.App:
+    global TOKENIZER
     app = gofr_tpu.new_app()
-    # LLAMA_PRESET / LLAMA_KV_QUANT / LLAMA_W8 -> config (shared with
-    # openai_server)
+    TOKENIZER = _tokenizer_from_env()
+    # LLAMA_PRESET / LLAMA_KV_QUANT / LLAMA_W8 / LLAMA_CKPT -> config
+    # (shared with openai_server; a HF checkpoint defines the arch)
     cfg = llama.config_from_env(tiny_vocab_size=TOKENIZER.vocab_size)
     params = llama.params_from_config(cfg)
     app.register_llm(
@@ -64,6 +79,9 @@ def main() -> gofr_tpu.App:
         max_seq=min(cfg.max_seq_len, 1024),
         chunk=int(os.environ.get("LLM_CHUNK", "4")),
         sampler=Sampler(temperature=float(os.environ.get("LLM_TEMPERATURE", "0"))),
+        # real checkpoints carry their stop id (hf_config); random-weight
+        # presets keep decoding to max_new (any id is as likely as eos)
+        eos_id=getattr(cfg, "eos_id", None),
         # LLM_SPEC_K>0: device-resident prompt-lookup speculation inside
         # the continuous-batching chunk (greedy-only, lossless)
         spec_k=int(os.environ.get("LLM_SPEC_K", "0")),

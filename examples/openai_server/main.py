@@ -208,9 +208,16 @@ async def models(ctx: gofr_tpu.Context):
 
 
 def main() -> gofr_tpu.App:
+    global TOKENIZER
     app = gofr_tpu.new_app()
-    # LLAMA_PRESET / LLAMA_KV_QUANT / LLAMA_W8 -> config (shared with
-    # llama_server)
+    # real checkpoints bring their own tokenizer (LLAMA_CKPT/tokenizer.json
+    # or TOKENIZER_JSON) — encoding a 128k-vocab model's prompt with the
+    # byte-level fallback would feed it meaningless ids
+    from examples.llama_server.main import _tokenizer_from_env
+
+    TOKENIZER = _tokenizer_from_env()
+    # LLAMA_PRESET / LLAMA_KV_QUANT / LLAMA_W8 / LLAMA_CKPT -> config
+    # (shared with llama_server)
     cfg = llama.config_from_env(tiny_vocab_size=TOKENIZER.vocab_size)
     params = llama.params_from_config(cfg)
     app.register_llm(
@@ -219,6 +226,8 @@ def main() -> gofr_tpu.App:
         max_seq=min(cfg.max_seq_len, 1024),
         chunk=int(os.environ.get("LLM_CHUNK", "4")),
         sampler=Sampler(temperature=float(os.environ.get("LLM_TEMPERATURE", "0"))),
+        eos_id=getattr(cfg, "eos_id", None),
+        spec_k=int(os.environ.get("LLM_SPEC_K", "0")),
     )
     app.post("/v1/chat/completions", chat_completions)
     app.post("/v1/completions", completions)

@@ -125,6 +125,13 @@ class Generator:
         self.max_seq = max_seq
         self.sampler = sampler or greedy()
         self.eos_id = eos_id
+        # an int, or a collection (Llama-3 instruct stops on several ids)
+        if eos_id is None:
+            self._eos = frozenset()
+        elif isinstance(eos_id, (list, tuple, set, frozenset)):
+            self._eos = frozenset(int(e) for e in eos_id)
+        else:
+            self._eos = frozenset((int(eos_id),))
         self.chunk = chunk
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_seq
@@ -312,7 +319,7 @@ class Generator:
         self._tokens_dev = self._repl_zeros((B, hist_cap))
         host_visible = self._host_visible
 
-        ngrams = tuple(range(min(self.spec_ngram, 3), 0, -1))
+        ngrams = tuple(range(max(1, self.spec_ngram), 0, -1))
 
         def draft_row(td_row, h):
             """Longest-trailing-n-gram lookup over one row's history
@@ -434,6 +441,29 @@ class Generator:
         self._spec_post_prefill_many = jax.jit(spec_post_prefill_many,
                                                donate_argnums=(0, 1))
 
+    def _after_prefill(self, logits, tokens, lens, slots, valid=None) -> None:
+        """Route prefill logits into first-token state — spec mode also
+        records prompt + first into the history rows. One site for the
+        single-slot (valid=None) and wave shapes, shared by warmup and
+        admission so compiled shapes always stay warm."""
+        if valid is None:
+            if self.spec_k:
+                self._tok_dev, self._tokens_dev = self._spec_post_prefill(
+                    self._tok_dev, self._tokens_dev, logits, tokens, lens,
+                    slots)
+            else:
+                self._tok_dev = self._post_prefill(
+                    self._tok_dev, logits, self._prefill_key,
+                    np.uint32(self._n_requests), slots)
+        elif self.spec_k:
+            self._tok_dev, self._tokens_dev = self._spec_post_prefill_many(
+                self._tok_dev, self._tokens_dev, logits, tokens, lens,
+                slots, valid)
+        else:
+            self._tok_dev = self._post_prefill_many(
+                self._tok_dev, logits, self._prefill_key,
+                np.uint32(self._n_requests), slots, valid)
+
     def _host_visible(self, x):
         """Force replicated layout on arrays the host will read — in
         multi-controller mode every process must hold the full value.
@@ -504,16 +534,7 @@ class Generator:
                 logits, self.cache = self._prefill_into(
                     self.params, padded, ones, self.cache, np.int32(0),
                 )
-                if self.spec_k:
-                    self._tok_dev, self._tokens_dev = self._spec_post_prefill(
-                        self._tok_dev, self._tokens_dev, logits, padded,
-                        ones, np.int32(0),
-                    )
-                else:
-                    self._tok_dev = self._post_prefill(
-                        self._tok_dev, logits, self._prefill_key,
-                        np.uint32(0), np.int32(0),
-                    )
+                self._after_prefill(logits, padded, ones, np.int32(0))
                 if self._admit_cap > 1:  # the wave-admission shapes too
                     b = self._admit_cap
                     toks_b = np.zeros((b, bucket), np.int32)
@@ -524,17 +545,8 @@ class Generator:
                         self.params, toks_b, lens_b, self.cache, slots_b,
                         dead,
                     )
-                    if self.spec_k:
-                        (self._tok_dev,
-                         self._tokens_dev) = self._spec_post_prefill_many(
-                            self._tok_dev, self._tokens_dev, logits, toks_b,
-                            lens_b, slots_b, dead,
-                        )
-                    else:
-                        self._tok_dev = self._post_prefill_many(
-                            self._tok_dev, logits, self._prefill_key,
-                            np.uint32(0), slots_b, dead,
-                        )
+                    self._after_prefill(logits, toks_b, lens_b, slots_b,
+                                        dead)
         # a REAL device->host fetch, not block_until_ready: through remote
         # transports the latter returns before queued work has drained, and
         # the first live request's token fetch would then absorb the entire
@@ -634,32 +646,15 @@ class Generator:
                             self.params, tokens, lens, self.cache,
                             np.int32(slots[0]),
                         )
-                        if self.spec_k:
-                            (self._tok_dev, self._tokens_dev) = \
-                                self._spec_post_prefill(
-                                    self._tok_dev, self._tokens_dev, logits,
-                                    tokens, lens, np.int32(slots[0]))
-                        else:
-                            self._tok_dev = self._post_prefill(
-                                self._tok_dev, logits, self._prefill_key,
-                                np.uint32(self._n_requests),
-                                np.int32(slots[0]),
-                            )
+                        self._after_prefill(logits, tokens, lens,
+                                            np.int32(slots[0]))
                     else:
                         logits, self.cache = self._prefill_many(
                             self.params, tokens, lens, self.cache, slot_arr,
                             valid,
                         )
-                        if self.spec_k:
-                            (self._tok_dev, self._tokens_dev) = \
-                                self._spec_post_prefill_many(
-                                    self._tok_dev, self._tokens_dev, logits,
-                                    tokens, lens, slot_arr, valid)
-                        else:
-                            self._tok_dev = self._post_prefill_many(
-                                self._tok_dev, logits, self._prefill_key,
-                                np.uint32(self._n_requests), slot_arr, valid,
-                            )
+                        self._after_prefill(logits, tokens, lens, slot_arr,
+                                            valid)
             except Exception:
                 for j in slots:  # unwind this wave's reservations
                     self.slots[j].live = False
@@ -692,7 +687,7 @@ class Generator:
             if not s.live:
                 continue
             s.tokens.append(t)
-            if self.eos_id is not None and t == self.eos_id:
+            if t in self._eos:
                 s.eos_hit = True
             if s.callback is not None:
                 s.callback(slot, [t])
@@ -778,8 +773,8 @@ class Generator:
         firsts), emitted candidates [W, B, K+1], counts [W, B] — to slot
         state. Each window contributes 1..K+1 tokens per live slot."""
         self._resolve_first(row0)
+        bursts: dict[int, list[int]] = {}
         for w in range(emits.shape[0]):
-            bursts: dict[int, list[int]] = {}
             for i, s in enumerate(self.slots):
                 if not s.live:
                     continue
@@ -789,17 +784,17 @@ class Generator:
                     s.tokens.append(tok)
                     s.produced += 1
                     self.spec_emitted += 1
-                    if self.eos_id is not None and tok == self.eos_id:
+                    if tok in self._eos:
                         s.eos_hit = True
                     if s.callback is not None:
                         bursts.setdefault(i, []).append(tok)
                     self._maybe_finish(i)
                     if not s.live:
                         break
-            for i, burst in bursts.items():
-                cb = self.slots[i].callback
-                if cb is not None:
-                    cb(i, burst)
+        for i, burst in bursts.items():
+            cb = self.slots[i].callback
+            if cb is not None:
+                cb(i, burst)
 
     def _process(self, toks: np.ndarray) -> None:
         """Apply one [1 input + chunk sampled, B] token block to slot
@@ -820,7 +815,7 @@ class Generator:
                 t = int(row[i])
                 s.tokens.append(t)
                 s.produced += 1
-                if self.eos_id is not None and t == self.eos_id:
+                if t in self._eos:
                     s.eos_hit = True
                 if s.callback is not None:
                     bursts.setdefault(i, []).append(t)

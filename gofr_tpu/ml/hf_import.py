@@ -1,0 +1,305 @@
+"""Real-weights ingestion: HF-layout Llama checkpoints and tokenizers.
+
+The serving stack initializes random weights by default; this module turns
+a HuggingFace-format model directory — ``config.json`` + ``*.safetensors``
+(+ optional ``tokenizer.json``) — into the framework's stacked
+``[n_layers, ...]`` parameter tree and a native ``BPETokenizer``, so
+``LLAMA_CKPT=/path/to/hf_model`` serves real weights end-to-end (the
+BASELINE north star: "serves Llama-3-8B").
+
+Design notes (TPU-first, zero-torch):
+
+- **safetensors is parsed from scratch** (``read_safetensors``): 8-byte
+  little-endian header length, JSON header of ``{name: {dtype, shape,
+  data_offsets}}``, then raw little-endian tensor bytes. Tensors are
+  returned as ``np.memmap`` views — a 16 GB checkpoint never fully
+  materializes in host RAM; each layer's slice streams to device during
+  the stacking copy. bf16 maps through ``ml_dtypes.bfloat16`` (numpy has
+  no native bf16).
+- **Projection layout**: PyTorch ``nn.Linear`` stores ``[out, in]`` and
+  computes ``x @ W.T``; our matmuls are ``x @ W`` with ``[in, out]`` —
+  every projection transposes on import. RoPE needs NO permutation:
+  ops.apply_rope uses the rotate-half convention, the same as HF's
+  modeling_llama (unlike Meta's original interleaved layout).
+- **Sharded checkpoints**: ``model.safetensors.index.json``'s weight_map
+  routes each tensor to its shard file; single-file checkpoints are
+  globbed directly.
+
+Reference parity: the reference has no ML, so there is no Go counterpart;
+the importer plays the role loaders like hf-transformers'
+``from_pretrained`` play, re-designed for a jax parameter tree.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any
+
+import numpy as np
+
+__all__ = ["read_safetensors", "hf_config", "import_hf_llama",
+           "load_hf_tokenizer", "is_hf_dir"]
+
+_ST_DTYPES = {
+    "F64": np.float64, "F32": np.float32, "F16": np.float16,
+    "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+    "U8": np.uint8, "BOOL": np.bool_,
+}
+
+
+def _st_dtype(name: str):
+    if name == "BF16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    try:
+        return np.dtype(_ST_DTYPES[name])
+    except KeyError:
+        raise ValueError(f"unsupported safetensors dtype {name!r}") from None
+
+
+def read_safetensors(path: str) -> dict[str, np.ndarray]:
+    """Parse one .safetensors file: {tensor name: memmapped ndarray}.
+
+    The returned arrays are zero-copy views into a file memmap — reading
+    a tensor touches only its pages, so stacking a 32-layer tree streams
+    the file once instead of loading it whole.
+    """
+    with open(path, "rb") as f:
+        (header_len,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(header_len))
+    data = np.memmap(path, dtype=np.uint8, mode="r", offset=8 + header_len)
+    out: dict[str, np.ndarray] = {}
+    for name, meta in header.items():
+        if name == "__metadata__":
+            continue
+        dt = _st_dtype(meta["dtype"])
+        beg, end = meta["data_offsets"]
+        out[name] = data[beg:end].view(dt).reshape(meta["shape"])
+    return out
+
+
+class _ShardedWeights:
+    """Tensor lookup across one or many safetensors shards, lazily opened."""
+
+    def __init__(self, model_dir: str) -> None:
+        self.model_dir = model_dir
+        self._open: dict[str, dict[str, np.ndarray]] = {}
+        index = os.path.join(model_dir, "model.safetensors.index.json")
+        if os.path.isfile(index):
+            with open(index) as f:
+                self.weight_map: dict[str, str] | None = (
+                    json.load(f)["weight_map"])
+        else:
+            self.weight_map = None
+            self._files = sorted(
+                fn for fn in os.listdir(model_dir)
+                if fn.endswith(".safetensors"))
+            if not self._files:
+                raise FileNotFoundError(
+                    f"no .safetensors files in {model_dir}")
+
+    def _shard(self, fn: str) -> dict[str, np.ndarray]:
+        if fn not in self._open:
+            self._open[fn] = read_safetensors(
+                os.path.join(self.model_dir, fn))
+        return self._open[fn]
+
+    def __contains__(self, name: str) -> bool:
+        try:
+            self[name]
+            return True
+        except KeyError:
+            return False
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        if self.weight_map is not None:
+            return self._shard(self.weight_map[name])[name]
+        for fn in self._files:
+            shard = self._shard(fn)
+            if name in shard:
+                return shard[name]
+        raise KeyError(name)
+
+
+def is_hf_dir(path: str | None) -> bool:
+    """True when ``path`` looks like a HF model directory (config.json +
+    safetensors) — lets LLAMA_CKPT point at either an orbax run or a HF
+    checkpoint and boot the right loader."""
+    if not path or not os.path.isdir(path):
+        return False
+    if not os.path.isfile(os.path.join(path, "config.json")):
+        return False
+    return (os.path.isfile(os.path.join(path,
+                                        "model.safetensors.index.json"))
+            or any(fn.endswith(".safetensors") for fn in os.listdir(path)))
+
+
+def hf_config(model_dir: str, **overrides: Any):
+    """config.json -> LlamaConfig (serving knobs pass through overrides)."""
+    from ..models.llama import LlamaConfig
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hc = json.load(f)
+    kw = dict(
+        vocab_size=hc["vocab_size"],
+        dim=hc["hidden_size"],
+        n_layers=hc["num_hidden_layers"],
+        n_heads=hc["num_attention_heads"],
+        n_kv_heads=hc.get("num_key_value_heads",
+                          hc["num_attention_heads"]),
+        ffn_dim=hc["intermediate_size"],
+        max_seq_len=hc.get("max_position_embeddings", 8192),
+        # HF's LlamaConfig default is 10000 (Llama-2 era configs omit it)
+        rope_theta=float(hc.get("rope_theta", 10_000.0)),
+        norm_eps=float(hc.get("rms_norm_eps", 1e-5)),
+    )
+    kw.update(overrides)
+    cfg = LlamaConfig(**kw)
+    # serving metadata the param tree doesn't carry
+    # int or list (Llama-3 instruct stops on several ids) — the Generator
+    # accepts either form verbatim
+    cfg.eos_id = hc.get("eos_token_id")
+    cfg.tie_word_embeddings = bool(hc.get("tie_word_embeddings", False))
+    return cfg
+
+
+def import_hf_llama(model_dir: str, cfg=None) -> tuple[Any, dict]:
+    """HF Llama checkpoint directory -> (LlamaConfig, stacked param tree).
+
+    HF name -> tree mapping (all projections transposed [out,in]->[in,out],
+    layer tensors stacked on a leading [n_layers] axis to match
+    ``init_params``):
+
+        model.embed_tokens.weight            embed         [V, D]
+        model.layers.{i}.input_layernorm     layers/attn_norm
+        model.layers.{i}.self_attn.q_proj    layers/wq     [L, D, H*hd]
+        ...k_proj / v_proj / o_proj          wk / wv / wo
+        model.layers.{i}.post_attention_layernorm  layers/mlp_norm
+        model.layers.{i}.mlp.gate_proj/up_proj/down_proj  w_gate/w_up/w_down
+        model.norm.weight                    final_norm
+        lm_head.weight (or tied embed)       lm_head       [D, V]
+    """
+    import jax.numpy as jnp
+
+    if cfg is None:
+        cfg = hf_config(model_dir)
+    w = _ShardedWeights(model_dir)
+    L = cfg.n_layers
+    dt = cfg.dtype
+
+    def proj(i: int, name: str) -> np.ndarray:
+        return np.asarray(w[f"model.layers.{i}.{name}.weight"])
+
+    def stack_t(name: str) -> "jnp.ndarray":
+        # [L, in, out]: transpose each torch [out, in] layer then stack
+        return jnp.stack([jnp.asarray(proj(i, name).T, dtype=dt)
+                          for i in range(L)])
+
+    def stack_norm(name: str) -> "jnp.ndarray":
+        return jnp.stack([jnp.asarray(proj(i, name), dtype=jnp.float32)
+                          for i in range(L)])
+
+    embed = jnp.asarray(np.asarray(w["model.embed_tokens.weight"]), dtype=dt)
+    if getattr(cfg, "tie_word_embeddings", False) or "lm_head.weight" not in w:
+        lm_head = embed.T
+    else:
+        lm_head = jnp.asarray(np.asarray(w["lm_head.weight"]).T, dtype=dt)
+    params = {
+        "embed": embed,
+        "layers": {
+            "attn_norm": stack_norm("input_layernorm"),
+            "mlp_norm": stack_norm("post_attention_layernorm"),
+            "wq": stack_t("self_attn.q_proj"),
+            "wk": stack_t("self_attn.k_proj"),
+            "wv": stack_t("self_attn.v_proj"),
+            "wo": stack_t("self_attn.o_proj"),
+            "w_gate": stack_t("mlp.gate_proj"),
+            "w_up": stack_t("mlp.up_proj"),
+            "w_down": stack_t("mlp.down_proj"),
+        },
+        "final_norm": jnp.asarray(np.asarray(w["model.norm.weight"]),
+                                  dtype=jnp.float32),
+        "lm_head": lm_head,
+    }
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# tokenizer.json (HF tokenizers byte-level BPE) -> native BPETokenizer
+# ---------------------------------------------------------------------------
+
+def _gpt2_byte_decoder() -> dict[str, int]:
+    """The GPT-2 printable-unicode <-> byte bijection used by every
+    byte-level BPE tokenizer (Llama-3, GPT-2, Qwen, Mistral v3): bytes
+    that are printable keep their codepoint, the rest map to 256+n."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("¡"), ord("¬") + 1))
+          + list(range(ord("®"), ord("ÿ") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
+def _token_bytes(tok: str, byte_decoder: dict[str, int]) -> bytes:
+    try:
+        return bytes(byte_decoder[ch] for ch in tok)
+    except KeyError:
+        # added/special tokens are literal text, not byte-encoded
+        return tok.encode("utf-8")
+
+
+def load_hf_tokenizer(path: str, *, use_native: bool = True):
+    """``tokenizer.json`` (or a model dir containing one) -> BPETokenizer.
+
+    Decode is exact. Encode runs merge-rank BPE over raw bytes without
+    HF's regex pre-tokenizer; because merge pairs were learned inside
+    pre-tokenized chunks, cross-chunk merges essentially never exist in
+    the table, so outputs match the reference tokenizer for ordinary
+    text (the serving API also accepts raw ids for exactness-critical
+    callers).
+    """
+    from ..native.tokenizer import BPETokenizer
+
+    if os.path.isdir(path):
+        path = os.path.join(path, "tokenizer.json")
+    with open(path, encoding="utf-8") as f:
+        tj = json.load(f)
+    model = tj["model"]
+    if model.get("type") not in (None, "BPE"):
+        raise ValueError(f"unsupported tokenizer model {model.get('type')!r}")
+    dec = _gpt2_byte_decoder()
+    vocab_map: dict[str, int] = model["vocab"]
+    size = max(vocab_map.values()) + 1
+    vocab: list[bytes] = [b""] * size
+    for tok, idx in vocab_map.items():
+        vocab[idx] = _token_bytes(tok, dec)
+    specials: dict[str, int] = {}
+    for added in tj.get("added_tokens", ()):
+        idx = added["id"]
+        if idx >= size:
+            vocab.extend([b""] * (idx + 1 - size))
+            size = idx + 1
+        vocab[idx] = added["content"].encode("utf-8")
+        specials[added["content"]] = idx
+    merges = []
+    for m in model.get("merges", ()):
+        left, right = m.split(" ", 1) if isinstance(m, str) else m
+        li = vocab_map.get(left)
+        ri = vocab_map.get(right)
+        mi = vocab_map.get(left + right)
+        if li is None or ri is None or mi is None:
+            continue  # merge over tokens outside the vocab: unreachable
+        merges.append((li, ri, mi))
+    # byte -> base token id (the single-char byte-level tokens)
+    enc = {b: ch for ch, b in dec.items()}
+    byte_map = [vocab_map.get(enc[b], 0) for b in range(256)]
+    return BPETokenizer(vocab, merges, byte_map, specials=specials,
+                        use_native=use_native)

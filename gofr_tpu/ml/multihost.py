@@ -332,13 +332,22 @@ class MultiHostWorker:
                 rid = req.get("id")
                 try:
                     tokens = [int(t) for t in req.get("tokens", [])]
-                    max_new = max(1, int(req.get("max_new", 16)))
+                    # clamp to the int32 command frame: an unchecked
+                    # 2**31 max_new would overflow _encode_admit and tear
+                    # the whole mesh down (fail-fast treats it as fatal)
+                    max_new = max(1, min(int(req.get("max_new", 16)),
+                                         1_000_000_000))
                 except (TypeError, ValueError):
                     conn.send({"id": rid, "error": "tokens/max_new must be ints"})
                     continue
                 if not tokens or len(tokens) > self.bucket_cap:
                     conn.send({"id": rid, "error":
                                f"prompt must be 1..{self.bucket_cap} tokens"})
+                    continue
+                vocab = self.cfg.vocab_size
+                if any(t < 0 or t >= vocab for t in tokens):
+                    conn.send({"id": rid, "error":
+                               f"token ids must be 0..{vocab - 1}"})
                     continue
                 self._inbox.put(("gen", conn, (rid, tokens, max_new)))
         except Exception:

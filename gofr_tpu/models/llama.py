@@ -109,14 +109,28 @@ def params_from_config(cfg: "LlamaConfig", seed: int = 0,
     one place that consumes ``cfg.w8`` and ``LLAMA_CKPT``, so every boot
     path (examples, bench, multi-host workers) serves the same way.
 
-    ``LLAMA_CKPT=<dir>`` (or ``checkpoint_dir``) restores the latest
-    orbax checkpoint instead of random init: either a bare params tree or
-    a training state whose ``"params"`` entry matches. Quantization
-    (``w8``) applies AFTER restore — checkpoints store fp weights.
+    ``LLAMA_CKPT=<dir>`` (or ``checkpoint_dir``) restores real weights
+    instead of random init. Two layouts are auto-detected:
+
+    - a **HuggingFace model directory** (config.json + *.safetensors):
+      imported via ml/hf_import (from-scratch safetensors parser,
+      projections transposed, layers stacked);
+    - an **orbax run**: the latest step, either a bare params tree or a
+      training state whose ``"params"`` entry matches.
+
+    Quantization (``w8``) applies AFTER restore — checkpoints store fp
+    weights.
     """
     import os as _os
 
     checkpoint_dir = checkpoint_dir or _os.environ.get("LLAMA_CKPT")
+    from ..ml.hf_import import import_hf_llama, is_hf_dir
+
+    if checkpoint_dir and is_hf_dir(checkpoint_dir):
+        _, params = import_hf_llama(checkpoint_dir, cfg)
+        if cfg.w8:
+            params = quantize_weights(params)
+        return params
     params = init_params(cfg, jax.random.PRNGKey(seed))
     if checkpoint_dir:
         from ..ml.checkpoint import Checkpointer
@@ -152,6 +166,13 @@ def config_from_env(tiny_vocab_size: int | None = None) -> LlamaConfig:
     preset = os.environ.get("LLAMA_PRESET", "tiny")
     kv_quant = os.environ.get("LLAMA_KV_QUANT") == "1"
     w8 = os.environ.get("LLAMA_W8") == "1"
+    ckpt = os.environ.get("LLAMA_CKPT")
+    from ..ml.hf_import import hf_config, is_hf_dir
+
+    if ckpt and is_hf_dir(ckpt):
+        # a HF checkpoint defines its own architecture: the preset only
+        # contributes serving knobs
+        return hf_config(ckpt, kv_quant=kv_quant, w8=w8)
     if preset == "tiny":
         kw = {"use_flash": False, "kv_quant": kv_quant, "w8": w8}
         if tiny_vocab_size is not None:

@@ -73,6 +73,13 @@ class BPETokenizer:
         return self.decode_bytes(ids).decode("utf-8", errors="replace")
 
     def decode_bytes(self, ids: Sequence[int]) -> bytes:
+        n = len(self.vocab)
+        if any(not 0 <= int(i) < n for i in ids):
+            # the model's vocab can exceed the tokenizer's (proxy weights,
+            # trimmed vocabs): degrade to U+FFFD instead of failing the
+            # request — a sampler may emit any id up to the model's vocab
+            return b"".join(self.vocab[int(i)] if 0 <= int(i) < n
+                            else b"\xef\xbf\xbd" for i in ids)
         if self._native is not None and len(ids):
             return self._native.decode(list(ids))
         return b"".join(self.vocab[i] for i in ids)
