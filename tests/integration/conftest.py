@@ -1,0 +1,89 @@
+"""Opt-in real-service integration tests (reference parity:
+.github/workflows/go.yml:26-76 boots Kafka/Redis/MySQL containers and runs
+examples against them).
+
+Every wire client in gofr_tpu/datasource was written from the protocol
+spec and is normally validated only against in-tree fakes; a fake
+validated against the same code that talks to it cannot catch a protocol
+misreading. These tests point the SAME clients at real servers.
+
+Hermetic by default: each fixture probes its service with a 1-second TCP
+connect and SKIPS when unreachable, so `pytest tests/` stays green on a
+laptop with nothing running. Bring services up with
+
+    docker compose -f docker-compose.integration.yml up -d
+
+and override locations with ``GOFR_IT_<SERVICE>=host:port``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import uuid
+
+import pytest
+
+_DEFAULTS = {
+    "redis": ("localhost", 6379),
+    "kafka": ("localhost", 9092),
+    "mysql": ("localhost", 3306),
+    "postgres": ("localhost", 5432),
+    "mongo": ("localhost", 27017),
+    "cassandra": ("localhost", 9042),
+    "nats": ("localhost", 4222),
+}
+
+
+def _endpoint(name: str) -> tuple[str, int]:
+    raw = os.environ.get(f"GOFR_IT_{name.upper()}")
+    if raw:
+        host, _, port = raw.partition(":")
+        return host or "localhost", int(port or _DEFAULTS[name][1])
+    return _DEFAULTS[name]
+
+
+def _reachable(host: str, port: int, timeout: float = 1.0) -> bool:
+    try:
+        with socket.create_connection((host, port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def _service_fixture(name: str):
+    @pytest.fixture(scope="session", name=name)
+    def fx() -> tuple[str, int]:
+        host, port = _endpoint(name)
+        if not _reachable(host, port):
+            pytest.skip(f"{name} not reachable at {host}:{port} "
+                        f"(start docker-compose.integration.yml or set "
+                        f"GOFR_IT_{name.upper()})")
+        return host, port
+
+    return fx
+
+
+redis = _service_fixture("redis")
+kafka = _service_fixture("kafka")
+mysql = _service_fixture("mysql")
+postgres = _service_fixture("postgres")
+mongo = _service_fixture("mongo")
+cassandra = _service_fixture("cassandra")
+nats = _service_fixture("nats")
+
+
+@pytest.fixture
+def unique() -> str:
+    """Collision-free name for topics/tables/keys across repeated runs."""
+    return f"gofr_it_{uuid.uuid4().hex[:12]}"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "integration: talks to real services (skips when down)")
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        item.add_marker(pytest.mark.integration)
