@@ -1,4 +1,6 @@
-"""Long-context decode: int8 KV cache vs fp at >= 8k context.
+"""Long-context decode: int8 KV cache vs fp at >= 8k context, plus the
+paged-pool capacity A/B (r3 verdict #8): at EQUAL cache HBM, the paged
+layout serves 2x the concurrent mixed-length slots of the dense one.
 
 kv_quant's reason to exist is long contexts — decode there is dominated by
 sweeping the KV cache out of HBM, so halving cache bytes should buy real
@@ -57,6 +59,61 @@ def _decode_tok_s(kv_quant: bool, *, slots: int, ctx: int, max_seq: int,
     return out
 
 
+def _mixed_run(*, paged: bool, slots: int, n_pages: int | None,
+               page_size: int, prompts, max_new: int, max_seq: int,
+               chunk: int, buckets, cfg_kw: dict) -> dict:
+    """Serve the SAME mixed-length request set with `slots` concurrency;
+    returns aggregate tok/s + the cache HBM actually allocated."""
+    import jax  # noqa: F401
+
+    from gofr_tpu.ml.generate import Generator
+    from gofr_tpu.models import llama
+
+    cfg = llama.LlamaConfig(**cfg_kw)
+    params = llama.params_from_config(cfg)
+    gen = Generator(params, cfg, batch_slots=slots, max_seq=max_seq,
+                    prefill_buckets=buckets, chunk=chunk,
+                    page_size=page_size if paged else 0,
+                    n_pages=n_pages if paged else None)
+    done: dict[int, int] = {}
+
+    def collect() -> None:
+        # settle bookkeeping and bank finished slots BEFORE any admission:
+        # add_request's internal drain could otherwise finish a slot whose
+        # tokens the slot-reuse then discards (the hazard llm.py guards)
+        gen.drain()
+        for i, s in enumerate(gen.slots):
+            if not s.live and s.tokens:
+                done[i] = done.get(i, 0) + len(s.tokens)
+                gen.release(i)
+
+    t0 = time.perf_counter()
+    pending = list(prompts)
+    while pending or gen.n_live:
+        collect()
+        while pending and gen.free_slot() is not None:
+            try:
+                slot = gen.add_request(pending[0], max_new_tokens=max_new)
+            except RuntimeError:
+                break  # pool momentarily dry: decode some slots out first
+            pending.pop(0)
+            done[slot] = done.get(slot, 0)
+        gen.step()
+        collect()
+    elapsed = time.perf_counter() - t0
+    total = sum(done.values())
+    cache_gib = sum(
+        int(np.prod(gen.cache[k].shape)) * gen.cache[k].dtype.itemsize
+        for k in gen.cache if k != "len") / 2**30
+    out = {"tok_per_s": round(total / elapsed, 1),
+           "slots": slots,
+           "cache_gib": round(cache_gib, 2),
+           "wall_s": round(elapsed, 2),
+           "evictions": gen.evictions}
+    del gen, params
+    return out
+
+
 def main() -> None:
     os.environ.setdefault("LOG_LEVEL", "ERROR")
     import jax
@@ -88,6 +145,34 @@ def main() -> None:
                        chunk=chunk, n_chunks=n_chunks, cfg_kw=cfg_kw,
                        w8=True)
 
+    # ---- paged capacity A/B at EQUAL cache HBM ---------------------------
+    # mixed-length workload (half long, half short): dense pins worst-case
+    # rows per slot; the paged pool shares them, so the same HBM carries
+    # 2x the concurrent slots (the long-context capacity lever).
+    if on_tpu:
+        ps, dense_slots, max_new = 128, 4, 64
+        ctx_long, ctx_short = 8192, 1024
+    else:
+        ps, dense_slots, max_new = 8, 2, 4
+        ctx_long, ctx_short = 16, 8
+    rng = np.random.default_rng(1)
+    vocab = cfg_kw["vocab_size"]
+    n_req = 4 * dense_slots
+    prompts = [
+        rng.integers(1, vocab,
+                     (ctx_long if i % 2 == 0 else ctx_short,)
+                     ).astype(np.int32)
+        for i in range(n_req)
+    ]
+    common = dict(page_size=ps, prompts=prompts, max_new=max_new,
+                  max_seq=max_seq, chunk=chunk,
+                  buckets=(ctx_short, ctx_long), cfg_kw=cfg_kw)
+    dense_run = _mixed_run(paged=False, slots=dense_slots, n_pages=None,
+                           **common)
+    equal_hbm_pages = 1 + dense_slots * (-(-max_seq // ps))
+    paged_run = _mixed_run(paged=True, slots=2 * dense_slots,
+                           n_pages=equal_hbm_pages, **common)
+
     emit(
         "longcontext_int8_speedup_8k", q8["tok_per_s"] / fp["tok_per_s"],
         "x", None,
@@ -98,6 +183,14 @@ def main() -> None:
             "int8": q8,
             "int8_w8": w8,
             "w8_speedup": round(w8["tok_per_s"] / fp["tok_per_s"], 3),
+            # paged A/B: same request set, same cache HBM, 2x slots
+            "paged_ab": {
+                "dense": dense_run,
+                "paged_equal_hbm": paged_run,
+                "paged_speedup": round(
+                    paged_run["tok_per_s"] / dense_run["tok_per_s"], 3),
+                "page_size": ps,
+            },
             "backend": jax.default_backend(),
             "config": 7,
         },

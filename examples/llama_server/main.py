@@ -85,6 +85,10 @@ def main() -> gofr_tpu.App:
         # LLM_SPEC_K>0: device-resident prompt-lookup speculation inside
         # the continuous-batching chunk (greedy-only, lossless)
         spec_k=int(os.environ.get("LLM_SPEC_K", "0")),
+        # LLM_PAGE_SIZE>0: block-paged KV pool (LLM_PAGES sizes it below
+        # the dense worst case — more concurrent slots per HBM byte)
+        page_size=int(os.environ.get("LLM_PAGE_SIZE", "0")),
+        n_pages=int(os.environ.get("LLM_PAGES", "0")) or None,
     )
 
     app.post("/generate", generate)

@@ -33,7 +33,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["Sampler", "sample_logits", "greedy", "Generator"]
+__all__ = ["Sampler", "sample_logits", "greedy", "Generator",
+           "PagePoolExhausted"]
+
+
+class PagePoolExhausted(RuntimeError):
+    """Paged-KV admission failed for lack of free pages — transient
+    back-pressure (pages free as slots finish), not a bad request; the
+    serving layer requeues instead of erroring the client."""
 
 
 class Sampler:
@@ -112,7 +119,8 @@ class Generator:
                  eos_id: int | None = None, prefill_buckets=(128, 512, 2048),
                  seed: int = 0, mesh=None, chunk: int = 1,
                  shard_cache: bool = False, spec_k: int = 0,
-                 spec_ngram: int = 3) -> None:
+                 spec_ngram: int = 3, page_size: int = 0,
+                 n_pages: int | None = None) -> None:
         import contextlib
 
         from ..models import llama
@@ -138,7 +146,34 @@ class Generator:
         ) or (max_seq,)
         self.mesh = mesh
         self._repl = None  # replicated sharding for host-visible outputs
-        if shard_cache:
+        self.page_size = int(page_size)
+        self.evictions = 0  # slots truncated because the page pool ran dry
+        if self.page_size:
+            # Block-paged KV cache (llama.init_paged_cache): a shared page
+            # pool + host-owned page tables instead of a dense [B, S_max]
+            # rectangle per slot. HBM holds ACTUAL tokens, not worst case,
+            # so the same memory serves more concurrent long-context slots
+            # (config7). n_pages defaults to the dense-equivalent so the
+            # operator dials capacity down explicitly.
+            if shard_cache or spec_k or (
+                    mesh is not None
+                    and getattr(cfg, "sequence_parallel", False)):
+                raise ValueError(
+                    "page_size doesn't compose with shard_cache/sp/spec yet")
+            for b in self.prefill_buckets:
+                if b % self.page_size:
+                    raise ValueError(
+                        f"prefill bucket {b} not a multiple of page_size")
+            self._p_max = -(-max_seq // self.page_size)
+            self.n_pages = n_pages or (1 + batch_slots * self._p_max)
+            self.cache = llama.init_paged_cache(
+                cfg, batch_slots, self.n_pages, self.page_size)
+            # page 0 is scratch; the free list is a stack of real pages
+            self._free_pages = list(range(self.n_pages - 1, 0, -1))
+            self._slot_pages: list[list[int]] = [
+                [] for _ in range(batch_slots)]
+            self._table = np.zeros((batch_slots, self._p_max), np.int32)
+        elif shard_cache:
             # Multi-controller serving (ml/multihost.py): slots shard over
             # dp, kv heads over tp (matching SHARDING_RULES so decode never
             # reshards), and every array the host reads is explicitly
@@ -227,8 +262,28 @@ class Generator:
                 block = jnp.concatenate([tok_in[None], toks], axis=0)
                 return host_visible(block), host_visible(tok), cache
 
+            def paged_chunk_fn(params, tok, cache, step0, base_key, table):
+                # identical shape contract; decode routes through the page
+                # table (constant across the chunk — growth pre-allocates)
+                tok_in = tok
+
+                def body(carry, j):
+                    tok, cache = carry
+                    logits, cache = llama.paged_decode_step(
+                        params, tok, cache, table, cfg)
+                    key = jax.random.fold_in(base_key, step0 + j)
+                    nxt = _sample_impl(logits, key, sampler_cfg)
+                    return (nxt, cache), nxt
+
+                (tok, cache), toks = jax.lax.scan(
+                    body, (tok, cache), jnp.arange(n_chunk)
+                )
+                block = jnp.concatenate([tok_in[None], toks], axis=0)
+                return block, tok, cache
+
             # donate the cache: in-place KV update on device, no copy per step
-            return jax.jit(chunk_fn, donate_argnums=(2,))
+            return jax.jit(paged_chunk_fn if self.page_size else chunk_fn,
+                           donate_argnums=(2,))
 
         self._chunk_fn = make_chunk_fn(self.chunk)
         # TTFT path: a 1-step mini-chunk dispatched while first tokens are
@@ -250,6 +305,13 @@ class Generator:
             return host_visible(tok_dev.at[slot].set(first))
 
         self._post_prefill = jax.jit(post_prefill, donate_argnums=(0,))
+        if self.page_size:
+            ps = self.page_size
+            self._prefill_paged = jax.jit(
+                lambda p, t, l, c, row, slot: llama.paged_prefill_into(
+                    p, t, l, cfg, c, row, slot, ps),
+                donate_argnums=(3,),
+            )
         self._prefill_into = jax.jit(
             lambda p, t, l, c, slot: llama.prefill_into(p, t, l, cfg, c, slot,
                                                         mesh=mesh),
@@ -280,7 +342,9 @@ class Generator:
         # admission-wave shape buckets: 1 (the common trickle) and
         # _admit_cap (bursts). Waves of 2..cap-1 pad to cap with masked
         # rows — a little extra MXU work instead of a fresh compile.
-        self._admit_cap = min(8, batch_slots)
+        # Paged mode admits per-request (each prefill scatters into its
+        # own page set).
+        self._admit_cap = 1 if self.page_size else min(8, batch_slots)
 
         # -- speculative decoding (device-resident prompt lookup) ----------
         self.spec_k = int(spec_k)
@@ -464,6 +528,46 @@ class Generator:
                 self._tok_dev, logits, self._prefill_key,
                 np.uint32(self._n_requests), slots, valid)
 
+    # -- paged-pool bookkeeping (page_size > 0) ------------------------------
+    def _alloc_pages_to(self, slot: int, upto_len: int) -> bool:
+        """Grow the slot's page list to cover ``upto_len`` virtual
+        positions (in order — virtual offsets stay contiguous). False when
+        the pool ran dry; the caller picks the policy."""
+        need = min(-(-upto_len // self.page_size), self._p_max)
+        pages = self._slot_pages[slot]
+        while len(pages) < need:
+            if not self._free_pages:
+                return False
+            pg = self._free_pages.pop()
+            pages.append(pg)
+            self._table[slot, len(pages) - 1] = pg
+        return True
+
+    def _free_slot_pages(self, slot: int) -> None:
+        self._free_pages.extend(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self._table[slot, :] = 0
+
+    def _grow_pages(self) -> None:
+        """Pre-allocate pages for the upcoming dispatch: host bookkeeping
+        lags one chunk, so cover produced + a pipeline margin. A dry pool
+        TRUNCATES the growing slot — it finishes early with the tokens it
+        has (counted in ``evictions``) rather than corrupting neighbors."""
+        margin = self.chunk * (len(self._inflight) + 2)
+        for i, s in enumerate(self.slots):
+            if not s.live:
+                continue
+            est = min(s.prompt_len + s.produced + margin,
+                      s.prompt_len + s.max_new,  # never past its budget
+                      self.max_seq)
+            if not self._alloc_pages_to(i, est):
+                s.live = False
+                self.evictions += 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free_pages) if self.page_size else 0
+
     def _host_visible(self, x):
         """Force replicated layout on arrays the host will read — in
         multi-controller mode every process must hold the full value.
@@ -523,6 +627,12 @@ class Generator:
                     (_row0, _e, _c, self._tok_dev, self.cache,
                      self._tokens_dev) = fn(self.params, self._tok_dev,
                                             self.cache, self._tokens_dev)
+                elif self.page_size:
+                    _toks, self._tok_dev, self.cache = fn(
+                        self.params, self._tok_dev, self.cache,
+                        np.int32(0), self._base_key,
+                        np.zeros_like(self._table),  # all-scratch tables
+                    )
                 else:
                     _toks, self._tok_dev, self.cache = fn(
                         self.params, self._tok_dev, self.cache,
@@ -531,9 +641,16 @@ class Generator:
             for bucket in self.prefill_buckets:
                 padded = np.zeros((1, bucket), np.int32)
                 ones = np.array([1], np.int32)
-                logits, self.cache = self._prefill_into(
-                    self.params, padded, ones, self.cache, np.int32(0),
-                )
+                if self.page_size:
+                    logits, self.cache = self._prefill_paged(
+                        self.params, padded, ones, self.cache,
+                        np.zeros((bucket // self.page_size,), np.int32),
+                        np.int32(0),
+                    )
+                else:
+                    logits, self.cache = self._prefill_into(
+                        self.params, padded, ones, self.cache, np.int32(0),
+                    )
                 self._after_prefill(logits, padded, ones, np.int32(0))
                 if self._admit_cap > 1:  # the wave-admission shapes too
                     b = self._admit_cap
@@ -609,6 +726,8 @@ class Generator:
             dead = set(out)
             for j in dead:
                 self.slots[j].live = False
+                if self.page_size:
+                    self._free_slot_pages(j)
             if dead:
                 self._pending_first = collections.deque(
                     s for s in self._pending_first if s not in dead)
@@ -641,7 +760,30 @@ class Generator:
                 slot_arr[row] = slots[row]
             try:
                 with self._mesh_ctx():
-                    if b == 1:
+                    if self.page_size:
+                        # admission control: no pages, no slot — the
+                        # caller requeues on PagePoolExhausted instead of
+                        # risking a silent mid-generation eviction. The
+                        # estimate never exceeds the request's own budget.
+                        upto = min(int(lens[0]) + 2 * self.chunk,
+                                   int(lens[0]) + wave[0][2],
+                                   self.max_seq)
+                        if not self._alloc_pages_to(slots[0], upto):
+                            raise PagePoolExhausted(
+                                "kv page pool exhausted "
+                                f"({self.free_pages} pages free)")
+                        row = np.zeros((s_bucket // self.page_size,),
+                                       np.int32)
+                        pages = self._slot_pages[slots[0]]
+                        row[:min(len(pages), len(row))] = \
+                            pages[:len(row)]
+                        logits, self.cache = self._prefill_paged(
+                            self.params, tokens, lens, self.cache, row,
+                            np.int32(slots[0]),
+                        )
+                        self._after_prefill(logits, tokens, lens,
+                                            np.int32(slots[0]))
+                    elif b == 1:
                         logits, self.cache = self._prefill_into(
                             self.params, tokens, lens, self.cache,
                             np.int32(slots[0]),
@@ -658,6 +800,8 @@ class Generator:
             except Exception:
                 for j in slots:  # unwind this wave's reservations
                     self.slots[j].live = False
+                    if self.page_size:
+                        self._free_slot_pages(j)
                 raise
             self._n_requests += len(wave)
             for slot, (ids, n, max_new, callback) in zip(slots, wave):
@@ -727,6 +871,13 @@ class Generator:
                  self._tokens_dev) = fn(self.params, self._tok_dev,
                                         self.cache, self._tokens_dev)
                 item: Any = (row0, emits, counts)
+            elif self.page_size:
+                self._grow_pages()  # table must cover this whole chunk
+                toks, self._tok_dev, self.cache = fn(
+                    self.params, self._tok_dev, self.cache,
+                    np.int32(self.steps), self._base_key, self._table,
+                )
+                item = toks
             else:
                 toks, self._tok_dev, self.cache = fn(
                     self.params, self._tok_dev, self.cache,
@@ -829,6 +980,8 @@ class Generator:
         """Return a finished slot to the free pool (its tokens are consumed)."""
         if self.slots[i].live:
             raise RuntimeError(f"slot {i} still decoding")
+        if self.page_size:
+            self._free_slot_pages(i)
         self.slots[i] = _Slot()
 
     def generate(self, prompt_ids, max_new_tokens: int = 32) -> list[int]:

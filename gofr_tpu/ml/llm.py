@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Any, AsyncIterator
 
+from .generate import PagePoolExhausted
+
 __all__ = ["LLMServer"]
 
 _DONE = object()
@@ -173,8 +175,15 @@ class LLMServer:
             self.gen.drain()
             self._finish_dead_slots()
             # admit everything that fits as ONE wave: a batched prefill pays
-            # the per-program dispatch overhead once for the whole burst
+            # the per-program dispatch overhead once for the whole burst.
+            # Paged mode admits one request per call instead — add_requests
+            # is all-or-nothing, so a multi-request batch that hit
+            # PagePoolExhausted on its LAST member would unwind the
+            # admitted ones too and livelock on retry; single admission
+            # keeps partial progress (paged prefill is per-request anyway).
             n_free = sum(not s.live for s in self.gen.slots)
+            if getattr(self.gen, "page_size", 0):
+                n_free = min(n_free, 1)
             batch, rejected = [], []
             while self._waiting and len(batch) < n_free:
                 req = self._waiting.pop(0)
@@ -195,6 +204,12 @@ class LLMServer:
                      (lambda i, toks, r=req: self._emit(r, toks)))
                     for req, ids in batch
                 ])
+            except PagePoolExhausted:
+                # transient paged-KV back-pressure: pages free as live
+                # slots finish, so requeue the whole batch (front, FIFO)
+                # and let decode progress instead of erroring clients
+                self._waiting[:0] = [req for req, _ in batch]
+                break
             except Exception as exc:  # device-side failure: relay to all
                 for req, _ in batch:
                     req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)

@@ -618,6 +618,116 @@ def decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
     return logits, {**arrays, "len": new_len}
 
 
+def init_paged_cache(cfg: LlamaConfig, batch: int, n_pages: int,
+                     page_s: int) -> dict:
+    """Block-paged KV cache: a POOL of pages shared by every slot instead
+    of a dense [B, S_max] rectangle per slot.
+
+    Dense caches pin worst-case HBM per slot — a 1024-token budget costs
+    the full 1024 rows even for a 40-token chat turn. Here slots map
+    virtual positions onto pool pages through a host-owned page table
+    ([B, pages_per_slot] int32, passed into each program), so concurrent
+    slot count is bounded by ACTUAL tokens, not worst case — the capacity
+    lever for long-context serving (config7). Page 0 is reserved as
+    scratch: unallocated table entries point at it, over-capacity writes
+    land there harmlessly, and kv_len masking keeps reads out.
+
+    fp-only (int8 kv_quant pairs with the dense layout for now).
+    """
+    if cfg.kv_quant:
+        raise ValueError("paged cache requires the fp KV layout")
+    shape = (cfg.n_layers, n_pages, page_s, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "len": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def paged_prefill_into(params: dict, tokens: jnp.ndarray,
+                       seq_lens: jnp.ndarray, cfg: LlamaConfig, cache: dict,
+                       table_row: jnp.ndarray, slot: jnp.ndarray,
+                       page_s: int) -> tuple[jnp.ndarray, dict]:
+    """Prefill ONE prompt [1, S_pad] and scatter its kv rows into the
+    slot's pages (``table_row`` [S_pad // page_s]). Pages past the prompt
+    point at scratch page 0, so whole-page writes never need masking."""
+    logits, filled = prefill(params, tokens, seq_lens, cfg,
+                             init_cache(cfg, 1, tokens.shape[1]))
+    arrays = {"k": cache["k"], "v": cache["v"]}
+    n_pg = tokens.shape[1] // page_s
+    for j in range(n_pg):  # static unroll: one page-sized slab per write
+        for key in ("k", "v"):
+            slab = filled[key][:, 0, j * page_s:(j + 1) * page_s]
+            arrays[key] = jax.lax.dynamic_update_index_in_dim(
+                arrays[key], slab, table_row[j], axis=1)
+    new_len = cache["len"].at[slot].set(seq_lens[0])
+    return logits, {**arrays, "len": new_len}
+
+
+def paged_decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
+                      table: jnp.ndarray, cfg: LlamaConfig
+                      ) -> tuple[jnp.ndarray, dict]:
+    """One token per row against the paged pool. ``table`` [B, P_max]
+    maps each row's virtual pages (in order, so virtual positions are
+    contiguous and kv_len masking is exact). The new token's kv row
+    writes at (table[b, pos//page_s], pos % page_s); attention gathers
+    the row's pages back into a virtual [P_max * page_s] sequence.
+    """
+    from ..ops import (apply_rope, attention, repeat_kv, rms_norm,
+                       rope_table)
+
+    b = tokens.shape[0]
+    page_s = cache["k"].shape[2]
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    pos = cache["len"]                           # [B]
+    p_max = table.shape[1]
+    # over-capacity rows (pos pinned at S_virt) write into scratch page 0
+    # — the paged analogue of the dense path's dropped OOB scatters
+    page = jnp.where(
+        pos < p_max * page_s,
+        table[jnp.arange(b), jnp.minimum(pos // page_s, p_max - 1)], 0)
+    off = pos % page_s
+    x = params["embed"][tokens][:, None, :].astype(cfg.dtype)
+    cos, sin = rope_table(pos[:, None], cfg.head_dim, cfg.rope_theta)
+    rows = jnp.arange(b)
+
+    def body(carry, lp):
+        x, arrays, layer = carry
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = _mm(h, lp["wq"]).reshape(b, 1, H, hd)
+        k = _mm(h, lp["wk"]).reshape(b, 1, KV, hd)
+        v = _mm(h, lp["wv"]).reshape(b, 1, KV, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        dt = arrays["k"].dtype
+        arrays = {
+            "k": arrays["k"].at[layer, page, off].set(k[:, 0].astype(dt)),
+            "v": arrays["v"].at[layer, page, off].set(v[:, 0].astype(dt)),
+        }
+        k_l = jax.lax.dynamic_index_in_dim(arrays["k"], layer, 0,
+                                           keepdims=False)
+        v_l = jax.lax.dynamic_index_in_dim(arrays["v"], layer, 0,
+                                           keepdims=False)
+        # virtual sequence: gather this row's pages in table order
+        k_virt = jnp.take(k_l, table, axis=0).reshape(b, -1, KV, hd)
+        v_virt = jnp.take(v_l, table, axis=0).reshape(b, -1, KV, hd)
+        o = attention(q, repeat_kv(k_virt, cfg.n_rep),
+                      repeat_kv(v_virt, cfg.n_rep),
+                      causal=False, kv_len=pos + 1)
+        x = x + _mm(o.reshape(b, 1, H * hd), lp["wo"])
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + _swiglu(h2, lp)
+        return (x, arrays, layer + 1), None
+
+    arrays0 = {"k": cache["k"], "v": cache["v"]}
+    (x, arrays, _), _ = jax.lax.scan(
+        body, (x, arrays0, jnp.int32(0)), params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = _mm(x[:, 0], params["lm_head"]).astype(jnp.float32)
+    S_virt = table.shape[1] * page_s
+    return logits, {**arrays, "len": jnp.minimum(pos + 1, S_virt)}
+
+
 def decode_window(params: dict, toks: jnp.ndarray, cache: dict,
                   cfg: LlamaConfig, mesh=None) -> tuple[jnp.ndarray, dict]:
     """Speculative verify window: W tokens per row, starting at each row's

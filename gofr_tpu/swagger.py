@@ -3,8 +3,11 @@
 Mirrors the reference (pkg/gofr/swagger.go:22-55 + gofr.go:98-106): when
 ``./static/openapi.json`` exists, serve it at /.well-known/openapi.json and
 render a Swagger-UI page at /.well-known/swagger. The reference embeds the
-Swagger-UI assets; we render a minimal self-contained HTML viewer (no CDN
-dependency — zero-egress environments still get a usable spec browser).
+full Swagger-UI assets; we render a self-contained HTML viewer (no CDN
+dependency — zero-egress environments still get a usable browser) with the
+same core affordances: per-operation expansion, parameter/body inputs, and
+**"try it out"** execution against the live server with status + timing +
+pretty-printed response display.
 """
 
 from __future__ import annotations
@@ -23,12 +26,24 @@ _VIEWER_HTML = """<!DOCTYPE html>
 <style>
  body { font-family: system-ui, sans-serif; margin: 2rem; background: #fafafa; }
  h1 { color: #1a1a2e; } h2 { margin-top: 2rem; }
- .op { border: 1px solid #ddd; border-radius: 6px; margin: .5rem 0; padding: .7rem 1rem; background: #fff; }
+ .op { border: 1px solid #ddd; border-radius: 6px; margin: .5rem 0; background: #fff; }
+ .op-head { padding: .7rem 1rem; cursor: pointer; }
+ .op-body { display: none; padding: .7rem 1rem; border-top: 1px solid #eee; }
+ .op.open .op-body { display: block; }
  .method { display: inline-block; min-width: 4.5rem; font-weight: 700; }
  .GET { color: #0b7285; } .POST { color: #2b8a3e; } .PUT { color: #e67700; }
  .DELETE { color: #c92a2a; } .PATCH { color: #5f3dc4; }
  .path { font-family: ui-monospace, monospace; }
  .summary { color: #555; margin-left: .75rem; }
+ label { display: block; margin: .4rem 0 .15rem; font-size: .85rem; color: #444; }
+ input, textarea { width: 100%; box-sizing: border-box; font-family: ui-monospace, monospace;
+   padding: .35rem; border: 1px solid #ccc; border-radius: 4px; }
+ textarea { min-height: 5rem; }
+ button { margin-top: .6rem; padding: .45rem 1.1rem; border: 0; border-radius: 4px;
+   background: #1a1a2e; color: #fff; font-weight: 600; cursor: pointer; }
+ button:hover { background: #33335c; }
+ .result { margin-top: .6rem; }
+ .status { font-weight: 700; } .ok { color: #2b8a3e; } .err { color: #c92a2a; }
  pre { background: #f1f3f5; padding: 1rem; border-radius: 6px; overflow-x: auto; }
 </style>
 </head>
@@ -38,6 +53,12 @@ _VIEWER_HTML = """<!DOCTYPE html>
 <h2>Raw specification</h2>
 <pre id="raw"></pre>
 <script>
+function el(tag, attrs, text) {
+  const e = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs || {})) e.setAttribute(k, v);
+  if (text !== undefined) e.textContent = text;
+  return e;
+}
 fetch('/.well-known/openapi.json').then(r => r.json()).then(spec => {
   document.getElementById('title').textContent =
     (spec.info && spec.info.title) || 'API Documentation';
@@ -45,12 +66,76 @@ fetch('/.well-known/openapi.json').then(r => r.json()).then(spec => {
   const ops = document.getElementById('ops');
   for (const [path, methods] of Object.entries(spec.paths || {})) {
     for (const [method, op] of Object.entries(methods)) {
-      const div = document.createElement('div');
-      div.className = 'op';
+      // path items also carry non-operation keys (parameters, servers)
+      if (!['get','post','put','delete','patch','head','options']
+            .includes(method)) continue;
+      if (typeof op !== 'object' || op === null) continue;
       const m = method.toUpperCase();
-      div.innerHTML = '<span class="method ' + m + '">' + m + '</span>' +
-        '<span class="path">' + path + '</span>' +
-        '<span class="summary">' + ((op && op.summary) || '') + '</span>';
+      const div = el('div', {class: 'op'});
+      const head = el('div', {class: 'op-head'});
+      head.appendChild(el('span', {class: 'method ' + m}, m));
+      head.appendChild(el('span', {class: 'path'}, path));
+      head.appendChild(el('span', {class: 'summary'}, (op && op.summary) || ''));
+      div.appendChild(head);
+      const body = el('div', {class: 'op-body'});
+
+      // parameter inputs (path + query per the spec)
+      const params = (op.parameters || []).filter(
+        p => p.in === 'path' || p.in === 'query');
+      const inputs = {};
+      for (const p of params) {
+        body.appendChild(el('label', {}, p.in + ': ' + p.name +
+                            (p.required ? ' *' : '')));
+        inputs[p.name] = body.appendChild(
+          el('input', {placeholder: (p.schema && p.schema.type) || 'string'}));
+      }
+      // request body editor for methods that carry one
+      let bodyBox = null;
+      if (m !== 'GET' && m !== 'DELETE') {
+        body.appendChild(el('label', {}, 'request body (JSON)'));
+        bodyBox = body.appendChild(el('textarea', {}));
+        const rb = op.requestBody && op.requestBody.content &&
+          op.requestBody.content['application/json'];
+        if (rb && rb.example) bodyBox.value = JSON.stringify(rb.example, null, 2);
+      }
+      const btn = body.appendChild(el('button', {}, 'Execute'));
+      const result = body.appendChild(el('div', {class: 'result'}));
+      btn.onclick = async () => {
+        let url = path;
+        const qs = new URLSearchParams();
+        for (const p of params) {
+          const v = inputs[p.name].value;
+          if (p.in === 'path') url = url.replace('{' + p.name + '}',
+                                                 encodeURIComponent(v));
+          else if (v !== '') qs.set(p.name, v);
+        }
+        if ([...qs].length) url += '?' + qs.toString();
+        const init = {method: m, headers: {}};
+        if (bodyBox && bodyBox.value.trim() !== '') {
+          init.headers['Content-Type'] = 'application/json';
+          init.body = bodyBox.value;
+        }
+        result.textContent = '...';
+        const t0 = performance.now();
+        try {
+          const resp = await fetch(url, init);
+          const ms = Math.round(performance.now() - t0);
+          const text = await resp.text();
+          result.textContent = '';
+          result.appendChild(el('div', {class: 'status ' +
+                                        (resp.ok ? 'ok' : 'err')},
+                                resp.status + ' ' + resp.statusText +
+                                ' · ' + ms + ' ms'));
+          let shown = text;
+          try { shown = JSON.stringify(JSON.parse(text), null, 2); } catch (e) {}
+          result.appendChild(el('pre', {}, shown));
+        } catch (e) {
+          result.textContent = '';
+          result.appendChild(el('div', {class: 'status err'}, String(e)));
+        }
+      };
+      div.appendChild(body);
+      head.onclick = () => div.classList.toggle('open');
       ops.appendChild(div);
     }
   }

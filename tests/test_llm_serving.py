@@ -202,3 +202,26 @@ def test_http_and_ws_transports(model, run):
     http_tokens, ws_tokens = run(scenario())
     assert http_tokens == expect
     assert ws_tokens == expect
+
+
+def test_paged_pool_backpressure_requeues(model, run):
+    """With a page pool too small for every stream at once, admission hits
+    PagePoolExhausted; the server must REQUEUE (transient back-pressure),
+    not error the clients — all streams finish correctly."""
+    cfg, params = model
+    prompts = [[i + 1, i + 2, i + 3] for i in range(6)]
+    expects = [_expected(params, cfg, p, 4) for p in prompts]
+
+    async def scenario():
+        # 4 slots but pages for ~2 concurrent requests (8 tokens each)
+        server = LLMServer(Generator(params, cfg, batch_slots=4, max_seq=32,
+                                     prefill_buckets=(8,), chunk=2,
+                                     page_size=8, n_pages=3))
+        try:
+            return await asyncio.gather(
+                *(server.generate(p, 4) for p in prompts))
+        finally:
+            server.close()
+
+    outs = run(scenario())
+    assert outs == expects
