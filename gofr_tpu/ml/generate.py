@@ -160,10 +160,13 @@ class Generator:
                     and getattr(cfg, "sequence_parallel", False)):
                 raise ValueError(
                     "page_size doesn't compose with shard_cache/sp/spec yet")
-            for b in self.prefill_buckets:
+            for b in (*self.prefill_buckets, max_seq):
+                # max_seq included: it is the prefill-bucket fallback, and
+                # a non-multiple would silently drop trailing prompt rows
                 if b % self.page_size:
                     raise ValueError(
-                        f"prefill bucket {b} not a multiple of page_size")
+                        f"prefill bucket/max_seq {b} not a multiple of "
+                        f"page_size")
             self._p_max = -(-max_seq // self.page_size)
             self.n_pages = n_pages or (1 + batch_slots * self._p_max)
             self.cache = llama.init_paged_cache(
@@ -173,6 +176,12 @@ class Generator:
             self._slot_pages: list[list[int]] = [
                 [] for _ in range(batch_slots)]
             self._table = np.zeros((batch_slots, self._p_max), np.int32)
+            # shared-prefix bookkeeping: per-slot count of BORROWED pages
+            # (never freed back by this slot) and the owning prefix id
+            self._slot_shared = [0] * batch_slots
+            self._slot_prefix: list[int | None] = [None] * batch_slots
+            self._prefixes: dict[int, dict] = {}
+            self._next_prefix = 1
         elif shard_cache:
             # Multi-controller serving (ml/multihost.py): slots shard over
             # dp, kv heads over tp (matching SHARDING_RULES so decode never
@@ -312,6 +321,19 @@ class Generator:
                     p, t, l, cfg, c, row, slot, ps),
                 donate_argnums=(3,),
             )
+
+            def make_suffix_prefill(set_len: bool):
+                def f(p, t, l, c, row, start, slot):
+                    logits, c2 = llama.paged_suffix_prefill(
+                        p, t, l, cfg, c, row, start, ps)
+                    if set_len:  # a slot admission; prefix builds skip it
+                        c2 = {**c2,
+                              "len": c2["len"].at[slot].set(start + l[0])}
+                    return logits, c2
+                return jax.jit(f, donate_argnums=(3,))
+
+            self._suffix_prefill = make_suffix_prefill(True)
+            self._prefix_prefill = make_suffix_prefill(False)
         self._prefill_into = jax.jit(
             lambda p, t, l, c, slot: llama.prefill_into(p, t, l, cfg, c, slot,
                                                         mesh=mesh),
@@ -543,8 +565,23 @@ class Generator:
             self._table[slot, len(pages) - 1] = pg
         return True
 
+    def _pages_ever_free(self) -> int:
+        """Pool pages that could EVER be free: everything except the
+        scratch page and pages held by registered prefixes. A request
+        needing more than this can never admit — reject it instead of
+        requeueing forever."""
+        held = sum(len(i["pages"]) for i in self._prefixes.values())
+        return (self.n_pages - 1) - held
+
     def _free_slot_pages(self, slot: int) -> None:
-        self._free_pages.extend(self._slot_pages[slot])
+        shared = self._slot_shared[slot] if self.page_size else 0
+        self._free_pages.extend(self._slot_pages[slot][shared:])
+        if shared:
+            pid = self._slot_prefix[slot]
+            if pid in self._prefixes:
+                self._prefixes[pid]["refs"] -= 1
+            self._slot_shared[slot] = 0
+            self._slot_prefix[slot] = None
         self._slot_pages[slot] = []
         self._table[slot, :] = 0
 
@@ -567,6 +604,131 @@ class Generator:
     @property
     def free_pages(self) -> int:
         return len(self._free_pages) if self.page_size else 0
+
+    # -- shared-prefix prefill (paged mode) ----------------------------------
+    def register_prefix(self, prefix_ids) -> int:
+        """Compute a shared prefix's KV pages ONCE; requests then admit
+        with ``prefix=<id>`` and prefill only their SUFFIX while attending
+        the shared pages read-only. Sharing needs no copy-on-write: decode
+        never writes below a slot's own start position, so the prefix
+        pages are immutable by construction. Only WHOLE pages are shared —
+        the remainder (< page_size tokens) re-prefills with each suffix.
+
+        The vLLM-style system-prompt lever: N concurrent chat slots pay
+        the prefix's HBM and prefill compute once instead of N times.
+        """
+        if not self.page_size:
+            raise ValueError("prefix sharing requires page_size > 0")
+        ids = np.asarray(prefix_ids, np.int32).reshape(-1)
+        ps = self.page_size
+        shared_len = (len(ids) // ps) * ps
+        n_need = shared_len // ps
+        if len(self._free_pages) < n_need:
+            raise PagePoolExhausted(
+                f"prefix needs {n_need} pages, {self.free_pages} free")
+        pages = [self._free_pages.pop() for _ in range(n_need)]
+        if shared_len:
+            bucket = next((b for b in self.prefill_buckets
+                           if shared_len <= b), None)
+            if bucket is None:
+                for pg in pages:
+                    self._free_pages.append(pg)
+                raise ValueError(
+                    f"prefix length {shared_len} exceeds the largest "
+                    f"prefill bucket {self.prefill_buckets[-1]}")
+            row = np.zeros((self._p_max,), np.int32)
+            row[:n_need] = pages
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :shared_len] = ids[:shared_len]
+            with self._mesh_ctx():
+                _logits, self.cache = self._prefix_prefill(
+                    self.params, toks, np.array([shared_len], np.int32),
+                    self.cache, row, np.int32(0), np.int32(0),
+                )
+        pid = self._next_prefix
+        self._next_prefix += 1
+        self._prefixes[pid] = {"pages": pages, "len": shared_len,
+                               "tail": [int(t) for t in ids[shared_len:]],
+                               "refs": 0}
+        return pid
+
+    def drop_prefix(self, pid: int) -> None:
+        """Return a prefix's pages to the pool (no live borrowers)."""
+        info = self._prefixes[pid]
+        if info["refs"] > 0:
+            raise RuntimeError(f"prefix {pid} still used by {info['refs']} slots")
+        self._free_pages.extend(info["pages"])
+        del self._prefixes[pid]
+
+    def _admit_prefixed(self, pid: int, ids: np.ndarray, max_new: int,
+                        callback) -> int:
+        """Admit one request on top of a registered prefix: borrow its
+        pages, prefill only the suffix at start=shared_len."""
+        info = self._prefixes[pid]
+        suffix = info["tail"] + [int(t) for t in ids]
+        n_suf = len(suffix)
+        start = info["len"]
+        if n_suf == 0:
+            raise ValueError("prompt adds no tokens beyond the prefix")
+        if start + n_suf >= self.max_seq:
+            raise ValueError(
+                f"prefix {start} + suffix {n_suf} exceeds max_seq")
+        self.drain()  # settle bookkeeping before reusing slots
+        slot = self.free_slot()
+        if slot is None:
+            raise RuntimeError("no free generation slot")
+        self.slots[slot].live = True  # reserve
+        if self._slot_pages[slot]:
+            # a reused dead slot still holds its previous pages — return
+            # them first or overwriting the list would leak them forever
+            self._free_slot_pages(slot)
+        try:
+            shared = info["pages"]
+            self._slot_pages[slot] = list(shared)
+            self._slot_shared[slot] = len(shared)
+            self._slot_prefix[slot] = pid
+            info["refs"] += 1  # the except path's _free_slot_pages unrefs
+            self._table[slot, :len(shared)] = shared
+            upto = min(start + n_suf + 2 * self.chunk,
+                       start + n_suf + max_new, self.max_seq)
+            if not self._alloc_pages_to(slot, upto):
+                need_own = -(-upto // self.page_size) - len(shared)
+                if need_own > self._pages_ever_free():
+                    raise ValueError(
+                        f"request needs {need_own} own pages but the pool "
+                        f"can only ever free {self._pages_ever_free()}")
+                raise PagePoolExhausted(
+                    f"kv page pool exhausted ({self.free_pages} pages free)")
+            bucket = next((b for b in self.prefill_buckets if n_suf <= b),
+                          None)
+            if bucket is None:
+                raise ValueError(
+                    f"suffix length {n_suf} exceeds the largest "
+                    f"prefill bucket {self.prefill_buckets[-1]}")
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :n_suf] = suffix
+            lens = np.array([n_suf], np.int32)
+            with self._mesh_ctx():
+                logits, self.cache = self._suffix_prefill(
+                    self.params, toks, lens, self.cache,
+                    self._table[slot].copy(), np.int32(start),
+                    np.int32(slot),
+                )
+                self._after_prefill(logits, toks, lens, np.int32(slot))
+        except Exception:
+            self.slots[slot].live = False
+            self._free_slot_pages(slot)
+            raise
+        self._n_requests += 1
+        self._pending_first.append(slot)
+        s = _Slot()
+        s.live = True
+        s.max_new = max_new
+        s.produced = 1  # the pending first token counts as sampled
+        s.prompt_len = start + n_suf
+        s.callback = callback
+        self.slots[slot] = s
+        return slot
 
     def _host_visible(self, x):
         """Force replicated layout on arrays the host will read — in
@@ -679,10 +841,16 @@ class Generator:
         return None
 
     def add_request(self, prompt_ids, max_new_tokens: int,
-                    callback=None) -> int:
+                    callback=None, prefix: int | None = None) -> int:
         """Prefill the prompt into a free slot; returns the slot index.
         ``callback(slot, tokens)`` receives each arriving BURST of sampled
-        tokens (a list: the slot's share of one processed chunk)."""
+        tokens (a list: the slot's share of one processed chunk).
+        ``prefix`` (paged mode) continues from a ``register_prefix``
+        result — only the suffix prefills."""
+        if prefix is not None:
+            ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+            return self._admit_prefixed(prefix, ids, max_new_tokens,
+                                        callback)
         return self.add_requests([(prompt_ids, max_new_tokens, callback)])[0]
 
     def add_requests(self, requests) -> list[int]:
@@ -761,6 +929,11 @@ class Generator:
             try:
                 with self._mesh_ctx():
                     if self.page_size:
+                        if self._slot_shared[slots[0]]:
+                            # previous occupant borrowed prefix pages:
+                            # reusing its list would write INTO the shared
+                            # prefix — reset to a fresh own-page list
+                            self._free_slot_pages(slots[0])
                         # admission control: no pages, no slot — the
                         # caller requeues on PagePoolExhausted instead of
                         # risking a silent mid-generation eviction. The
@@ -769,6 +942,12 @@ class Generator:
                                    int(lens[0]) + wave[0][2],
                                    self.max_seq)
                         if not self._alloc_pages_to(slots[0], upto):
+                            need = -(-upto // self.page_size)
+                            if need > self._pages_ever_free():
+                                raise ValueError(
+                                    f"request needs {need} pages but the "
+                                    f"pool can only ever free "
+                                    f"{self._pages_ever_free()}")
                             raise PagePoolExhausted(
                                 "kv page pool exhausted "
                                 f"({self.free_pages} pages free)")

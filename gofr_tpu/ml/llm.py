@@ -31,9 +31,9 @@ _DONE = object()
 
 class _Request:
     __slots__ = ("prompt", "max_new", "out_q", "loop", "enqueued_at", "slot",
-                 "first_token_at", "cancelled")
+                 "first_token_at", "cancelled", "prefix")
 
-    def __init__(self, prompt, max_new, out_q, loop) -> None:
+    def __init__(self, prompt, max_new, out_q, loop, prefix=None) -> None:
         self.prompt = prompt
         self.max_new = max_new
         self.out_q = out_q
@@ -42,6 +42,7 @@ class _Request:
         self.slot = None
         self.first_token_at = None
         self.cancelled = False  # consumer went away: stop decoding the slot
+        self.prefix = prefix    # registered shared-prefix id (paged mode)
 
 
 class LLMServer:
@@ -62,6 +63,7 @@ class LLMServer:
         self._idle_backoff = idle_wait_s
         self._admit_window = admit_window_s
         self._requests: _queue.Queue[_Request | None] = _queue.Queue()
+        self._setup_q: _queue.Queue = _queue.Queue()  # run-on-serving-thread
         self._waiting: list[_Request] = []
         self._active: dict[int, _Request] = {}
         self._closed = False
@@ -80,6 +82,7 @@ class LLMServer:
 
     def _serve(self) -> None:
         while not self._closed:
+            self._run_setup_tasks()
             self._reap_cancelled()
             self._admit_waiting()
             if self.gen.n_live:
@@ -122,6 +125,46 @@ class LLMServer:
                         self._closed = True
                         return
                     self._waiting.append(more)
+
+    def _run_setup_tasks(self) -> None:
+        """Drain device-touching setup work (e.g. register_prefix) onto
+        the serving thread — the one thread allowed to dispatch."""
+        while True:
+            try:
+                work = self._setup_q.get_nowait()
+            except _queue.Empty:
+                return
+            work()
+
+    def register_prefix(self, prefix_ids, timeout_s: float = 120.0) -> int:
+        """Register a shared prefix (system prompt) on the paged
+        Generator; returns the id to pass as ``prefix=`` to
+        stream/generate. Thread-safe: the prefill runs on the serving
+        thread (it may wait one idle-poll interval, <= 50 ms, plus the
+        prefix compile on first use)."""
+        done = threading.Event()
+        box: dict = {}
+
+        def work() -> None:
+            try:
+                box["pid"] = self.gen.register_prefix(prefix_ids)
+            except Exception as exc:  # relayed to the caller below
+                box["err"] = exc
+            finally:
+                done.set()
+
+        if self._closed:
+            raise RuntimeError("llm server is closed")
+        self._setup_q.put(work)
+        deadline = time.monotonic() + timeout_s
+        while not done.wait(0.1):
+            if self._closed:  # serving thread gone: fail fast, not 120 s
+                raise RuntimeError("llm server is closed")
+            if time.monotonic() > deadline:
+                raise TimeoutError("register_prefix timed out")
+        if "err" in box:
+            raise box["err"]
+        return box["pid"]
 
     def _flush_on_close(self) -> None:
         """The serving thread is exiting: every parked or still-queued
@@ -199,11 +242,18 @@ class LLMServer:
             if not batch:
                 continue
             try:
-                slots = self.gen.add_requests([
-                    (ids, req.max_new,
-                     (lambda i, toks, r=req: self._emit(r, toks)))
-                    for req, ids in batch
-                ])
+                if len(batch) == 1 and batch[0][0].prefix is not None:
+                    req, ids = batch[0]
+                    slots = [self.gen.add_request(
+                        ids, req.max_new,
+                        (lambda i, toks, r=req: self._emit(r, toks)),
+                        prefix=req.prefix)]
+                else:
+                    slots = self.gen.add_requests([
+                        (ids, req.max_new,
+                         (lambda i, toks, r=req: self._emit(r, toks)))
+                        for req, ids in batch
+                    ])
             except PagePoolExhausted:
                 # transient paged-KV back-pressure: pages free as live
                 # slots finish, so requeue the whole batch (front, FIFO)
@@ -277,7 +327,8 @@ class LLMServer:
                 req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
 
     # -- async API ------------------------------------------------------------
-    async def stream_chunks(self, prompt_ids, max_new_tokens: int = 64
+    async def stream_chunks(self, prompt_ids, max_new_tokens: int = 64,
+                            prefix: int | None = None
                             ) -> AsyncIterator[list[int]]:
         """Yield BURSTS of tokens — each list is the slot's share of one
         processed decode chunk (the first is ``[first_token]`` from the
@@ -289,7 +340,8 @@ class LLMServer:
             raise RuntimeError("llm server is closed")
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue = asyncio.Queue()
-        req = _Request(prompt_ids, max_new_tokens, out_q, loop)
+        req = _Request(prompt_ids, max_new_tokens, out_q, loop,
+                       prefix=prefix)
         self._requests.put(req)
         if self._closed:
             # close() may have drained the queue before our put landed —
@@ -313,11 +365,11 @@ class LLMServer:
             # decoding to max_new_tokens for nobody
             req.cancelled = True
 
-    async def stream(self, prompt_ids, max_new_tokens: int = 64
-                     ) -> AsyncIterator[int]:
+    async def stream(self, prompt_ids, max_new_tokens: int = 64,
+                     prefix: int | None = None) -> AsyncIterator[int]:
         """Yield tokens as the device produces them (token-at-a-time view
         of ``stream_chunks``)."""
-        agen = self.stream_chunks(prompt_ids, max_new_tokens)
+        agen = self.stream_chunks(prompt_ids, max_new_tokens, prefix=prefix)
         try:
             async for burst in agen:
                 for tok in burst:
@@ -327,10 +379,12 @@ class LLMServer:
             # cancelled); leaving it to GC delays slot reaping arbitrarily
             await agen.aclose()
 
-    async def generate(self, prompt_ids, max_new_tokens: int = 64) -> list[int]:
+    async def generate(self, prompt_ids, max_new_tokens: int = 64,
+                       prefix: int | None = None) -> list[int]:
         """Collect the full completion."""
         out: list[int] = []
-        async for burst in self.stream_chunks(prompt_ids, max_new_tokens):
+        async for burst in self.stream_chunks(prompt_ids, max_new_tokens,
+                                              prefix=prefix):
             out.extend(burst)
         return out
 

@@ -664,6 +664,77 @@ def paged_prefill_into(params: dict, tokens: jnp.ndarray,
     return logits, {**arrays, "len": new_len}
 
 
+def paged_suffix_prefill(params: dict, tokens: jnp.ndarray,
+                         seq_lens: jnp.ndarray, cfg: LlamaConfig,
+                         cache: dict, table_row: jnp.ndarray,
+                         start, page_s: int
+                         ) -> tuple[jnp.ndarray, dict]:
+    """Prefill ONE sequence segment [1, S_pad] at virtual positions
+    ``start..start+S_pad-1`` of a paged slot — the engine behind
+    shared-prefix serving: the common prefix's kv pages are computed once
+    (``start=0``) and every request then prefills only its SUFFIX
+    (``start=shared_len``), attending the shared pages through the same
+    table. Rows beyond ``seq_lens`` write garbage at positions decode
+    will overwrite before any masked read can reach them (the dense
+    prefill_into argument). Returns last-valid-token logits [1, V].
+    """
+    if cfg.kv_quant:
+        raise ValueError("paged cache requires the fp KV layout")
+    from ..ops import apply_rope, attention, repeat_kv, rms_norm, rope_table
+
+    b, s = tokens.shape
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    start = jnp.asarray(start, jnp.int32)
+    positions = start + jnp.arange(s)[None, :]            # [1, S_pad]
+    vpos = positions[0]                                   # [S_pad]
+    p_max = table_row.shape[0]
+    # positions past virtual capacity write into scratch page 0 (same
+    # guard as paged_decode_step) — never into a wrapped real page
+    page = jnp.where(vpos < p_max * page_s,
+                     table_row[jnp.minimum(vpos // page_s, p_max - 1)], 0)
+    off = vpos % page_s
+    x = params["embed"][tokens].astype(cfg.dtype)
+    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(carry, lp):
+        x, arrays, layer = carry
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = _mm(h, lp["wq"]).reshape(b, s, H, hd)
+        k = _mm(h, lp["wk"]).reshape(b, s, KV, hd)
+        v = _mm(h, lp["wv"]).reshape(b, s, KV, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        dt = arrays["k"].dtype
+        arrays = {
+            "k": arrays["k"].at[layer, page, off].set(k[0].astype(dt)),
+            "v": arrays["v"].at[layer, page, off].set(v[0].astype(dt)),
+        }
+        k_l = jax.lax.dynamic_index_in_dim(arrays["k"], layer, 0,
+                                           keepdims=False)
+        v_l = jax.lax.dynamic_index_in_dim(arrays["v"], layer, 0,
+                                           keepdims=False)
+        # virtual sequence for this ONE slot: [1, P_max*page_s, KV, hd]
+        k_virt = jnp.take(k_l, table_row, axis=0).reshape(1, -1, KV, hd)
+        v_virt = jnp.take(v_l, table_row, axis=0).reshape(1, -1, KV, hd)
+        # causal from the segment's absolute offset: suffix token t
+        # attends every prefix position plus the window up to itself
+        o = attention(q, repeat_kv(k_virt, cfg.n_rep),
+                      repeat_kv(v_virt, cfg.n_rep),
+                      causal=True, q_offset=start)
+        x = x + _mm(o.reshape(b, s, H * hd), lp["wo"])
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + _swiglu(h2, lp)
+        return (x, arrays, layer + 1), None
+
+    arrays0 = {"k": cache["k"], "v": cache["v"]}
+    (x, arrays, _), _ = jax.lax.scan(
+        body, (x, arrays0, jnp.int32(0)), params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = x[jnp.arange(b), seq_lens - 1]                 # [1, D]
+    logits = _mm(last, params["lm_head"]).astype(jnp.float32)
+    return logits, {**arrays, "len": cache["len"]}
+
+
 def paged_decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
                       table: jnp.ndarray, cfg: LlamaConfig
                       ) -> tuple[jnp.ndarray, dict]:

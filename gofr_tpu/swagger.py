@@ -79,9 +79,10 @@ fetch('/.well-known/openapi.json').then(r => r.json()).then(spec => {
       div.appendChild(head);
       const body = el('div', {class: 'op-body'});
 
-      // parameter inputs (path + query per the spec)
-      const params = (op.parameters || []).filter(
-        p => p.in === 'path' || p.in === 'query');
+      // parameter inputs: path-item-level parameters apply to every
+      // operation under the path; merge them with the op's own
+      const params = (methods.parameters || []).concat(op.parameters || [])
+        .filter(p => p.in === 'path' || p.in === 'query');
       const inputs = {};
       for (const p of params) {
         body.appendChild(el('label', {}, p.in + ': ' + p.name +

@@ -32,6 +32,7 @@ _DEFAULTS = {
     "mongo": ("localhost", 27017),
     "cassandra": ("localhost", 9042),
     "nats": ("localhost", 4222),
+    "clickhouse": ("localhost", 8123),
 }
 
 
@@ -71,6 +72,7 @@ postgres = _service_fixture("postgres")
 mongo = _service_fixture("mongo")
 cassandra = _service_fixture("cassandra")
 nats = _service_fixture("nats")
+clickhouse = _service_fixture("clickhouse")
 
 
 @pytest.fixture

@@ -184,3 +184,29 @@ def test_nats_core_and_jetstream(nats, unique, run):
             await n.close()
 
     run(scenario())
+
+
+# ------------------------------------------------------------ clickhouse
+def test_clickhouse_ddl_insert_select(clickhouse, unique, run):
+    from gofr_tpu.datasource.clickhouse import ClickHouse
+
+    async def scenario():
+        ch = ClickHouse(host=clickhouse[0], port=clickhouse[1])
+        try:
+            await ch.exec(f"CREATE TABLE {unique} "
+                          f"(id UInt32, name String) ENGINE = Memory")
+            await ch.insert_rows(unique, [{"id": 1, "name": "ada"},
+                                          {"id": 2, "name": "bob"}])
+            rows = await ch.select(
+                f"SELECT id, name FROM {unique} ORDER BY id")
+            assert rows == [{"id": 1, "name": "ada"},
+                            {"id": 2, "name": "bob"}]
+            health = await ch.health_check()
+            assert health["status"] == "UP"
+        finally:
+            try:
+                await ch.exec(f"DROP TABLE IF EXISTS {unique}")
+            finally:
+                await ch.close()
+
+    run(scenario())

@@ -278,3 +278,69 @@ def test_paged_pool_exhaustion_truncates_not_corrupts(setup):
     dense = Generator(params, cfg, batch_slots=1, max_seq=32,
                       prefill_buckets=(8,))
     assert gen.generate([2, 7], 5) == dense.generate([2, 7], 5)
+
+
+def test_shared_prefix_matches_full_prompt(setup):
+    """register_prefix + suffix admission must reproduce the full-prompt
+    decode exactly: the suffix attends the shared pages with the right
+    rope offsets, and two slots BORROW the same physical pages."""
+    from gofr_tpu.ml.generate import Generator
+
+    cfg, params = setup
+    prefix = [5, 9, 2, 7, 1, 4, 8, 3]          # one full page of 8
+    suffixes = [[6, 2], [9, 9, 1]]
+
+    dense = Generator(params, cfg, batch_slots=1, max_seq=32,
+                      prefill_buckets=(16,))
+    expects = [dense.generate(prefix + sfx, max_new_tokens=6)
+               for sfx in suffixes]
+
+    gen = Generator(params, cfg, batch_slots=2, max_seq=32,
+                    prefill_buckets=(8, 16), chunk=2, page_size=8)
+    pid = gen.register_prefix(prefix)
+    streamed: dict[int, list[int]] = {}
+    slots = [gen.add_request(
+        sfx, 6, prefix=pid,
+        callback=lambda i, toks: streamed.setdefault(i, []).extend(toks))
+        for sfx in suffixes]
+    # both slots' tables start with the SAME physical page (borrowed)
+    assert gen._table[slots[0], 0] == gen._table[slots[1], 0] != 0
+    while gen.n_live:
+        gen.step()
+    gen.drain()
+    for slot, expect in zip(slots, expects):
+        assert streamed[slot] == expect
+    for slot in slots:
+        gen.release(slot)
+    # borrowed pages stayed with the prefix; own pages returned
+    assert gen._prefixes[pid]["refs"] == 0
+    gen.drop_prefix(pid)
+    assert gen.free_pages == gen.n_pages - 1
+
+
+def test_shared_prefix_partial_page_tail(setup):
+    """A prefix that is not page-aligned shares only its whole pages; the
+    tail tokens re-prefill with each suffix — output still exact."""
+    from gofr_tpu.ml.generate import Generator
+
+    cfg, params = setup
+    prefix = [5, 9, 2, 7, 1, 4, 8, 3, 6, 6]    # 8 shared + tail [6, 6]
+    suffix = [2, 2]
+
+    dense = Generator(params, cfg, batch_slots=1, max_seq=32,
+                      prefill_buckets=(16,))
+    expect = dense.generate(prefix + suffix, max_new_tokens=6)
+
+    gen = Generator(params, cfg, batch_slots=2, max_seq=32,
+                    prefill_buckets=(8, 16), chunk=2, page_size=8)
+    pid = gen.register_prefix(prefix)
+    assert gen._prefixes[pid]["len"] == 8
+    assert gen._prefixes[pid]["tail"] == [6, 6]
+    streamed: dict[int, list[int]] = {}
+    slot = gen.add_request(
+        suffix, 6, prefix=pid,
+        callback=lambda i, toks: streamed.setdefault(i, []).extend(toks))
+    while gen.n_live:
+        gen.step()
+    gen.drain()
+    assert streamed[slot] == expect

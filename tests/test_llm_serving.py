@@ -225,3 +225,27 @@ def test_paged_pool_backpressure_requeues(model, run):
 
     outs = run(scenario())
     assert outs == expects
+
+
+def test_shared_prefix_through_server(model, run):
+    """register_prefix on the live server (runs on the serving thread) +
+    prefix= streaming: output equals the full-prompt decode, concurrent
+    streams share the prefix pages."""
+    cfg, params = model
+    prefix = [5, 9, 2, 7, 1, 4, 8, 3]
+    suffixes = [[6, 2], [9, 1, 1]]
+    expects = [_expected(params, cfg, prefix + sfx, 5) for sfx in suffixes]
+
+    async def scenario():
+        server = LLMServer(Generator(params, cfg, batch_slots=2, max_seq=64,
+                                     prefill_buckets=(8, 16), chunk=2,
+                                     page_size=8))
+        try:
+            pid = await asyncio.to_thread(server.register_prefix, prefix)
+            return await asyncio.gather(
+                *(server.generate(sfx, 5, prefix=pid) for sfx in suffixes))
+        finally:
+            server.close()
+
+    outs = run(scenario())
+    assert outs == expects
