@@ -95,6 +95,54 @@ def _prepare(ctx, prompt_text: str, body: dict):
     return ids, max_new, llm
 
 
+_PREFIX_CACHE_CAP = 8  # distinct system prompts cached per server
+
+
+async def _cached_prefix(llm, messages, prompt_text: str):
+    """Auto-cache leading system messages as a shared KV prefix when the
+    generator is paged: the common chat pattern reuses one system prompt
+    across every conversation, so its prefill compute and KV pages pay
+    once instead of per request.
+
+    Returns (prefix_id | None, prompt_or_suffix_ids, full_prompt_len).
+    Guard: the suffix split must re-tokenize identically to the full
+    prompt (merges could straddle the boundary on a trained vocab);
+    otherwise fall back to the plain path."""
+    import asyncio
+
+    if not getattr(llm.gen, "page_size", 0):
+        ids = TOKENIZER.encode(prompt_text)
+        return None, ids, len(ids)
+    n_sys = 0
+    while (n_sys < len(messages)
+           and messages[n_sys].get("role") == "system"):
+        n_sys += 1
+    if n_sys == 0:
+        ids = TOKENIZER.encode(prompt_text)
+        return None, ids, len(ids)
+    sys_text = "\n".join(
+        f"{m.get('role', 'user')}: {m.get('content', '')}"
+        for m in messages[:n_sys]) + "\n"
+    ids_full = TOKENIZER.encode(prompt_text)
+    ids_sys = TOKENIZER.encode(sys_text)
+    if ids_full[:len(ids_sys)] != ids_sys:
+        return None, ids_full, len(ids_full)
+    # per-server cache: a module-level map would hand a rebooted server
+    # prefix ids registered on a dead generator
+    cache = getattr(llm, "_openai_prefix_cache", None)
+    if cache is None:
+        cache = llm._openai_prefix_cache = {}
+    key = tuple(ids_sys)
+    pid = cache.get(key)
+    if pid is None:
+        if len(cache) >= _PREFIX_CACHE_CAP:
+            return None, ids_full, len(ids_full)  # bounded: no churn
+        # one-time prefill on the serving thread; don't block the loop
+        pid = await asyncio.to_thread(llm.register_prefix, ids_sys)
+        cache[key] = pid
+    return pid, ids_full[len(ids_sys):], len(ids_full)
+
+
 def _chunk(kind: str, rid: str, created: int, choices) -> dict:
     return {"id": rid, "object": kind, "created": created,
             "model": MODEL_ID, "choices": choices}
@@ -105,7 +153,9 @@ async def chat_completions(ctx: gofr_tpu.Context):
     messages = body.get("messages")
     if not messages:
         raise gofr_tpu.errors.MissingParam("messages")
-    ids, max_new, llm = _prepare(ctx, _render_chat(messages), body)
+    _, max_new, llm = _prepare(ctx, "", body)
+    prefix, ids, n_prompt = await _cached_prefix(
+        llm, messages, _render_chat(messages))
     rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
     created = int(time.time())
 
@@ -118,7 +168,8 @@ async def chat_completions(ctx: gofr_tpu.Context):
             dec = _StreamDecoder()
             # one SSE chunk per decode-chunk burst (a delta may carry
             # several tokens' text — valid OpenAI protocol, far fewer frames)
-            async for burst in llm.stream_chunks(ids, max_new):
+            async for burst in llm.stream_chunks(ids, max_new,
+                                                 prefix=prefix):
                 n_out += len(burst)
                 await stream.send(_chunk(
                     "chat.completion.chunk", rid, created,
@@ -136,11 +187,11 @@ async def chat_completions(ctx: gofr_tpu.Context):
             if (body.get("stream_options") or {}).get("include_usage"):
                 await stream.send({**_chunk("chat.completion.chunk", rid,
                                             created, []),
-                                   "usage": _usage(len(ids), n_out)})
+                                   "usage": _usage(n_prompt, n_out)})
             await stream.done()
         return stream.response
 
-    toks = await llm.generate(ids, max_new)
+    toks = await llm.generate(ids, max_new, prefix=prefix)
     return gofr_tpu.Raw({
         "id": rid, "object": "chat.completion", "created": created,
         "model": MODEL_ID,
@@ -150,7 +201,7 @@ async def chat_completions(ctx: gofr_tpu.Context):
                         "content": _decode(toks)},
             "finish_reason": "stop" if len(toks) < max_new else "length",
         }],
-        "usage": _usage(len(ids), len(toks)),
+        "usage": _usage(n_prompt, len(toks)),
     })
 
 
@@ -228,6 +279,9 @@ def main() -> gofr_tpu.App:
         sampler=Sampler(temperature=float(os.environ.get("LLM_TEMPERATURE", "0"))),
         eos_id=getattr(cfg, "eos_id", None),
         spec_k=int(os.environ.get("LLM_SPEC_K", "0")),
+        # paged pool enables automatic system-prompt prefix caching
+        page_size=int(os.environ.get("LLM_PAGE_SIZE", "0")),
+        n_pages=int(os.environ.get("LLM_PAGES", "0")) or None,
     )
     app.post("/v1/chat/completions", chat_completions)
     app.post("/v1/completions", completions)

@@ -128,3 +128,51 @@ def test_streaming_chat_and_completions(run):
             await app.shutdown()
 
     run(scenario())
+
+
+def test_chat_system_prompt_prefix_caching(run):
+    """With a paged generator (LLM_PAGE_SIZE), leading system messages
+    auto-register as a shared KV prefix: two chats with the same system
+    prompt share it (one registration), and the completion equals the
+    uncached path's byte-for-byte."""
+    async def scenario():
+        import aiohttp
+
+        with example_env(LLM_SLOTS="2", LLM_CHUNK="2"):
+            from examples.openai_server.main import main
+
+            # uncached reference
+            app = main()
+            base = await _booted(app)
+            body = {"messages": [
+                {"role": "system", "content": "be terse and helpful ok"},
+                {"role": "user", "content": "hi"}],
+                "max_tokens": 6}
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(base + "/v1/chat/completions", json=body)
+                ref = (await r.json())["choices"][0]["message"]["content"]
+            await app.shutdown()
+
+        with example_env(LLM_SLOTS="2", LLM_CHUNK="2", LLM_PAGE_SIZE="8"):
+            from examples.openai_server.main import main
+
+            app = main()
+            base = await _booted(app)
+            llm = app.container.ml.llm("gofr-llama")
+            assert llm.gen.page_size == 8
+            async with aiohttp.ClientSession() as s:
+                outs = []
+                for _ in range(2):
+                    r = await s.post(base + "/v1/chat/completions",
+                                     json=body)
+                    outs.append(
+                        (await r.json())["choices"][0]["message"]["content"])
+            cache = getattr(llm, "_openai_prefix_cache", {})
+            assert len(cache) == 1          # registered exactly once
+            pid = next(iter(cache.values()))
+            assert llm.gen._prefixes[pid]["len"] > 0
+            await app.shutdown()
+            return ref, outs
+
+    ref, outs = run(scenario())
+    assert outs == [ref, ref]
