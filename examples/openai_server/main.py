@@ -128,18 +128,37 @@ async def _cached_prefix(llm, messages, prompt_text: str):
     if ids_full[:len(ids_sys)] != ids_sys:
         return None, ids_full, len(ids_full)
     # per-server cache: a module-level map would hand a rebooted server
-    # prefix ids registered on a dead generator
+    # prefix ids registered on a dead generator. Values: int pid, a
+    # Future (registration in flight — concurrent first requests await it
+    # instead of double-registering and leaking pages), or None
+    # (negative-cached: registration failed once, don't re-attempt).
     cache = getattr(llm, "_openai_prefix_cache", None)
     if cache is None:
         cache = llm._openai_prefix_cache = {}
     key = tuple(ids_sys)
-    pid = cache.get(key)
-    if pid is None:
-        if len(cache) >= _PREFIX_CACHE_CAP:
-            return None, ids_full, len(ids_full)  # bounded: no churn
+    if key in cache:
+        entry = cache[key]
+        if isinstance(entry, asyncio.Future):
+            entry = await entry
+        if entry is None:
+            return None, ids_full, len(ids_full)
+        return entry, ids_full[len(ids_sys):], len(ids_full)
+    if len(cache) >= _PREFIX_CACHE_CAP:
+        return None, ids_full, len(ids_full)  # bounded: no churn
+    fut = asyncio.get_running_loop().create_future()
+    cache[key] = fut  # reserve BEFORE awaiting: no check-then-act race
+    try:
         # one-time prefill on the serving thread; don't block the loop
         pid = await asyncio.to_thread(llm.register_prefix, ids_sys)
-        cache[key] = pid
+    except Exception:
+        # caching is an optimization: the uncached path serves the same
+        # request (docs promise a silent fallback), and the negative
+        # entry stops every later request re-attempting a doomed prefill
+        pid = None
+    cache[key] = pid
+    fut.set_result(pid)
+    if pid is None:
+        return None, ids_full, len(ids_full)
     return pid, ids_full[len(ids_sys):], len(ids_full)
 
 
@@ -153,7 +172,8 @@ async def chat_completions(ctx: gofr_tpu.Context):
     messages = body.get("messages")
     if not messages:
         raise gofr_tpu.errors.MissingParam("messages")
-    _, max_new, llm = _prepare(ctx, "", body)
+    max_new = int(body.get("max_tokens") or 64)
+    llm = ctx.ml.llm(MODEL_ID)
     prefix, ids, n_prompt = await _cached_prefix(
         llm, messages, _render_chat(messages))
     rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
