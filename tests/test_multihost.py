@@ -301,6 +301,16 @@ def test_multihost_serving_topology(tmp_path, run):
             toks2 = await llm.generate([3, 1], 4)
             assert toks2 == _reference_greedy([3, 1], 4)
 
+            # malformed request: out-of-vocab ids get an error FRAME (the
+            # r4 hardening — an unvalidated frame once int32-overflowed
+            # the broadcast and tore the mesh down); mesh keeps serving
+            try:
+                await llm.generate([10**7], 4)
+                raise AssertionError("out-of-vocab prompt was accepted")
+            except RuntimeError as exc:
+                assert "token ids" in str(exc)
+            assert await llm.generate([3, 1], 4) == toks2
+
             # CONCURRENT DISTINCT prompts (r3 verdict: the dp axis must
             # serve different requests, not clones): three multiplexed
             # generations share the continuous-batching slots and each
