@@ -155,11 +155,11 @@ class Generator:
             # so the same memory serves more concurrent long-context slots
             # (config7). n_pages defaults to the dense-equivalent so the
             # operator dials capacity down explicitly.
-            if shard_cache or spec_k or (
+            if shard_cache or (
                     mesh is not None
                     and getattr(cfg, "sequence_parallel", False)):
                 raise ValueError(
-                    "page_size doesn't compose with shard_cache/sp/spec yet")
+                    "page_size doesn't compose with shard_cache/sp yet")
             for b in (*self.prefill_buckets, max_seq):
                 # max_seq included: it is the prefill-bucket fallback, and
                 # a non-multiple would silently drop trailing prompt rows
@@ -435,12 +435,15 @@ class Generator:
             src = jnp.where(start >= 0, start + npick, h - 1)
             return jax.lax.dynamic_slice(td_row, (src,), (K,))
 
+        paged = bool(self.page_size)
+
         def make_spec_chunk_fn(n_windows: int):
-            def spec_chunk_fn(params, tok, cache, tokens_dev):
+            def spec_chunk_fn(params, tok, cache, tokens_dev, table=None):
                 """``n_windows`` draft→verify→accept rounds. Returns
                 (input token row [B] — the firsts ride-along, as in the
                 plain chunk — emitted candidates [W, B, K+1], emit counts
-                [W, B], final carry tok, cache, tokens_dev)."""
+                [W, B], final carry tok, cache, tokens_dev). Paged mode
+                routes window writes/reads through the page table."""
                 tok_in = tok
                 ar = jnp.arange(K + 1)[None, :]
                 rows = jnp.arange(B)
@@ -450,8 +453,14 @@ class Generator:
                     h = cache["len"] + 1  # [B] history length
                     draft = jax.vmap(draft_row)(td, h)           # [B, K]
                     window = jnp.concatenate([tok[:, None], draft], axis=1)
-                    logits, cache = llama.decode_window(
-                        params, window, cache, cfg, mesh=mesh)
+                    if paged:
+                        logits, cache = llama.paged_decode_window(
+                            params, window, cache, table, cfg)
+                        S_max = table.shape[1] * self.page_size
+                    else:
+                        logits, cache = llama.decode_window(
+                            params, window, cache, cfg, mesh=mesh)
+                        S_max = cache["k"].shape[2]
                     greedy_t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                     match = (draft == greedy_t[:, :K]).astype(jnp.int32)
                     n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
@@ -464,7 +473,6 @@ class Generator:
                         ar < n_acc[:, None], draft_pad,
                         jnp.where(ar == n_acc[:, None], g_last, 0))
                     n_emit = n_acc + 1
-                    S_max = cache["k"].shape[2]
                     cache = {**cache,
                              "len": jnp.minimum(cache["len"] + n_emit, S_max)}
                     # append emitted tokens to history; rejected positions
@@ -590,7 +598,8 @@ class Generator:
         lags one chunk, so cover produced + a pipeline margin. A dry pool
         TRUNCATES the growing slot — it finishes early with the tokens it
         has (counted in ``evictions``) rather than corrupting neighbors."""
-        margin = self.chunk * (len(self._inflight) + 2)
+        per_dispatch = (self.spec_k + 1) if self.spec_k else 1
+        margin = self.chunk * (len(self._inflight) + 2) * per_dispatch
         for i, s in enumerate(self.slots):
             if not s.live:
                 continue
@@ -619,6 +628,12 @@ class Generator:
         """
         if not self.page_size:
             raise ValueError("prefix sharing requires page_size > 0")
+        if self.spec_k:
+            # guard at REGISTRATION so callers with a silent-fallback path
+            # (the OpenAI server's auto cache) fail here once and
+            # negative-cache, instead of poisoning every later admission
+            raise ValueError(
+                "prefix sharing doesn't compose with speculative decode yet")
         ids = np.asarray(prefix_ids, np.int32).reshape(-1)
         ps = self.page_size
         shared_len = (len(ids) // ps) * ps
@@ -664,6 +679,11 @@ class Generator:
                         callback) -> int:
         """Admit one request on top of a registered prefix: borrow its
         pages, prefill only the suffix at start=shared_len."""
+        if self.spec_k:
+            # the spec history rows would hold only the suffix while cache
+            # positions include the prefix — drafting would misalign
+            raise ValueError(
+                "prefix sharing doesn't compose with speculative decode yet")
         info = self._prefixes[pid]
         suffix = info["tail"] + [int(t) for t in ids]
         n_suf = len(suffix)
@@ -785,7 +805,12 @@ class Generator:
             fns.append(self._mini_chunk_fn)
         with self._mesh_ctx():
             for fn in fns:
-                if self.spec_k:
+                if self.spec_k and self.page_size:
+                    (_row0, _e, _c, self._tok_dev, self.cache,
+                     self._tokens_dev) = fn(self.params, self._tok_dev,
+                                            self.cache, self._tokens_dev,
+                                            np.zeros_like(self._table))
+                elif self.spec_k:
                     (_row0, _e, _c, self._tok_dev, self.cache,
                      self._tokens_dev) = fn(self.params, self._tok_dev,
                                             self.cache, self._tokens_dev)
@@ -1046,9 +1071,16 @@ class Generator:
         fn = self._mini_chunk_fn if mini else self._chunk_fn
         with self._mesh_ctx():
             if self.spec_k:
-                (row0, emits, counts, self._tok_dev, self.cache,
-                 self._tokens_dev) = fn(self.params, self._tok_dev,
-                                        self.cache, self._tokens_dev)
+                if self.page_size:
+                    self._grow_pages()
+                    (row0, emits, counts, self._tok_dev, self.cache,
+                     self._tokens_dev) = fn(self.params, self._tok_dev,
+                                            self.cache, self._tokens_dev,
+                                            self._table)
+                else:
+                    (row0, emits, counts, self._tok_dev, self.cache,
+                     self._tokens_dev) = fn(self.params, self._tok_dev,
+                                            self.cache, self._tokens_dev)
                 item: Any = (row0, emits, counts)
             elif self.page_size:
                 self._grow_pages()  # table must cover this whole chunk

@@ -799,6 +799,70 @@ def paged_decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
     return logits, {**arrays, "len": jnp.minimum(pos + 1, S_virt)}
 
 
+def paged_decode_window(params: dict, toks: jnp.ndarray, cache: dict,
+                        table: jnp.ndarray, cfg: LlamaConfig
+                        ) -> tuple[jnp.ndarray, dict]:
+    """decode_window (speculative K+1 verify) against the paged pool:
+    toks [B, W] at per-row positions ``cache['len']``; kv rows scatter
+    through each row's page table, attention gathers the virtual
+    sequences back. ``len`` is NOT advanced — the caller advances by
+    1 + accepted, and rejected rows are overwritten before any causal
+    mask can reach them (the decode_window argument, page-routed)."""
+    if cfg.kv_quant:
+        raise ValueError("paged cache requires the fp KV layout")
+    from ..ops import apply_rope, attention, repeat_kv, rms_norm, rope_table
+
+    b, w = toks.shape
+    page_s = cache["k"].shape[2]
+    p_max = table.shape[1]
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    pos0 = cache["len"]                                    # [B]
+    positions = pos0[:, None] + jnp.arange(w)[None, :]     # [B, W]
+    rows = jnp.arange(b)
+    # over-capacity window cells write into scratch page 0
+    page = jnp.where(
+        positions < p_max * page_s,
+        table[rows[:, None], jnp.minimum(positions // page_s, p_max - 1)],
+        0)                                                 # [B, W]
+    off = positions % page_s
+    x = params["embed"][toks].astype(cfg.dtype)            # [B, W, D]
+    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(carry, lp):
+        x, arrays, layer = carry
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = _mm(h, lp["wq"]).reshape(b, w, H, hd)
+        k = _mm(h, lp["wk"]).reshape(b, w, KV, hd)
+        v = _mm(h, lp["wv"]).reshape(b, w, KV, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        dt = arrays["k"].dtype
+        arrays = {
+            "k": arrays["k"].at[layer, page, off].set(k.astype(dt)),
+            "v": arrays["v"].at[layer, page, off].set(v.astype(dt)),
+        }
+        k_l = jax.lax.dynamic_index_in_dim(arrays["k"], layer, 0,
+                                           keepdims=False)
+        v_l = jax.lax.dynamic_index_in_dim(arrays["v"], layer, 0,
+                                           keepdims=False)
+        k_virt = jnp.take(k_l, table, axis=0).reshape(b, -1, KV, hd)
+        v_virt = jnp.take(v_l, table, axis=0).reshape(b, -1, KV, hd)
+        o = attention(q, repeat_kv(k_virt, cfg.n_rep),
+                      repeat_kv(v_virt, cfg.n_rep),
+                      causal=True, q_offset=pos0)  # per-row offsets
+        x = x + _mm(o.reshape(b, w, H * hd), lp["wo"])
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + _swiglu(h2, lp)
+        return (x, arrays, layer + 1), None
+
+    arrays0 = {"k": cache["k"], "v": cache["v"]}
+    (x, arrays, _), _ = jax.lax.scan(
+        body, (x, arrays0, jnp.int32(0)), params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)  # [B, W, V]
+    return logits, {**arrays, "len": cache["len"]}
+
+
 def decode_window(params: dict, toks: jnp.ndarray, cache: dict,
                   cfg: LlamaConfig, mesh=None) -> tuple[jnp.ndarray, dict]:
     """Speculative verify window: W tokens per row, starting at each row's

@@ -172,3 +172,31 @@ def test_generator_speculative_guards():
     with pytest.raises(ValueError, match="fp KV cache"):
         Generator(params, _cfg(kv_quant=True), batch_slots=1, max_seq=64,
                   spec_k=2)
+
+
+def test_generator_speculative_on_paged_cache():
+    """spec_k + page_size: the K+1 verify window routes through the page
+    tables (llama.paged_decode_window); output equals the plain dense
+    greedy Generator exactly, concurrent slots included."""
+    from gofr_tpu.ml.generate import Generator
+
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = [[5, 9, 2, 7] * 3, [3, 1, 3, 1, 3, 1]]
+
+    dense = Generator(params, cfg, batch_slots=1, max_seq=64,
+                      prefill_buckets=(16,))
+    expects = [dense.generate(p, max_new_tokens=10) for p in prompts]
+
+    gen = Generator(params, cfg, batch_slots=2, max_seq=64,
+                    prefill_buckets=(16,), chunk=2, spec_k=3, page_size=8)
+    streamed: dict[int, list[int]] = {}
+    slots = [gen.add_request(
+        p, 10, callback=lambda i, toks: streamed.setdefault(i, []).extend(toks))
+        for p in prompts]
+    while gen.n_live:
+        gen.step()
+    gen.drain()
+    for slot, expect in zip(slots, expects):
+        assert streamed[slot] == expect
+    assert gen.spec_windows > 0

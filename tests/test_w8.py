@@ -111,3 +111,27 @@ def test_w8_shards_over_tp_mesh():
             sharded, jnp.asarray(toks))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_w8_composes_with_paged_cache():
+    """int8 weights + the paged pool: decode streams int8 weights while
+    attention gathers pages — output equals the dense fp-weight path's
+    greedy argmax chain (same guard as the plain w8 parity tests)."""
+    import jax
+
+    from gofr_tpu.ml.generate import Generator
+    from gofr_tpu.models import llama
+
+    cfg = llama.tiny_llama(use_flash=False, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    qcfg = llama.tiny_llama(use_flash=False, dtype=jnp.float32, w8=True)
+    qparams = llama.quantize_weights(params)
+
+    prompt = [5, 9, 2, 7]
+    dense_q = Generator(qparams, qcfg, batch_slots=1, max_seq=32,
+                        prefill_buckets=(8,))
+    expect = dense_q.generate(prompt, max_new_tokens=8)
+
+    paged_q = Generator(qparams, qcfg, batch_slots=2, max_seq=32,
+                        prefill_buckets=(8,), chunk=2, page_size=8)
+    assert paged_q.generate(prompt, max_new_tokens=8) == expect
