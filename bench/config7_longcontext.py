@@ -146,9 +146,9 @@ def main() -> None:
                        w8=True)
 
     # ---- paged capacity A/B at EQUAL cache HBM ---------------------------
-    # mixed-length workload (half long, half short): dense pins worst-case
-    # rows per slot; the paged pool shares them, so the same HBM carries
-    # 2x the concurrent slots (the long-context capacity lever).
+    # mixed-length workload (1-in-4 long): dense pins worst-case rows per
+    # slot; the paged pool shares them, so the same HBM carries 2x (fp) /
+    # 4x (int8) the concurrent slots (the long-context capacity lever).
     if on_tpu:
         ps, dense_slots, max_new = 128, 4, 64
         ctx_long, ctx_short = 8192, 1024
@@ -158,9 +158,12 @@ def main() -> None:
     rng = np.random.default_rng(1)
     vocab = cfg_kw["vocab_size"]
     n_req = 4 * dense_slots
+    # 1-in-4 long: the mixed ratio where worst-case CONCURRENT pages fit
+    # the shared pool at 2x (fp) / 4x (int8) the dense slot count — the
+    # dense layout still pins max_seq rows for every one of them
     prompts = [
         rng.integers(1, vocab,
-                     (ctx_long if i % 2 == 0 else ctx_short,)
+                     (ctx_long if i % 4 == 0 else ctx_short,)
                      ).astype(np.int32)
         for i in range(n_req)
     ]
@@ -172,6 +175,12 @@ def main() -> None:
     equal_hbm_pages = 1 + dense_slots * (-(-max_seq // ps))
     paged_run = _mixed_run(paged=True, slots=2 * dense_slots,
                            n_pages=equal_hbm_pages, **common)
+    # both memory levers at once: int8 pages are ~half the bytes, so the
+    # SAME byte budget holds ~2x the pages -> 4x the dense slot count
+    paged_q_run = _mixed_run(
+        paged=True, slots=4 * dense_slots,
+        n_pages=1 + 2 * dense_slots * (-(-max_seq // ps)),
+        **{**common, "cfg_kw": dict(cfg_kw, kv_quant=True)})
 
     emit(
         "longcontext_int8_speedup_8k", q8["tok_per_s"] / fp["tok_per_s"],
@@ -187,8 +196,11 @@ def main() -> None:
             "paged_ab": {
                 "dense": dense_run,
                 "paged_equal_hbm": paged_run,
+                "paged_int8_equal_hbm": paged_q_run,
                 "paged_speedup": round(
                     paged_run["tok_per_s"] / dense_run["tok_per_s"], 3),
+                "paged_int8_speedup": round(
+                    paged_q_run["tok_per_s"] / dense_run["tok_per_s"], 3),
                 "page_size": ps,
             },
             "backend": jax.default_backend(),

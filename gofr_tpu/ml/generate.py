@@ -628,12 +628,12 @@ class Generator:
         """
         if not self.page_size:
             raise ValueError("prefix sharing requires page_size > 0")
-        if self.spec_k:
+        if self.spec_k or getattr(self.cfg, "kv_quant", False):
             # guard at REGISTRATION so callers with a silent-fallback path
             # (the OpenAI server's auto cache) fail here once and
             # negative-cache, instead of poisoning every later admission
             raise ValueError(
-                "prefix sharing doesn't compose with speculative decode yet")
+                "prefix sharing doesn't compose with spec/kv_quant yet")
         ids = np.asarray(prefix_ids, np.int32).reshape(-1)
         ps = self.page_size
         shared_len = (len(ids) // ps) * ps
@@ -679,11 +679,6 @@ class Generator:
                         callback) -> int:
         """Admit one request on top of a registered prefix: borrow its
         pages, prefill only the suffix at start=shared_len."""
-        if self.spec_k:
-            # the spec history rows would hold only the suffix while cache
-            # positions include the prefix — drafting would misalign
-            raise ValueError(
-                "prefix sharing doesn't compose with speculative decode yet")
         info = self._prefixes[pid]
         suffix = info["tail"] + [int(t) for t in ids]
         n_suf = len(suffix)

@@ -632,10 +632,22 @@ def init_paged_cache(cfg: LlamaConfig, batch: int, n_pages: int,
     scratch: unallocated table entries point at it, over-capacity writes
     land there harmlessly, and kv_len masking keeps reads out.
 
-    fp-only (int8 kv_quant pairs with the dense layout for now).
+    kv_quant composes: int8 page values stay FLAT [L, N, ps, KV*D] and
+    scales ride page-shaped [L, N, KV, ps] (the same tiling rationale as
+    the dense int8 layout) — the two memory levers multiply: half the
+    bytes per token AND pages shared across slots.
     """
     if cfg.kv_quant:
-        raise ValueError("paged cache requires the fp KV layout")
+        flat = (cfg.n_layers, n_pages, page_s,
+                cfg.n_kv_heads * cfg.head_dim)
+        scale_shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page_s)
+        return {
+            "k": jnp.zeros(flat, jnp.int8),
+            "v": jnp.zeros(flat, jnp.int8),
+            "k_scale": jnp.zeros(scale_shape, jnp.bfloat16),
+            "v_scale": jnp.zeros(scale_shape, jnp.bfloat16),
+            "len": jnp.zeros((batch,), jnp.int32),
+        }
     shape = (cfg.n_layers, n_pages, page_s, cfg.n_kv_heads, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
@@ -653,11 +665,14 @@ def paged_prefill_into(params: dict, tokens: jnp.ndarray,
     point at scratch page 0, so whole-page writes never need masking."""
     logits, filled = prefill(params, tokens, seq_lens, cfg,
                              init_cache(cfg, 1, tokens.shape[1]))
-    arrays = {"k": cache["k"], "v": cache["v"]}
+    arrays = {key: cache[key] for key in cache if key != "len"}
     n_pg = tokens.shape[1] // page_s
     for j in range(n_pg):  # static unroll: one page-sized slab per write
-        for key in ("k", "v"):
-            slab = filled[key][:, 0, j * page_s:(j + 1) * page_s]
+        for key in arrays:
+            if key.endswith("_scale"):  # int8 scales: [L, B, KV, S]
+                slab = filled[key][:, 0, :, j * page_s:(j + 1) * page_s]
+            else:                       # values: [L, B, S, ...]
+                slab = filled[key][:, 0, j * page_s:(j + 1) * page_s]
             arrays[key] = jax.lax.dynamic_update_index_in_dim(
                 arrays[key], slab, table_row[j], axis=1)
     new_len = cache["len"].at[slot].set(seq_lens[0])
@@ -744,8 +759,8 @@ def paged_decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
     writes at (table[b, pos//page_s], pos % page_s); attention gathers
     the row's pages back into a virtual [P_max * page_s] sequence.
     """
-    from ..ops import (apply_rope, attention, repeat_kv, rms_norm,
-                       rope_table)
+    from ..ops import (apply_rope, attention, dequantize_kv, quantize_kv,
+                       repeat_kv, rms_norm, rope_table)
 
     b = tokens.shape[0]
     page_s = cache["k"].shape[2]
@@ -761,6 +776,7 @@ def paged_decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
     x = params["embed"][tokens][:, None, :].astype(cfg.dtype)
     cos, sin = rope_table(pos[:, None], cfg.head_dim, cfg.rope_theta)
     rows = jnp.arange(b)
+    kv_idx = jnp.arange(KV)[None, :]
 
     def body(carry, lp):
         x, arrays, layer = carry
@@ -770,18 +786,46 @@ def paged_decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
         v = _mm(h, lp["wv"]).reshape(b, 1, KV, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        dt = arrays["k"].dtype
-        arrays = {
-            "k": arrays["k"].at[layer, page, off].set(k[:, 0].astype(dt)),
-            "v": arrays["v"].at[layer, page, off].set(v[:, 0].astype(dt)),
-        }
-        k_l = jax.lax.dynamic_index_in_dim(arrays["k"], layer, 0,
-                                           keepdims=False)
-        v_l = jax.lax.dynamic_index_in_dim(arrays["v"], layer, 0,
-                                           keepdims=False)
-        # virtual sequence: gather this row's pages in table order
-        k_virt = jnp.take(k_l, table, axis=0).reshape(b, -1, KV, hd)
-        v_virt = jnp.take(v_l, table, axis=0).reshape(b, -1, KV, hd)
+        if cfg.kv_quant:
+            kq, k_sc = quantize_kv(k[:, 0])          # [B, KV, hd], [B, KV]
+            vq, v_sc = quantize_kv(v[:, 0])
+            arrays = {
+                "k": arrays["k"].at[layer, page, off].set(
+                    kq.reshape(b, KV * hd)),
+                "v": arrays["v"].at[layer, page, off].set(
+                    vq.reshape(b, KV * hd)),
+                "k_scale": arrays["k_scale"].at[
+                    layer, page[:, None], kv_idx, off[:, None]].set(k_sc),
+                "v_scale": arrays["v_scale"].at[
+                    layer, page[:, None], kv_idx, off[:, None]].set(v_sc),
+            }
+
+            def virt(name):
+                q8 = jnp.take(jax.lax.dynamic_index_in_dim(
+                    arrays[name], layer, 0, keepdims=False), table, axis=0)
+                sc = jnp.take(jax.lax.dynamic_index_in_dim(
+                    arrays[name + "_scale"], layer, 0, keepdims=False),
+                    table, axis=0)                  # [B, P, KV, ps]
+                q8 = q8.reshape(b, -1, KV, hd)      # [B, P*ps, KV, hd]
+                sc = jnp.swapaxes(sc, -1, -2).reshape(b, -1, KV)
+                return dequantize_kv(q8, sc, cfg.dtype)
+
+            k_virt, v_virt = virt("k"), virt("v")
+        else:
+            dt = arrays["k"].dtype
+            arrays = {
+                "k": arrays["k"].at[layer, page, off].set(
+                    k[:, 0].astype(dt)),
+                "v": arrays["v"].at[layer, page, off].set(
+                    v[:, 0].astype(dt)),
+            }
+            k_l = jax.lax.dynamic_index_in_dim(arrays["k"], layer, 0,
+                                               keepdims=False)
+            v_l = jax.lax.dynamic_index_in_dim(arrays["v"], layer, 0,
+                                               keepdims=False)
+            # virtual sequence: gather this row's pages in table order
+            k_virt = jnp.take(k_l, table, axis=0).reshape(b, -1, KV, hd)
+            v_virt = jnp.take(v_l, table, axis=0).reshape(b, -1, KV, hd)
         o = attention(q, repeat_kv(k_virt, cfg.n_rep),
                       repeat_kv(v_virt, cfg.n_rep),
                       causal=False, kv_len=pos + 1)
@@ -790,7 +834,7 @@ def paged_decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
         x = x + _swiglu(h2, lp)
         return (x, arrays, layer + 1), None
 
-    arrays0 = {"k": cache["k"], "v": cache["v"]}
+    arrays0 = {key: cache[key] for key in cache if key != "len"}
     (x, arrays, _), _ = jax.lax.scan(
         body, (x, arrays0, jnp.int32(0)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)

@@ -115,3 +115,34 @@ def test_decode_kernel_quantized_interpret():
                                    block_s=256, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-2, atol=2e-2)
+
+
+def test_kv_quant_composes_with_paged_cache():
+    """int8 pages + page tables: the two memory levers multiply (half the
+    bytes per token AND pages shared across slots). Greedy output must
+    match the DENSE int8 cache exactly — same quantization, different
+    placement."""
+    import jax
+
+    from gofr_tpu.ml.generate import Generator
+    from gofr_tpu.models import llama
+
+    cfg = llama.tiny_llama(use_flash=False, kv_quant=True)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = [[5, 9, 2, 7], [3, 1, 4]]
+
+    dense = Generator(params, cfg, batch_slots=1, max_seq=32,
+                      prefill_buckets=(8,))
+    expects = [dense.generate(p, max_new_tokens=8) for p in prompts]
+
+    paged = Generator(params, cfg, batch_slots=2, max_seq=32,
+                      prefill_buckets=(8,), chunk=2, page_size=8)
+    streamed: dict[int, list[int]] = {}
+    slots = [paged.add_request(
+        p, 8, callback=lambda i, t: streamed.setdefault(i, []).extend(t))
+        for p in prompts]
+    while paged.n_live:
+        paged.step()
+    paged.drain()
+    for slot, expect in zip(slots, expects):
+        assert streamed[slot] == expect
