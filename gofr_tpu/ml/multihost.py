@@ -125,7 +125,8 @@ class MultiHostWorker:
                  batch_slots: int | None = None, max_seq: int | None = None,
                  prefill_buckets: tuple = (), prompt_bucket: int | None = None,
                  chunk: int = 4, sampler=None, eos_id: int | None = None,
-                 heartbeat_s: float = 5.0, logger=None) -> None:
+                 spec_k: int = 0, heartbeat_s: float = 5.0,
+                 logger=None) -> None:
         self.process_id = process_id
         self.num_processes = num_processes
         self.coordinator = coordinator
@@ -134,6 +135,7 @@ class MultiHostWorker:
         self.chunk = chunk
         self.sampler = sampler
         self.eos_id = eos_id
+        self.spec_k = spec_k
         self.heartbeat_s = heartbeat_s
         self.batch_slots = batch_slots
         self.max_seq = max_seq
@@ -183,7 +185,11 @@ class MultiHostWorker:
             params, cfg, batch_slots=self.batch_slots, max_seq=self.max_seq,
             sampler=self.sampler, eos_id=self.eos_id,
             prefill_buckets=self.prefill_buckets, seed=self.seed, mesh=mesh,
-            chunk=self.chunk, shard_cache=True)
+            chunk=self.chunk, shard_cache=True,
+            # speculation stays lock-step: greedy windows are deterministic
+            # and the emit/count blocks come back replicated, so every
+            # rank's bookkeeping sees identical acceptance
+            spec_k=self.spec_k)
         # compile every program up front ON EVERY RANK — a lazy first-use
         # compile inside the command loop would stall that rank alone
         self.gen.warmup()

@@ -186,6 +186,58 @@ print(f"OK proc={pid}", flush=True)
 """
 
 
+def _spawn_serve_workers(tmp_path, source: str, coord: str,
+                         model_port: int):
+    """Start the 2-process serving mesh; returns (procs, logs)."""
+    worker = tmp_path / "serve_worker.py"
+    worker.write_text(source)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    logs = [open(tmp_path / f"w{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), coord, str(model_port)],
+            stdout=logs[i], stderr=subprocess.STDOUT, env=env, cwd=repo,
+        )
+        for i in range(2)
+    ]
+    return procs, logs
+
+
+async def _wait_model_port(llm, procs, deadline_s: float = 150.0) -> None:
+    """Wait for rank 0's model port (jax.distributed init + warmup
+    compiles take a while), failing fast if a worker dies."""
+    import asyncio
+
+    deadline = asyncio.get_running_loop().time() + deadline_s
+    while True:
+        try:
+            await llm._ensure()
+            return
+        except OSError:
+            if any(p.poll() is not None for p in procs):
+                raise AssertionError("a worker died during startup")
+            if asyncio.get_running_loop().time() > deadline:
+                raise AssertionError("rank 0 never opened the model port")
+            await asyncio.sleep(0.5)
+
+
+def _teardown_workers(procs, logs, expect_ok: bool) -> None:
+    try:
+        if expect_ok:
+            for i, p in enumerate(procs):
+                assert p.wait(timeout=30) == 0, f"worker {i} exited non-zero"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+
 def _reference_greedy(prompt, max_new):
     """Single-process greedy decode with the same seed: the multi-host
     mesh must reproduce it exactly."""
@@ -222,23 +274,10 @@ def test_multihost_serving_topology(tmp_path, run):
     import asyncio
     import json as _json
 
-    worker = tmp_path / "serve_worker.py"
-    worker.write_text(_SERVE_WORKER)
     coord = f"127.0.0.1:{get_free_port()}"
     model_port = get_free_port()
-    env = dict(os.environ)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    env["JAX_PLATFORMS"] = "cpu"
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    logs = [open(tmp_path / f"w{i}.log", "w+") for i in range(2)]
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(worker), str(i), coord, str(model_port)],
-            stdout=logs[i], stderr=subprocess.STDOUT, env=env, cwd=repo,
-        )
-        for i in range(2)
-    ]
+    procs, logs = _spawn_serve_workers(tmp_path, _SERVE_WORKER, coord,
+                                       model_port)
 
     prompt = [5, 9, 2, 7]
     max_new = 8
@@ -253,19 +292,7 @@ def test_multihost_serving_topology(tmp_path, run):
         from gofr_tpu.ml.multihost import MultiHostLLMClient
 
         llm = MultiHostLLMClient("127.0.0.1", model_port)
-        # wait for rank 0 to open the model port (jax.distributed init +
-        # first CPU compiles take a while)
-        deadline = asyncio.get_running_loop().time() + 120
-        while True:
-            try:
-                await llm._ensure()
-                break
-            except OSError:
-                if any(p.poll() is not None for p in procs):
-                    raise AssertionError("a worker died during startup")
-                if asyncio.get_running_loop().time() > deadline:
-                    raise AssertionError("rank 0 never opened the model port")
-                await asyncio.sleep(0.5)
+        await _wait_model_port(llm, procs)
 
         # the front-end gofr app: SSE /generate backed by the mesh client
         app = App(config=MapConfig({"APP_NAME": "frontend"}))
@@ -333,8 +360,50 @@ def test_multihost_serving_topology(tmp_path, run):
             logs[i].seek(0)
             assert f"OK proc={i}" in logs[i].read()
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        for f in logs:
-            f.close()
+        _teardown_workers(procs, logs, expect_ok=False)
+
+
+def test_multihost_serving_with_speculation(tmp_path, run):
+    """spec_k on the mesh: every rank runs the same device-resident
+    draft/verify windows in lock-step (greedy is deterministic and the
+    emit blocks come back replicated) — output must equal the plain
+    single-process greedy decode."""
+    src = _SERVE_WORKER.replace("prompt_bucket=16)",
+                                "prompt_bucket=16, spec_k=2)")
+    assert "spec_k=2" in src  # template drift would silently disable spec
+    src = src.replace(
+        'print(f"OK proc={pid}", flush=True)',
+        'print(f"OK proc={pid} spec_windows={w.gen.spec_windows}",'
+        ' flush=True)')
+    src = src.replace("MultiHostWorker(", "w = MultiHostWorker(")
+    src = src.replace("prompt_bucket=16, spec_k=2).run()",
+                      "prompt_bucket=16, spec_k=2)\nw.run()")
+    coord = f"127.0.0.1:{get_free_port()}"
+    model_port = get_free_port()
+    procs, logs = _spawn_serve_workers(tmp_path, src, coord, model_port)
+    prompt = [5, 9, 2, 5, 9, 2, 5, 9]  # repetitive: drafts should land
+
+    async def scenario():
+        from gofr_tpu.ml.multihost import MultiHostLLMClient
+
+        llm = MultiHostLLMClient("127.0.0.1", model_port)
+        await _wait_model_port(llm, procs)
+        try:
+            toks = await llm.generate(prompt, 8)
+            assert toks == _reference_greedy(prompt, 8)
+            await llm.shutdown_workers()
+        finally:
+            await llm.close()
+
+    try:
+        run(scenario())
+        for i, p in enumerate(procs):
+            assert p.wait(timeout=30) == 0, f"worker {i} exited non-zero"
+            logs[i].seek(0)
+            out = logs[i].read()
+            assert f"OK proc={i}" in out
+            # speculation really ran: windows were dispatched on this rank
+            windows = int(out.rsplit("spec_windows=", 1)[1].split()[0])
+            assert windows > 0
+    finally:
+        _teardown_workers(procs, logs, expect_ok=False)
