@@ -93,6 +93,31 @@ class Cassandra:
         """INSERT/UPDATE/DELETE/DDL."""
         await self._run("exec", stmt, params)
 
+    async def exec_cas(self, stmt: str, params: Sequence | None = None
+                       ) -> tuple[bool, dict | None]:
+        """Lightweight transaction through the injected session: returns
+        Cassandra's ``[applied]`` flag plus the current row on a failed
+        condition (reference Client.ExecCAS, cassandra.go:113-180). Works
+        with dict rows (the native wire client's shape) and driver row
+        objects exposing ``applied``."""
+        rows = await self._run("exec_cas", stmt, params)
+        if not rows:
+            raise CassandraError("CAS statement returned no result row")
+        first = rows[0]
+        if isinstance(first, dict):
+            if "[applied]" not in first:
+                raise CassandraError("result has no [applied] column")
+            applied = bool(first["[applied]"])
+            current = {k: v for k, v in first.items() if k != "[applied]"}
+            return applied, (current or None) if not applied else None
+        flag = getattr(first, "applied", None)
+        if flag is None:
+            # same strictness as the dict path: a row object without the
+            # flag means this wasn't a conditional statement — (False, row)
+            # here would invent a failed condition that never existed
+            raise CassandraError("result has no applied flag")
+        return bool(flag), (None if flag else first)
+
     async def batch_exec(self, stmts: Sequence[tuple[str, Sequence | None]]) -> None:
         """Logged batch: executes statements as one unit when the underlying
         session supports BatchStatement, else sequentially."""

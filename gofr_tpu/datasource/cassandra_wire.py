@@ -508,28 +508,86 @@ class CassandraWire:
                 await self._query_raw(stmt)
         self._observe("exec", start, stmt)
 
-    async def batch_exec(self,
-                         stmts: Sequence[tuple[str, Sequence | None]]) -> None:
-        """LOGGED batch in one BATCH frame: every statement prepared, values
-        bound at protocol level (reference cassandra_batch.go role)."""
+    async def exec_cas(self, stmt: str, params: Sequence | None = None
+                       ) -> tuple[bool, dict | None]:
+        """Lightweight transaction (CAS): run an ``IF NOT EXISTS`` /
+        ``IF <cond>`` statement and surface Cassandra's ``[applied]``
+        result column (reference ``Client.ExecCAS``,
+        cassandra.go:113-180,180-218).
+
+        Returns ``(applied, current_row)``: ``current_row`` is the
+        server's view of the existing row when the condition failed (the
+        reference scans it into ``dest``), or None when applied.
+        """
+        start = time.perf_counter()
+        self._adopt_loop()
+        async with self._lock:
+            await self._ensure()
+            if params:
+                rows = await self._execute(stmt, params)
+            else:
+                rows = await self._query_raw(stmt)
+        self._observe("exec_cas", start, stmt)
+        return self._cas_result(rows)
+
+    @staticmethod
+    def _cas_result(rows: list[dict]) -> tuple[bool, dict | None]:
+        if not rows or "[applied]" not in rows[0]:
+            raise CassandraWireError(
+                "not a CAS statement: result has no [applied] column")
+        applied = bool(rows[0]["[applied]"])
+        current = {k: v for k, v in rows[0].items() if k != "[applied]"}
+        return applied, (current or None) if not applied else None
+
+    async def _batch_with_retry(self, op: str,
+                                stmts: Sequence[tuple[str, Sequence | None]]
+                                ) -> list[dict]:
+        """One LOGGED BATCH frame with the same UNPREPARED recovery as
+        _execute: drop every cached id in the batch, re-prepare, retry the
+        whole frame once. Returns the result rows (empty for Void)."""
         start = time.perf_counter()
         self._adopt_loop()
         async with self._lock:
             await self._ensure()
             try:
-                await self._batch_once(stmts)
+                rows = await self._batch_once(stmts)
             except CassandraWireError as exc:
-                # Same UNPREPARED recovery as _execute: drop every cached id
-                # in the batch, re-prepare, and retry the whole frame once.
                 if exc.code != _ERR_UNPREPARED:
                     raise
                 for stmt, _ in stmts:
                     self._prepared.pop(stmt, None)
-                await self._batch_once(stmts)
-        self._observe("batch", start, f"{len(stmts)} statements")
+                rows = await self._batch_once(stmts)
+        self._observe(op, start, f"{len(stmts)} statements")
+        return rows
+
+    async def batch_exec(self,
+                         stmts: Sequence[tuple[str, Sequence | None]]) -> None:
+        """LOGGED batch in one BATCH frame: every statement prepared, values
+        bound at protocol level (reference cassandra_batch.go role)."""
+        await self._batch_with_retry("batch", stmts)
+
+    async def batch_exec_cas(self,
+                             stmts: Sequence[tuple[str, Sequence | None]]
+                             ) -> tuple[bool, list[dict]]:
+        """Conditional (CAS) LOGGED batch: all statements must target one
+        partition; the server applies all or none and returns ``[applied]``
+        plus the current rows when the condition failed (reference
+        ``ExecuteBatchCAS``, cassandra_batch.go).
+
+        Returns ``(applied, current_rows)``.
+        """
+        rows = await self._batch_with_retry("batch_cas", stmts)
+        if not rows or "[applied]" not in rows[0]:
+            raise CassandraWireError(
+                "not a conditional batch: result has no [applied] column")
+        applied = bool(rows[0]["[applied]"])
+        current = [] if applied else [
+            {k: v for k, v in r.items() if k != "[applied]"} for r in rows]
+        return applied, current
 
     async def _batch_once(self,
-                          stmts: Sequence[tuple[str, Sequence | None]]) -> None:
+                          stmts: Sequence[tuple[str, Sequence | None]]
+                          ) -> list[dict]:
         body = struct.pack(">BH", 0, len(stmts))  # type LOGGED, count
         for stmt, params in stmts:
             stmt_id, specs = await self._prepare(stmt)
@@ -540,9 +598,11 @@ class CassandraWire:
                 body += _bytes_value(raw)
         body += struct.pack(">HB", _CONSISTENCY_ONE, 0)
         await self._send_frame(_OP_BATCH, body)
-        opcode, _ = await self._recv_frame()
+        opcode, payload = await self._recv_frame()
         if opcode != _OP_RESULT:
             raise CassandraWireError(f"unexpected batch opcode {opcode}")
+        rows, _ = self._parse_rows(payload)  # conditional batches: [applied]
+        return rows
 
     def _observe(self, op: str, start: float, stmt: str) -> None:
         dur = time.perf_counter() - start

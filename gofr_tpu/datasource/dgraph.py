@@ -3,8 +3,13 @@
 Reference: separate module wrapping dgo with Query/Mutate/Alter/Txn
 (SURVEY §2.8, datasource/dgraph, 1,052 LoC). Dgraph exposes the same
 operations over HTTP (/query, /mutate, /alter, /health), so this driver is
-a full implementation; transactions use the HTTP txn context
-(start_ts/keys) with explicit commit/discard.
+a full implementation. Transactions (reference NewTxn/NewReadOnlyTxn,
+dgraph.go:246-254) use Dgraph's HTTP txn protocol: the first operation
+acquires ``start_ts`` from the response's ``extensions.txn``; later
+operations pin ``startTs``; mutations accumulate ``keys``/``preds``; and
+``commit()`` POSTs them to ``/commit?startTs=...`` (``discard()`` adds
+``abort=true``). Read-only transactions query at a consistent snapshot
+and need no commit.
 """
 
 from __future__ import annotations
@@ -15,11 +20,112 @@ from typing import Any
 
 from ._http import HTTPDriver
 
-__all__ = ["Dgraph", "DgraphError"]
+__all__ = ["Dgraph", "DgraphTxn", "DgraphError"]
 
 
 class DgraphError(Exception):
     pass
+
+
+class DgraphTxn:
+    """One Dgraph transaction over HTTP (parity: dgo's Txn via the
+    reference's NewTxn/NewReadOnlyTxn, dgraph.go:246-254).
+
+    All operations share one ``start_ts`` snapshot; mutations stage
+    server-side until ``commit()``. After commit/discard the txn refuses
+    further use.
+    """
+
+    def __init__(self, client: "Dgraph", *, read_only: bool = False) -> None:
+        self._client = client
+        self.read_only = read_only
+        self.start_ts: int | None = None
+        self._keys: set[str] = set()
+        self._preds: set[str] = set()
+        self._finished = False
+
+    def _check_open(self) -> None:
+        if self._finished:
+            raise DgraphError("transaction already committed/discarded")
+
+    def _absorb(self, out: dict) -> None:
+        txn = (out.get("extensions") or {}).get("txn") or {}
+        ts = txn.get("start_ts")
+        if ts:
+            if self.start_ts is None:
+                self.start_ts = int(ts)
+            elif int(ts) != self.start_ts:
+                raise DgraphError(
+                    f"server moved start_ts {self.start_ts} -> {ts}")
+        self._keys.update(txn.get("keys") or [])
+        self._preds.update(txn.get("preds") or [])
+
+    async def query(self, dql: str, *,
+                    variables: dict | None = None) -> dict:
+        """DQL read at the transaction's snapshot."""
+        self._check_open()
+        params: dict[str, str] = {}
+        if self.start_ts is not None:
+            params["startTs"] = str(self.start_ts)
+        elif self.read_only:
+            params["ro"] = "true"
+        out = await self._client._query_raw(dql, variables=variables,
+                                            params=params or None)
+        self._absorb(out)
+        return out.get("data", {})
+
+    async def mutate(self, *, set_json: Any = None,
+                     delete_json: Any = None) -> dict:
+        """Staged mutation (no commitNow): visible inside this txn only
+        until commit()."""
+        self._check_open()
+        if self.read_only:
+            raise DgraphError("read-only transaction cannot mutate")
+        params = ({"startTs": str(self.start_ts)}
+                  if self.start_ts is not None else None)
+        out = await self._client._mutate_raw(set_json=set_json,
+                                             delete_json=delete_json,
+                                             commit_now=False, params=params)
+        self._absorb(out)
+        return out.get("data", {})
+
+    async def commit(self) -> None:
+        self._check_open()
+        if self.read_only or self.start_ts is None:
+            self._finished = True
+            return  # nothing staged server-side
+        # mark finished only AFTER the server acknowledged: a transient
+        # /commit failure must leave the txn retryable or discardable,
+        # not poisoned with its keys dangling server-side
+        await self._client._call(
+            "commit", "/commit",
+            data=json.dumps({"keys": sorted(self._keys),
+                             "preds": sorted(self._preds)}),
+            params={"startTs": str(self.start_ts)})
+        self._finished = True
+
+    async def discard(self) -> None:
+        self._check_open()
+        self._finished = True  # abort resolves client-side either way:
+        if self.read_only or self.start_ts is None:  # the server expires
+            return                                   # undelivered aborts
+        await self._client._call(
+            "discard", "/commit", data="{}",
+            params={"startTs": str(self.start_ts), "abort": "true"})
+
+    async def __aenter__(self) -> "DgraphTxn":
+        return self
+
+    async def __aexit__(self, exc_type, exc, tb) -> None:
+        if self._finished:
+            return
+        if exc_type is None:
+            await self.commit()
+        else:
+            try:
+                await self.discard()
+            except DgraphError:
+                pass
 
 
 class Dgraph(HTTPDriver):
@@ -43,18 +149,26 @@ class Dgraph(HTTPDriver):
             raise DgraphError(str(errors or body[:200]))
         return out
 
-    async def query(self, dql: str, *, variables: dict | None = None) -> dict:
-        """DQL read: returns the ``data`` object."""
+    async def _query_raw(self, dql: str, *, variables: dict | None = None,
+                         params: dict | None = None) -> dict:
+        """Full /query response (data + extensions) — txns need the
+        ``extensions.txn`` context the public query() discards."""
         if variables:
             payload = json.dumps({"query": dql, "variables": variables})
-            out = await self._call("query", "/query", data=payload)
-        else:
-            out = await self._call("query", "/query", data=dql.encode(),
-                                   content_type="application/dql")
+            return await self._call("query", "/query", data=payload,
+                                    params=params)
+        return await self._call("query", "/query", data=dql.encode(),
+                                content_type="application/dql",
+                                params=params)
+
+    async def query(self, dql: str, *, variables: dict | None = None) -> dict:
+        """DQL read: returns the ``data`` object."""
+        out = await self._query_raw(dql, variables=variables)
         return out.get("data", {})
 
-    async def mutate(self, *, set_json: Any = None, delete_json: Any = None,
-                     commit_now: bool = True) -> dict:
+    async def _mutate_raw(self, *, set_json: Any = None,
+                          delete_json: Any = None, commit_now: bool = True,
+                          params: dict | None = None) -> dict:
         body: dict[str, Any] = {}
         if set_json is not None:
             body["set"] = set_json
@@ -62,10 +176,28 @@ class Dgraph(HTTPDriver):
             body["delete"] = delete_json
         if not body:
             raise ValueError("mutate needs set_json or delete_json")
-        params = {"commitNow": "true"} if commit_now else None
-        out = await self._call("mutate", "/mutate", data=json.dumps(body),
-                               params=params)
+        merged = dict(params or {})
+        if commit_now:
+            merged["commitNow"] = "true"
+        return await self._call("mutate", "/mutate", data=json.dumps(body),
+                                params=merged or None)
+
+    async def mutate(self, *, set_json: Any = None, delete_json: Any = None,
+                     commit_now: bool = True) -> dict:
+        out = await self._mutate_raw(set_json=set_json,
+                                     delete_json=delete_json,
+                                     commit_now=commit_now)
         return out.get("data", {})
+
+    # -- transactions (reference NewTxn/NewReadOnlyTxn, dgraph.go:246-254) -----
+    def new_txn(self) -> DgraphTxn:
+        """Read-write transaction; commit()/discard() or use as an async
+        context manager (commit on clean exit, discard on exception)."""
+        return DgraphTxn(self)
+
+    def new_read_only_txn(self) -> DgraphTxn:
+        """Snapshot-consistent read-only transaction (no commit needed)."""
+        return DgraphTxn(self, read_only=True)
 
     async def alter(self, schema: str) -> dict:
         return await self._call("alter", "/alter", data=schema.encode(),

@@ -19,7 +19,6 @@ import os
 import time
 import urllib.parse
 import xml.etree.ElementTree as ET
-from typing import Any
 
 from . import RowReader
 

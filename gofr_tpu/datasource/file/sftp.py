@@ -9,7 +9,6 @@ instrumentation — the same keep-heavy-deps-out pattern as cassandra.py.
 
 from __future__ import annotations
 
-import io
 import os
 import stat as stat_mod
 import time

@@ -6,7 +6,11 @@ wire protocol stays in the client library (pymongo/motor when installed,
 or any object with pymongo's database API); this driver adds the
 framework's instrumentation and the reference's method surface:
 find / find_one / insert_one / insert_many / update_by_id / update_one /
-update_many / delete_one / delete_many / count_documents / drop.
+update_many / delete_one / delete_many / count_documents / drop, plus
+sessions/transactions (start_session / start_transaction /
+commit_transaction / abort_transaction / end_session — mongo.go:329-346).
+The native MongoWire client implements the same surface directly on the
+wire protocol (lsid/txnNumber/startTransaction on OP_MSG).
 """
 
 from __future__ import annotations
@@ -91,10 +95,19 @@ class Mongo:
             self._observe(op, start, coll)
 
     # -- CRUD (reference container/datasources.go Mongo interface) -------------
+    @staticmethod
+    def _sess(session) -> dict:
+        """kwargs for an optional client session — pymongo's CRUD methods
+        take ``session=``; omitting the key keeps injected fakes that
+        don't model sessions working unchanged."""
+        return {"session": session} if session is not None else {}
+
     async def find(self, collection: str, filter: dict | None = None, *,
-                   limit: int = 0, sort: Any = None) -> list[dict]:
+                   limit: int = 0, sort: Any = None,
+                   session: Any = None) -> list[dict]:
         def run():
-            cur = self._coll(collection).find(filter or {})
+            cur = self._coll(collection).find(filter or {},
+                                              **self._sess(session))
             if sort:
                 cur = cur.sort(sort)
             if limit:
@@ -103,45 +116,83 @@ class Mongo:
 
         return await self._run("find", collection, run)
 
-    async def find_one(self, collection: str, filter: dict | None = None) -> dict | None:
+    async def find_one(self, collection: str, filter: dict | None = None,
+                       session: Any = None) -> dict | None:
         return await self._run("find_one", collection,
-                               self._coll(collection).find_one, filter or {})
+                               self._coll(collection).find_one, filter or {},
+                               **self._sess(session))
 
-    async def insert_one(self, collection: str, document: dict) -> Any:
+    async def insert_one(self, collection: str, document: dict,
+                         session: Any = None) -> Any:
         res = await self._run("insert_one", collection,
-                              self._coll(collection).insert_one, document)
+                              self._coll(collection).insert_one, document,
+                              **self._sess(session))
         return getattr(res, "inserted_id", res)
 
-    async def insert_many(self, collection: str, documents: list[dict]) -> list:
+    async def insert_many(self, collection: str, documents: list[dict],
+                          session: Any = None) -> list:
         res = await self._run("insert_many", collection,
-                              self._coll(collection).insert_many, documents)
+                              self._coll(collection).insert_many, documents,
+                              **self._sess(session))
         return list(getattr(res, "inserted_ids", []))
 
-    async def update_by_id(self, collection: str, id: Any, update: dict) -> int:
+    async def update_by_id(self, collection: str, id: Any, update: dict,
+                           session: Any = None) -> int:
         res = await self._run("update_by_id", collection,
                               self._coll(collection).update_one,
-                              {"_id": id}, {"$set": update})
+                              {"_id": id}, {"$set": update},
+                              **self._sess(session))
         return getattr(res, "modified_count", 0)
 
-    async def update_one(self, collection: str, filter: dict, update: dict) -> int:
+    async def update_one(self, collection: str, filter: dict, update: dict,
+                         session: Any = None) -> int:
         res = await self._run("update_one", collection,
-                              self._coll(collection).update_one, filter, update)
+                              self._coll(collection).update_one, filter,
+                              update, **self._sess(session))
         return getattr(res, "modified_count", 0)
 
-    async def update_many(self, collection: str, filter: dict, update: dict) -> int:
+    async def update_many(self, collection: str, filter: dict, update: dict,
+                          session: Any = None) -> int:
         res = await self._run("update_many", collection,
-                              self._coll(collection).update_many, filter, update)
+                              self._coll(collection).update_many, filter,
+                              update, **self._sess(session))
         return getattr(res, "modified_count", 0)
 
-    async def delete_one(self, collection: str, filter: dict) -> int:
+    async def delete_one(self, collection: str, filter: dict,
+                         session: Any = None) -> int:
         res = await self._run("delete_one", collection,
-                              self._coll(collection).delete_one, filter)
+                              self._coll(collection).delete_one, filter,
+                              **self._sess(session))
         return getattr(res, "deleted_count", 0)
 
-    async def delete_many(self, collection: str, filter: dict) -> int:
+    async def delete_many(self, collection: str, filter: dict,
+                          session: Any = None) -> int:
         res = await self._run("delete_many", collection,
-                              self._coll(collection).delete_many, filter)
+                              self._coll(collection).delete_many, filter,
+                              **self._sess(session))
         return getattr(res, "deleted_count", 0)
+
+    # -- sessions / transactions (reference mongo.go:329-346) ------------------
+    async def start_session(self):
+        """New client session (delegates to the injected client's
+        ``start_session``). Pair with ``start_transaction`` /
+        ``commit_transaction`` / ``abort_transaction`` / ``end_session``
+        below, mirroring the reference's Mongo interface."""
+        if self._client is None:
+            raise MongoError("not connected")
+        return await self._run("start_session", "", self._client.start_session)
+
+    async def start_transaction(self, session) -> None:
+        await self._run("start_transaction", "", session.start_transaction)
+
+    async def commit_transaction(self, session) -> None:
+        await self._run("commit_transaction", "", session.commit_transaction)
+
+    async def abort_transaction(self, session) -> None:
+        await self._run("abort_transaction", "", session.abort_transaction)
+
+    async def end_session(self, session) -> None:
+        await self._run("end_session", "", session.end_session)
 
     async def count_documents(self, collection: str, filter: dict | None = None) -> int:
         return await self._run("count", collection,

@@ -12,6 +12,11 @@ MongoDB's modern command protocol:
   section carrying the command document; replies parsed the same way.
 - Commands: insert / find (+getMore) / update / delete / count / drop /
   ping — each a single document addressed with ``$db``.
+- **Sessions & transactions** (mongo.go:329-346 parity): ``start_session``
+  issues a UUID ``lsid``; ``MongoSession.start_transaction`` attaches
+  ``txnNumber``/``autocommit: false``/``startTransaction`` to the first
+  operation; ``commit_transaction``/``abort_transaction`` are admin-db
+  commands; ``with_transaction`` wraps commit-on-return/abort-on-raise.
 
 Auth note: SCRAM challenge-response is deliberately out of scope here
 (connect to localhost/emulator/sidecar-proxied instances, or keep the
@@ -25,10 +30,10 @@ import datetime as _dt
 import os
 import struct
 import time
-from typing import Any, Sequence
+from typing import Any
 
-__all__ = ["MongoWire", "MongoWireError", "ObjectId",
-           "encode_document", "decode_document"]
+__all__ = ["MongoWire", "MongoWireError", "MongoSession", "ObjectId",
+           "Binary", "Int64", "encode_document", "decode_document"]
 
 
 class MongoWireError(Exception):
@@ -77,6 +82,18 @@ class Int64(int):
     __slots__ = ()
 
 
+class Binary(bytes):
+    """BSON binary with an explicit subtype — plain ``bytes`` encode as
+    subtype 0; logical-session ids must be subtype 4 (UUID)."""
+
+    subtype: int
+
+    def __new__(cls, data: bytes, subtype: int = 0) -> "Binary":
+        self = super().__new__(cls, data)
+        self.subtype = subtype
+        return self
+
+
 def _encode_value(name: bytes, value: Any) -> bytes:
     if isinstance(value, bool):  # before int: bool is an int subclass
         return b"\x08" + name + b"\x00" + (b"\x01" if value else b"\x00")
@@ -97,6 +114,9 @@ def _encode_value(name: bytes, value: Any) -> bytes:
     if isinstance(value, (list, tuple)):
         inner = {str(i): v for i, v in enumerate(value)}
         return b"\x04" + name + b"\x00" + encode_document(inner)
+    if isinstance(value, Binary):
+        return (b"\x05" + name + b"\x00" + struct.pack("<i", len(value))
+                + bytes([value.subtype]) + bytes(value))
     if isinstance(value, (bytes, bytearray)):
         return (b"\x05" + name + b"\x00"
                 + struct.pack("<i", len(value)) + b"\x00" + bytes(value))
@@ -131,7 +151,10 @@ def _decode_value(tag: int, data: bytes, off: int) -> tuple[Any, int]:
         return inner, off + n
     if tag == 0x05:
         n = struct.unpack_from("<i", data, off)[0]
-        return bytes(data[off + 5:off + 5 + n]), off + 5 + n
+        sub = data[off + 4]
+        raw = bytes(data[off + 5:off + 5 + n])
+        # non-zero subtypes (UUID lsids in session replies) must round-trip
+        return (raw if sub == 0 else Binary(raw, sub)), off + 5 + n
     if tag == 0x07:
         return ObjectId(bytes(data[off:off + 12])), off + 12
     if tag == 0x08:
@@ -166,6 +189,78 @@ def decode_document(data: bytes) -> dict:
 
 # ---------------------------------------------------------------------- OP_MSG
 _OP_MSG = 2013
+
+
+class MongoSession:
+    """Logical session + multi-document transaction state.
+
+    The reference driver exposes StartSession / StartTransaction / commit /
+    abort (pkg/gofr/datasource/mongo/mongo.go:329-346); this is the same
+    surface over the raw protocol: the session is an ``lsid`` (UUID
+    subtype-4 binary) attached to every command, a transaction is a
+    monotonically increasing ``txnNumber`` with ``autocommit: false`` and
+    ``startTransaction: true`` on its FIRST operation, and commit/abort are
+    admin-db commands carrying the same session fields.
+
+    Usage::
+
+        session = client.start_session()
+        session.start_transaction()
+        await client.insert_one("orders", {...}, session=session)
+        await client.commit_transaction(session)   # or abort_transaction
+        await client.end_session(session)
+
+    Requires a replica-set or mongos deployment (standalone mongod rejects
+    transactions — the reference inherits the same server constraint).
+    """
+
+    __slots__ = ("lsid", "_txn_number", "_in_txn", "_first_txn_cmd")
+
+    def __init__(self) -> None:
+        self.lsid = {"id": Binary(os.urandom(16), 4)}
+        self._txn_number = 0
+        self._in_txn = False
+        self._first_txn_cmd = False
+
+    @property
+    def in_transaction(self) -> bool:
+        return self._in_txn
+
+    def start_transaction(self) -> None:
+        if self._in_txn:
+            raise MongoWireError("transaction already in progress")
+        self._txn_number += 1
+        self._in_txn = True
+        self._first_txn_cmd = True
+
+    def apply(self, cmd: dict) -> dict:
+        """Merge session/transaction fields into an outgoing command."""
+        cmd["lsid"] = self.lsid
+        if self._in_txn:
+            cmd["txnNumber"] = Int64(self._txn_number)
+            cmd["autocommit"] = False
+            if self._first_txn_cmd:
+                cmd["startTransaction"] = True
+                self._first_txn_cmd = False
+        return cmd
+
+    def finish_fields(self) -> dict | None:
+        """Fields for commitTransaction/abortTransaction — None when the
+        transaction never ran an operation (drivers resolve an empty
+        transaction client-side; the server has no txn to see). Does NOT
+        mutate state: the client clears it via ``finished()`` only after
+        the server acknowledged (or on abort), so a transient commit
+        failure stays retryable or abortable."""
+        if not self._in_txn:
+            raise MongoWireError("no transaction in progress")
+        if self._first_txn_cmd:
+            return None
+        return {"lsid": self.lsid, "txnNumber": Int64(self._txn_number),
+                "autocommit": False}
+
+    def finished(self) -> None:
+        self._in_txn = False
+        self._first_txn_cmd = False
 
 
 class MongoWire:
@@ -219,7 +314,10 @@ class MongoWire:
                 asyncio.open_connection(self.host, self.port), self._timeout)
 
     # -- protocol --------------------------------------------------------------
-    async def _command(self, command: dict) -> dict:
+    async def _command(self, command: dict,
+                       session: "MongoSession | None" = None) -> dict:
+        if session is not None:
+            command = session.apply(dict(command))
         self._adopt_loop()
         async with self._lock:
             await self._ensure()
@@ -261,7 +359,8 @@ class MongoWire:
 
     # -- CRUD surface (parity with datasource/mongo.py) ------------------------
     async def find(self, collection: str, filter: dict | None = None, *,
-                   limit: int = 0, sort: dict | None = None) -> list[dict]:
+                   limit: int = 0, sort: dict | None = None,
+                   session: "MongoSession | None" = None) -> list[dict]:
         start = time.perf_counter()
         cmd: dict[str, Any] = {"find": collection, "filter": filter or {},
                                "$db": self.database}
@@ -269,34 +368,35 @@ class MongoWire:
             cmd["limit"] = limit
         if sort:
             cmd["sort"] = sort
-        reply = await self._command(cmd)
+        reply = await self._command(cmd, session)
         cursor = reply["cursor"]
         docs = list(cursor.get("firstBatch", []))
         while cursor.get("id"):
             reply = await self._command({"getMore": Int64(cursor["id"]),
                                          "collection": collection,
-                                         "$db": self.database})
+                                         "$db": self.database}, session)
             cursor = reply["cursor"]
             docs.extend(cursor.get("nextBatch", []))
         self._observe("find", start, collection)
         return docs
 
-    async def find_one(self, collection: str,
-                       filter: dict | None = None) -> dict | None:
-        docs = await self.find(collection, filter, limit=1)
+    async def find_one(self, collection: str, filter: dict | None = None,
+                       session: "MongoSession | None" = None) -> dict | None:
+        docs = await self.find(collection, filter, limit=1, session=session)
         return docs[0] if docs else None
 
-    async def insert_one(self, collection: str, document: dict) -> Any:
+    async def insert_one(self, collection: str, document: dict,
+                         session: "MongoSession | None" = None) -> Any:
         start = time.perf_counter()
         doc = dict(document)
         doc.setdefault("_id", ObjectId())
         await self._command({"insert": collection, "documents": [doc],
-                             "$db": self.database})
+                             "$db": self.database}, session)
         self._observe("insert_one", start, collection)
         return doc["_id"]
 
-    async def insert_many(self, collection: str,
-                          documents: list[dict]) -> list:
+    async def insert_many(self, collection: str, documents: list[dict],
+                          session: "MongoSession | None" = None) -> list:
         start = time.perf_counter()
         docs = []
         for d in documents:
@@ -304,12 +404,13 @@ class MongoWire:
             d.setdefault("_id", ObjectId())
             docs.append(d)
         await self._command({"insert": collection, "documents": docs,
-                             "$db": self.database})
+                             "$db": self.database}, session)
         self._observe("insert_many", start, collection)
         return [d["_id"] for d in docs]
 
     async def _update(self, op: str, collection: str, filter: dict,
-                      update: dict, multi: bool) -> int:
+                      update: dict, multi: bool,
+                      session: "MongoSession | None" = None) -> int:
         start = time.perf_counter()
         if not any(k.startswith("$") for k in update):
             update = {"$set": update}
@@ -317,40 +418,104 @@ class MongoWire:
             "update": collection,
             "updates": [{"q": filter, "u": update, "multi": multi}],
             "$db": self.database,
-        })
+        }, session)
         self._observe(op, start, collection)
         return int(reply.get("nModified", 0))
 
-    async def update_one(self, collection: str, filter: dict,
-                         update: dict) -> int:
+    async def update_one(self, collection: str, filter: dict, update: dict,
+                         session: "MongoSession | None" = None) -> int:
         return await self._update("update_one", collection, filter, update,
-                                  multi=False)
+                                  multi=False, session=session)
 
-    async def update_many(self, collection: str, filter: dict,
-                          update: dict) -> int:
+    async def update_many(self, collection: str, filter: dict, update: dict,
+                          session: "MongoSession | None" = None) -> int:
         return await self._update("update_many", collection, filter, update,
-                                  multi=True)
+                                  multi=True, session=session)
 
-    async def update_by_id(self, collection: str, id: Any,
-                           update: dict) -> int:
-        return await self.update_one(collection, {"_id": id}, update)
+    async def update_by_id(self, collection: str, id: Any, update: dict,
+                           session: "MongoSession | None" = None) -> int:
+        return await self.update_one(collection, {"_id": id}, update,
+                                     session=session)
 
     async def _delete(self, op: str, collection: str, filter: dict,
-                      limit: int) -> int:
+                      limit: int,
+                      session: "MongoSession | None" = None) -> int:
         start = time.perf_counter()
         reply = await self._command({
             "delete": collection,
             "deletes": [{"q": filter, "limit": limit}],
             "$db": self.database,
-        })
+        }, session)
         self._observe(op, start, collection)
         return int(reply.get("n", 0))
 
-    async def delete_one(self, collection: str, filter: dict) -> int:
-        return await self._delete("delete_one", collection, filter, 1)
+    async def delete_one(self, collection: str, filter: dict,
+                         session: "MongoSession | None" = None) -> int:
+        return await self._delete("delete_one", collection, filter, 1,
+                                  session=session)
 
-    async def delete_many(self, collection: str, filter: dict) -> int:
-        return await self._delete("delete_many", collection, filter, 0)
+    async def delete_many(self, collection: str, filter: dict,
+                          session: "MongoSession | None" = None) -> int:
+        return await self._delete("delete_many", collection, filter, 0,
+                                  session=session)
+
+    # -- sessions / transactions (parity: mongo.go:329-346) --------------------
+    def start_session(self) -> MongoSession:
+        """New logical session. Attach to CRUD calls via ``session=``;
+        drive transactions with ``session.start_transaction()`` +
+        ``commit_transaction``/``abort_transaction``."""
+        return MongoSession()
+
+    async def _finish_txn(self, verb: str, session: MongoSession) -> None:
+        fields = session.finish_fields()
+        if fields is None:
+            session.finished()
+            return  # empty transaction: resolved client-side, nothing sent
+        start = time.perf_counter()
+        try:
+            await self._command({verb: 1, "$db": "admin", **fields})
+        except Exception:
+            # a failed COMMIT must stay retryable/abortable (the server
+            # txn is still open, holding locks); a failed ABORT is
+            # resolved client-side — the server expires it on its own
+            if verb == "abortTransaction":
+                session.finished()
+            raise
+        session.finished()
+        self._observe(verb, start, "")
+
+    async def commit_transaction(self, session: MongoSession) -> None:
+        await self._finish_txn("commitTransaction", session)
+
+    async def abort_transaction(self, session: MongoSession) -> None:
+        await self._finish_txn("abortTransaction", session)
+
+    async def end_session(self, session: MongoSession) -> None:
+        """Release the server-side session (best effort — servers also
+        expire idle sessions on their own)."""
+        if session.in_transaction:
+            await self.abort_transaction(session)
+        try:
+            await self._command({"endSessions": [session.lsid],
+                                 "$db": "admin"})
+        except MongoWireError:
+            pass
+
+    async def with_transaction(self, fn, *, session: MongoSession | None = None):
+        """Run ``await fn(session)`` inside a transaction: commit on return,
+        abort on exception (re-raised). Convenience over the explicit API."""
+        session = session or self.start_session()
+        session.start_transaction()
+        try:
+            result = await fn(session)
+        except BaseException:
+            try:
+                await self.abort_transaction(session)
+            except MongoWireError:
+                pass
+            raise
+        await self.commit_transaction(session)
+        return result
 
     async def count_documents(self, collection: str,
                               filter: dict | None = None) -> int:

@@ -29,7 +29,7 @@ import hashlib
 import hmac
 import time
 import urllib.parse
-from typing import Any, Awaitable, Callable
+from typing import Awaitable, Callable
 
 from . import Message
 

@@ -42,7 +42,6 @@ import asyncio
 import struct
 import time
 import zlib
-from typing import Any
 
 from . import Message, run_sync as _run_sync
 from .kafka_records import (decode_records, encode_record_batch,

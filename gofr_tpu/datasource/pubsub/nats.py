@@ -23,8 +23,6 @@ from __future__ import annotations
 
 import asyncio
 import json
-import time
-from typing import Any
 
 from . import Message, run_sync as _run_sync
 

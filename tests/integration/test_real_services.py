@@ -169,6 +169,46 @@ def test_cassandra_keyspace_table_prepared(cassandra, unique, run):
     run(scenario())
 
 
+def test_cassandra_exec_cas_applied(cassandra, unique, run):
+    """insert-if-not-exists returns applied=True once, then (False,
+    current row); a conditional batch behaves the same — reference
+    ExecCAS / ExecuteBatchCAS."""
+    from gofr_tpu.datasource.cassandra_wire import CassandraWire
+
+    async def scenario():
+        c = CassandraWire(host=cassandra[0], port=cassandra[1])
+        try:
+            await c.exec(
+                f"CREATE KEYSPACE IF NOT EXISTS {unique} WITH replication ="
+                " {'class': 'SimpleStrategy', 'replication_factor': 1}")
+            await c.exec(f"CREATE TABLE {unique}.cas "
+                         f"(id int PRIMARY KEY, name text)")
+            stmt = (f"INSERT INTO {unique}.cas (id, name) VALUES (?, ?) "
+                    "IF NOT EXISTS")
+            applied, current = await c.exec_cas(stmt, (1, "ada"))
+            assert applied is True and current is None
+            applied, current = await c.exec_cas(stmt, (1, "bob"))
+            assert applied is False and current["name"] == "ada"
+
+            applied, rows = await c.batch_exec_cas([
+                (f"UPDATE {unique}.cas SET name = ? WHERE id = ? "
+                 "IF name = ?", ("eve", 1, "ada")),
+            ])
+            assert applied is True
+            applied, rows = await c.batch_exec_cas([
+                (f"UPDATE {unique}.cas SET name = ? WHERE id = ? "
+                 "IF name = ?", ("mal", 1, "ada")),
+            ])
+            assert applied is False and rows and rows[0]["name"] == "eve"
+        finally:
+            try:
+                await c.exec(f"DROP KEYSPACE IF EXISTS {unique}")
+            finally:
+                await c.close()
+
+    run(scenario())
+
+
 # ---------------------------------------------------------------- nats
 def test_nats_core_and_jetstream(nats, unique, run):
     from gofr_tpu.datasource.pubsub.nats import NATS
@@ -208,5 +248,53 @@ def test_clickhouse_ddl_insert_select(clickhouse, unique, run):
                 await ch.exec(f"DROP TABLE IF EXISTS {unique}")
             finally:
                 await ch.close()
+
+    run(scenario())
+
+
+def test_mongo_session_transaction_roundtrip(mongo, unique, run):
+    """Real-server session + transaction: commit persists, abort rolls
+    back (mongo.go:329-346 parity). Transactions need a replica set; the
+    compose file runs mongod --replSet rs0 and this test initiates it on
+    first contact, skipping only if the server is a plain standalone."""
+    import asyncio
+
+    from gofr_tpu.datasource.mongo_wire import MongoWire, MongoWireError
+
+    async def scenario():
+        m = MongoWire(host=mongo[0], port=mongo[1], database="test")
+        try:
+            try:
+                await m._command({"replSetInitiate": {}, "$db": "admin"})
+            except MongoWireError as exc:
+                if "AlreadyInitialized" not in str(exc):
+                    pytest.skip(f"mongod without --replSet: {exc}")
+            for _ in range(60):  # wait for PRIMARY election
+                hello = await m._command({"hello": 1, "$db": "admin"})
+                if hello.get("isWritablePrimary"):
+                    break
+                await asyncio.sleep(0.5)
+            else:
+                pytest.skip("replica set never elected a primary")
+
+            session = m.start_session()
+            session.start_transaction()
+            await m.insert_one(unique, {"k": "committed"}, session=session)
+            await m.commit_transaction(session)
+            assert (await m.find_one(unique, {"k": "committed"})) is not None
+
+            session.start_transaction()
+            await m.insert_one(unique, {"k": "aborted"}, session=session)
+            assert (await m.find_one(unique, {"k": "aborted"},
+                                     session=session)) is not None
+            await m.abort_transaction(session)
+            assert (await m.find_one(unique, {"k": "aborted"})) is None
+            await m.end_session(session)
+        finally:
+            try:
+                await m.drop(unique)
+            except Exception:
+                pass
+            await m.close()
 
     run(scenario())

@@ -104,6 +104,7 @@ class FakeCassandra:
         self.batches: list[list[tuple[bytes, list]]] = []
         self.auth_tokens: list[bytes] = []
         self.result_body = struct.pack(">i", 1)    # Void by default
+        self.batch_result_body = struct.pack(">i", 1)  # Void; CAS sets Rows
         # cql -> (stmt_id, [(name, tid)]) the fake will hand out on PREPARE
         self.preparable: dict[str, tuple[bytes, list]] = {}
         # paging_state (or None for page 0) -> rows_result body
@@ -222,7 +223,7 @@ class FakeCassandra:
                             "unprepared") + _bytes(evicted[0])
                     else:
                         self.batches.append(items)
-                        reply_op, reply = _OP_RESULT, struct.pack(">i", 1)
+                        reply_op, reply = _OP_RESULT, self.batch_result_body
                 else:
                     raise AssertionError(f"unexpected opcode {opcode}")
                 writer.write(struct.pack(">BBhBi", 0x84, 0, stream, reply_op,
@@ -529,5 +530,77 @@ def test_health_check(run):
             await fake.stop()
         down = CassandraWire(host="127.0.0.1", port=get_free_port())
         assert (await down.health_check())["status"] == "DOWN"
+
+    run(scenario())
+
+
+# ------------------------------------------------------------ CAS / LWT
+def test_exec_cas_applied_flag(run):
+    """Lightweight transactions surface Cassandra's [applied] column
+    (reference Client.ExecCAS, cassandra.go:113-180): True on first
+    insert-if-not-exists, then (False, current row) when the row exists."""
+    async def scenario():
+        fake, db = await _pair()
+        stmt = "INSERT INTO users (id, name) VALUES (?, ?) IF NOT EXISTS"
+        fake.preparable[stmt] = (b"\x0c\x0a\x05", [("id", 0x0009),
+                                                   ("name", 0x000D)])
+        try:
+            fake.result_body = rows_result([("[applied]", 0x0004)],
+                                           [[b"\x01"]])
+            applied, current = await db.exec_cas(stmt, [7, "ada"])
+            assert applied is True and current is None
+
+            fake.result_body = rows_result(
+                [("[applied]", 0x0004), ("id", 0x0009), ("name", 0x000D)],
+                [[b"\x00", struct.pack(">i", 7), b"ada"]])
+            applied, current = await db.exec_cas(stmt, [7, "bob"])
+            assert applied is False
+            assert current == {"id": 7, "name": "ada"}
+            # values went over the wire protocol-bound, not in the CQL text
+            assert fake.executes[-1][1] == [struct.pack(">i", 7), b"bob"]
+
+            # a non-conditional statement through exec_cas fails loudly
+            fake.result_body = struct.pack(">i", 1)  # Void
+            with pytest.raises(CassandraWireError, match="applied"):
+                await db.exec_cas("UPDATE users SET name='x' WHERE id=7")
+        finally:
+            await db.close()
+            await fake.stop()
+
+    run(scenario())
+
+
+def test_batch_exec_cas(run):
+    """Conditional batch returns (applied, current_rows) — reference
+    ExecuteBatchCAS (cassandra_batch.go)."""
+    async def scenario():
+        fake, db = await _pair()
+        s1 = "INSERT INTO t (pk, a) VALUES (?, ?) IF NOT EXISTS"
+        s2 = "UPDATE t SET b = ? WHERE pk = ? IF a = ?"
+        fake.preparable[s1] = (b"\x01", [("pk", 0x0009), ("a", 0x0009)])
+        fake.preparable[s2] = (b"\x02", [("b", 0x0009), ("pk", 0x0009),
+                                         ("a", 0x0009)])
+        try:
+            fake.batch_result_body = rows_result([("[applied]", 0x0004)],
+                                                 [[b"\x01"]])
+            applied, rows = await db.batch_exec_cas(
+                [(s1, [1, 2]), (s2, [3, 1, 2])])
+            assert applied is True and rows == []
+            assert len(fake.batches[-1]) == 2
+
+            fake.batch_result_body = rows_result(
+                [("[applied]", 0x0004), ("pk", 0x0009), ("a", 0x0009)],
+                [[b"\x00", struct.pack(">i", 1), struct.pack(">i", 9)]])
+            applied, rows = await db.batch_exec_cas(
+                [(s1, [1, 2]), (s2, [3, 1, 2])])
+            assert applied is False
+            assert rows == [{"pk": 1, "a": 9}]
+
+            fake.batch_result_body = struct.pack(">i", 1)  # Void
+            with pytest.raises(CassandraWireError, match="applied"):
+                await db.batch_exec_cas([(s1, [1, 2])])
+        finally:
+            await db.close()
+            await fake.stop()
 
     run(scenario())

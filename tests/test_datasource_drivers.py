@@ -14,7 +14,7 @@ from aiohttp.test_utils import TestServer
 
 from gofr_tpu.datasource.cassandra import Cassandra, CassandraError
 from gofr_tpu.datasource.clickhouse import ClickHouse, ClickHouseError
-from gofr_tpu.datasource.dgraph import Dgraph
+from gofr_tpu.datasource.dgraph import Dgraph, DgraphError
 from gofr_tpu.datasource.mongo import Mongo
 from gofr_tpu.datasource.opentsdb import OpenTSDB
 from gofr_tpu.datasource.pubsub.nats import NATS
@@ -353,6 +353,66 @@ def test_mongo_injected_client(run):
     assert found["name"] == "ada"
     assert n == 1 and cnt == 1 and deleted == 1
     assert h["status"] == "UP"
+
+
+def test_mongo_injected_client_sessions(run):
+    """Wrapper session surface (reference mongo.go:329-346): CRUD calls
+    made with session= hand pymongo's session kwarg through; the
+    transaction verbs delegate to the session object."""
+    events: list = []
+
+    class _Session:
+        def start_transaction(self):
+            events.append("start")
+
+        def commit_transaction(self):
+            events.append("commit")
+
+        def abort_transaction(self):
+            events.append("abort")
+
+        def end_session(self):
+            events.append("end")
+
+    class _Coll:
+        def insert_one(self, doc, session=None):
+            events.append(("insert", session is not None))
+
+            class R:
+                inserted_id = 1
+
+            return R()
+
+        def find(self, f, session=None):
+            events.append(("find", session is not None))
+            return []
+
+    async def scenario():
+        client = _FakeMongoClient()
+        client.start_session = lambda: _Session()
+
+        class _DB(dict):
+            def __getitem__(self, coll):
+                return _Coll()
+
+        client.dbs["appdb"] = _DB()
+        m = Mongo(client=client, database="appdb")
+        m.connect()
+        s = await m.start_session()
+        await m.start_transaction(s)
+        await m.insert_one("t", {"x": 1}, session=s)
+        await m.find("t", {}, session=s)
+        await m.commit_transaction(s)
+        # without session= the kwarg must be omitted entirely so injected
+        # fakes that don't model sessions keep working
+        await m.insert_one("t", {"x": 2})
+        await m.abort_transaction(s)
+        await m.end_session(s)
+        await m.close()
+
+    run(scenario())
+    assert events == ["start", ("insert", True), ("find", True), "commit",
+                      ("insert", False), "abort", "end"]
 
 
 # ------------------------------------------------------------------------ nats
@@ -718,5 +778,100 @@ def test_nats_jetstream_terminal_status_raises(run):
         finally:
             await n.close()
             await mini.stop()
+
+    run(scenario())
+
+
+def test_dgraph_transactions_commit_discard(run):
+    """Real txn protocol over HTTP (reference NewTxn/NewReadOnlyTxn,
+    dgraph.go:246-254): first mutate acquires start_ts, later ops pin
+    startTs, commit posts accumulated keys/preds to /commit, discard
+    aborts — and staged writes are invisible outside the txn."""
+    committed: dict = {}
+    txns: dict = {}
+    next_ts = [100]
+    commit_calls: list = []
+
+    def _txn_ext(ts):
+        return {"txn": {"start_ts": ts,
+                        "keys": [f"k{ts}"], "preds": [f"p{ts}"]}}
+
+    async def mutate(request: web.Request):
+        body = json.loads(await request.text())
+        assert "commitNow" not in request.query  # txn ops must stage
+        ts = int(request.query.get("startTs") or 0)
+        if not ts:
+            ts = next_ts[0]
+            next_ts[0] += 1
+        staged = txns.setdefault(ts, {})
+        for obj in body.get("set", []):
+            staged[obj["uid"]] = obj
+        return web.json_response({"data": {"code": "Success"},
+                                  "extensions": _txn_ext(ts)})
+
+    async def query(request: web.Request):
+        ts = int(request.query.get("startTs") or 0)
+        view = dict(committed)
+        if ts in txns:
+            view.update(txns[ts])
+        return web.json_response({
+            "data": {"all": sorted(view, key=str)},
+            "extensions": _txn_ext(ts) if ts else {},
+        })
+
+    async def commit(request: web.Request):
+        ts = int(request.query["startTs"])
+        body = json.loads(await request.text())
+        commit_calls.append((ts, dict(request.query), body))
+        staged = txns.pop(ts, {})
+        if request.query.get("abort") != "true":
+            committed.update(staged)
+        return web.json_response({"data": {"code": "Success"}})
+
+    async def scenario():
+        server = await _serve([
+            web.post("/mutate", mutate), web.post("/query", query),
+            web.post("/commit", commit),
+        ])
+        dg = Dgraph(host=server.host, port=server.port)
+        try:
+            txn = dg.new_txn()
+            await txn.mutate(set_json=[{"uid": "_:a", "name": "ada"}])
+            assert txn.start_ts == 100
+            await txn.mutate(set_json=[{"uid": "_:b", "name": "bob"}])
+            assert txn.start_ts == 100  # pinned, not re-acquired
+            # read-your-writes inside; invisible outside
+            assert len((await txn.query("{...}"))["all"]) == 2
+            assert (await dg.query("{...}"))["all"] == []
+            await txn.commit()
+            assert (ts := commit_calls[-1][0]) == 100
+            assert commit_calls[-1][2] == {"keys": ["k100"],
+                                           "preds": ["p100"]}
+            assert len((await dg.query("{...}"))["all"]) == 2
+            with pytest.raises(DgraphError):
+                await txn.mutate(set_json=[{"uid": "_:c"}])  # finished
+
+            # discard: staged write vanishes
+            async with dg.new_txn() as t2:
+                await t2.mutate(set_json=[{"uid": "_:c", "name": "eve"}])
+                await t2.discard()
+            assert commit_calls[-1][1].get("abort") == "true"
+            assert len((await dg.query("{...}"))["all"]) == 2
+
+            # context manager: discard on exception
+            with pytest.raises(RuntimeError):
+                async with dg.new_txn() as t3:
+                    await t3.mutate(set_json=[{"uid": "_:d"}])
+                    raise RuntimeError("boom")
+            assert commit_calls[-1][1].get("abort") == "true"
+            assert len((await dg.query("{...}"))["all"]) == 2
+
+            # read-only txn cannot mutate
+            ro = dg.new_read_only_txn()
+            with pytest.raises(DgraphError):
+                await ro.mutate(set_json=[{"uid": "_:e"}])
+        finally:
+            await dg.close()
+            await server.close()
 
     run(scenario())

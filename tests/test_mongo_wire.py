@@ -56,6 +56,10 @@ class FakeMongod:
     def __init__(self):
         self.collections: dict[str, list[dict]] = {}
         self.commands: list[dict] = []
+        # live transactions: (lsid bytes, txnNumber) -> snapshot workspace.
+        # Commands in a txn operate on the snapshot; commit swaps it in,
+        # abort discards it — mirroring snapshot-isolation semantics.
+        self.txns: dict = {}
         self._server = None
         self.port = get_free_port()
 
@@ -98,19 +102,50 @@ class FakeMongod:
     def _dispatch(self, cmd):
         if "ping" in cmd:
             return {"ok": 1}
+        key = None
+        if "lsid" in cmd and "txnNumber" in cmd:
+            key = (bytes(cmd["lsid"]["id"]), cmd["txnNumber"])
+        if "commitTransaction" in cmd:
+            ws = self.txns.pop(key, None)
+            if ws is None:
+                return {"ok": 0, "codeName": "NoSuchTransaction",
+                        "errmsg": "no transaction"}
+            self.collections = ws
+            return {"ok": 1}
+        if "abortTransaction" in cmd:
+            if self.txns.pop(key, None) is None:
+                return {"ok": 0, "codeName": "NoSuchTransaction",
+                        "errmsg": "no transaction"}
+            return {"ok": 1}
+        if "endSessions" in cmd:
+            return {"ok": 1}
+        if key is not None:
+            import copy
+
+            if cmd.get("startTransaction"):
+                if cmd.get("autocommit") is not False:
+                    return {"ok": 0, "codeName": "InvalidOptions",
+                            "errmsg": "startTransaction needs autocommit=false"}
+                self.txns[key] = copy.deepcopy(self.collections)
+            if key not in self.txns:
+                return {"ok": 0, "codeName": "NoSuchTransaction",
+                        "errmsg": "txn command without startTransaction"}
+            store = self.txns[key]
+        else:
+            store = self.collections
         if "insert" in cmd:
-            rows = self.collections.setdefault(cmd["insert"], [])
+            rows = store.setdefault(cmd["insert"], [])
             rows.extend(cmd["documents"])
             return {"ok": 1, "n": len(cmd["documents"])}
         if "find" in cmd:
-            rows = [d for d in self.collections.get(cmd["find"], [])
+            rows = [d for d in store.get(cmd["find"], [])
                     if self._match(d, cmd.get("filter") or {})]
             if cmd.get("limit"):
                 rows = rows[:cmd["limit"]]
             return {"ok": 1, "cursor": {"id": 0, "ns": cmd["find"],
                                         "firstBatch": rows}}
         if "update" in cmd:
-            rows = self.collections.get(cmd["update"], [])
+            rows = store.get(cmd["update"], [])
             n = 0
             for u in cmd["updates"]:
                 for doc in rows:
@@ -121,7 +156,7 @@ class FakeMongod:
                             break
             return {"ok": 1, "n": n, "nModified": n}
         if "delete" in cmd:
-            rows = self.collections.get(cmd["delete"], [])
+            rows = store.get(cmd["delete"], [])
             n = 0
             for d in cmd["deletes"]:
                 keep = []
@@ -130,17 +165,17 @@ class FakeMongod:
                         n += 1
                     else:
                         keep.append(doc)
-                self.collections[cmd["delete"]] = rows = keep
+                store[cmd["delete"]] = rows = keep
             return {"ok": 1, "n": n}
         if "count" in cmd:
-            rows = [d for d in self.collections.get(cmd["count"], [])
+            rows = [d for d in store.get(cmd["count"], [])
                     if self._match(d, cmd.get("query") or {})]
             return {"ok": 1, "n": len(rows)}
         if "drop" in cmd:
-            if cmd["drop"] not in self.collections:
+            if cmd["drop"] not in store:
                 return {"ok": 0, "codeName": "NamespaceNotFound",
                         "errmsg": "ns not found"}
-            del self.collections[cmd["drop"]]
+            del store[cmd["drop"]]
             return {"ok": 1}
         return {"ok": 0, "codeName": "CommandNotFound",
                 "errmsg": f"unknown command {list(cmd)[0]}"}
@@ -229,5 +264,112 @@ def test_health_check(run):
         down = MongoWire(host="127.0.0.1", port=get_free_port())
         health = await down.health_check()
         assert health["status"] == "DOWN"
+
+    run(scenario())
+
+
+# ---------------------------------------------------------- sessions and txns
+def test_session_transaction_commit_and_wire_fields(run):
+    """First txn command carries lsid + txnNumber + startTransaction +
+    autocommit=false; later ones drop startTransaction; commit is an
+    admin-db command with the same session fields — and writes only become
+    visible outside the session at commit (mongo.go:329-346 parity)."""
+    async def scenario():
+        fake, db = await _pair()
+        try:
+            session = db.start_session()
+            session.start_transaction()
+            await db.insert_one("orders", {"sku": "a1"}, session=session)
+            await db.update_one("orders", {"sku": "a1"}, {"qty": 2},
+                                session=session)
+            # read-your-writes inside the txn...
+            row = await db.find_one("orders", {"sku": "a1"}, session=session)
+            assert row is not None and row["qty"] == 2
+            # ...but invisible outside until commit
+            assert (await db.find_one("orders", {"sku": "a1"})) is None
+            await db.commit_transaction(session)
+            row = await db.find_one("orders", {"sku": "a1"})
+            assert row is not None and row["qty"] == 2
+
+            ins, upd = fake.commands[0], fake.commands[1]
+            assert ins["startTransaction"] is True
+            assert ins["autocommit"] is False
+            assert isinstance(ins["lsid"]["id"], bytes)
+            assert len(ins["lsid"]["id"]) == 16
+            assert "startTransaction" not in upd
+            assert upd["txnNumber"] == ins["txnNumber"]
+            assert upd["lsid"] == ins["lsid"]
+            commit = next(c for c in fake.commands
+                          if "commitTransaction" in c)
+            assert commit["$db"] == "admin"
+            assert commit["lsid"] == ins["lsid"]
+            await db.end_session(session)
+        finally:
+            await db.close()
+            await fake.stop()
+
+    run(scenario())
+
+
+def test_session_transaction_abort_rolls_back(run):
+    async def scenario():
+        fake, db = await _pair()
+        try:
+            await db.insert_one("acct", {"id": 1, "bal": 10})
+            session = db.start_session()
+            session.start_transaction()
+            await db.update_one("acct", {"id": 1}, {"bal": 0},
+                                session=session)
+            await db.delete_one("acct", {"id": 1}, session=session)
+            await db.abort_transaction(session)
+            row = await db.find_one("acct", {"id": 1})
+            assert row is not None and row["bal"] == 10
+            # a NEW transaction on the same session bumps txnNumber
+            session.start_transaction()
+            await db.insert_one("acct", {"id": 2}, session=session)
+            await db.commit_transaction(session)
+            nums = [c["txnNumber"] for c in fake.commands
+                    if "txnNumber" in c and "lsid" in c
+                    and ("insert" in c or "update" in c or "delete" in c)]
+            assert nums[-1] == nums[0] + 1
+            assert await db.count_documents("acct") == 2
+        finally:
+            await db.close()
+            await fake.stop()
+
+    run(scenario())
+
+
+def test_with_transaction_helper_and_empty_commit(run):
+    async def scenario():
+        fake, db = await _pair()
+        try:
+            async def work(session):
+                await db.insert_one("t", {"k": 1}, session=session)
+                return "done"
+
+            assert await db.with_transaction(work) == "done"
+            assert await db.count_documents("t") == 1
+
+            async def broken(session):
+                await db.insert_one("t", {"k": 2}, session=session)
+                raise RuntimeError("boom")
+
+            with pytest.raises(RuntimeError):
+                await db.with_transaction(broken)
+            assert await db.count_documents("t") == 1  # rolled back
+
+            # empty transaction: commit resolves client-side, no wire cmd
+            n_before = len(fake.commands)
+            session = db.start_session()
+            session.start_transaction()
+            await db.commit_transaction(session)
+            assert len(fake.commands) == n_before
+            # double-finish is an error (state machine parity)
+            with pytest.raises(MongoWireError):
+                await db.commit_transaction(session)
+        finally:
+            await db.close()
+            await fake.stop()
 
     run(scenario())
