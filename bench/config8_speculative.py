@@ -24,14 +24,20 @@ from common import boot, configure_free_ports, emit, run
 
 
 async def _served_ab(streams: int, max_new: int, prompt: list[int],
-                     spec_k: int) -> dict:
-    """Boot llama_server with/without speculation; return tok/s + outputs."""
+                     spec_k: int, draft_preset: str | None = None) -> dict:
+    """Boot llama_server with/without speculation; return tok/s + outputs.
+    ``draft_preset`` selects draft-model proposals (LLM_DRAFT_PRESET) for
+    the window instead of prompt lookup."""
     import asyncio
 
     import grpc.aio
 
     ports = configure_free_ports()
     os.environ["LLM_SPEC_K"] = str(spec_k)
+    if draft_preset is None:
+        os.environ.pop("LLM_DRAFT_PRESET", None)
+    else:
+        os.environ["LLM_DRAFT_PRESET"] = draft_preset
 
     import examples.llama_server.main as llama_server
 
@@ -161,8 +167,15 @@ async def main() -> None:
 
     plain = await _served_ab(streams, max_new, prompt, spec_k=0)
     spec = await _served_ab(streams, max_new, prompt, spec_k=k)
+    # draft-model arm (VERDICT r4 #7): "self" = target-as-draft, the
+    # machinery's acceptance upper bound; point LLM_DRAFT_CKPT at a real
+    # small checkpoint for the production number
+    draft = await _served_ab(streams, max_new, prompt, spec_k=k,
+                             draft_preset="self")
 
     n_match = sum(a == b for a, b in zip(spec["outputs"], plain["outputs"]))
+    n_match_draft = sum(a == b for a, b in zip(draft["outputs"],
+                                               plain["outputs"]))
 
     # oracle ceiling on the same weights (single stream, no serving stack)
     oracle = _oracle_row(cfg_probe, params, np.asarray(prompt, np.int32),
@@ -178,6 +191,15 @@ async def main() -> None:
                                   if spec["accept_per_window"] is not None
                                   else None),
             "streams_matching_plain": f"{n_match}/{streams}",
+            "served_draft_tok_per_s": round(draft["tok_per_s"], 1),
+            "draft_model_speedup": round(
+                draft["tok_per_s"] / plain["tok_per_s"], 3),
+            "draft_accept_per_window": (
+                round(draft["accept_per_window"], 3)
+                if draft["accept_per_window"] is not None else None),
+            "draft_streams_matching_plain": f"{n_match_draft}/{streams}",
+            "draft_arm": "self (target-as-draft upper bound; "
+                         "LLM_DRAFT_CKPT for a real small draft)",
             "streams": streams,
             "max_new": max_new,
             "k": k,

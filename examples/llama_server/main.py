@@ -13,8 +13,6 @@ on CPU tests and on real chips.
 
 import os
 
-import jax
-
 import gofr_tpu
 from gofr_tpu.grpc import JSONService
 from gofr_tpu.ml.generate import Sampler
@@ -73,6 +71,9 @@ def main() -> gofr_tpu.App:
     # (shared with openai_server; a HF checkpoint defines the arch)
     cfg = llama.config_from_env(tiny_vocab_size=TOKENIZER.vocab_size)
     params = llama.params_from_config(cfg)
+    spec_k = int(os.environ.get("LLM_SPEC_K", "0"))
+    draft_params, draft_cfg = (llama.draft_from_env(cfg, params)
+                               if spec_k else (None, None))
     app.register_llm(
         "chat", params, cfg,
         batch_slots=int(os.environ.get("LLM_SLOTS", "4")),
@@ -82,9 +83,11 @@ def main() -> gofr_tpu.App:
         # real checkpoints carry their stop id (hf_config); random-weight
         # presets keep decoding to max_new (any id is as likely as eos)
         eos_id=getattr(cfg, "eos_id", None),
-        # LLM_SPEC_K>0: device-resident prompt-lookup speculation inside
-        # the continuous-batching chunk (greedy-only, lossless)
-        spec_k=int(os.environ.get("LLM_SPEC_K", "0")),
+        # LLM_SPEC_K>0: device-resident speculation inside the
+        # continuous-batching chunk (greedy-only, lossless); drafts come
+        # from LLM_DRAFT_CKPT/LLM_DRAFT_PRESET when set, else prompt lookup
+        spec_k=spec_k,
+        draft_params=draft_params, draft_cfg=draft_cfg,
         # LLM_PAGE_SIZE>0: block-paged KV pool (LLM_PAGES sizes it below
         # the dense worst case — more concurrent slots per HBM byte)
         page_size=int(os.environ.get("LLM_PAGE_SIZE", "0")),

@@ -18,10 +18,8 @@ import os
 import time
 import uuid
 
-import jax
-
 import gofr_tpu
-from gofr_tpu.ml.generate import Sampler
+from gofr_tpu.ml.generate import PrefixEvicted, Sampler
 from gofr_tpu.models import llama
 from gofr_tpu.native.tokenizer import BPETokenizer
 
@@ -140,26 +138,85 @@ async def _cached_prefix(llm, messages, prompt_text: str):
         entry = cache[key]
         if isinstance(entry, asyncio.Future):
             entry = await entry
-        if entry is None:
+        if isinstance(entry, int) and not llm.has_prefix(entry):
+            # the generator LRU-evicted it under pool pressure — treat as
+            # a miss and re-register below
+            cache.pop(key, None)
+        elif entry is None:
             return None, ids_full, len(ids_full)
-        return entry, ids_full[len(ids_sys):], len(ids_full)
+        else:
+            cache[key] = cache.pop(key)  # LRU: re-insert at the tail
+            return entry, ids_full[len(ids_sys):], len(ids_full)
     if len(cache) >= _PREFIX_CACHE_CAP:
-        return None, ids_full, len(ids_full)  # bounded: no churn
+        # evict the least-recently-used idle entry so a rotating set of
+        # system prompts keeps caching instead of freezing the first N
+        for old_key, old_entry in list(cache.items()):
+            if isinstance(old_entry, asyncio.Future):
+                continue  # registration in flight
+            cache.pop(old_key, None)
+            if isinstance(old_entry, int):
+                try:
+                    await asyncio.to_thread(llm.drop_prefix, old_entry)
+                except Exception:
+                    pass  # still borrowed or already evicted — fine
+            break
+        if len(cache) >= _PREFIX_CACHE_CAP:
+            return None, ids_full, len(ids_full)  # everything in flight
     fut = asyncio.get_running_loop().create_future()
     cache[key] = fut  # reserve BEFORE awaiting: no check-then-act race
-    try:
-        # one-time prefill on the serving thread; don't block the loop
-        pid = await asyncio.to_thread(llm.register_prefix, ids_sys)
-    except Exception:
-        # caching is an optimization: the uncached path serves the same
-        # request (docs promise a silent fallback), and the negative
-        # entry stops every later request re-attempting a doomed prefill
-        pid = None
-    cache[key] = pid
-    fut.set_result(pid)
+
+    async def _register():
+        try:
+            # one-time prefill on the serving thread; don't block the loop
+            pid = await asyncio.to_thread(llm.register_prefix, ids_sys)
+        except Exception:
+            # caching is an optimization: the uncached path serves the same
+            # request (docs promise a silent fallback), and the negative
+            # entry stops every later request re-attempting a doomed prefill
+            pid = None
+        cache[key] = pid
+        if not fut.done():
+            fut.set_result(pid)
+        return pid
+
+    # An independent task, awaited through shield: if THIS request is
+    # cancelled (client disconnect) mid-registration, the task still runs
+    # to completion and resolves the Future — otherwise every later
+    # request with the same system prompt would await a forever-pending
+    # entry (CancelledError is a BaseException; an except Exception here
+    # would never resolve it).
+    task = asyncio.get_running_loop().create_task(_register())
+    pid = await asyncio.shield(task)
     if pid is None:
         return None, ids_full, len(ids_full)
     return pid, ids_full[len(ids_sys):], len(ids_full)
+
+
+def _forget_prefix(llm, pid) -> None:
+    """Drop cache entries pointing at an evicted prefix id."""
+    cache = getattr(llm, "_openai_prefix_cache", None) or {}
+    for key, entry in list(cache.items()):
+        if entry == pid:
+            cache.pop(key, None)
+
+
+def _openai_finish(info: dict, n_out: int, max_new: int) -> str:
+    """Map the LLM server's finish reason onto OpenAI's vocabulary. An
+    evicted (pool-dry, truncated) answer reports "length" — never the
+    false natural "stop" (ADVICE r4 #4); the precise reason stays in
+    the non-standard "gofr_finish_reason" field clients may inspect."""
+    reason = info.get("finish_reason")
+    if reason == "eviction":
+        return "length"
+    if reason in ("stop", "length"):
+        return reason
+    return "length" if n_out >= max_new else "stop"
+
+
+def _finish_extra(info: dict) -> dict:
+    """The non-standard precise-reason field promised by _openai_finish."""
+    return ({"gofr_finish_reason": "eviction"}
+            if info.get("finish_reason") == "eviction" else {})
 
 
 def _chunk(kind: str, rid: str, created: int, choices) -> dict:
@@ -186,24 +243,41 @@ async def chat_completions(ctx: gofr_tpu.Context):
                 [_choice_delta(0, role="assistant", content="")]))
             n_out = 0
             dec = _StreamDecoder()
-            # one SSE chunk per decode-chunk burst (a delta may carry
-            # several tokens' text — valid OpenAI protocol, far fewer frames)
-            async for burst in llm.stream_chunks(ids, max_new,
-                                                 prefix=prefix):
-                n_out += len(burst)
-                await stream.send(_chunk(
-                    "chat.completion.chunk", rid, created,
-                    [_choice_delta(0, content="".join(
-                        dec.push(t) for t in burst))]))
+            fin: dict = {}
+            try:
+                # one SSE chunk per decode-chunk burst (a delta may carry
+                # several tokens' text — valid OpenAI protocol, far fewer
+                # frames)
+                async for burst in llm.stream_chunks(ids, max_new,
+                                                     prefix=prefix,
+                                                     info=fin):
+                    n_out += len(burst)
+                    await stream.send(_chunk(
+                        "chat.completion.chunk", rid, created,
+                        [_choice_delta(0, content="".join(
+                            dec.push(t) for t in burst))]))
+            except PrefixEvicted:
+                # eviction raced our admission (nothing streamed yet):
+                # retry once with the full prompt, uncached
+                _forget_prefix(llm, prefix)
+                ids = TOKENIZER.encode(_render_chat(messages))
+                async for burst in llm.stream_chunks(ids, max_new,
+                                                     info=fin):
+                    n_out += len(burst)
+                    await stream.send(_chunk(
+                        "chat.completion.chunk", rid, created,
+                        [_choice_delta(0, content="".join(
+                            dec.push(t) for t in burst))]))
             tail = dec.flush()
             if tail:
                 await stream.send(_chunk(
                     "chat.completion.chunk", rid, created,
                     [_choice_delta(0, content=tail)]))
-            finish = "length" if n_out >= max_new else "stop"
             await stream.send(_chunk(
                 "chat.completion.chunk", rid, created,
-                [_choice_delta(0, finish=finish)]))
+                [{**_choice_delta(0, finish=_openai_finish(fin, n_out,
+                                                           max_new)),
+                  **_finish_extra(fin)}]))
             if (body.get("stream_options") or {}).get("include_usage"):
                 await stream.send({**_chunk("chat.completion.chunk", rid,
                                             created, []),
@@ -211,7 +285,13 @@ async def chat_completions(ctx: gofr_tpu.Context):
             await stream.done()
         return stream.response
 
-    toks = await llm.generate(ids, max_new, prefix=prefix)
+    fin: dict = {}
+    try:
+        toks = await llm.generate(ids, max_new, prefix=prefix, info=fin)
+    except PrefixEvicted:
+        _forget_prefix(llm, prefix)
+        ids = TOKENIZER.encode(_render_chat(messages))
+        toks = await llm.generate(ids, max_new, info=fin)
     return gofr_tpu.Raw({
         "id": rid, "object": "chat.completion", "created": created,
         "model": MODEL_ID,
@@ -219,7 +299,8 @@ async def chat_completions(ctx: gofr_tpu.Context):
             "index": 0,
             "message": {"role": "assistant",
                         "content": _decode(toks)},
-            "finish_reason": "stop" if len(toks) < max_new else "length",
+            "finish_reason": _openai_finish(fin, len(toks), max_new),
+            **_finish_extra(fin),
         }],
         "usage": _usage(n_prompt, len(toks)),
     })
@@ -246,26 +327,31 @@ async def completions(ctx: gofr_tpu.Context):
         async with gofr_tpu.EventStream(ctx) as stream:
             n_out = 0
             dec = _StreamDecoder()
-            async for burst in llm.stream_chunks(ids, max_new):
+            fin: dict = {}
+            async for burst in llm.stream_chunks(ids, max_new, info=fin):
                 n_out += len(burst)
                 await stream.send(_chunk(
                     "text_completion", rid, created,
                     [{"index": 0,
                       "text": "".join(dec.push(t) for t in burst),
                       "finish_reason": None}]))
-            finish = "length" if n_out >= max_new else "stop"
             await stream.send(_chunk(
                 "text_completion", rid, created,
-                [{"index": 0, "text": dec.flush(), "finish_reason": finish}]))
+                [{"index": 0, "text": dec.flush(),
+                  "finish_reason": _openai_finish(fin, n_out, max_new),
+                  **_finish_extra(fin)}]))
             await stream.done()
         return stream.response
 
-    toks = await llm.generate(ids, max_new)
+    fin: dict = {}
+    toks = await llm.generate(ids, max_new, info=fin)
     return gofr_tpu.Raw({
         "id": rid, "object": "text_completion", "created": created,
         "model": MODEL_ID,
         "choices": [{"index": 0, "text": _decode(toks),
-                     "finish_reason": "stop" if len(toks) < max_new else "length"}],
+                     "finish_reason": _openai_finish(fin, len(toks),
+                                                     max_new),
+                     **_finish_extra(fin)}],
         "usage": _usage(len(ids), len(toks)),
     })
 
@@ -291,6 +377,9 @@ def main() -> gofr_tpu.App:
     # (shared with llama_server)
     cfg = llama.config_from_env(tiny_vocab_size=TOKENIZER.vocab_size)
     params = llama.params_from_config(cfg)
+    spec_k = int(os.environ.get("LLM_SPEC_K", "0"))
+    draft_params, draft_cfg = (llama.draft_from_env(cfg, params)
+                               if spec_k else (None, None))
     app.register_llm(
         MODEL_ID, params, cfg,
         batch_slots=int(os.environ.get("LLM_SLOTS", "4")),
@@ -298,7 +387,10 @@ def main() -> gofr_tpu.App:
         chunk=int(os.environ.get("LLM_CHUNK", "4")),
         sampler=Sampler(temperature=float(os.environ.get("LLM_TEMPERATURE", "0"))),
         eos_id=getattr(cfg, "eos_id", None),
-        spec_k=int(os.environ.get("LLM_SPEC_K", "0")),
+        # drafts from LLM_DRAFT_CKPT/LLM_DRAFT_PRESET when set, else
+        # prompt lookup
+        spec_k=spec_k,
+        draft_params=draft_params, draft_cfg=draft_cfg,
         # paged pool enables automatic system-prompt prefix caching
         page_size=int(os.environ.get("LLM_PAGE_SIZE", "0")),
         n_pages=int(os.environ.get("LLM_PAGES", "0")) or None,

@@ -9,7 +9,6 @@ its own HTTP port and the mesh's dp axis carries the batch.
 ``GET /image?prompt=...`` returns image/png (sampler steps via DIT_STEPS env).
 """
 
-import io
 import os
 import struct
 import zlib

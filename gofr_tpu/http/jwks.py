@@ -20,7 +20,7 @@ import hashlib
 import json
 import time
 import urllib.request
-from typing import Any, Callable
+from typing import Callable
 
 __all__ = ["JWKSProvider", "JWKSError", "verify_rs256", "decode_b64url"]
 

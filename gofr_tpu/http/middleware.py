@@ -23,7 +23,7 @@ import time
 import traceback
 from dataclasses import dataclass, field
 from http import HTTPStatus
-from typing import Any, Awaitable, Callable, TextIO
+from typing import Awaitable, Callable, TextIO
 
 from aiohttp import web
 

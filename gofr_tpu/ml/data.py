@@ -73,7 +73,6 @@ def jsonl_source(path: str, filesystem=None) -> Callable[[], Iterator[dict]]:
 
 def csv_source(path: str, filesystem=None) -> Callable[[], Iterator[dict]]:
     import csv
-    import io
 
     def gen() -> Iterator[dict]:
         if filesystem is not None:

@@ -34,13 +34,19 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["Sampler", "sample_logits", "greedy", "Generator",
-           "PagePoolExhausted"]
+           "PagePoolExhausted", "PrefixEvicted"]
 
 
 class PagePoolExhausted(RuntimeError):
     """Paged-KV admission failed for lack of free pages — transient
     back-pressure (pages free as slots finish), not a bad request; the
     serving layer requeues instead of erroring the client."""
+
+
+class PrefixEvicted(RuntimeError):
+    """The registered prefix this request references was LRU-evicted under
+    pool pressure. Callers re-register (or retry with the full prompt) —
+    the suffix-only ids they hold are meaningless without the prefix."""
 
 
 class Sampler:
@@ -91,7 +97,8 @@ def sample_logits(logits: jnp.ndarray, key, sampler: Sampler) -> jnp.ndarray:
 
 class _Slot:
     __slots__ = ("live", "tokens", "max_new", "produced", "prompt_len",
-                 "eos_hit", "callback")
+                 "eos_hit", "evicted", "callback", "spec_windows",
+                 "spec_emitted")
 
     def __init__(self) -> None:
         self.live = False
@@ -100,6 +107,14 @@ class _Slot:
         self.produced = 0
         self.prompt_len = 0
         self.eos_hit = False
+        # per-stream draft efficiency (spec mode): windows seen / tokens
+        # emitted — the serving layer exports the acceptance rate
+        self.spec_windows = 0
+        self.spec_emitted = 0
+        # a dry page pool truncated this slot: it finished with the tokens
+        # it had, NOT at eos/max_new — serving layers must not report it
+        # as a natural "stop" (ADVICE r4 #4)
+        self.evicted = False
         self.callback = None
 
 
@@ -120,7 +135,8 @@ class Generator:
                  seed: int = 0, mesh=None, chunk: int = 1,
                  shard_cache: bool = False, spec_k: int = 0,
                  spec_ngram: int = 3, page_size: int = 0,
-                 n_pages: int | None = None) -> None:
+                 n_pages: int | None = None, draft_params: Any = None,
+                 draft_cfg: Any = None) -> None:
         import contextlib
 
         from ..models import llama
@@ -182,6 +198,8 @@ class Generator:
             self._slot_prefix: list[int | None] = [None] * batch_slots
             self._prefixes: dict[int, dict] = {}
             self._next_prefix = 1
+            self._prefix_clock = 0   # LRU stamp for prefix eviction
+            self.prefix_evictions = 0
         elif shard_cache:
             # Multi-controller serving (ml/multihost.py): slots shard over
             # dp, kv heads over tp (matching SHARDING_RULES so decode never
@@ -372,6 +390,20 @@ class Generator:
         self.spec_k = int(spec_k)
         self.spec_ngram = int(spec_ngram)
         self._tokens_dev = None
+        # draft-model speculation: a small shared-vocab model proposes the
+        # K draft tokens instead of prompt lookup (VERDICT r4 #7) — its own
+        # dense fp cache rides the jitted window as donated state
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError("draft_params and draft_cfg come together")
+        if draft_params is not None and not spec_k:
+            raise ValueError("a draft model requires spec_k > 0")
+        if draft_cfg is not None and draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError("draft and target must share the vocabulary")
+        if draft_cfg is not None and getattr(draft_cfg, "kv_quant", False):
+            raise ValueError("the draft model uses the fp cache")
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self._draft_cache: Any = {}  # empty pytree when no draft model
         # draft efficiency: emitted / windows - 1 == avg accepted per window
         self.spec_windows = 0
         self.spec_emitted = 0
@@ -396,14 +428,23 @@ class Generator:
         mesh = self.mesh
         if self.sampler.temperature > 0:
             raise ValueError("speculative decode is greedy-only")
-        if getattr(cfg, "kv_quant", False):
-            raise ValueError("speculative decode needs the fp KV cache")
+        if getattr(cfg, "kv_quant", False) and self.page_size:
+            # dense spec composes with the int8 cache (decode_window
+            # quantizes window rows); the paged window is still fp-only
+            raise ValueError(
+                "speculative decode with int8 KV requires the dense cache")
         K = self.spec_k
         hist_cap = self.max_seq + K + 2
         self._hist_cap = hist_cap
         B = self.batch_slots
         self._tokens_dev = self._repl_zeros((B, hist_cap))
         host_visible = self._host_visible
+        draft_params, draft_cfg = self.draft_params, self.draft_cfg
+        if draft_params is not None:
+            # the draft's dense fp cache: sized past max_seq so the K+1
+            # draft steps of the last window never clip
+            self._draft_cache = llama.init_cache(draft_cfg, B,
+                                                 self.max_seq + K + 2)
 
         ngrams = tuple(range(max(1, self.spec_ngram), 0, -1))
 
@@ -437,21 +478,48 @@ class Generator:
 
         paged = bool(self.page_size)
 
+        def run_draft_model(tok, dcache):
+            """Propose K tokens with the draft model: K sequential greedy
+            draft steps (the window input token first), plus one extra
+            step writing d_K's KV row — a fully-accepted window needs that
+            row in place before the next round. Returns ([B, K] drafts,
+            updated draft cache). ~2K+1 small-model sweeps per window; the
+            target's single big sweep still dominates."""
+            def dstep(carry, _):
+                t, dc = carry
+                dlogits, dc = llama.decode_step(draft_params, t, dc,
+                                                draft_cfg)
+                nxt = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+                return (nxt, dc), nxt
+
+            (last, dcache), drafts = jax.lax.scan(
+                dstep, (tok, dcache), None, length=K)
+            _, dcache = llama.decode_step(draft_params, last, dcache,
+                                          draft_cfg)
+            return jnp.moveaxis(drafts, 0, 1), dcache
+
         def make_spec_chunk_fn(n_windows: int):
-            def spec_chunk_fn(params, tok, cache, tokens_dev, table=None):
+            def spec_chunk_fn(params, tok, cache, tokens_dev, draft_cache,
+                              table=None):
                 """``n_windows`` draft→verify→accept rounds. Returns
                 (input token row [B] — the firsts ride-along, as in the
                 plain chunk — emitted candidates [W, B, K+1], emit counts
-                [W, B], final carry tok, cache, tokens_dev). Paged mode
-                routes window writes/reads through the page table."""
+                [W, B], final carry tok, cache, tokens_dev, draft_cache).
+                Drafts come from the draft model when one is configured,
+                else prompt lookup; ``draft_cache`` is the empty pytree in
+                lookup mode. Paged mode routes window writes/reads through
+                the page table."""
                 tok_in = tok
                 ar = jnp.arange(K + 1)[None, :]
                 rows = jnp.arange(B)
 
                 def body(carry, _):
-                    tok, cache, td = carry
+                    tok, cache, td, dcache = carry
                     h = cache["len"] + 1  # [B] history length
-                    draft = jax.vmap(draft_row)(td, h)           # [B, K]
+                    if draft_params is not None:
+                        draft, dcache = run_draft_model(tok, dcache)
+                    else:
+                        draft = jax.vmap(draft_row)(td, h)       # [B, K]
                     window = jnp.concatenate([tok[:, None], draft], axis=1)
                     if paged:
                         logits, cache = llama.paged_decode_window(
@@ -473,22 +541,30 @@ class Generator:
                         ar < n_acc[:, None], draft_pad,
                         jnp.where(ar == n_acc[:, None], g_last, 0))
                     n_emit = n_acc + 1
-                    cache = {**cache,
-                             "len": jnp.minimum(cache["len"] + n_emit, S_max)}
+                    new_len = jnp.minimum(cache["len"] + n_emit, S_max)
+                    cache = {**cache, "len": new_len}
+                    if draft_params is not None:
+                        # the draft fed tok,d1..dK itself, so rows for every
+                        # accepted token exist — roll its len back to the
+                        # target's (rejected rows are overwritten next round)
+                        d_S = dcache["k"].shape[2]
+                        dcache = {**dcache,
+                                  "len": jnp.minimum(new_len, d_S)}
                     # append emitted tokens to history; rejected positions
                     # route to hist_cap and drop
                     widx = jnp.where(ar < n_emit[:, None],
                                      h[:, None] + ar, hist_cap)
                     td = td.at[rows[:, None], widx].set(emit, mode="drop")
-                    return (g_last[:, 0], cache, td), (emit, n_emit)
+                    return (g_last[:, 0], cache, td, dcache), (emit, n_emit)
 
-                (tok, cache, tokens_dev), (emits, counts) = jax.lax.scan(
-                    body, (tok, cache, tokens_dev), None, length=n_windows)
+                carry0 = (tok, cache, tokens_dev, draft_cache)
+                (tok, cache, tokens_dev, draft_cache), (emits, counts) = \
+                    jax.lax.scan(body, carry0, None, length=n_windows)
                 return (host_visible(tok_in), host_visible(emits),
                         host_visible(counts), host_visible(tok), cache,
-                        tokens_dev)
+                        tokens_dev, draft_cache)
 
-            return jax.jit(spec_chunk_fn, donate_argnums=(2, 3))
+            return jax.jit(spec_chunk_fn, donate_argnums=(2, 3, 4))
 
         self._chunk_fn = make_spec_chunk_fn(self.chunk)
         self._mini_chunk_fn = self._chunk_fn if self.chunk == 1 \
@@ -535,6 +611,19 @@ class Generator:
         self._spec_post_prefill_many = jax.jit(spec_post_prefill_many,
                                                donate_argnums=(0, 1))
 
+        if draft_params is not None:
+            # the draft must ingest every admitted prompt too: its cache
+            # rows are the drafting context (same buckets as the target
+            # prefill, so warmup compiles both together)
+            self._draft_prefill_into = jax.jit(
+                lambda p, t, l, c, s: llama.prefill_into(
+                    p, t, l, draft_cfg, c, s),
+                donate_argnums=(3,))
+            self._draft_prefill_many = jax.jit(
+                lambda p, t, l, c, s, v: llama.prefill_into_many(
+                    p, t, l, draft_cfg, c, s, v),
+                donate_argnums=(3,))
+
     def _after_prefill(self, logits, tokens, lens, slots, valid=None) -> None:
         """Route prefill logits into first-token state — spec mode also
         records prompt + first into the history rows. One site for the
@@ -545,6 +634,10 @@ class Generator:
                 self._tok_dev, self._tokens_dev = self._spec_post_prefill(
                     self._tok_dev, self._tokens_dev, logits, tokens, lens,
                     slots)
+                if self.draft_params is not None:
+                    _, self._draft_cache = self._draft_prefill_into(
+                        self.draft_params, tokens, lens, self._draft_cache,
+                        slots)
             else:
                 self._tok_dev = self._post_prefill(
                     self._tok_dev, logits, self._prefill_key,
@@ -553,6 +646,10 @@ class Generator:
             self._tok_dev, self._tokens_dev = self._spec_post_prefill_many(
                 self._tok_dev, self._tokens_dev, logits, tokens, lens,
                 slots, valid)
+            if self.draft_params is not None:
+                _, self._draft_cache = self._draft_prefill_many(
+                    self.draft_params, tokens, lens, self._draft_cache,
+                    slots, valid)
         else:
             self._tok_dev = self._post_prefill_many(
                 self._tok_dev, logits, self._prefill_key,
@@ -578,7 +675,8 @@ class Generator:
         scratch page and pages held by registered prefixes. A request
         needing more than this can never admit — reject it instead of
         requeueing forever."""
-        held = sum(len(i["pages"]) for i in self._prefixes.values())
+        held = sum(len(i["pages"]) for i in self._prefixes.values()
+                   if i["refs"] > 0)  # idle prefixes are reclaimable cache
         return (self.n_pages - 1) - held
 
     def _free_slot_pages(self, slot: int) -> None:
@@ -607,7 +705,14 @@ class Generator:
                       s.prompt_len + s.max_new,  # never past its budget
                       self.max_seq)
             if not self._alloc_pages_to(i, est):
+                # idle prefix pages are reclaimable cache — spend them
+                # before truncating a live stream
+                need = -(-est // self.page_size) - len(self._slot_pages[i])
+                self._reclaim_prefix_pages(max(need, 1))
+                if self._alloc_pages_to(i, est):
+                    continue
                 s.live = False
+                s.evicted = True  # distinguishable from eos/length finishes
                 self.evictions += 1
 
     @property
@@ -639,6 +744,10 @@ class Generator:
         shared_len = (len(ids) // ps) * ps
         n_need = shared_len // ps
         if len(self._free_pages) < n_need:
+            # drop idle (refs == 0) prefixes LRU-first before giving up —
+            # a rotating set of system prompts must not brick registration
+            self._reclaim_prefix_pages(n_need)
+        if len(self._free_pages) < n_need:
             raise PagePoolExhausted(
                 f"prefix needs {n_need} pages, {self.free_pages} free")
         pages = [self._free_pages.pop() for _ in range(n_need)]
@@ -662,10 +771,34 @@ class Generator:
                 )
         pid = self._next_prefix
         self._next_prefix += 1
+        self._prefix_clock += 1
         self._prefixes[pid] = {"pages": pages, "len": shared_len,
                                "tail": [int(t) for t in ids[shared_len:]],
-                               "refs": 0}
+                               "refs": 0, "last_use": self._prefix_clock}
         return pid
+
+    def has_prefix(self, pid: int) -> bool:
+        """False once a prefix has been dropped or LRU-evicted — callers
+        holding suffix-only ids must re-register before admitting."""
+        return pid in self._prefixes
+
+    def _reclaim_prefix_pages(self, n_need: int) -> bool:
+        """Evict idle (refs == 0) prefixes, least-recently-used first,
+        until at least ``n_need`` pages are free. Prefix pages are a
+        CACHE: under pool pressure an idle system prompt's pages are worth
+        less than a live stream's next tokens (VERDICT r4 #6 — without
+        this, rotating system prompts exhaust the pool forever)."""
+        while len(self._free_pages) < n_need:
+            idle = [(info["last_use"], pid)
+                    for pid, info in self._prefixes.items()
+                    if info["refs"] == 0]
+            if not idle:
+                return False
+            _, pid = min(idle)
+            info = self._prefixes.pop(pid)
+            self._free_pages.extend(info["pages"])
+            self.prefix_evictions += 1
+        return True
 
     def drop_prefix(self, pid: int) -> None:
         """Return a prefix's pages to the pool (no live borrowers)."""
@@ -679,7 +812,11 @@ class Generator:
                         callback) -> int:
         """Admit one request on top of a registered prefix: borrow its
         pages, prefill only the suffix at start=shared_len."""
+        if pid not in self._prefixes:
+            raise PrefixEvicted(f"prefix {pid} was evicted; re-register")
         info = self._prefixes[pid]
+        self._prefix_clock += 1
+        info["last_use"] = self._prefix_clock
         suffix = info["tail"] + [int(t) for t in ids]
         n_suf = len(suffix)
         start = info["len"]
@@ -706,6 +843,13 @@ class Generator:
             self._table[slot, :len(shared)] = shared
             upto = min(start + n_suf + 2 * self.chunk,
                        start + n_suf + max_new, self.max_seq)
+            if not self._alloc_pages_to(slot, upto):
+                # idle prefixes are reclaimable cache (this one is pinned:
+                # refs was just incremented) — without this, a pool full of
+                # abandoned prefixes livelocks admission on requeue
+                missing = (-(-upto // self.page_size)
+                           - len(self._slot_pages[slot]))
+                self._reclaim_prefix_pages(max(missing, 1))
             if not self._alloc_pages_to(slot, upto):
                 need_own = -(-upto // self.page_size) - len(shared)
                 if need_own > self._pages_ever_free():
@@ -802,13 +946,15 @@ class Generator:
             for fn in fns:
                 if self.spec_k and self.page_size:
                     (_row0, _e, _c, self._tok_dev, self.cache,
-                     self._tokens_dev) = fn(self.params, self._tok_dev,
-                                            self.cache, self._tokens_dev,
-                                            np.zeros_like(self._table))
+                     self._tokens_dev, self._draft_cache) = fn(
+                        self.params, self._tok_dev, self.cache,
+                        self._tokens_dev, self._draft_cache,
+                        np.zeros_like(self._table))
                 elif self.spec_k:
                     (_row0, _e, _c, self._tok_dev, self.cache,
-                     self._tokens_dev) = fn(self.params, self._tok_dev,
-                                            self.cache, self._tokens_dev)
+                     self._tokens_dev, self._draft_cache) = fn(
+                        self.params, self._tok_dev, self.cache,
+                        self._tokens_dev, self._draft_cache)
                 elif self.page_size:
                     _toks, self._tok_dev, self.cache = fn(
                         self.params, self._tok_dev, self.cache,
@@ -962,6 +1108,12 @@ class Generator:
                                    int(lens[0]) + wave[0][2],
                                    self.max_seq)
                         if not self._alloc_pages_to(slots[0], upto):
+                            # reclaim idle prefixes before declaring
+                            # back-pressure (see _admit_prefixed)
+                            missing = (-(-upto // self.page_size)
+                                       - len(self._slot_pages[slots[0]]))
+                            self._reclaim_prefix_pages(max(missing, 1))
+                        if not self._alloc_pages_to(slots[0], upto):
                             need = -(-upto // self.page_size)
                             if need > self._pages_ever_free():
                                 raise ValueError(
@@ -1069,13 +1221,14 @@ class Generator:
                 if self.page_size:
                     self._grow_pages()
                     (row0, emits, counts, self._tok_dev, self.cache,
-                     self._tokens_dev) = fn(self.params, self._tok_dev,
-                                            self.cache, self._tokens_dev,
-                                            self._table)
+                     self._tokens_dev, self._draft_cache) = fn(
+                        self.params, self._tok_dev, self.cache,
+                        self._tokens_dev, self._draft_cache, self._table)
                 else:
                     (row0, emits, counts, self._tok_dev, self.cache,
-                     self._tokens_dev) = fn(self.params, self._tok_dev,
-                                            self.cache, self._tokens_dev)
+                     self._tokens_dev, self._draft_cache) = fn(
+                        self.params, self._tok_dev, self.cache,
+                        self._tokens_dev, self._draft_cache)
                 item: Any = (row0, emits, counts)
             elif self.page_size:
                 self._grow_pages()  # table must cover this whole chunk
@@ -1136,6 +1289,8 @@ class Generator:
                 if not s.live:
                     continue
                 self.spec_windows += 1
+                s.spec_windows += 1
+                s.spec_emitted += int(counts[w, i])
                 for t in range(int(counts[w, i])):
                     tok = int(emits[w, i, t])
                     s.tokens.append(tok)

@@ -155,9 +155,24 @@ def hf_config(model_dir: str, **overrides: Any):
         # HF's LlamaConfig default is 10000 (Llama-2 era configs omit it)
         rope_theta=float(hc.get("rope_theta", 10_000.0)),
         norm_eps=float(hc.get("rms_norm_eps", 1e-5)),
+        # Llama-3.1/3.2 configs specify llama3-type scaling; ignoring it
+        # would mis-rotate every position past the original context
+        # (ADVICE r4 #2) — so it flows into rope_table via the config
+        rope_scaling=hc.get("rope_scaling") or None,
     )
     kw.update(overrides)
     cfg = LlamaConfig(**kw)
+    if cfg.rope_scaling is not None:
+        # fail loudly at LOAD time on an unsupported scaling type, not
+        # deep inside the first traced forward
+        from ..ops import scale_rope_freqs
+        import jax.numpy as jnp
+
+        scale_rope_freqs(
+            1.0 / (cfg.rope_theta ** (
+                jnp.arange(0, cfg.head_dim // 2, dtype=jnp.float32)
+                / (cfg.head_dim // 2))),
+            cfg.rope_scaling)
     # serving metadata the param tree doesn't carry
     # int or list (Llama-3 instruct stops on several ids) — the Generator
     # accepts either form verbatim

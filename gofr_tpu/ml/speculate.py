@@ -21,9 +21,11 @@ far), return the per-position argmax. Acceptance is then a host-side
 prefix match, and "rollback" is just NOT advancing ``len`` past the
 accepted prefix.
 
-Standalone single-stream path (the Generator's continuous-batching loop
-is unchanged); greedy-only; composes with int8 weights (w8) but not the
-int8 KV cache.
+Standalone single-stream path; greedy-only; composes with int8 weights
+(w8) but needs the fp KV cache HERE — the Generator's device-resident
+speculation (generate.py spec_k) is the serving path and DOES compose
+with the int8 KV cache (decode_window quantizes window rows) and with
+draft-model proposals (draft_params/draft_cfg).
 """
 
 from __future__ import annotations
@@ -103,7 +105,8 @@ class SpeculativeDecoder:
         pos0 = cache["len"][0]
         x = params["embed"][toks].astype(cfg.dtype)          # [1, K, D]
         positions = pos0 + jnp.arange(K)[None, :]
-        cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
+        cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
 
         def body(carry, lp):
             x, arrays, layer = carry

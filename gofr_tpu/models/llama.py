@@ -60,6 +60,7 @@ class LlamaConfig:
         attn_impl: str = "auto",
         kv_quant: bool = False,
         w8: bool = False,
+        rope_scaling: dict | None = None,
     ) -> None:
         self.vocab_size = vocab_size
         self.dim = dim
@@ -70,6 +71,9 @@ class LlamaConfig:
         self.ffn_dim = ffn_dim
         self.max_seq_len = max_seq_len
         self.rope_theta = rope_theta
+        # HF rope_scaling dict (llama3 / linear) — Llama-3.1+ checkpoints
+        # require it for correct long-context rotations (ops.scale_rope_freqs)
+        self.rope_scaling = rope_scaling
         self.norm_eps = norm_eps
         self.dtype = dtype
         self.use_flash = use_flash
@@ -187,6 +191,66 @@ def config_from_env(tiny_vocab_size: int | None = None) -> LlamaConfig:
     if preset == "8b":
         return llama3_8b(kv_quant=kv_quant, w8=w8)
     raise ValueError(f"unknown LLAMA_PRESET {preset!r}")
+
+
+def draft_from_env(target_cfg: "LlamaConfig", target_params=None) -> tuple:
+    """(draft_params, draft_cfg) for speculative decoding, from env — or
+    (None, None) when no draft is configured.
+
+    ``LLM_DRAFT_CKPT=<hf dir>`` loads a real shared-vocab draft checkpoint
+    (e.g. a 1B draft for an 8B target); ``LLM_DRAFT_PRESET=tiny|1b``
+    builds a random-weight draft of that shape (demo/testing — a random
+    draft keeps outputs lossless, it just accepts ~nothing);
+    ``LLM_DRAFT_PRESET=self`` reuses the target weights as the draft —
+    the acceptance upper bound for the draft-model machinery (config8's
+    draft arm; a real small checkpoint slots in via LLM_DRAFT_CKPT).
+    """
+    import os
+
+    ckpt = os.environ.get("LLM_DRAFT_CKPT")
+    preset = os.environ.get("LLM_DRAFT_PRESET")
+    if not ckpt and not preset:
+        return None, None
+    from ..ml.hf_import import hf_config, is_hf_dir
+
+    if preset == "self" and not ckpt:
+        if target_params is None:
+            raise ValueError("LLM_DRAFT_PRESET=self needs target params")
+        # the draft path keeps its own fp dense cache, so clone the config
+        # with quant/paging knobs off
+        dcfg = LlamaConfig(
+            vocab_size=target_cfg.vocab_size, dim=target_cfg.dim,
+            n_layers=target_cfg.n_layers, n_heads=target_cfg.n_heads,
+            n_kv_heads=target_cfg.n_kv_heads, ffn_dim=target_cfg.ffn_dim,
+            max_seq_len=target_cfg.max_seq_len,
+            rope_theta=target_cfg.rope_theta, norm_eps=target_cfg.norm_eps,
+            dtype=target_cfg.dtype, use_flash=target_cfg.use_flash,
+            w8=target_cfg.w8, rope_scaling=target_cfg.rope_scaling)
+        return target_params, dcfg
+    if ckpt:
+        if not is_hf_dir(ckpt):
+            # fail loudly: silently substituting a random draft would make
+            # serving strictly SLOWER (~0% acceptance) with no signal
+            raise ValueError(
+                f"LLM_DRAFT_CKPT={ckpt!r} is not a HF model directory "
+                "(config.json + *.safetensors)")
+        dcfg = hf_config(ckpt)
+        dparams = params_from_config(dcfg, checkpoint_dir=ckpt)
+    else:
+        if preset == "1b":
+            dcfg = LlamaConfig(
+                vocab_size=target_cfg.vocab_size, dim=2048, n_layers=16,
+                n_heads=16, n_kv_heads=8, ffn_dim=8192,
+                max_seq_len=target_cfg.max_seq_len)
+        else:
+            dcfg = tiny_llama(use_flash=False,
+                              vocab_size=target_cfg.vocab_size)
+        dparams = init_params(dcfg, jax.random.PRNGKey(1))
+    if dcfg.vocab_size != target_cfg.vocab_size:
+        raise ValueError(
+            f"draft vocab {dcfg.vocab_size} != target "
+            f"{target_cfg.vocab_size}: speculation needs a shared vocab")
+    return dparams, dcfg
 
 
 def tiny_llama(**kw) -> LlamaConfig:
@@ -436,7 +500,8 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
     x = params["embed"][tokens].astype(cfg.dtype)
     x = constrain(x, P("dp", "sp", None))
     positions = jnp.arange(tokens.shape[1])[None, :]
-    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
 
     def body(x, lp):
         x, _, _ = _layer(cfg, x, lp, cos, sin, kv_len=seq_lens, full_seq=True,
@@ -492,7 +557,8 @@ def prefill(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
     x = params["embed"][tokens].astype(cfg.dtype)
     x = constrain(x, P("dp", "sp", None))
     positions = jnp.arange(s)[None, :]
-    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
 
     def body(x, lp):
         x, k, v = _layer(cfg, x, lp, cos, sin, kv_len=seq_lens, full_seq=True,
@@ -594,7 +660,8 @@ def decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
     b = tokens.shape[0]
     pos = cache["len"]  # [B]
     x = params["embed"][tokens][:, None, :].astype(cfg.dtype)
-    cos, sin = rope_table(pos[:, None], cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_table(pos[:, None], cfg.head_dim, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
     rows = jnp.arange(b)
 
     # weights stream through scan xs; the FULL caches ride the carry with a
@@ -709,7 +776,8 @@ def paged_suffix_prefill(params: dict, tokens: jnp.ndarray,
                      table_row[jnp.minimum(vpos // page_s, p_max - 1)], 0)
     off = vpos % page_s
     x = params["embed"][tokens].astype(cfg.dtype)
-    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
 
     def body(carry, lp):
         x, arrays, layer = carry
@@ -774,7 +842,8 @@ def paged_decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
         table[jnp.arange(b), jnp.minimum(pos // page_s, p_max - 1)], 0)
     off = pos % page_s
     x = params["embed"][tokens][:, None, :].astype(cfg.dtype)
-    cos, sin = rope_table(pos[:, None], cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_table(pos[:, None], cfg.head_dim, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
     rows = jnp.arange(b)
     kv_idx = jnp.arange(KV)[None, :]
 
@@ -870,7 +939,8 @@ def paged_decode_window(params: dict, toks: jnp.ndarray, cache: dict,
         0)                                                 # [B, W]
     off = positions % page_s
     x = params["embed"][toks].astype(cfg.dtype)            # [B, W, D]
-    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
 
     def body(carry, lp):
         x, arrays, layer = carry
@@ -919,11 +989,13 @@ def decode_window(params: dict, toks: jnp.ndarray, cache: dict,
     is NOT advanced here: the caller advances by 1 + accepted, so
     "rollback" of rejected drafts is simply not advancing past them —
     later windows overwrite the stale rows before any query can reach
-    them. Requires the fp cache (int8 kv_quant unsupported).
+    them. Composes with the int8 cache (cfg.kv_quant): window rows are
+    quantized per token per KV head on write, and each layer's cache is
+    dequantized for the window attention — the HBM sweep (the decode
+    roofline) still reads int8.
     """
-    if cfg.kv_quant:
-        raise ValueError("decode_window requires the fp KV cache")
-    from ..ops import apply_rope, attention, repeat_kv, rms_norm, rope_table
+    from ..ops import (apply_rope, attention, dequantize_kv, quantize_kv,
+                       repeat_kv, rms_norm, rope_table)
     from ..parallel import constrain
 
     b, w = toks.shape
@@ -931,7 +1003,8 @@ def decode_window(params: dict, toks: jnp.ndarray, cache: dict,
     pos0 = cache["len"]                                   # [B]
     positions = pos0[:, None] + jnp.arange(w)[None, :]    # [B, W]
     x = params["embed"][toks].astype(cfg.dtype)           # [B, W, D]
-    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
     rows = jnp.arange(b)
 
     def body(carry, lp):
@@ -944,17 +1017,44 @@ def decode_window(params: dict, toks: jnp.ndarray, cache: dict,
         k = constrain(k, P("dp", None, "tp", None))
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        dt = arrays["k"].dtype
-        arrays = {
-            "k": arrays["k"].at[layer, rows[:, None], positions].set(
-                k.astype(dt), mode="drop"),
-            "v": arrays["v"].at[layer, rows[:, None], positions].set(
-                v.astype(dt), mode="drop"),
-        }
-        k_row = jax.lax.dynamic_index_in_dim(arrays["k"], layer, 0,
-                                             keepdims=False)
-        v_row = jax.lax.dynamic_index_in_dim(arrays["v"], layer, 0,
-                                             keepdims=False)
+        if cfg.kv_quant:
+            # same layouts as _decode_layer: int8 values FLAT [L,B,S,KV*D],
+            # scales [L,B,KV,S] — W rows scatter at their own positions
+            kq, k_sc = quantize_kv(k)      # [B,W,KV,hd] -> sc [B,W,KV]
+            vq, v_sc = quantize_kv(v)
+            r_i = rows[:, None, None]
+            kv_i = jnp.arange(KV)[None, None, :]
+            p_i = positions[:, :, None]
+            arrays = {
+                "k": arrays["k"].at[layer, rows[:, None], positions].set(
+                    kq.reshape(b, w, KV * hd), mode="drop"),
+                "v": arrays["v"].at[layer, rows[:, None], positions].set(
+                    vq.reshape(b, w, KV * hd), mode="drop"),
+                "k_scale": arrays["k_scale"].at[layer, r_i, kv_i, p_i].set(
+                    k_sc, mode="drop"),
+                "v_scale": arrays["v_scale"].at[layer, r_i, kv_i, p_i].set(
+                    v_sc, mode="drop"),
+            }
+            idx = lambda a: jax.lax.dynamic_index_in_dim(a, layer, 0,
+                                                         keepdims=False)
+            s_max = arrays["k"].shape[2]
+            deq = lambda qv, sc: dequantize_kv(
+                idx(qv).reshape(b, s_max, KV, hd),
+                idx(sc).transpose(0, 2, 1), cfg.dtype)
+            k_row = deq(arrays["k"], arrays["k_scale"])
+            v_row = deq(arrays["v"], arrays["v_scale"])
+        else:
+            dt = arrays["k"].dtype
+            arrays = {
+                "k": arrays["k"].at[layer, rows[:, None], positions].set(
+                    k.astype(dt), mode="drop"),
+                "v": arrays["v"].at[layer, rows[:, None], positions].set(
+                    v.astype(dt), mode="drop"),
+            }
+            k_row = jax.lax.dynamic_index_in_dim(arrays["k"], layer, 0,
+                                                 keepdims=False)
+            v_row = jax.lax.dynamic_index_in_dim(arrays["v"], layer, 0,
+                                                 keepdims=False)
         # per-row causal offset: query t of row i attends positions
         # <= pos0[i]+t — its prefix plus the window so far; stale cells
         # past the window are unreachable
@@ -966,7 +1066,7 @@ def decode_window(params: dict, toks: jnp.ndarray, cache: dict,
         x = x + _swiglu(h2, lp)
         return (x, arrays, layer + 1), None
 
-    arrays0 = {"k": cache["k"], "v": cache["v"]}
+    arrays0 = {key: cache[key] for key in cache if key != "len"}
     (x, arrays, _), _ = jax.lax.scan(
         body, (x, arrays0, jnp.int32(0)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)

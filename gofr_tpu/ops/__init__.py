@@ -24,6 +24,7 @@ __all__ = [
     "rms_norm",
     "layer_norm",
     "rope_table",
+    "scale_rope_freqs",
     "apply_rope",
     "repeat_kv",
     "attention",
@@ -59,14 +60,51 @@ def layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
     return (out * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
 
 
-def rope_table(positions: jnp.ndarray, head_dim: int, theta: float = 500_000.0):
+def scale_rope_freqs(freqs: jnp.ndarray, scaling: dict) -> jnp.ndarray:
+    """Apply a HF ``rope_scaling`` spec to the base rotary frequencies.
+
+    Supports ``llama3`` (Llama-3.1/3.2's NTK-by-parts: low-frequency bands
+    are slowed by ``factor``, high-frequency bands kept, the middle smoothly
+    interpolated — reference behavior: transformers'
+    ``_compute_llama3_parameters``) and ``linear`` (all bands divided by
+    ``factor``). Anything else raises at trace/load time rather than
+    silently mis-rotating (ADVICE r4 #2: Llama-3.1 checkpoints specify
+    llama3 scaling; ignoring it degrades every generation with no error).
+    """
+    rtype = str(scaling.get("rope_type") or scaling.get("type") or "").lower()
+    if rtype == "linear":
+        return freqs / float(scaling["factor"])
+    if rtype != "llama3":
+        raise ValueError(
+            f"unsupported rope_scaling type {rtype!r}; "
+            "supported: 'llama3', 'linear'")
+    factor = float(scaling.get("factor", 8.0))
+    low_ff = float(scaling.get("low_freq_factor", 1.0))
+    high_ff = float(scaling.get("high_freq_factor", 4.0))
+    orig = float(scaling.get("original_max_position_embeddings", 8192))
+    wavelen = 2.0 * jnp.pi / freqs
+    # smooth in [0, 1]: 1 at the high-frequency boundary, 0 at the low one
+    smooth = (orig / wavelen - low_ff) / (high_ff - low_ff)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    scaled = (1.0 - smooth) * freqs / factor + smooth * freqs
+    return jnp.where(wavelen < orig / high_ff, freqs,
+                     jnp.where(wavelen > orig / low_ff, freqs / factor,
+                               scaled))
+
+
+def rope_table(positions: jnp.ndarray, head_dim: int, theta: float = 500_000.0,
+               scaling: dict | None = None):
     """cos/sin tables for rotary embeddings at the given positions.
 
     positions: int array [...]; returns (cos, sin) of shape [..., head_dim//2]
     in float32 — rotation is numerically sensitive, done in f32 then cast.
+    ``scaling`` is an optional HF ``rope_scaling`` dict (see
+    ``scale_rope_freqs``).
     """
     half = head_dim // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    if scaling is not None:
+        freqs = scale_rope_freqs(freqs, scaling)
     angles = positions.astype(jnp.float32)[..., None] * freqs
     return jnp.cos(angles), jnp.sin(angles)
 

@@ -177,3 +177,37 @@ def test_load_hf_tokenizer_byte_level(tmp_path):
     # bytes with no merge coverage fall back to base byte tokens
     raw = tok.encode(bytes([0, 7, 255]))
     assert tok.decode_bytes(raw) == bytes([0, 7, 255])
+
+
+def test_hf_config_rope_scaling_flows_and_validates(tmp_path):
+    """A Llama-3.1-style config.json with llama3 rope_scaling must land on
+    cfg.rope_scaling (ADVICE r4: ignoring it silently mis-rotates); an
+    unsupported scaling type must fail at LOAD time, not trace time."""
+    cfg = llama.tiny_llama(use_flash=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    model_dir = str(tmp_path / "hf")
+    _export_hf(cfg, params, model_dir)
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hc = json.load(f)
+    hc["rope_scaling"] = {
+        "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+        "high_freq_factor": 4.0, "original_max_position_embeddings": 64}
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        json.dump(hc, f)
+
+    got = hf_config(model_dir)
+    assert got.rope_scaling["rope_type"] == "llama3"
+    # the scaled config must actually change the forward pass
+    x = jnp.zeros((1, 8), jnp.int32)
+    base_cfg, _ = import_hf_llama(model_dir)
+    unscaled = llama.tiny_llama(use_flash=False)
+    logits_scaled = llama.forward(params, x, base_cfg)
+    logits_plain = llama.forward(params, x, unscaled)
+    assert not np.allclose(np.asarray(logits_scaled, np.float32),
+                           np.asarray(logits_plain, np.float32))
+
+    hc["rope_scaling"] = {"rope_type": "yarn", "factor": 2.0}
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        json.dump(hc, f)
+    with pytest.raises(ValueError, match="rope_scaling"):
+        hf_config(model_dir)

@@ -344,3 +344,60 @@ def test_shared_prefix_partial_page_tail(setup):
         gen.step()
     gen.drain()
     assert streamed[slot] == expect
+
+
+def test_prefix_lru_eviction_rotating_prompts(setup):
+    """A rotating set of registered prefixes must never exhaust the pool:
+    idle (refs == 0) prefixes are LRU-evicted to make room (VERDICT r4
+    #6), in-use prefixes are never touched, and admitting on an evicted
+    id raises the typed PrefixEvicted."""
+    from gofr_tpu.ml.generate import Generator, PrefixEvicted
+
+    cfg, params = setup
+    # 1 scratch + 4 usable pages; every one-page prefix is 8 tokens
+    gen = Generator(params, cfg, batch_slots=2, max_seq=32,
+                    prefill_buckets=(8, 16), chunk=2, page_size=8,
+                    n_pages=5)
+    first = gen.register_prefix([1, 2, 3, 4, 5, 6, 7, 8])
+    # a live borrower pins `first`
+    slot = gen.add_request([9, 9], 2, prefix=first)
+    # rotate through more prefixes than the pool could ever hold at once
+    pids = [gen.register_prefix([i + 1] * 8) for i in range(6)]
+    assert gen.prefix_evictions > 0
+    assert gen.has_prefix(first)          # refs > 0: never evicted
+    assert gen.has_prefix(pids[-1])       # newest survives
+    assert not gen.has_prefix(pids[0])    # oldest idle went first
+    with pytest.raises(PrefixEvicted):
+        gen.add_request([7], 2, prefix=pids[0])
+    while gen.n_live:
+        gen.step()
+    gen.drain()
+    gen.release(slot)
+    # once the borrower is gone the pinned prefix becomes evictable too
+    assert gen._prefixes[first]["refs"] == 0
+    for _ in range(4):
+        gen.register_prefix([3] * 8)
+    assert not gen.has_prefix(first)
+
+
+def test_grow_pages_reclaims_idle_prefix_before_truncating(setup):
+    """Under pool pressure mid-decode, an idle prefix's pages are
+    reclaimed BEFORE a live stream is truncated: the stream finishes its
+    full budget and only the prefix dies."""
+    from gofr_tpu.ml.generate import Generator
+
+    cfg, params = setup
+    gen = Generator(params, cfg, batch_slots=1, max_seq=32,
+                    prefill_buckets=(8,), chunk=2, page_size=8, n_pages=5)
+    pid = gen.register_prefix([1, 2, 3, 4, 5, 6, 7, 8])  # 1 idle page
+    got: list[int] = []
+    slot = gen.add_request([5, 3, 2, 6, 1, 9, 4, 7], 20,
+                           callback=lambda i, toks: got.extend(toks))
+    while gen.n_live:
+        gen.step()
+    gen.drain()
+    assert len(got) == 20                  # full budget, no truncation
+    assert not gen.slots[slot].evicted
+    assert gen.evictions == 0
+    assert not gen.has_prefix(pid)         # the idle prefix paid instead
+    assert gen.prefix_evictions == 1

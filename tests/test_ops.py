@@ -144,3 +144,42 @@ def test_flash_kernel_kv_len_masks_padding():
                                atol=2e-2, rtol=2e-2)
     np.testing.assert_allclose(np.asarray(ref[1]), np.asarray(out[1]),
                                atol=2e-2, rtol=2e-2)
+
+
+def test_rope_scaling_llama3_bands():
+    """llama3 NTK-by-parts (transformers _compute_llama3_parameters
+    behavior): high-frequency bands untouched, low-frequency bands slowed
+    by ``factor``, the middle interpolated strictly between."""
+    from gofr_tpu.ops import scale_rope_freqs
+
+    half = 64
+    freqs = 1.0 / (500_000.0 ** (jnp.arange(0, half, dtype=jnp.float32)
+                                 / half))
+    sc = {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+          "high_freq_factor": 4.0,
+          "original_max_position_embeddings": 8192}
+    out = np.asarray(scale_rope_freqs(freqs, sc))
+    base = np.asarray(freqs)
+    wavelen = 2 * np.pi / base
+    hi = wavelen < 8192 / 4.0
+    lo = wavelen > 8192 / 1.0
+    mid = ~hi & ~lo
+    np.testing.assert_allclose(out[hi], base[hi])
+    np.testing.assert_allclose(out[lo], base[lo] / 8.0, rtol=1e-6)
+    assert np.all(out[mid] < base[mid])
+    assert np.all(out[mid] > base[mid] / 8.0)
+    # and the table itself changes where it must: position past the
+    # original context rotates differently under scaling
+    c0, _ = rope_table(jnp.asarray([[9000]]), 128, 500_000.0)
+    c1, _ = rope_table(jnp.asarray([[9000]]), 128, 500_000.0, scaling=sc)
+    assert not np.allclose(np.asarray(c0), np.asarray(c1))
+
+
+def test_rope_scaling_linear_and_unsupported():
+    from gofr_tpu.ops import scale_rope_freqs
+
+    freqs = jnp.asarray([1.0, 0.5, 0.25], jnp.float32)
+    out = scale_rope_freqs(freqs, {"type": "linear", "factor": 4.0})
+    np.testing.assert_allclose(np.asarray(out), np.asarray(freqs) / 4.0)
+    with pytest.raises(ValueError, match="rope_scaling"):
+        scale_rope_freqs(freqs, {"rope_type": "yarn", "factor": 2.0})

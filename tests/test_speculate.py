@@ -169,9 +169,19 @@ def test_generator_speculative_guards():
     with pytest.raises(ValueError, match="greedy"):
         Generator(params, cfg, batch_slots=1, max_seq=64, spec_k=2,
                   sampler=Sampler(temperature=0.7))
-    with pytest.raises(ValueError, match="fp KV cache"):
+    # dense spec now COMPOSES with kv_quant (decode_window quantizes the
+    # window rows); only the paged window is still fp-only
+    with pytest.raises(ValueError, match="dense cache"):
         Generator(params, _cfg(kv_quant=True), batch_slots=1, max_seq=64,
-                  spec_k=2)
+                  spec_k=2, page_size=8, prefill_buckets=(8,))
+    with pytest.raises(ValueError, match="shared vocab|vocabulary"):
+        Generator(params, cfg, batch_slots=1, max_seq=64, spec_k=2,
+                  draft_params=params,
+                  draft_cfg=llama.tiny_llama(use_flash=False,
+                                             vocab_size=32))
+    with pytest.raises(ValueError, match="spec_k"):
+        Generator(params, cfg, batch_slots=1, max_seq=64,
+                  draft_params=params, draft_cfg=cfg)
 
 
 def test_generator_speculative_on_paged_cache():
@@ -200,3 +210,110 @@ def test_generator_speculative_on_paged_cache():
     for slot, expect in zip(slots, expects):
         assert streamed[slot] == expect
     assert gen.spec_windows > 0
+
+
+def test_generator_spec_composes_with_int8_kv():
+    """VERDICT r4 #7: speculation must compose with the int8 KV cache —
+    the verify window quantizes its K+1 rows on write and the output is
+    exactly the int8 plain-greedy chain (lossless within the quantized
+    model's own logits)."""
+    from gofr_tpu.ml.generate import Generator
+
+    cfg = _cfg(kv_quant=True)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = [5, 3, 2, 6, 1, 9, 4, 7]
+    ref = Generator(params, cfg, batch_slots=1, max_seq=64,
+                    prefill_buckets=(8,)).generate(prompt, 12)
+    gen = Generator(params, cfg, batch_slots=2, max_seq=64,
+                    prefill_buckets=(8,), chunk=2, spec_k=3)
+    assert gen.generate(prompt, 12) == ref
+    assert gen.spec_windows > 0
+
+
+def test_generator_draft_model_speculation():
+    """Draft-model proposals (VERDICT r4 #7): a perfect draft (the target
+    itself) accepts nearly everything; a random draft accepts ~nothing;
+    BOTH are lossless — output is always the verifier's own greedy chain."""
+    from gofr_tpu.ml.generate import Generator
+
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = [5, 3, 2, 6, 1, 9, 4, 7]
+    ref = Generator(params, cfg, batch_slots=1, max_seq=64,
+                    prefill_buckets=(8,)).generate(prompt, 12)
+
+    perfect = Generator(params, cfg, batch_slots=2, max_seq=64,
+                        prefill_buckets=(8,), chunk=2, spec_k=3,
+                        draft_params=params, draft_cfg=cfg)
+    assert perfect.generate(prompt, 12) == ref
+    acc = ((perfect.spec_emitted - perfect.spec_windows)
+           / (perfect.spec_windows * 3))
+    assert acc > 0.7  # only the budget-truncated last window loses drafts
+
+    dparams = llama.init_params(cfg, jax.random.PRNGKey(7))
+    random_draft = Generator(params, cfg, batch_slots=2, max_seq=64,
+                             prefill_buckets=(8,), chunk=2, spec_k=3,
+                             draft_params=dparams, draft_cfg=cfg)
+    assert random_draft.generate(prompt, 12) == ref
+    acc_r = ((random_draft.spec_emitted - random_draft.spec_windows)
+             / (random_draft.spec_windows * 3))
+    assert acc_r < acc
+
+
+def test_generator_draft_model_concurrent_slots():
+    """Draft caches must track per-slot positions under continuous
+    batching: two different prompts decode concurrently and each matches
+    its single-stream output."""
+    from gofr_tpu.ml.generate import Generator
+
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = [[5, 3, 2, 6], [9, 1, 4, 7, 8, 2]]
+    expects = [Generator(params, cfg, batch_slots=1, max_seq=64,
+                         prefill_buckets=(8,)).generate(p, 8)
+               for p in prompts]
+    gen = Generator(params, cfg, batch_slots=2, max_seq=64,
+                    prefill_buckets=(8,), chunk=2, spec_k=3,
+                    draft_params=params, draft_cfg=cfg)
+    got: dict[int, list[int]] = {}
+    slots = [gen.add_request(
+        p, 8, callback=lambda i, toks: got.setdefault(i, []).extend(toks))
+        for p in prompts]
+    while gen.n_live:
+        gen.step()
+    gen.drain()
+    assert [got[s] for s in slots] == expects
+
+
+def test_spec_accept_metric_exported(run):
+    """Per-stream acceptance rate lands in app_llm_spec_accept
+    (VERDICT r4 #7 'Done' bar)."""
+    from gofr_tpu.ml.generate import Generator
+    from gofr_tpu.ml.llm import LLMServer
+
+    recorded = []
+
+    class _Metrics:
+        def record_histogram(self, name, value, **labels):
+            recorded.append((name, value, labels))
+
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    async def scenario():
+        server = LLMServer(
+            Generator(params, cfg, batch_slots=2, max_seq=64,
+                      prefill_buckets=(8,), chunk=2, spec_k=3,
+                      draft_params=params, draft_cfg=cfg),
+            metrics=_Metrics())
+        try:
+            await server.generate([5, 3, 2, 6], 8)
+        finally:
+            server.close()
+
+    run(scenario())
+    accept = [(n, v, lb) for n, v, lb in recorded
+              if n == "app_llm_spec_accept"]
+    assert len(accept) == 1
+    assert 0.0 <= accept[0][1] <= 1.0
+    assert accept[0][1] > 0.5  # perfect draft: high acceptance
