@@ -26,13 +26,11 @@ from aiohttp import web
 
 from .config import Config, new_env_config
 from .container import Container, new_container
-from .context import Context
 from .handler import (
     HandlerFunc,
     alive_handler,
     catch_all_handler,
     health_handler,
-    invoke,
     wrap_handler,
 )
 from .http import middleware as mw
