@@ -15,6 +15,11 @@ Three phases, all in one run so the numbers share the same tunnel weather:
                      sized to match: aggregate tok/s over the full window,
                      counted at the CLIENT after gRPC framing — the number
                      the north-star >= 2000 tok/s target is about.
+  C. prefill jitter— short-stream TTFT while LONG prompts keep arriving,
+                     A/B'd against a reboot with LLM_PREFILL_CHUNK set:
+                     segmented prefill bounds the p99 TTFT spike a 2k
+                     prefill otherwise injects into every live stream
+                     (VERDICT r4 #2).
 
 LLAMA_PRESET=1b on TPU by default (the 8B/8-chip per-chip share), tiny on CPU.
 """
@@ -143,8 +148,71 @@ async def main() -> None:
     elapsed = time.perf_counter() - t_start
     sum3, cnt3 = await _metrics_ttft(ports)
 
+    # ---- phase C: prefill-induced TTFT jitter, chunked-prefill A/B ------
+    long_len = int(os.environ.get("BENCH_LONG_PROMPT",
+                                  "768" if on_tpu else "48"))
+    seg = int(os.environ.get("LLM_PREFILL_CHUNK_AB",
+                             "256" if on_tpu else "16"))
+
+    async def jitter_phase(gen_fn) -> dict:
+        """Short-stream TTFTs while long prompts arrive every ~40 ms."""
+        stop = asyncio.Event()
+
+        async def long_loop():
+            while not stop.is_set():
+                body = {"prompt_ids": rng.integers(
+                            1, vocab_hi, (long_len,)).tolist(),
+                        "max_new_tokens": 8}
+                async for _ in gen_fn(body):
+                    break  # prefill is the interference; drop the rest
+                await asyncio.sleep(0.04)
+
+        interferers = [asyncio.create_task(long_loop()) for _ in range(2)]
+        ttfts: list[float] = []
+        try:
+            for _ in range(int(os.environ.get("BENCH_JITTER_PROBES",
+                                              "16" if on_tpu else "6"))):
+                t0 = time.perf_counter()
+                async for _ in gen_fn(req(8)):
+                    ttfts.append(time.perf_counter() - t0)
+                    break
+                await asyncio.sleep(0.02)
+        finally:
+            stop.set()
+            for t in interferers:
+                t.cancel()
+            await asyncio.gather(*interferers, return_exceptions=True)
+        return {"p50_ms": round(percentile(ttfts, 50) * 1e3, 1),
+                "p99_ms": round(percentile(ttfts, 99) * 1e3, 1)}
+
+    jitter_plain = await jitter_phase(generate)
     await channel.close()
     await app.shutdown()
+
+    # reboot with segmented prefill and repeat the same interference
+    os.environ["LLM_PREFILL_CHUNK"] = str(seg)
+    try:
+        app2 = build_app()
+        await boot(app2)
+        channel2 = grpc.aio.insecure_channel(
+            f"127.0.0.1:{ports['GRPC_PORT']}")
+        generate2 = channel2.unary_stream(
+            "/llm.Chat/Generate",
+            request_serializer=lambda o: json.dumps(o).encode(),
+            response_deserializer=lambda raw: json.loads(raw) if raw else {},
+        )
+        async for _ in generate2(req(4)):   # warm compiles
+            pass
+        body = {"prompt_ids": rng.integers(1, vocab_hi,
+                                           (long_len,)).tolist(),
+                "max_new_tokens": 4}
+        async for _ in generate2(body):     # warm the segment program
+            pass
+        jitter_chunked = await jitter_phase(generate2)
+        await channel2.close()
+        await app2.shutdown()
+    finally:
+        os.environ.pop("LLM_PREFILL_CHUNK", None)
 
     agg_tok_s = sum(token_counts) / elapsed
     emit(
@@ -172,6 +240,13 @@ async def main() -> None:
             "herd_server_ttft_avg_ms": (
                 round(1e3 * (sum3 - sum2) / (cnt3 - cnt2), 1)
                 if cnt3 > cnt2 else None),
+            # phase C: short-stream TTFT under long-prompt interference —
+            # segmented prefill must bound the p99 spike
+            "prefill_jitter": {
+                "long_prompt_len": long_len,
+                "plain": jitter_plain,
+                "chunked": {**jitter_chunked, "prefill_chunk": seg},
+            },
             "preset": os.environ.get("LLAMA_PRESET", "tiny"),
             "backend": jax.default_backend(),
             "config": 4,

@@ -90,6 +90,9 @@ def main() -> gofr_tpu.App:
         draft_params=draft_params, draft_cfg=draft_cfg,
         # LLM_PAGE_SIZE>0: block-paged KV pool (LLM_PAGES sizes it below
         # the dense worst case — more concurrent slots per HBM byte)
+        # LLM_PREFILL_CHUNK>0: segmented prefill interleaved with decode
+        # chunks — a long prompt can't stall live streams (TTFT jitter)
+        prefill_chunk=int(os.environ.get("LLM_PREFILL_CHUNK", "0")),
         page_size=int(os.environ.get("LLM_PAGE_SIZE", "0")),
         n_pages=int(os.environ.get("LLM_PAGES", "0")) or None,
     )

@@ -392,6 +392,9 @@ def main() -> gofr_tpu.App:
         spec_k=spec_k,
         draft_params=draft_params, draft_cfg=draft_cfg,
         # paged pool enables automatic system-prompt prefix caching
+        # LLM_PREFILL_CHUNK>0: segmented prefill interleaved with decode
+        # chunks — a long prompt can't stall live streams (TTFT jitter)
+        prefill_chunk=int(os.environ.get("LLM_PREFILL_CHUNK", "0")),
         page_size=int(os.environ.get("LLM_PAGE_SIZE", "0")),
         n_pages=int(os.environ.get("LLM_PAGES", "0")) or None,
     )

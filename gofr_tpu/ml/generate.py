@@ -136,7 +136,7 @@ class Generator:
                  shard_cache: bool = False, spec_k: int = 0,
                  spec_ngram: int = 3, page_size: int = 0,
                  n_pages: int | None = None, draft_params: Any = None,
-                 draft_cfg: Any = None) -> None:
+                 draft_cfg: Any = None, prefill_chunk: int = 0) -> None:
         import contextlib
 
         from ..models import llama
@@ -163,6 +163,27 @@ class Generator:
         self.mesh = mesh
         self._repl = None  # replicated sharding for host-visible outputs
         self.page_size = int(page_size)
+        # prefill_chunk > 0: prompts longer than this are prefilled in
+        # segments interleaved with decode chunks (llama.prefill_segment_
+        # into) so one long prefill can't stall every live stream — the
+        # TTFT-jitter fix (VERDICT r4 #2). Dense non-spec serving only.
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk:
+            if page_size or spec_k:
+                raise ValueError(
+                    "prefill_chunk composes with the dense non-speculative "
+                    "path only (paged/spec admission has its own shapes)")
+            if shard_cache:
+                raise ValueError("prefill_chunk + shard_cache unsupported")
+            if max_seq % self.prefill_chunk:
+                # the segment program writes a fixed C-wide window; a final
+                # window crossing capacity would CLAMP its start and
+                # silently overwrite earlier prefilled rows
+                raise ValueError(
+                    f"max_seq {max_seq} must be a multiple of "
+                    f"prefill_chunk {self.prefill_chunk}")
+        self._chunked: dict[int, dict] = {}   # slot -> chunked-prefill state
+        self._chunked_order: list[int] = []   # round-robin across slots
         self.evictions = 0  # slots truncated because the page pool ran dry
         if self.page_size:
             # Block-paged KV cache (llama.init_paged_cache): a shared page
@@ -357,6 +378,13 @@ class Generator:
                                                         mesh=mesh),
             donate_argnums=(3,),
         )
+        if self.prefill_chunk:
+            self._segment_prefill = jax.jit(
+                lambda p, t, l, c, slot, start, new_len:
+                llama.prefill_segment_into(p, t, l, cfg, c, slot, start,
+                                           new_len, mesh=mesh),
+                donate_argnums=(3,),
+            )
 
         def post_prefill_many(tok_dev, logits, prefill_key, n_req0, slots,
                               valid):
@@ -966,6 +994,14 @@ class Generator:
                         self.params, self._tok_dev, self.cache,
                         np.int32(0), self._base_key,
                     )
+            if self.prefill_chunk:
+                # segment program: startup pays the compile, not the first
+                # long prompt (len reset by the bucket prefills below)
+                seg = np.zeros((1, self.prefill_chunk), np.int32)
+                one = np.array([1], np.int32)
+                _logits, self.cache = self._segment_prefill(
+                    self.params, seg, one, self.cache, np.int32(0),
+                    np.int32(0), np.int32(self.cache["k"].shape[2]))
             for bucket in self.prefill_buckets:
                 padded = np.zeros((1, bucket), np.int32)
                 ones = np.array([1], np.int32)
@@ -1034,19 +1070,45 @@ class Generator:
         """
         self.drain()  # settle bookkeeping before reusing slots
         prepped = []
+        chunked = []
         for prompt_ids, max_new, callback in requests:
             ids = np.asarray(prompt_ids, np.int32).reshape(-1)
             n = len(ids)
             if n == 0 or n >= self.max_seq:
                 raise ValueError(
                     f"prompt length {n} out of range (1..{self.max_seq - 1})")
-            prepped.append((ids, n, max_new, callback))
+            if self.prefill_chunk and n > self.prefill_chunk:
+                chunked.append((ids, n, max_new, callback))
+            else:
+                prepped.append((ids, n, max_new, callback))
 
         free = sum(1 for s in self.slots if not s.live)
-        if len(prepped) > free:
+        if len(prepped) + len(chunked) > free:
             raise RuntimeError(
-                f"no free generation slot ({len(prepped)} requested, "
-                f"{free} free)")
+                f"no free generation slot "
+                f"({len(prepped) + len(chunked)} requested, {free} free)")
+        if chunked and not prepped:
+            return [self._admit_chunked(*c) for c in chunked]
+        if chunked:
+            slots_c = [self._admit_chunked(*c) for c in chunked]
+            try:
+                slots_p = self.add_requests(
+                    [(ids, m, cb) for ids, _, m, cb in prepped])
+            except Exception:
+                # all-or-nothing: the caller sees the whole batch fail, so
+                # the chunked slots must not stay admitted either
+                for j in slots_c:
+                    self._chunked.pop(j, None)
+                    if j in self._chunked_order:
+                        self._chunked_order.remove(j)
+                    self.slots[j].live = False
+                raise
+            # preserve the caller's request order in the returned slots
+            it_c, it_p = iter(slots_c), iter(slots_p)
+            return [next(it_c) if (self.prefill_chunk
+                                   and len(np.asarray(r[0]).reshape(-1))
+                                   > self.prefill_chunk) else next(it_p)
+                    for r in requests]
 
         out: list[int] = []
         slots: list[int] = []
@@ -1066,6 +1128,80 @@ class Generator:
                 self._pending_first = collections.deque(
                     s for s in self._pending_first if s not in dead)
             raise
+
+    def _admit_chunked(self, ids, n: int, max_new: int, callback) -> int:
+        """Reserve a slot and queue the prompt for SEGMENTED prefill:
+        step() advances one segment per decode chunk, so live streams keep
+        producing while this prompt fills in. The slot joins decode (and
+        gets its first token) only after the final segment."""
+        slot = self.free_slot()
+        if slot is None:
+            raise RuntimeError("no free generation slot")
+        s = _Slot()
+        s.live = True
+        s.max_new = max_new
+        s.prompt_len = n
+        s.callback = callback
+        self.slots[slot] = s
+        self._chunked[slot] = {"ids": ids, "done": 0, "max_new": max_new}
+        self._chunked_order.append(slot)
+        return slot
+
+    def _decodable(self) -> bool:
+        """Any slot actually producing tokens (live and not mid-prefill)?"""
+        return bool(self._pending_first) or any(
+            s.live and i not in self._chunked
+            for i, s in enumerate(self.slots))
+
+    def _advance_chunked(self) -> None:
+        """Run the next prefill segment for one chunked slot (round-robin).
+        While nothing is decodable the segments run back-to-back — no
+        reason to interleave garbage decode chunks into an idle batch."""
+        while self._chunked_order:
+            slot = self._chunked_order[0]
+            st = self._chunked.get(slot)
+            if st is None:
+                # released elsewhere: drop ONLY the order entry — the slot
+                # may already host an unrelated new request
+                self._chunked_order.pop(0)
+                continue
+            if not self.slots[slot].live:
+                # cancelled mid-prefill: drop the bookkeeping
+                self._chunked.pop(slot, None)
+                self._chunked_order.pop(0)
+                continue
+            C = self.prefill_chunk
+            start = st["done"]
+            seg = st["ids"][start:start + C]
+            toks = np.zeros((1, C), np.int32)
+            toks[0, :len(seg)] = seg
+            lens = np.array([len(seg)], np.int32)
+            final = start + len(seg) == len(st["ids"])
+            s_cap = self.cache["k"].shape[2]
+            # capacity len parks the row: interleaved decode chunks drop
+            # their garbage writes out of bounds instead of corrupting
+            # the prefilled positions (prefill_segment_into docstring)
+            new_len = np.int32(len(st["ids"]) if final else s_cap)
+            with self._mesh_ctx():
+                logits, self.cache = self._segment_prefill(
+                    self.params, toks, lens, self.cache, np.int32(slot),
+                    np.int32(start), new_len)
+            st["done"] += len(seg)
+            if final:
+                # flush decode chunks dispatched while this slot was
+                # mid-prefill FIRST: their garbage rows for the slot must
+                # be dropped while the _chunked guard still holds
+                self.drain()
+                self._chunked.pop(slot)
+                self._chunked_order.pop(0)
+                self._n_requests += 1
+                self._pending_first.append(slot)
+                self.slots[slot].produced = 1  # the pending first token
+                self._after_prefill(logits, toks, lens, np.int32(slot))
+            else:
+                self._chunked_order.append(self._chunked_order.pop(0))
+            if self._decodable():
+                return  # one segment per decode chunk: keep streams warm
 
     def _admit_waves(self, prepped, out: list[int]) -> list[int]:
         for start in range(0, len(prepped), self._admit_cap):
@@ -1209,6 +1345,10 @@ class Generator:
         if self.n_live == 0:
             self.drain()
             return
+        if self._chunked:
+            self._advance_chunked()
+            if not self._decodable():
+                return  # everything live is still mid-prefill
         # Pending first tokens -> ONE 1-step mini-chunk so they surface a
         # full chunk earlier (TTFT); otherwise the throughput-sized chunk.
         # All firsts pending at dispatch ride that chunk's input row, and
@@ -1322,8 +1462,8 @@ class Generator:
         bursts: dict[int, list[int]] = {}
         for row in toks:
             for i, s in enumerate(self.slots):
-                if not s.live:
-                    continue
+                if not s.live or i in self._chunked:
+                    continue  # mid-prefill rows decode garbage; drop it
                 t = int(row[i])
                 s.tokens.append(t)
                 s.produced += 1
@@ -1339,6 +1479,9 @@ class Generator:
 
     def release(self, i: int) -> None:
         """Return a finished slot to the free pool (its tokens are consumed)."""
+        self._chunked.pop(i, None)
+        if i in self._chunked_order:  # a stale entry would later hand the
+            self._chunked_order.remove(i)  # slot's NEW occupant a kill
         if self.slots[i].live:
             raise RuntimeError(f"slot {i} still decoding")
         if self.page_size:

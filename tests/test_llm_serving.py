@@ -288,3 +288,94 @@ def test_rotating_prefixes_never_exhaust_pool(model, run):
 
     outs = run(scenario())
     assert outs == expects
+
+
+# ------------------------------------------------------------ chunked prefill
+def test_chunked_prefill_lossless_and_nonblocking(model, run):
+    """VERDICT r4 #2: with prefill_chunk set, a long prompt prefills in
+    segments interleaved with decode — a live short stream KEEPS receiving
+    tokens while the long prompt fills in, and both outputs equal their
+    whole-prompt-prefill decodes exactly."""
+    import numpy as np
+
+    cfg, params = model
+    long_prompt = list((np.arange(40) % 200 + 3).astype(int))
+    short = [5, 3, 2]
+    dense = Generator(params, cfg, batch_slots=1, max_seq=128,
+                      prefill_buckets=(64,))
+    ref_long = dense.generate(long_prompt, 8)
+    ref_short = dense.generate(short, 16)
+
+    async def scenario():
+        server = LLMServer(Generator(params, cfg, batch_slots=2, max_seq=128,
+                                     prefill_buckets=(8, 64), chunk=2,
+                                     prefill_chunk=8))
+        try:
+            import asyncio
+
+            short_bursts: list[tuple[int, list[int]]] = []
+            seq = [0]
+
+            async def short_stream():
+                out = []
+                async for burst in server.stream_chunks(short, 16):
+                    seq[0] += 1
+                    short_bursts.append((seq[0], burst))
+                    out.extend(burst)
+                return out
+
+            async def long_req():
+                # admitted while the short stream decodes: its 5-segment
+                # prefill must interleave, not stall
+                await asyncio.sleep(0.05)
+                seq[0] += 1
+                mark = seq[0]
+                out = await server.generate(long_prompt, 8)
+                return mark, out
+
+            short_out, (mark, long_out) = await asyncio.gather(
+                short_stream(), long_req())
+            assert short_out == ref_short
+            assert long_out == ref_long
+            # the short stream received bursts AFTER the long request
+            # started — the long prefill did not stall it to completion
+            assert any(i > mark for i, _ in short_bursts), short_bursts
+            return True
+        finally:
+            server.close()
+
+    assert run(scenario())
+
+
+def test_chunked_prefill_cancel_mid_prefill(model, run):
+    """A client abandoning a request during its segmented prefill frees
+    the slot; later requests serve normally."""
+    import asyncio
+
+    import numpy as np
+
+    cfg, params = model
+    long_prompt = list((np.arange(60) % 200 + 3).astype(int))
+
+    async def scenario():
+        server = LLMServer(Generator(params, cfg, batch_slots=1, max_seq=128,
+                                     prefill_buckets=(8, 64), chunk=2,
+                                     prefill_chunk=8))
+        try:
+            agen = server.stream_chunks(long_prompt, 8)
+            task = asyncio.create_task(agen.__anext__())
+            await asyncio.sleep(0.05)   # admission + first segments
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, StopAsyncIteration):
+                pass
+            await agen.aclose()         # client walks away mid-prefill
+            # the slot must come back: a fresh request completes
+            out = await asyncio.wait_for(server.generate([5, 3, 2], 4), 60)
+            assert len(out) == 4
+            return True
+        finally:
+            server.close()
+
+    assert run(scenario())
