@@ -456,11 +456,6 @@ class Generator:
         mesh = self.mesh
         if self.sampler.temperature > 0:
             raise ValueError("speculative decode is greedy-only")
-        if getattr(cfg, "kv_quant", False) and self.page_size:
-            # dense spec composes with the int8 cache (decode_window
-            # quantizes window rows); the paged window is still fp-only
-            raise ValueError(
-                "speculative decode with int8 KV requires the dense cache")
         K = self.spec_k
         hist_cap = self.max_seq + K + 2
         self._hist_cap = hist_cap

@@ -1007,10 +1007,11 @@ def paged_decode_window(params: dict, toks: jnp.ndarray, cache: dict,
     through each row's page table, attention gathers the virtual
     sequences back. ``len`` is NOT advanced — the caller advances by
     1 + accepted, and rejected rows are overwritten before any causal
-    mask can reach them (the decode_window argument, page-routed)."""
-    if cfg.kv_quant:
-        raise ValueError("paged cache requires the fp KV layout")
-    from ..ops import apply_rope, attention, repeat_kv, rms_norm, rope_table
+    mask can reach them (the decode_window argument, page-routed).
+    Composes with int8 pages (cfg.kv_quant): window rows quantize on
+    write, attention dequantizes the gathered virtual sequence."""
+    from ..ops import (apply_rope, attention, dequantize_kv, quantize_kv,
+                       repeat_kv, rms_norm, rope_table)
 
     b, w = toks.shape
     page_s = cache["k"].shape[2]
@@ -1029,6 +1030,8 @@ def paged_decode_window(params: dict, toks: jnp.ndarray, cache: dict,
     cos, sin = rope_table(positions, cfg.head_dim, cfg.rope_theta,
                           scaling=cfg.rope_scaling)
 
+    kv_idx3 = jnp.arange(KV)[None, None, :]
+
     def body(carry, lp):
         x, arrays, layer = carry
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
@@ -1037,17 +1040,47 @@ def paged_decode_window(params: dict, toks: jnp.ndarray, cache: dict,
         v = _mm(h, lp["wv"]).reshape(b, w, KV, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        dt = arrays["k"].dtype
-        arrays = {
-            "k": arrays["k"].at[layer, page, off].set(k.astype(dt)),
-            "v": arrays["v"].at[layer, page, off].set(v.astype(dt)),
-        }
-        k_l = jax.lax.dynamic_index_in_dim(arrays["k"], layer, 0,
-                                           keepdims=False)
-        v_l = jax.lax.dynamic_index_in_dim(arrays["v"], layer, 0,
-                                           keepdims=False)
-        k_virt = jnp.take(k_l, table, axis=0).reshape(b, -1, KV, hd)
-        v_virt = jnp.take(v_l, table, axis=0).reshape(b, -1, KV, hd)
+        if cfg.kv_quant:
+            # int8 page layouts (init_paged_cache): values flat
+            # [L, N, ps, KV*D], scales [L, N, KV, ps]
+            kq, k_sc = quantize_kv(k)        # [B, W, KV, hd] -> [B, W, KV]
+            vq, v_sc = quantize_kv(v)
+            arrays = {
+                "k": arrays["k"].at[layer, page, off].set(
+                    kq.reshape(b, w, KV * hd)),
+                "v": arrays["v"].at[layer, page, off].set(
+                    vq.reshape(b, w, KV * hd)),
+                "k_scale": arrays["k_scale"].at[
+                    layer, page[:, :, None], kv_idx3,
+                    off[:, :, None]].set(k_sc),
+                "v_scale": arrays["v_scale"].at[
+                    layer, page[:, :, None], kv_idx3,
+                    off[:, :, None]].set(v_sc),
+            }
+
+            def virt(name):
+                q8 = jnp.take(jax.lax.dynamic_index_in_dim(
+                    arrays[name], layer, 0, keepdims=False), table, axis=0)
+                sc = jnp.take(jax.lax.dynamic_index_in_dim(
+                    arrays[name + "_scale"], layer, 0, keepdims=False),
+                    table, axis=0)                  # [B, P, KV, ps]
+                q8 = q8.reshape(b, -1, KV, hd)      # [B, P*ps, KV, hd]
+                sc = jnp.swapaxes(sc, -1, -2).reshape(b, -1, KV)
+                return dequantize_kv(q8, sc, cfg.dtype)
+
+            k_virt, v_virt = virt("k"), virt("v")
+        else:
+            dt = arrays["k"].dtype
+            arrays = {
+                "k": arrays["k"].at[layer, page, off].set(k.astype(dt)),
+                "v": arrays["v"].at[layer, page, off].set(v.astype(dt)),
+            }
+            k_l = jax.lax.dynamic_index_in_dim(arrays["k"], layer, 0,
+                                               keepdims=False)
+            v_l = jax.lax.dynamic_index_in_dim(arrays["v"], layer, 0,
+                                               keepdims=False)
+            k_virt = jnp.take(k_l, table, axis=0).reshape(b, -1, KV, hd)
+            v_virt = jnp.take(v_l, table, axis=0).reshape(b, -1, KV, hd)
         o = attention(q, repeat_kv(k_virt, cfg.n_rep),
                       repeat_kv(v_virt, cfg.n_rep),
                       causal=True, q_offset=pos0)  # per-row offsets
@@ -1056,7 +1089,7 @@ def paged_decode_window(params: dict, toks: jnp.ndarray, cache: dict,
         x = x + _swiglu(h2, lp)
         return (x, arrays, layer + 1), None
 
-    arrays0 = {"k": cache["k"], "v": cache["v"]}
+    arrays0 = {key: cache[key] for key in cache if key != "len"}
     (x, arrays, _), _ = jax.lax.scan(
         body, (x, arrays0, jnp.int32(0)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)

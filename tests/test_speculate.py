@@ -169,11 +169,6 @@ def test_generator_speculative_guards():
     with pytest.raises(ValueError, match="greedy"):
         Generator(params, cfg, batch_slots=1, max_seq=64, spec_k=2,
                   sampler=Sampler(temperature=0.7))
-    # dense spec now COMPOSES with kv_quant (decode_window quantizes the
-    # window rows); only the paged window is still fp-only
-    with pytest.raises(ValueError, match="dense cache"):
-        Generator(params, _cfg(kv_quant=True), batch_slots=1, max_seq=64,
-                  spec_k=2, page_size=8, prefill_buckets=(8,))
     with pytest.raises(ValueError, match="shared vocab|vocabulary"):
         Generator(params, cfg, batch_slots=1, max_seq=64, spec_k=2,
                   draft_params=params,
@@ -209,6 +204,23 @@ def test_generator_speculative_on_paged_cache():
     gen.drain()
     for slot, expect in zip(slots, expects):
         assert streamed[slot] == expect
+    assert gen.spec_windows > 0
+
+
+def test_generator_spec_paged_int8_lossless():
+    """The FULL composition: speculation x paged pool x int8 pages —
+    output equals the int8 plain-greedy chain exactly (the last guard in
+    the spec/paging/quant matrix is gone)."""
+    from gofr_tpu.ml.generate import Generator
+
+    cfg = _cfg(kv_quant=True)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = [5, 3, 2, 6, 1, 9, 4, 7]
+    ref = Generator(params, cfg, batch_slots=1, max_seq=64,
+                    prefill_buckets=(8,)).generate(prompt, 12)
+    gen = Generator(params, cfg, batch_slots=2, max_seq=64,
+                    prefill_buckets=(8,), chunk=2, spec_k=3, page_size=8)
+    assert gen.generate(prompt, 12) == ref
     assert gen.spec_windows > 0
 
 
