@@ -18,9 +18,9 @@ MongoDB's modern command protocol:
   operation; ``commit_transaction``/``abort_transaction`` are admin-db
   commands; ``with_transaction`` wraps commit-on-return/abort-on-raise.
 
-Auth note: SCRAM challenge-response is deliberately out of scope here
-(connect to localhost/emulator/sidecar-proxied instances, or keep the
-injected-client wrapper for authenticated clusters).
+Auth: SCRAM-SHA-256 (RFC 7677) from scratch — pass username/password
+(+ auth_db, default "admin"); the exchange runs on connect and verifies
+the server's signature as well as proving the client's.
 """
 
 from __future__ import annotations
@@ -269,10 +269,15 @@ class MongoWire:
 
     def __init__(self, *, host: str = "localhost", port: int = 27017,
                  database: str = "test", timeout: float = 10.0,
+                 username: str | None = None, password: str | None = None,
+                 auth_db: str = "admin",
                  logger=None, metrics=None) -> None:
         self.host = host
         self.port = port
         self.database = database
+        self.username = username
+        self.password = password
+        self.auth_db = auth_db
         self._timeout = timeout
         self._logger = logger
         self._metrics = metrics
@@ -312,27 +317,29 @@ class MongoWire:
         if self._writer is None or self._writer.is_closing():
             self._reader, self._writer = await asyncio.wait_for(
                 asyncio.open_connection(self.host, self.port), self._timeout)
+            if self.username is not None:
+                try:
+                    await self._authenticate()
+                except BaseException:
+                    self._writer.close()
+                    self._writer = None
+                    raise
 
-    # -- protocol --------------------------------------------------------------
-    async def _command(self, command: dict,
-                       session: "MongoSession | None" = None) -> dict:
-        if session is not None:
-            command = session.apply(dict(command))
-        self._adopt_loop()
-        async with self._lock:
-            await self._ensure()
-            self._request_id += 1
-            body = b"\x00\x00\x00\x00" + b"\x00" + encode_document(command)
-            header = struct.pack("<iiii", 16 + len(body), self._request_id,
-                                 0, _OP_MSG)
-            self._writer.write(header + body)
-            await self._writer.drain()
+    async def _roundtrip(self, command: dict) -> dict:
+        """One OP_MSG exchange on the open connection. Caller holds the
+        lock (the handshake path calls this directly during _ensure)."""
+        self._request_id += 1
+        body = b"\x00\x00\x00\x00" + b"\x00" + encode_document(command)
+        header = struct.pack("<iiii", 16 + len(body), self._request_id,
+                             0, _OP_MSG)
+        self._writer.write(header + body)
+        await self._writer.drain()
 
-            raw = await asyncio.wait_for(
-                self._reader.readexactly(16), self._timeout)
-            length, _rid, _rto, opcode = struct.unpack("<iiii", raw)
-            payload = await asyncio.wait_for(
-                self._reader.readexactly(length - 16), self._timeout)
+        raw = await asyncio.wait_for(
+            self._reader.readexactly(16), self._timeout)
+        length, _rid, _rto, opcode = struct.unpack("<iiii", raw)
+        payload = await asyncio.wait_for(
+            self._reader.readexactly(length - 16), self._timeout)
         if opcode != _OP_MSG:
             raise MongoWireError(f"unexpected reply opcode {opcode}")
         # flagBits(4) + kind byte, then the reply document
@@ -343,6 +350,73 @@ class MongoWire:
             raise MongoWireError(
                 f"{reply.get('codeName', 'error')}: {reply.get('errmsg', reply)}")
         return reply
+
+    async def _authenticate(self) -> None:
+        """SCRAM-SHA-256 (RFC 7677) over saslStart/saslContinue — the
+        challenge-response auth mongod requires for real deployments; pure
+        hashlib/hmac, no driver library. The server's proof (``v=``) is
+        verified too, so a spoofed server can't silently accept."""
+        import base64
+        import hashlib
+        import hmac
+
+        user = self.username.replace("=", "=3D").replace(",", "=2C")
+        cnonce = base64.b64encode(os.urandom(18)).decode()
+        client_first_bare = f"n={user},r={cnonce}"
+        first = await self._roundtrip({
+            "saslStart": 1, "mechanism": "SCRAM-SHA-256",
+            "payload": Binary(("n,," + client_first_bare).encode()),
+            "$db": self.auth_db,
+        })
+        server_first = bytes(first["payload"]).decode()
+        attrs = dict(part.split("=", 1)
+                     for part in server_first.split(","))
+        nonce, salt_b64, iters = attrs["r"], attrs["s"], int(attrs["i"])
+        if not nonce.startswith(cnonce):
+            raise MongoWireError("server nonce does not extend ours")
+
+        salted = hashlib.pbkdf2_hmac(
+            "sha256", self.password.encode(),
+            base64.b64decode(salt_b64), iters)
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored_key = hashlib.sha256(client_key).digest()
+        without_proof = f"c=biws,r={nonce}"
+        auth_message = ",".join(
+            (client_first_bare, server_first, without_proof)).encode()
+        signature = hmac.new(stored_key, auth_message,
+                             hashlib.sha256).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, signature))
+        client_final = (without_proof
+                        + ",p=" + base64.b64encode(proof).decode())
+        final = await self._roundtrip({
+            "saslContinue": 1,
+            "conversationId": first.get("conversationId", 1),
+            "payload": Binary(client_final.encode()),
+            "$db": self.auth_db,
+        })
+        server_final = bytes(final["payload"]).decode()
+        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        expect_v = base64.b64encode(hmac.new(
+            server_key, auth_message, hashlib.sha256).digest()).decode()
+        if dict(part.split("=", 1) for part in
+                server_final.split(",")).get("v") != expect_v:
+            raise MongoWireError("server signature mismatch")
+        while not final.get("done"):
+            final = await self._roundtrip({
+                "saslContinue": 1,
+                "conversationId": first.get("conversationId", 1),
+                "payload": Binary(b""), "$db": self.auth_db,
+            })
+
+    # -- protocol --------------------------------------------------------------
+    async def _command(self, command: dict,
+                       session: "MongoSession | None" = None) -> dict:
+        if session is not None:
+            command = session.apply(dict(command))
+        self._adopt_loop()
+        async with self._lock:
+            await self._ensure()
+            return await self._roundtrip(command)
 
     def _observe(self, op: str, start: float, coll: str) -> None:
         dur = time.perf_counter() - start
