@@ -756,12 +756,14 @@ class Generator:
         """
         if not self.page_size:
             raise ValueError("prefix sharing requires page_size > 0")
-        if self.spec_k or getattr(self.cfg, "kv_quant", False):
+        if self.spec_k:
             # guard at REGISTRATION so callers with a silent-fallback path
             # (the OpenAI server's auto cache) fail here once and
             # negative-cache, instead of poisoning every later admission
+            # (speculation needs the slot's full token history seeded,
+            # which prefixed admission doesn't do yet)
             raise ValueError(
-                "prefix sharing doesn't compose with spec/kv_quant yet")
+                "prefix sharing doesn't compose with spec_k yet")
         ids = np.asarray(prefix_ids, np.int32).reshape(-1)
         ps = self.page_size
         shared_len = (len(ids) // ps) * ps

@@ -846,10 +846,10 @@ def paged_suffix_prefill(params: dict, tokens: jnp.ndarray,
     table. Rows beyond ``seq_lens`` write garbage at positions decode
     will overwrite before any masked read can reach them (the dense
     prefill_into argument). Returns last-valid-token logits [1, V].
+    Composes with int8 pages (cfg.kv_quant).
     """
-    if cfg.kv_quant:
-        raise ValueError("paged cache requires the fp KV layout")
-    from ..ops import apply_rope, attention, repeat_kv, rms_norm, rope_table
+    from ..ops import (apply_rope, attention, dequantize_kv, quantize_kv,
+                       repeat_kv, rms_norm, rope_table)
 
     b, s = tokens.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -874,18 +874,46 @@ def paged_suffix_prefill(params: dict, tokens: jnp.ndarray,
         v = _mm(h, lp["wv"]).reshape(b, s, KV, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        dt = arrays["k"].dtype
-        arrays = {
-            "k": arrays["k"].at[layer, page, off].set(k[0].astype(dt)),
-            "v": arrays["v"].at[layer, page, off].set(v[0].astype(dt)),
-        }
-        k_l = jax.lax.dynamic_index_in_dim(arrays["k"], layer, 0,
-                                           keepdims=False)
-        v_l = jax.lax.dynamic_index_in_dim(arrays["v"], layer, 0,
-                                           keepdims=False)
-        # virtual sequence for this ONE slot: [1, P_max*page_s, KV, hd]
-        k_virt = jnp.take(k_l, table_row, axis=0).reshape(1, -1, KV, hd)
-        v_virt = jnp.take(v_l, table_row, axis=0).reshape(1, -1, KV, hd)
+        if cfg.kv_quant:
+            kq, k_sc = quantize_kv(k[0])     # [S, KV, hd] -> sc [S, KV]
+            vq, v_sc = quantize_kv(v[0])
+            kv_i = jnp.arange(KV)[None, :]
+            arrays = {
+                "k": arrays["k"].at[layer, page, off].set(
+                    kq.reshape(s, KV * hd)),
+                "v": arrays["v"].at[layer, page, off].set(
+                    vq.reshape(s, KV * hd)),
+                "k_scale": arrays["k_scale"].at[
+                    layer, page[:, None], kv_i, off[:, None]].set(k_sc),
+                "v_scale": arrays["v_scale"].at[
+                    layer, page[:, None], kv_i, off[:, None]].set(v_sc),
+            }
+
+            def virt(name):
+                q8 = jnp.take(jax.lax.dynamic_index_in_dim(
+                    arrays[name], layer, 0, keepdims=False),
+                    table_row, axis=0)
+                sc = jnp.take(jax.lax.dynamic_index_in_dim(
+                    arrays[name + "_scale"], layer, 0, keepdims=False),
+                    table_row, axis=0)              # [P, KV, ps]
+                q8 = q8.reshape(1, -1, KV, hd)
+                sc = jnp.swapaxes(sc, -1, -2).reshape(1, -1, KV)
+                return dequantize_kv(q8, sc, cfg.dtype)
+
+            k_virt, v_virt = virt("k"), virt("v")
+        else:
+            dt = arrays["k"].dtype
+            arrays = {
+                "k": arrays["k"].at[layer, page, off].set(k[0].astype(dt)),
+                "v": arrays["v"].at[layer, page, off].set(v[0].astype(dt)),
+            }
+            k_l = jax.lax.dynamic_index_in_dim(arrays["k"], layer, 0,
+                                               keepdims=False)
+            v_l = jax.lax.dynamic_index_in_dim(arrays["v"], layer, 0,
+                                               keepdims=False)
+            # virtual sequence for this ONE slot: [1, P_max*page_s, KV, hd]
+            k_virt = jnp.take(k_l, table_row, axis=0).reshape(1, -1, KV, hd)
+            v_virt = jnp.take(v_l, table_row, axis=0).reshape(1, -1, KV, hd)
         # causal from the segment's absolute offset: suffix token t
         # attends every prefix position plus the window up to itself
         o = attention(q, repeat_kv(k_virt, cfg.n_rep),
@@ -896,7 +924,7 @@ def paged_suffix_prefill(params: dict, tokens: jnp.ndarray,
         x = x + _swiglu(h2, lp)
         return (x, arrays, layer + 1), None
 
-    arrays0 = {"k": cache["k"], "v": cache["v"]}
+    arrays0 = {key: cache[key] for key in cache if key != "len"}
     (x, arrays, _), _ = jax.lax.scan(
         body, (x, arrays0, jnp.int32(0)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)

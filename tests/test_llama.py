@@ -401,3 +401,34 @@ def test_grow_pages_reclaims_idle_prefix_before_truncating(setup):
     assert gen.evictions == 0
     assert not gen.has_prefix(pid)         # the idle prefix paid instead
     assert gen.prefix_evictions == 1
+
+
+def test_shared_prefix_int8_pages_matches_dense_quant():
+    """Prefix sharing now composes with int8 pages: suffix admission over
+    a quantized shared prefix reproduces the int8 dense decode exactly."""
+    from gofr_tpu.ml.generate import Generator
+
+    cfg = llama.tiny_llama(use_flash=False, kv_quant=True)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prefix = [5, 9, 2, 7, 1, 4, 8, 3]
+    suffixes = [[6, 2], [9, 9, 1]]
+    dense = Generator(params, cfg, batch_slots=1, max_seq=32,
+                      prefill_buckets=(16,))
+    expects = [dense.generate(prefix + sfx, 6) for sfx in suffixes]
+
+    gen = Generator(params, cfg, batch_slots=2, max_seq=32,
+                    prefill_buckets=(8, 16), chunk=2, page_size=8)
+    pid = gen.register_prefix(prefix)
+    got: dict[int, list[int]] = {}
+    slots = [gen.add_request(
+        sfx, 6, prefix=pid,
+        callback=lambda i, toks: got.setdefault(i, []).extend(toks))
+        for sfx in suffixes]
+    while gen.n_live:
+        gen.step()
+    gen.drain()
+    assert [got[s] for s in slots] == expects
+    for s in slots:
+        gen.release(s)
+    gen.drop_prefix(pid)
+    assert gen.free_pages == gen.n_pages - 1
