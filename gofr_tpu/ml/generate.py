@@ -634,6 +634,22 @@ class Generator:
         self._spec_post_prefill_many = jax.jit(spec_post_prefill_many,
                                                donate_argnums=(0, 1))
 
+        def spec_prefix_post(tok_dev, tokens_dev, logits, row, length,
+                             slot):
+            """Prefixed admission under speculation: the slot's history
+            row is the FULL prefix+suffix (drafting context), written
+            whole — one [hist_cap] int32 transfer — plus the greedy first
+            token at position ``length``."""
+            first = jnp.argmax(logits[0]).astype(jnp.int32)
+            tok_dev = host_visible(tok_dev.at[slot].set(first))
+            row = row.at[length].set(first)
+            tokens_dev = jax.lax.dynamic_update_slice(
+                tokens_dev, row[None], (slot, jnp.int32(0)))
+            return tok_dev, host_visible(tokens_dev)
+
+        self._spec_prefix_post = jax.jit(spec_prefix_post,
+                                         donate_argnums=(0, 1))
+
         if draft_params is not None:
             # the draft must ingest every admitted prompt too: its cache
             # rows are the drafting context (same buckets as the target
@@ -756,14 +772,16 @@ class Generator:
         """
         if not self.page_size:
             raise ValueError("prefix sharing requires page_size > 0")
-        if self.spec_k:
+        if self.spec_k and self.draft_params is not None:
             # guard at REGISTRATION so callers with a silent-fallback path
             # (the OpenAI server's auto cache) fail here once and
-            # negative-cache, instead of poisoning every later admission
-            # (speculation needs the slot's full token history seeded,
-            # which prefixed admission doesn't do yet)
+            # negative-cache, instead of poisoning every later admission.
+            # Lookup-draft speculation composes (prefixed admission seeds
+            # the history row); a draft MODEL would also need its own
+            # cache prefilled with the shared prefix — not wired yet.
             raise ValueError(
-                "prefix sharing doesn't compose with spec_k yet")
+                "prefix sharing doesn't compose with draft-model "
+                "speculation yet (prompt-lookup spec_k works)")
         ids = np.asarray(prefix_ids, np.int32).reshape(-1)
         ps = self.page_size
         shared_len = (len(ids) // ps) * ps
@@ -799,6 +817,9 @@ class Generator:
         self._prefix_clock += 1
         self._prefixes[pid] = {"pages": pages, "len": shared_len,
                                "tail": [int(t) for t in ids[shared_len:]],
+                               # full ids: spec-mode admission seeds the
+                               # slot's device history row with these
+                               "ids_full": [int(t) for t in ids],
                                "refs": 0, "last_use": self._prefix_clock}
         return pid
 
@@ -898,7 +919,19 @@ class Generator:
                     self._table[slot].copy(), np.int32(start),
                     np.int32(slot),
                 )
-                self._after_prefill(logits, toks, lens, np.int32(slot))
+                if self.spec_k:
+                    # the suffix-only _after_prefill would seed a wrong
+                    # history; write the full prefix+suffix row instead
+                    # suffix already carries the tail — take only the
+                    # paged (whole-page) part of the registered ids
+                    hist = info["ids_full"][:info["len"]] + suffix
+                    row = np.zeros((self._hist_cap,), np.int32)
+                    row[:len(hist)] = hist
+                    self._tok_dev, self._tokens_dev = self._spec_prefix_post(
+                        self._tok_dev, self._tokens_dev, logits, row,
+                        np.int32(len(hist)), np.int32(slot))
+                else:
+                    self._after_prefill(logits, toks, lens, np.int32(slot))
         except Exception:
             self.slots[slot].live = False
             self._free_slot_pages(slot)

@@ -329,3 +329,41 @@ def test_spec_accept_metric_exported(run):
     assert len(accept) == 1
     assert 0.0 <= accept[0][1] <= 1.0
     assert accept[0][1] > 0.5  # perfect draft: high acceptance
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_spec_composes_with_shared_prefix(quant):
+    """Prefix sharing + prompt-lookup speculation (+ int8 pages): the
+    prefixed admission seeds the slot's device history row with the full
+    prefix+suffix, so drafting sees real context and the output equals
+    the dense whole-prompt greedy chain."""
+    from gofr_tpu.ml.generate import Generator
+
+    cfg = _cfg(kv_quant=quant)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prefix = [7, 3, 9, 2, 7, 3, 9, 2]     # repetitive: lookup can accept
+    suffixes = [[7, 3], [9, 2, 7]]
+    dense = Generator(params, cfg, batch_slots=1, max_seq=32,
+                      prefill_buckets=(16,))
+    expects = [dense.generate(prefix + sfx, 6) for sfx in suffixes]
+
+    gen = Generator(params, cfg, batch_slots=2, max_seq=32,
+                    prefill_buckets=(8, 16), chunk=2, page_size=8,
+                    spec_k=2)
+    pid = gen.register_prefix(prefix)
+    got: dict[int, list[int]] = {}
+    slots = [gen.add_request(
+        sfx, 6, prefix=pid,
+        callback=lambda i, toks: got.setdefault(i, []).extend(toks))
+        for sfx in suffixes]
+    while gen.n_live:
+        gen.step()
+    gen.drain()
+    assert [got[s] for s in slots] == expects
+    assert gen.spec_windows > 0
+    # draft-MODEL + prefix stays guarded (draft cache not prefix-seeded)
+    gen2 = Generator(params, _cfg(), batch_slots=1, max_seq=32,
+                     prefill_buckets=(8,), chunk=2, page_size=8, spec_k=2,
+                     draft_params=params, draft_cfg=_cfg())
+    with pytest.raises(ValueError, match="draft-model"):
+        gen2.register_prefix(prefix)
