@@ -772,16 +772,6 @@ class Generator:
         """
         if not self.page_size:
             raise ValueError("prefix sharing requires page_size > 0")
-        if self.spec_k and self.draft_params is not None:
-            # guard at REGISTRATION so callers with a silent-fallback path
-            # (the OpenAI server's auto cache) fail here once and
-            # negative-cache, instead of poisoning every later admission.
-            # Lookup-draft speculation composes (prefixed admission seeds
-            # the history row); a draft MODEL would also need its own
-            # cache prefilled with the shared prefix — not wired yet.
-            raise ValueError(
-                "prefix sharing doesn't compose with draft-model "
-                "speculation yet (prompt-lookup spec_k works)")
         ids = np.asarray(prefix_ids, np.int32).reshape(-1)
         ps = self.page_size
         shared_len = (len(ids) // ps) * ps
@@ -922,11 +912,28 @@ class Generator:
                 if self.spec_k:
                     # the suffix-only _after_prefill would seed a wrong
                     # history; write the full prefix+suffix row instead
-                    # suffix already carries the tail — take only the
-                    # paged (whole-page) part of the registered ids
+                    # (suffix already carries the tail — take only the
+                    # paged whole-page part of the registered ids)
                     hist = info["ids_full"][:info["len"]] + suffix
                     row = np.zeros((self._hist_cap,), np.int32)
                     row[:len(hist)] = hist
+                    if self.draft_params is not None:
+                        # the draft's own dense cache never saw the shared
+                        # pages: prefill it with the full history
+                        bucket_h = next((b for b in self.prefill_buckets
+                                         if len(hist) <= b), None)
+                        if bucket_h is None:
+                            raise ValueError(
+                                f"prefix+suffix length {len(hist)} "
+                                f"exceeds the largest prefill bucket "
+                                f"{self.prefill_buckets[-1]} (the draft "
+                                f"model must ingest the full history)")
+                        toks_h = np.zeros((1, bucket_h), np.int32)
+                        toks_h[0, :len(hist)] = hist
+                        _, self._draft_cache = self._draft_prefill_into(
+                            self.draft_params, toks_h,
+                            np.array([len(hist)], np.int32),
+                            self._draft_cache, np.int32(slot))
                     self._tok_dev, self._tokens_dev = self._spec_prefix_post(
                         self._tok_dev, self._tokens_dev, logits, row,
                         np.int32(len(hist)), np.int32(slot))

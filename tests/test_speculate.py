@@ -361,9 +361,36 @@ def test_spec_composes_with_shared_prefix(quant):
     gen.drain()
     assert [got[s] for s in slots] == expects
     assert gen.spec_windows > 0
-    # draft-MODEL + prefix stays guarded (draft cache not prefix-seeded)
-    gen2 = Generator(params, _cfg(), batch_slots=1, max_seq=32,
-                     prefill_buckets=(8,), chunk=2, page_size=8, spec_k=2,
-                     draft_params=params, draft_cfg=_cfg())
-    with pytest.raises(ValueError, match="draft-model"):
-        gen2.register_prefix(prefix)
+
+
+def test_draft_model_composes_with_shared_prefix():
+    """Draft-model speculation + shared prefixes: prefixed admission also
+    prefills the draft's own cache with the full history, so a perfect
+    draft keeps its high acceptance and the output stays the dense
+    whole-prompt greedy chain."""
+    from gofr_tpu.ml.generate import Generator
+
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prefix = [7, 3, 9, 2, 7, 3, 9, 2]
+    suffixes = [[7, 3], [9, 2, 7]]
+    dense = Generator(params, cfg, batch_slots=1, max_seq=32,
+                      prefill_buckets=(16,))
+    expects = [dense.generate(prefix + sfx, 6) for sfx in suffixes]
+
+    gen = Generator(params, cfg, batch_slots=2, max_seq=32,
+                    prefill_buckets=(8, 16), chunk=2, page_size=8,
+                    spec_k=2, draft_params=params, draft_cfg=cfg)
+    pid = gen.register_prefix(prefix)
+    got: dict[int, list[int]] = {}
+    slots = [gen.add_request(
+        sfx, 6, prefix=pid,
+        callback=lambda i, toks: got.setdefault(i, []).extend(toks))
+        for sfx in suffixes]
+    while gen.n_live:
+        gen.step()
+    gen.drain()
+    assert [got[s] for s in slots] == expects
+    acc = ((gen.spec_emitted - gen.spec_windows)
+           / max(gen.spec_windows * 2, 1))
+    assert acc > 0.5   # the perfect draft saw the full history
