@@ -169,16 +169,14 @@ class Generator:
         # TTFT-jitter fix (VERDICT r4 #2). Dense non-spec serving only.
         self.prefill_chunk = int(prefill_chunk)
         if self.prefill_chunk:
-            if page_size or spec_k:
-                raise ValueError(
-                    "prefill_chunk composes with the dense non-speculative "
-                    "path only (paged/spec admission has its own shapes)")
             if shard_cache:
                 raise ValueError("prefill_chunk + shard_cache unsupported")
             if max_seq % self.prefill_chunk:
-                # the segment program writes a fixed C-wide window; a final
-                # window crossing capacity would CLAMP its start and
-                # silently overwrite earlier prefilled rows
+                # the dense segment program writes a fixed C-wide window; a
+                # final window crossing capacity would CLAMP its start and
+                # silently overwrite earlier prefilled rows (the paged
+                # program routes overflow to scratch, but one rule is
+                # simpler than two)
                 raise ValueError(
                     f"max_seq {max_seq} must be a multiple of "
                     f"prefill_chunk {self.prefill_chunk}")
@@ -379,12 +377,24 @@ class Generator:
             donate_argnums=(3,),
         )
         if self.prefill_chunk:
-            self._segment_prefill = jax.jit(
-                lambda p, t, l, c, slot, start, new_len:
-                llama.prefill_segment_into(p, t, l, cfg, c, slot, start,
-                                           new_len, mesh=mesh),
-                donate_argnums=(3,),
-            )
+            if self.page_size:
+                ps = self.page_size
+
+                def seg_paged(p, t, l, c, row, start, slot, new_len):
+                    logits, c2 = llama.paged_suffix_prefill(
+                        p, t, l, cfg, c, row, start, ps)
+                    return logits, {**c2, "len":
+                                    c2["len"].at[slot].set(new_len)}
+
+                self._segment_prefill_paged = jax.jit(seg_paged,
+                                                      donate_argnums=(3,))
+            else:
+                self._segment_prefill = jax.jit(
+                    lambda p, t, l, c, slot, start, new_len:
+                    llama.prefill_segment_into(p, t, l, cfg, c, slot, start,
+                                               new_len, mesh=mesh),
+                    donate_argnums=(3,),
+                )
 
         def post_prefill_many(tok_dev, logits, prefill_key, n_req0, slots,
                               valid):
@@ -1036,9 +1046,16 @@ class Generator:
                 # long prompt (len reset by the bucket prefills below)
                 seg = np.zeros((1, self.prefill_chunk), np.int32)
                 one = np.array([1], np.int32)
-                _logits, self.cache = self._segment_prefill(
-                    self.params, seg, one, self.cache, np.int32(0),
-                    np.int32(0), np.int32(self.cache["k"].shape[2]))
+                if self.page_size:
+                    _logits, self.cache = self._segment_prefill_paged(
+                        self.params, seg, one, self.cache,
+                        np.zeros((self._p_max,), np.int32), np.int32(0),
+                        np.int32(0),
+                        np.int32(self._p_max * self.page_size))
+                else:
+                    _logits, self.cache = self._segment_prefill(
+                        self.params, seg, one, self.cache, np.int32(0),
+                        np.int32(0), np.int32(self.cache["k"].shape[2]))
             for bucket in self.prefill_buckets:
                 padded = np.zeros((1, bucket), np.int32)
                 ones = np.array([1], np.int32)
@@ -1170,10 +1187,32 @@ class Generator:
         """Reserve a slot and queue the prompt for SEGMENTED prefill:
         step() advances one segment per decode chunk, so live streams keep
         producing while this prompt fills in. The slot joins decode (and
-        gets its first token) only after the final segment."""
+        gets its first token) only after the final segment. Paged mode
+        applies the usual admission control here (the first segment's
+        pages must allocate; an impossible request rejects outright)."""
         slot = self.free_slot()
         if slot is None:
             raise RuntimeError("no free generation slot")
+        if self.page_size:
+            upto_total = min(n + 2 * self.chunk, n + max_new, self.max_seq)
+            need = -(-upto_total // self.page_size)
+            if need > self._pages_ever_free():
+                raise ValueError(
+                    f"request needs {need} pages but the pool can only "
+                    f"ever free {self._pages_ever_free()}")
+            self.slots[slot].live = True  # reserve for the alloc below
+            if self._slot_pages[slot]:
+                self._free_slot_pages(slot)
+            first_upto = min(self.prefill_chunk, n)
+            if not self._alloc_pages_to(slot, first_upto):
+                self._reclaim_prefix_pages(
+                    -(-first_upto // self.page_size))
+            if not self._alloc_pages_to(slot, first_upto):
+                self.slots[slot].live = False
+                self._free_slot_pages(slot)
+                raise PagePoolExhausted(
+                    f"kv page pool exhausted ({self.free_pages} pages "
+                    f"free)")
         s = _Slot()
         s.live = True
         s.max_new = max_new
@@ -1214,15 +1253,37 @@ class Generator:
             toks[0, :len(seg)] = seg
             lens = np.array([len(seg)], np.int32)
             final = start + len(seg) == len(st["ids"])
-            s_cap = self.cache["k"].shape[2]
-            # capacity len parks the row: interleaved decode chunks drop
-            # their garbage writes out of bounds instead of corrupting
-            # the prefilled positions (prefill_segment_into docstring)
-            new_len = np.int32(len(st["ids"]) if final else s_cap)
-            with self._mesh_ctx():
-                logits, self.cache = self._segment_prefill(
-                    self.params, toks, lens, self.cache, np.int32(slot),
-                    np.int32(start), new_len)
+            if self.page_size:
+                # cover this segment's positions (pages beyond stay
+                # scratch); mid-prefill pool-dry reclaims idle prefixes,
+                # then truncates honestly like a mid-decode eviction
+                if not self._alloc_pages_to(slot, start + len(seg)):
+                    self._reclaim_prefix_pages(1)
+                if not self._alloc_pages_to(slot, start + len(seg)):
+                    self.drain()
+                    self._chunked.pop(slot)
+                    self._chunked_order.pop(0)
+                    self.slots[slot].live = False
+                    self.slots[slot].evicted = True
+                    self.evictions += 1
+                    continue
+                s_cap = self._p_max * self.page_size
+                new_len = np.int32(len(st["ids"]) if final else s_cap)
+                with self._mesh_ctx():
+                    logits, self.cache = self._segment_prefill_paged(
+                        self.params, toks, lens, self.cache,
+                        self._table[slot].copy(), np.int32(start),
+                        np.int32(slot), new_len)
+            else:
+                s_cap = self.cache["k"].shape[2]
+                # capacity len parks the row: interleaved decode chunks
+                # drop their garbage writes out of bounds instead of
+                # corrupting prefilled positions (prefill_segment_into)
+                new_len = np.int32(len(st["ids"]) if final else s_cap)
+                with self._mesh_ctx():
+                    logits, self.cache = self._segment_prefill(
+                        self.params, toks, lens, self.cache, np.int32(slot),
+                        np.int32(start), new_len)
             st["done"] += len(seg)
             if final:
                 # flush decode chunks dispatched while this slot was
@@ -1234,7 +1295,28 @@ class Generator:
                 self._n_requests += 1
                 self._pending_first.append(slot)
                 self.slots[slot].produced = 1  # the pending first token
-                self._after_prefill(logits, toks, lens, np.int32(slot))
+                if self.spec_k:
+                    # seed the device history row with the FULL prompt
+                    # (the segment-shaped _after_prefill would write a
+                    # C-token suffix only); the draft cache re-ingests too
+                    hist = [int(t) for t in st["ids"]]
+                    if self.draft_params is not None:
+                        bucket_h = next((b for b in self.prefill_buckets
+                                         if len(hist) <= b), None)
+                        if bucket_h is not None:
+                            toks_h = np.zeros((1, bucket_h), np.int32)
+                            toks_h[0, :len(hist)] = hist
+                            _, self._draft_cache = self._draft_prefill_into(
+                                self.draft_params, toks_h,
+                                np.array([len(hist)], np.int32),
+                                self._draft_cache, np.int32(slot))
+                    row = np.zeros((self._hist_cap,), np.int32)
+                    row[:len(hist)] = hist
+                    self._tok_dev, self._tokens_dev = self._spec_prefix_post(
+                        self._tok_dev, self._tokens_dev, logits, row,
+                        np.int32(len(hist)), np.int32(slot))
+                else:
+                    self._after_prefill(logits, toks, lens, np.int32(slot))
             else:
                 self._chunked_order.append(self._chunked_order.pop(0))
             if self._decodable():
@@ -1463,8 +1545,8 @@ class Generator:
         bursts: dict[int, list[int]] = {}
         for w in range(emits.shape[0]):
             for i, s in enumerate(self.slots):
-                if not s.live:
-                    continue
+                if not s.live or i in self._chunked:
+                    continue  # mid-prefill rows decode garbage; drop it
                 self.spec_windows += 1
                 s.spec_windows += 1
                 s.spec_emitted += int(counts[w, i])

@@ -379,3 +379,46 @@ def test_chunked_prefill_cancel_mid_prefill(model, run):
             server.close()
 
     assert run(scenario())
+
+
+def test_chunked_prefill_paged_and_speculative(model, run):
+    """Chunked prefill now covers the paged pool and speculation: a long
+    prompt segments through the page tables (int8 pages included
+    elsewhere), and under spec_k the final segment seeds the device
+    history row — all outputs equal the dense whole-prompt decode."""
+    import numpy as np
+
+    cfg, params = model
+    long_prompt = list((np.arange(40) % 200 + 3).astype(int))
+    short = [5, 3, 2]
+    dense = Generator(params, cfg, batch_slots=1, max_seq=64,
+                      prefill_buckets=(64,))
+    ref_long = dense.generate(long_prompt, 8)
+    ref_short = dense.generate(short, 8)
+
+    async def scenario():
+        import asyncio
+
+        # paged + chunked through the server
+        server = LLMServer(Generator(params, cfg, batch_slots=2, max_seq=64,
+                                     prefill_buckets=(8, 64), chunk=2,
+                                     page_size=8, prefill_chunk=16))
+        try:
+            outs = await asyncio.gather(server.generate(long_prompt, 8),
+                                        server.generate(short, 8))
+            assert outs == [ref_long, ref_short]
+        finally:
+            server.close()
+
+        # speculative + chunked through the server
+        server = LLMServer(Generator(params, cfg, batch_slots=2, max_seq=64,
+                                     prefill_buckets=(8, 64), chunk=2,
+                                     spec_k=2, prefill_chunk=16))
+        try:
+            assert await server.generate(long_prompt, 8) == ref_long
+            assert server.gen.spec_windows > 0
+        finally:
+            server.close()
+        return True
+
+    assert run(scenario())
