@@ -169,8 +169,6 @@ class Generator:
         # TTFT-jitter fix (VERDICT r4 #2). Dense non-spec serving only.
         self.prefill_chunk = int(prefill_chunk)
         if self.prefill_chunk:
-            if shard_cache:
-                raise ValueError("prefill_chunk + shard_cache unsupported")
             if max_seq % self.prefill_chunk:
                 # the dense segment program writes a fixed C-wide window; a
                 # final window crossing capacity would CLAMP its start and

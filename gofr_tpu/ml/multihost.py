@@ -173,7 +173,8 @@ class MultiHostWorker:
                  batch_slots: int | None = None, max_seq: int | None = None,
                  prefill_buckets: tuple = (), prompt_bucket: int | None = None,
                  chunk: int = 4, sampler=None, eos_id: int | None = None,
-                 spec_k: int = 0, heartbeat_s: float = 5.0,
+                 spec_k: int = 0, prefill_chunk: int = 0,
+                 heartbeat_s: float = 5.0,
                  logger=None) -> None:
         self.process_id = process_id
         self.num_processes = num_processes
@@ -184,6 +185,10 @@ class MultiHostWorker:
         self.sampler = sampler
         self.eos_id = eos_id
         self.spec_k = spec_k
+        # segmented prefill in lock-step: every rank advances the same
+        # segment inside the broadcast STEP, so a long prompt can't stall
+        # the whole mesh's live streams
+        self.prefill_chunk = prefill_chunk
         self.heartbeat_s = heartbeat_s
         self.batch_slots = batch_slots
         self.max_seq = max_seq
@@ -237,7 +242,10 @@ class MultiHostWorker:
             # speculation stays lock-step: greedy windows are deterministic
             # and the emit/count blocks come back replicated, so every
             # rank's bookkeeping sees identical acceptance
-            spec_k=self.spec_k)
+            spec_k=self.spec_k,
+            # chunked prefill is also lock-step: segment advancement is a
+            # deterministic function of the replayed admit/step sequence
+            prefill_chunk=self.prefill_chunk)
         # compile every program up front ON EVERY RANK — a lazy first-use
         # compile inside the command loop would stall that rank alone
         self.gen.warmup()

@@ -423,7 +423,7 @@ from gofr_tpu.models import llama
 pid, coord, port = int(sys.argv[1]), sys.argv[2], int(sys.argv[3])
 cfg = llama.tiny_llama(use_flash=False, dtype=jnp.float32)
 MultiHostWorker(pid, 4, coord, port=port if pid == 0 else 0, cfg=cfg,
-                prompt_bucket=16).run()
+                prompt_bucket=16, prefill_chunk=8).run()
 print(f"OK proc={pid}", flush=True)
 """
 
@@ -454,6 +454,12 @@ def test_four_rank_serving_and_rank_kill(tmp_path, run):
                 240)
             for p, o in zip(prompts, outs):
                 assert o == _reference_greedy(p, 6)
+
+            # a LONG prompt (> prefill_chunk=8) takes the lock-step
+            # segmented-prefill path on every rank and must still match
+            long_p = [(i % 9) + 1 for i in range(14)]
+            out_long = await asyncio.wait_for(llm.generate(long_p, 6), 240)
+            assert out_long == _reference_greedy(long_p, 6)
 
             # rank-kill mid-stream: start long generations, let the first
             # burst arrive, then kill rank 0 (any rank loss kills the
