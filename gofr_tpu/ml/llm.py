@@ -360,7 +360,29 @@ class LLMServer:
             if req.cancelled and self.gen.slots[slot].live:
                 self.gen.slots[slot].live = False
 
+    def _export_pool_gauges(self) -> None:
+        """Pool pressure at :2121 — evictions (truncated streams) and
+        prefix evictions (LRU-dropped system prompts) are the two signals
+        an operator sizes n_pages by."""
+        if self._metrics is None:
+            return
+        try:
+            self._metrics.set_gauge("app_llm_evictions",
+                                    float(self.gen.evictions),
+                                    model=self.name)
+            if getattr(self.gen, "page_size", 0):
+                self._metrics.set_gauge(
+                    "app_llm_prefix_evictions",
+                    float(getattr(self.gen, "prefix_evictions", 0)),
+                    model=self.name)
+                self._metrics.set_gauge("app_llm_free_pages",
+                                        float(self.gen.free_pages),
+                                        model=self.name)
+        except Exception:
+            pass
+
     def _finish_dead_slots(self) -> None:
+        self._export_pool_gauges()
         for slot, req in list(self._active.items()):
             s = self.gen.slots[slot]
             if not s.live:

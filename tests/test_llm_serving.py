@@ -422,3 +422,32 @@ def test_chunked_prefill_paged_and_speculative(model, run):
         return True
 
     assert run(scenario())
+
+
+def test_pool_gauges_exported(model, run):
+    """Operators size n_pages by evictions/free-pages; the serving thread
+    exports them as gauges alongside the request metrics."""
+    cfg, params = model
+    gauges: dict[str, float] = {}
+
+    class _Metrics:
+        def set_gauge(self, name, value, **labels):
+            gauges[name] = value
+
+        def record_histogram(self, name, value, **labels):
+            pass
+
+    async def scenario():
+        server = LLMServer(Generator(params, cfg, batch_slots=2, max_seq=32,
+                                     prefill_buckets=(8,), chunk=2,
+                                     page_size=8, n_pages=4),
+                           metrics=_Metrics())
+        try:
+            await server.generate([5, 3, 2], 4)
+        finally:
+            server.close()
+
+    run(scenario())
+    assert gauges.get("app_llm_evictions") == 0.0
+    assert "app_llm_free_pages" in gauges
+    assert "app_llm_prefix_evictions" in gauges
