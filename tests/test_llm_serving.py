@@ -451,3 +451,88 @@ def test_pool_gauges_exported(model, run):
     assert gauges.get("app_llm_evictions") == 0.0
     assert "app_llm_free_pages" in gauges
     assert "app_llm_prefix_evictions" in gauges
+
+
+def test_chunked_prefill_pool_dry_evicts_honestly(model, run):
+    """If the paged pool runs dry MID-segmented-prefill (another stream
+    holds the pages), the chunked request finishes as an eviction — the
+    client sees finish_reason 'eviction', never a hang or a silent fake
+    completion — and the pool recovers."""
+    import numpy as np
+
+    cfg, params = model
+    long_prompt = list((np.arange(30) % 200 + 3).astype(int))
+
+    async def scenario():
+        import asyncio
+
+        # 1 scratch + 6 usable pages: the long request needs 5 (fits
+        # alone), the hog pins 3 while decoding -> dry mid-prefill
+        server = LLMServer(Generator(params, cfg, batch_slots=2, max_seq=64,
+                                     prefill_buckets=(8, 64), chunk=2,
+                                     page_size=8, n_pages=7,
+                                     prefill_chunk=8))
+        try:
+            hog_task = asyncio.create_task(
+                server.generate([1, 2, 3, 4, 5, 6, 7], 16))
+            await asyncio.sleep(0.2)  # hog admitted and decoding
+            fin: dict = {}
+            out = await asyncio.wait_for(
+                server.generate(long_prompt, 8, info=fin), 120)
+            hog = await asyncio.wait_for(hog_task, 120)
+            assert len(hog) == 16          # the hog was never corrupted
+            # the long request either squeezed through (pages freed in
+            # time) or was evicted — but NEVER silently truncated as a
+            # natural stop
+            if len(out) < 8:
+                assert fin.get("finish_reason") == "eviction", (out, fin)
+            # pool recovers fully for the next request
+            out2 = await asyncio.wait_for(server.generate([5, 3], 4), 120)
+            assert len(out2) == 4
+            return True
+        finally:
+            server.close()
+
+    assert run(scenario())
+
+
+def test_serving_soak_all_compositions(model, run):
+    """Soak the full composition through the server — paged + int8-free
+    spec drafting + chunked prefill + rotating prefixes — and assert the
+    steady-state invariants: every stream correct-length, all slots free,
+    all pages back in the pool, prefix evictions bounded the cache."""
+    import numpy as np
+
+    cfg, params = model
+
+    async def scenario():
+        import asyncio
+
+        server = LLMServer(Generator(params, cfg, batch_slots=3, max_seq=64,
+                                     prefill_buckets=(8, 64), chunk=2,
+                                     page_size=8, n_pages=12, spec_k=2,
+                                     prefill_chunk=8))
+        try:
+            rng = np.random.default_rng(0)
+            for wave in range(6):
+                pfx = [int(x) for x in rng.integers(1, 200, 8)]
+                pid = await asyncio.to_thread(server.register_prefix, pfx)
+                jobs = [
+                    server.generate([int(x) for x in rng.integers(1, 200, 3)], 5),
+                    server.generate(
+                        [int(x) for x in rng.integers(1, 200, 20)], 5),
+                    server.generate([7, 3], 5, prefix=pid),
+                ]
+                outs = await asyncio.wait_for(asyncio.gather(*jobs), 180)
+                assert [len(o) for o in outs] == [5, 5, 5]
+            gen = server.gen
+            assert gen.n_live == 0
+            held = sum(len(i["pages"])
+                       for i in gen._prefixes.values())
+            assert gen.free_pages + held == gen.n_pages - 1  # no page leak
+            assert gen.evictions == 0
+            return True
+        finally:
+            server.close()
+
+    assert run(scenario())
