@@ -351,16 +351,60 @@ class MongoWire:
                 f"{reply.get('codeName', 'error')}: {reply.get('errmsg', reply)}")
         return reply
 
+    @staticmethod
+    def _saslprep(s: str) -> str:
+        """SASLprep (RFC 4013) as SCRAM-SHA-256 requires for credentials:
+        map non-ASCII spaces to space, drop map-to-nothing characters,
+        NFKC-normalize, reject prohibited output and mixed-direction
+        strings. ASCII strings skip mapping/normalization (identity) but
+        still reject control characters (RFC 4013 C.2.1)."""
+        if s.isascii():
+            if any(ord(ch) < 0x20 or ord(ch) == 0x7F for ch in s):
+                raise MongoWireError(
+                    "prohibited control character in credential")
+            return s
+        import stringprep
+        import unicodedata
+
+        mapped = []
+        for ch in s:
+            if stringprep.in_table_c12(ch):
+                mapped.append(" ")
+            elif not stringprep.in_table_b1(ch):
+                mapped.append(ch)
+        out = unicodedata.normalize("NFKC", "".join(mapped))
+        if not out:
+            raise MongoWireError("credential is empty after SASLprep")
+        prohibited = (stringprep.in_table_c12, stringprep.in_table_c21_c22,
+                      stringprep.in_table_c3, stringprep.in_table_c4,
+                      stringprep.in_table_c5, stringprep.in_table_c6,
+                      stringprep.in_table_c7, stringprep.in_table_c8,
+                      stringprep.in_table_c9)
+        r_and_al = any(stringprep.in_table_d1(ch) for ch in out)
+        for ch in out:
+            if any(table(ch) for table in prohibited):
+                raise MongoWireError(
+                    f"prohibited character {ch!r} in credential")
+            if r_and_al and stringprep.in_table_d2(ch):
+                raise MongoWireError(
+                    "credential mixes left-to-right and right-to-left")
+        if r_and_al and not (stringprep.in_table_d1(out[0])
+                             and stringprep.in_table_d1(out[-1])):
+            raise MongoWireError("malformed bidirectional credential")
+        return out
+
     async def _authenticate(self) -> None:
         """SCRAM-SHA-256 (RFC 7677) over saslStart/saslContinue — the
         challenge-response auth mongod requires for real deployments; pure
-        hashlib/hmac, no driver library. The server's proof (``v=``) is
-        verified too, so a spoofed server can't silently accept."""
+        hashlib/hmac (+ stdlib stringprep for SASLprep), no driver
+        library. The server's proof (``v=``) is verified too, so a
+        spoofed server can't silently accept."""
         import base64
         import hashlib
         import hmac
 
-        user = self.username.replace("=", "=3D").replace(",", "=2C")
+        user = self._saslprep(self.username).replace("=", "=3D")
+        user = user.replace(",", "=2C")
         cnonce = base64.b64encode(os.urandom(18)).decode()
         client_first_bare = f"n={user},r={cnonce}"
         first = await self._roundtrip({
@@ -376,7 +420,7 @@ class MongoWire:
             raise MongoWireError("server nonce does not extend ours")
 
         salted = hashlib.pbkdf2_hmac(
-            "sha256", self.password.encode(),
+            "sha256", self._saslprep(self.password).encode(),
             base64.b64decode(salt_b64), iters)
         client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
         stored_key = hashlib.sha256(client_key).digest()
@@ -401,12 +445,16 @@ class MongoWire:
         if dict(part.split("=", 1) for part in
                 server_final.split(",")).get("v") != expect_v:
             raise MongoWireError("server signature mismatch")
-        while not final.get("done"):
+        for _ in range(3):  # SCRAM needs at most one empty extra round;
+            if final.get("done"):  # bounded so a misbehaving server that
+                break              # never terminates can't hang the client
             final = await self._roundtrip({
                 "saslContinue": 1,
                 "conversationId": first.get("conversationId", 1),
                 "payload": Binary(b""), "$db": self.auth_db,
             })
+        else:
+            raise MongoWireError("SCRAM conversation did not terminate")
 
     # -- protocol --------------------------------------------------------------
     async def _command(self, command: dict,

@@ -922,29 +922,9 @@ class Generator:
                     # history; write the full prefix+suffix row instead
                     # (suffix already carries the tail — take only the
                     # paged whole-page part of the registered ids)
-                    hist = info["ids_full"][:info["len"]] + suffix
-                    row = np.zeros((self._hist_cap,), np.int32)
-                    row[:len(hist)] = hist
-                    if self.draft_params is not None:
-                        # the draft's own dense cache never saw the shared
-                        # pages: prefill it with the full history
-                        bucket_h = next((b for b in self.prefill_buckets
-                                         if len(hist) <= b), None)
-                        if bucket_h is None:
-                            raise ValueError(
-                                f"prefix+suffix length {len(hist)} "
-                                f"exceeds the largest prefill bucket "
-                                f"{self.prefill_buckets[-1]} (the draft "
-                                f"model must ingest the full history)")
-                        toks_h = np.zeros((1, bucket_h), np.int32)
-                        toks_h[0, :len(hist)] = hist
-                        _, self._draft_cache = self._draft_prefill_into(
-                            self.draft_params, toks_h,
-                            np.array([len(hist)], np.int32),
-                            self._draft_cache, np.int32(slot))
-                    self._tok_dev, self._tokens_dev = self._spec_prefix_post(
-                        self._tok_dev, self._tokens_dev, logits, row,
-                        np.int32(len(hist)), np.int32(slot))
+                    self._seed_spec_history(
+                        slot, info["ids_full"][:info["len"]] + suffix,
+                        logits)
                 else:
                     self._after_prefill(logits, toks, lens, np.int32(slot))
         except Exception:
@@ -1140,20 +1120,16 @@ class Generator:
                 f"no free generation slot "
                 f"({len(prepped) + len(chunked)} requested, {free} free)")
         if chunked and not prepped:
-            return [self._admit_chunked(*c) for c in chunked]
+            return self._admit_chunked_batch(chunked)
         if chunked:
-            slots_c = [self._admit_chunked(*c) for c in chunked]
+            slots_c = self._admit_chunked_batch(chunked)
             try:
                 slots_p = self.add_requests(
                     [(ids, m, cb) for ids, _, m, cb in prepped])
             except Exception:
                 # all-or-nothing: the caller sees the whole batch fail, so
                 # the chunked slots must not stay admitted either
-                for j in slots_c:
-                    self._chunked.pop(j, None)
-                    if j in self._chunked_order:
-                        self._chunked_order.remove(j)
-                    self.slots[j].live = False
+                self._rollback_chunked(slots_c)
                 raise
             # preserve the caller's request order in the returned slots
             it_c, it_p = iter(slots_c), iter(slots_p)
@@ -1181,6 +1157,54 @@ class Generator:
                     s for s in self._pending_first if s not in dead)
             raise
 
+    def _rollback_chunked(self, slots_c: list) -> None:
+        """Unwind chunked admissions so a failed batch leaves nothing
+        live (the all-or-nothing contract add_requests documents)."""
+        for j in slots_c:
+            self._chunked.pop(j, None)
+            if j in self._chunked_order:
+                self._chunked_order.remove(j)
+            self.slots[j].live = False
+            if self.page_size:
+                self._free_slot_pages(j)
+
+    def _admit_chunked_batch(self, chunked) -> list:
+        slots_c: list = []
+        try:
+            for c in chunked:
+                slots_c.append(self._admit_chunked(*c))
+        except Exception:
+            # a later admission failing (e.g. PagePoolExhausted) must not
+            # leave earlier siblings live: the caller sees the whole
+            # batch fail and will retry it wholesale
+            self._rollback_chunked(slots_c)
+            raise
+        return slots_c
+
+    def _seed_spec_history(self, slot: int, hist: list, logits) -> None:
+        """Write a slot's FULL token history into the device drafting row
+        (+ the greedy first token), and re-ingest the draft model's own
+        cache — shared by prefixed and chunked admission."""
+        if self.draft_params is not None:
+            bucket_h = next((b for b in self.prefill_buckets
+                             if len(hist) <= b), None)
+            if bucket_h is None:
+                raise ValueError(
+                    f"history length {len(hist)} exceeds the largest "
+                    f"prefill bucket {self.prefill_buckets[-1]} (the "
+                    f"draft model must ingest the full history)")
+            toks_h = np.zeros((1, bucket_h), np.int32)
+            toks_h[0, :len(hist)] = hist
+            _, self._draft_cache = self._draft_prefill_into(
+                self.draft_params, toks_h,
+                np.array([len(hist)], np.int32),
+                self._draft_cache, np.int32(slot))
+        row = np.zeros((self._hist_cap,), np.int32)
+        row[:len(hist)] = hist
+        self._tok_dev, self._tokens_dev = self._spec_prefix_post(
+            self._tok_dev, self._tokens_dev, logits, row,
+            np.int32(len(hist)), np.int32(slot))
+
     def _admit_chunked(self, ids, n: int, max_new: int, callback) -> int:
         """Reserve a slot and queue the prompt for SEGMENTED prefill:
         step() advances one segment per decode chunk, so live streams keep
@@ -1191,6 +1215,15 @@ class Generator:
         slot = self.free_slot()
         if slot is None:
             raise RuntimeError("no free generation slot")
+        if (self.spec_k and self.draft_params is not None
+                and n > self.prefill_buckets[-1]):
+            # reject at ADMISSION (clean client error) — discovering it at
+            # the final segment would either crash the serving loop or
+            # silently run the draft on a stale cache
+            raise ValueError(
+                f"prompt length {n} exceeds the largest prefill bucket "
+                f"{self.prefill_buckets[-1]} (the draft model must ingest "
+                f"the full history)")
         if self.page_size:
             upto_total = min(n + 2 * self.chunk, n + max_new, self.max_seq)
             need = -(-upto_total // self.page_size)
@@ -1297,22 +1330,9 @@ class Generator:
                     # seed the device history row with the FULL prompt
                     # (the segment-shaped _after_prefill would write a
                     # C-token suffix only); the draft cache re-ingests too
-                    hist = [int(t) for t in st["ids"]]
-                    if self.draft_params is not None:
-                        bucket_h = next((b for b in self.prefill_buckets
-                                         if len(hist) <= b), None)
-                        if bucket_h is not None:
-                            toks_h = np.zeros((1, bucket_h), np.int32)
-                            toks_h[0, :len(hist)] = hist
-                            _, self._draft_cache = self._draft_prefill_into(
-                                self.draft_params, toks_h,
-                                np.array([len(hist)], np.int32),
-                                self._draft_cache, np.int32(slot))
-                    row = np.zeros((self._hist_cap,), np.int32)
-                    row[:len(hist)] = hist
-                    self._tok_dev, self._tokens_dev = self._spec_prefix_post(
-                        self._tok_dev, self._tokens_dev, logits, row,
-                        np.int32(len(hist)), np.int32(slot))
+                    # (feasibility was validated at admission)
+                    self._seed_spec_history(
+                        slot, [int(t) for t in st["ids"]], logits)
                 else:
                     self._after_prefill(logits, toks, lens, np.int32(slot))
             else:
