@@ -268,6 +268,10 @@ async def chat_completions(ctx: gofr_tpu.Context):
                         "chat.completion.chunk", rid, created,
                         [_choice_delta(0, content="".join(
                             dec.push(t) for t in burst))]))
+            except ValueError as exc:
+                if n_out:
+                    raise  # mid-stream: too late for a clean status
+                raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
             tail = dec.flush()
             if tail:
                 await stream.send(_chunk(
@@ -292,6 +296,10 @@ async def chat_completions(ctx: gofr_tpu.Context):
         _forget_prefix(llm, prefix)
         ids = TOKENIZER.encode(_render_chat(messages))
         toks = await llm.generate(ids, max_new, info=fin)
+    except ValueError as exc:
+        # un-admittable request (prompt exceeds max_seq/buckets): the
+        # OpenAI wire answers 400 invalid_request, not a 500 panic
+        raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
     return gofr_tpu.Raw({
         "id": rid, "object": "chat.completion", "created": created,
         "model": MODEL_ID,
@@ -344,7 +352,10 @@ async def completions(ctx: gofr_tpu.Context):
         return stream.response
 
     fin: dict = {}
-    toks = await llm.generate(ids, max_new, info=fin)
+    try:
+        toks = await llm.generate(ids, max_new, info=fin)
+    except ValueError as exc:
+        raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
     return gofr_tpu.Raw({
         "id": rid, "object": "text_completion", "created": created,
         "model": MODEL_ID,

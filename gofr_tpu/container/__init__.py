@@ -115,6 +115,16 @@ class Container:
         )
         m.new_histogram("app_llm_queue_seconds",
                         "LLM request wait before slot admission")
+        m.new_histogram(
+            "app_llm_spec_accept",
+            "per-stream speculative draft acceptance rate [0, 1]",
+            buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+        m.new_gauge("app_llm_evictions",
+                    "streams truncated because the KV page pool ran dry")
+        m.new_gauge("app_llm_prefix_evictions",
+                    "idle shared prefixes LRU-dropped under pool pressure")
+        m.new_gauge("app_llm_free_pages", "free KV pages in the paged pool")
         self._start_time = time.time()
 
     def refresh_process_metrics(self) -> None:
