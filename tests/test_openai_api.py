@@ -176,3 +176,38 @@ def test_chat_system_prompt_prefix_caching(run):
 
     ref, outs = run(scenario())
     assert outs == [ref, ref]
+
+
+def test_overlong_prompt_gets_400_not_500(run):
+    """A prompt the generator can never admit (longer than max_seq) must
+    answer 400 invalid-input on the OpenAI wire — not a 500 handler
+    panic — on both the chat and completions endpoints, including through
+    the prefix-cached path (a long system prompt + long user turn)."""
+    async def scenario():
+        import aiohttp
+
+        with example_env(LLM_SLOTS="2", LLM_CHUNK="2", LLM_PAGE_SIZE="8",
+                         LLM_PAGES="24"):
+            from examples.openai_server.main import main
+
+            app = main()
+            base = await _booted(app)
+            blob = "word " * 400   # >> tiny preset's max_seq
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(base + "/v1/chat/completions", json={
+                    "messages": [
+                        {"role": "system", "content": "be terse"},
+                        {"role": "user", "content": blob}],
+                    "max_tokens": 4})
+                assert r.status == 400, await r.text()
+                r = await s.post(base + "/v1/completions",
+                                 json={"prompt": blob, "max_tokens": 4})
+                assert r.status == 400, await r.text()
+                # the server still serves a normal request afterwards
+                r = await s.post(base + "/v1/chat/completions", json={
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4})
+                assert r.status == 200, await r.text()
+            await app.shutdown()
+
+    run(scenario())
