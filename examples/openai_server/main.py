@@ -200,6 +200,15 @@ def _forget_prefix(llm, pid) -> None:
             cache.pop(key, None)
 
 
+def _admissible_or_400(llm, ids, max_new, prefix=None) -> None:
+    """Reject un-admittable requests BEFORE any stream opens — once SSE
+    headers are on the wire a clean 400 is impossible."""
+    try:
+        llm.check_admissible(ids, max_new, prefix=prefix)
+    except ValueError as exc:
+        raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
+
+
 def _openai_finish(info: dict, n_out: int, max_new: int) -> str:
     """Map the LLM server's finish reason onto OpenAI's vocabulary. An
     evicted (pool-dry, truncated) answer reports "length" — never the
@@ -233,6 +242,7 @@ async def chat_completions(ctx: gofr_tpu.Context):
     llm = ctx.ml.llm(MODEL_ID)
     prefix, ids, n_prompt = await _cached_prefix(
         llm, messages, _render_chat(messages))
+    _admissible_or_400(llm, ids, max_new, prefix=prefix)
     rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
     created = int(time.time())
 
@@ -258,7 +268,9 @@ async def chat_completions(ctx: gofr_tpu.Context):
                             dec.push(t) for t in burst))]))
             except PrefixEvicted:
                 # eviction raced our admission (nothing streamed yet):
-                # retry once with the full prompt, uncached
+                # retry once with the full prompt, uncached. Mid-stream a
+                # clean 400 is impossible (SSE headers are sent);
+                # admission errors surface as the stream's error event.
                 _forget_prefix(llm, prefix)
                 ids = TOKENIZER.encode(_render_chat(messages))
                 async for burst in llm.stream_chunks(ids, max_new,
@@ -268,10 +280,6 @@ async def chat_completions(ctx: gofr_tpu.Context):
                         "chat.completion.chunk", rid, created,
                         [_choice_delta(0, content="".join(
                             dec.push(t) for t in burst))]))
-            except ValueError as exc:
-                if n_out:
-                    raise  # mid-stream: too late for a clean status
-                raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
             tail = dec.flush()
             if tail:
                 await stream.send(_chunk(
@@ -295,10 +303,11 @@ async def chat_completions(ctx: gofr_tpu.Context):
     except PrefixEvicted:
         _forget_prefix(llm, prefix)
         ids = TOKENIZER.encode(_render_chat(messages))
+        _admissible_or_400(llm, ids, max_new)  # the full prompt may not fit
         toks = await llm.generate(ids, max_new, info=fin)
     except ValueError as exc:
-        # un-admittable request (prompt exceeds max_seq/buckets): the
-        # OpenAI wire answers 400 invalid_request, not a 500 panic
+        # backstop for admission races (e.g. a prefix pinned between the
+        # up-front check and the serving thread's admit)
         raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
     return gofr_tpu.Raw({
         "id": rid, "object": "chat.completion", "created": created,
@@ -328,6 +337,7 @@ async def completions(ctx: gofr_tpu.Context):
             raise gofr_tpu.errors.InvalidParam(
                 "prompt (batch/token-array prompts unsupported: send one string)")
     ids, max_new, llm = _prepare(ctx, prompt, body)
+    _admissible_or_400(llm, ids, max_new)
     rid = f"cmpl-{uuid.uuid4().hex[:24]}"
     created = int(time.time())
 

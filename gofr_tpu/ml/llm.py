@@ -411,6 +411,64 @@ class LLMServer:
                 req.loop.call_soon_threadsafe(req.out_q.put_nowait,
                                               _Finish(reason))
 
+    def check_admissible(self, prompt_ids, max_new_tokens: int = 1,
+                         prefix: int | None = None) -> None:
+        """Raise ValueError if this request can NEVER admit under the
+        generator's static shape rules — prompt/suffix length vs max_seq
+        and the prefill buckets, draft-model full-history ingestion, and
+        a paged pool too small to ever cover the request. Transports call
+        this BEFORE opening a response stream so un-admittable requests
+        answer a clean 4xx instead of failing after headers are on the
+        wire. Transient conditions (busy slots, recoverable pool
+        pressure) pass — those requeue."""
+        import numpy as np
+
+        gen = self.gen
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        n = len(ids)
+        if n == 0 or n >= gen.max_seq:
+            raise ValueError(
+                f"prompt length {n} out of range (1..{gen.max_seq - 1})")
+        buckets = gen.prefill_buckets
+        draft = (getattr(gen, "spec_k", 0)
+                 and getattr(gen, "draft_params", None) is not None)
+        if prefix is not None:
+            info = getattr(gen, "_prefixes", {}).get(prefix)
+            if info is None:
+                return  # evicted: the PrefixEvicted retry path handles it
+            n_suf = len(info["tail"]) + n
+            if info["len"] + n_suf >= gen.max_seq:
+                raise ValueError(
+                    f"prefix {info['len']} + suffix {n_suf} exceeds "
+                    f"max_seq")
+            if n_suf > buckets[-1]:
+                raise ValueError(
+                    f"suffix length {n_suf} exceeds the largest prefill "
+                    f"bucket {buckets[-1]}")
+            if draft and info["len"] + n_suf > buckets[-1]:
+                raise ValueError(
+                    f"prefix+suffix length {info['len'] + n_suf} exceeds "
+                    f"the largest prefill bucket {buckets[-1]} (the draft "
+                    f"model must ingest the full history)")
+            return
+        chunked = getattr(gen, "prefill_chunk", 0) and n > gen.prefill_chunk
+        if not chunked and n > buckets[-1]:
+            raise ValueError(
+                f"prompt length {n} exceeds the largest prefill bucket "
+                f"{buckets[-1]}")
+        if chunked and draft and n > buckets[-1]:
+            raise ValueError(
+                f"prompt length {n} exceeds the largest prefill bucket "
+                f"{buckets[-1]} (the draft model must ingest the full "
+                f"history)")
+        if getattr(gen, "page_size", 0):
+            upto = min(n + 2 * gen.chunk, n + max_new_tokens, gen.max_seq)
+            need = -(-upto // gen.page_size)
+            if need > gen._pages_ever_free():
+                raise ValueError(
+                    f"request needs {need} pages but the pool can only "
+                    f"ever free {gen._pages_ever_free()}")
+
     # -- async API ------------------------------------------------------------
     async def stream_chunks(self, prompt_ids, max_new_tokens: int = 64,
                             prefix: int | None = None,

@@ -181,8 +181,9 @@ def test_chat_system_prompt_prefix_caching(run):
 def test_overlong_prompt_gets_400_not_500(run):
     """A prompt the generator can never admit (longer than max_seq) must
     answer 400 invalid-input on the OpenAI wire — not a 500 handler
-    panic — on both the chat and completions endpoints, including through
-    the prefix-cached path (a long system prompt + long user turn)."""
+    panic — on both endpoints, non-streaming AND streaming (the
+    admissibility check runs before SSE headers go out), including
+    through the prefix-cached path."""
     async def scenario():
         import aiohttp
 
@@ -202,6 +203,15 @@ def test_overlong_prompt_gets_400_not_500(run):
                 assert r.status == 400, await r.text()
                 r = await s.post(base + "/v1/completions",
                                  json={"prompt": blob, "max_tokens": 4})
+                assert r.status == 400, await r.text()
+                # STREAMING overlong prompts 400 as well: the
+                # admissibility check runs before SSE headers go out
+                r = await s.post(base + "/v1/chat/completions", json={
+                    "messages": [{"role": "user", "content": blob}],
+                    "max_tokens": 4, "stream": True})
+                assert r.status == 400, await r.text()
+                r = await s.post(base + "/v1/completions", json={
+                    "prompt": blob, "max_tokens": 4, "stream": True})
                 assert r.status == 400, await r.text()
                 # the server still serves a normal request afterwards
                 r = await s.post(base + "/v1/chat/completions", json={
