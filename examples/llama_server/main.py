@@ -44,11 +44,22 @@ def _prompt_ids(body) -> list[int]:
     raise gofr_tpu.errors.MissingParam("prompt or prompt_ids")
 
 
+def _admissible(llm, ids, max_new) -> None:
+    """Un-admittable prompts answer 400 (HTTP) / INVALID_ARGUMENT (gRPC)
+    before any stream opens, not a 500 after admission fails."""
+    try:
+        llm.check_admissible(ids, max_new)
+    except ValueError as exc:
+        raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
+
+
 async def generate(ctx: gofr_tpu.Context):
     body = await ctx.bind()
     ids = _prompt_ids(body)
     max_new = int(body.get("max_new_tokens", 64))
-    tokens = await ctx.ml.llm("chat").generate(ids, max_new)
+    llm = ctx.ml.llm("chat")
+    _admissible(llm, ids, max_new)
+    tokens = await llm.generate(ids, max_new)
     out = {"tokens": tokens}
     if body.get("prompt"):  # text in -> text out
         out["text"] = TOKENIZER.decode(tokens)
@@ -58,7 +69,10 @@ async def generate(ctx: gofr_tpu.Context):
 async def stream_ws(ctx: gofr_tpu.Context):
     body = await ctx.bind()
     ids = _prompt_ids(body)
-    async for tok in ctx.ml.llm("chat").stream(ids, int(body.get("max_new_tokens", 64))):
+    llm = ctx.ml.llm("chat")
+    max_new = int(body.get("max_new_tokens", 64))
+    _admissible(llm, ids, max_new)
+    async for tok in llm.stream(ids, max_new):
         await ctx.write_message_to_socket({"token": tok})
     return {"done": True}
 
@@ -107,8 +121,10 @@ def main() -> gofr_tpu.App:
         # messages at chunk=16 with identical token latency (tokens arrive
         # from the device in bursts anyway)
         llm = app.container.ml.llm("chat")
-        async for burst in llm.stream_chunks(
-                request["prompt_ids"], int(request.get("max_new_tokens", 64))):
+        max_new = int(request.get("max_new_tokens", 64))
+        _admissible(llm, request["prompt_ids"], max_new)
+        async for burst in llm.stream_chunks(request["prompt_ids"],
+                                             max_new):
             yield {"tokens": burst}
 
     svc.stream("Generate", grpc_generate)

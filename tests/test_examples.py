@@ -516,6 +516,10 @@ def test_llama_server_example(run):
                 assert isinstance(data["text"], str)
                 r = await s.post(base + "/generate", json={})
                 assert r.status == 400
+                r = await s.post(base + "/generate", json={
+                    "prompt_ids": list(range(1, 400)),
+                    "max_new_tokens": 4})
+                assert r.status == 400  # overlong: clean reject, not 500
             await app.shutdown()
 
     run(scenario())
