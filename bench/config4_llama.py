@@ -54,6 +54,10 @@ async def _metrics_ttft(ports) -> tuple[float, float]:
         return 0.0, 0.0
 
 
+class _SkipJitter(Exception):
+    """Control flow: BENCH_SKIP_JITTER short-circuits phase C."""
+
+
 async def main() -> None:
     import asyncio
 
@@ -149,6 +153,10 @@ async def main() -> None:
     sum3, cnt3 = await _metrics_ttft(ports)
 
     # ---- phase C: prefill-induced TTFT jitter, chunked-prefill A/B ------
+    # BENCH_SKIP_JITTER=1 (bench.py sets it): phase C boots the server a
+    # second time, which doesn't fit the headline run's watchdog budget —
+    # the capture loop runs config4 standalone with phase C included
+    skip_jitter = os.environ.get("BENCH_SKIP_JITTER") == "1"
     long_len = int(os.environ.get("BENCH_LONG_PROMPT",
                                   "768" if on_tpu else "48"))
     seg = int(os.environ.get("LLM_PREFILL_CHUNK_AB",
@@ -185,13 +193,16 @@ async def main() -> None:
         return {"p50_ms": round(percentile(ttfts, 50) * 1e3, 1),
                 "p99_ms": round(percentile(ttfts, 99) * 1e3, 1)}
 
-    jitter_plain = await jitter_phase(generate)
+    jitter_plain = None if skip_jitter else await jitter_phase(generate)
     await channel.close()
     await app.shutdown()
 
+    jitter_chunked = None
     # reboot with segmented prefill and repeat the same interference
     os.environ["LLM_PREFILL_CHUNK"] = str(seg)
     try:
+        if skip_jitter:
+            raise _SkipJitter
         app2 = build_app()
         await boot(app2)
         channel2 = grpc.aio.insecure_channel(
@@ -211,6 +222,8 @@ async def main() -> None:
         jitter_chunked = await jitter_phase(generate2)
         await channel2.close()
         await app2.shutdown()
+    except _SkipJitter:
+        pass
     finally:
         os.environ.pop("LLM_PREFILL_CHUNK", None)
 
@@ -242,11 +255,12 @@ async def main() -> None:
                 if cnt3 > cnt2 else None),
             # phase C: short-stream TTFT under long-prompt interference —
             # segmented prefill must bound the p99 spike
-            "prefill_jitter": {
+            "prefill_jitter": ("skipped (headline budget)" if skip_jitter
+                               else {
                 "long_prompt_len": long_len,
                 "plain": jitter_plain,
                 "chunked": {**jitter_chunked, "prefill_chunk": seg},
-            },
+            }),
             "preset": os.environ.get("LLAMA_PRESET", "tiny"),
             "backend": jax.default_backend(),
             "config": 4,
