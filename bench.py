@@ -103,12 +103,14 @@ def _last_json_line(stdout: str, required_key: str) -> dict | None:
 
 
 def _run_child(argv: list[str], timeout_s: float, required_key: str,
-               cwd: str | None = None) -> dict | None:
+               cwd: str | None = None,
+               env: dict | None = None) -> dict | None:
     """Run a subprocess, tracked so the watchdog can kill it, and return
     its last JSON line (None on hang/failure)."""
     try:
         proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
-                                stderr=subprocess.DEVNULL, text=True, cwd=cwd)
+                                stderr=subprocess.DEVNULL, text=True,
+                                cwd=cwd, env=env)
     except OSError:
         return None
     _CHILDREN.append(proc)
@@ -229,15 +231,11 @@ def _served_result(timeout_s: float) -> dict | None:
     here = os.path.dirname(os.path.abspath(__file__))
     # the headline run skips config4's phase C (a second server boot that
     # doesn't fit the watchdog budget); the capture loop runs config4
-    # standalone WITH the jitter A/B
-    os.environ["BENCH_SKIP_JITTER"] = "1"
-    try:
-        return _run_child(
-            [sys.executable, os.path.join(here, "bench",
-                                          "config4_llama.py")],
-            timeout_s, "metric", cwd=os.path.join(here, "bench"))
-    finally:
-        os.environ.pop("BENCH_SKIP_JITTER", None)
+    # standalone WITH the jitter A/B. Child-only env: no global mutation.
+    return _run_child(
+        [sys.executable, os.path.join(here, "bench", "config4_llama.py")],
+        timeout_s, "metric", cwd=os.path.join(here, "bench"),
+        env={**os.environ, "BENCH_SKIP_JITTER": "1"})
 
 
 def main() -> None:

@@ -54,10 +54,6 @@ async def _metrics_ttft(ports) -> tuple[float, float]:
         return 0.0, 0.0
 
 
-class _SkipJitter(Exception):
-    """Control flow: BENCH_SKIP_JITTER short-circuits phase C."""
-
-
 async def main() -> None:
     import asyncio
 
@@ -198,34 +194,32 @@ async def main() -> None:
     await app.shutdown()
 
     jitter_chunked = None
-    # reboot with segmented prefill and repeat the same interference
-    os.environ["LLM_PREFILL_CHUNK"] = str(seg)
-    try:
-        if skip_jitter:
-            raise _SkipJitter
-        app2 = build_app()
-        await boot(app2)
-        channel2 = grpc.aio.insecure_channel(
-            f"127.0.0.1:{ports['GRPC_PORT']}")
-        generate2 = channel2.unary_stream(
-            "/llm.Chat/Generate",
-            request_serializer=lambda o: json.dumps(o).encode(),
-            response_deserializer=lambda raw: json.loads(raw) if raw else {},
-        )
-        async for _ in generate2(req(4)):   # warm compiles
-            pass
-        body = {"prompt_ids": rng.integers(1, vocab_hi,
-                                           (long_len,)).tolist(),
-                "max_new_tokens": 4}
-        async for _ in generate2(body):     # warm the segment program
-            pass
-        jitter_chunked = await jitter_phase(generate2)
-        await channel2.close()
-        await app2.shutdown()
-    except _SkipJitter:
-        pass
-    finally:
-        os.environ.pop("LLM_PREFILL_CHUNK", None)
+    if not skip_jitter:
+        # reboot with segmented prefill and repeat the same interference
+        os.environ["LLM_PREFILL_CHUNK"] = str(seg)
+        try:
+            app2 = build_app()
+            await boot(app2)
+            channel2 = grpc.aio.insecure_channel(
+                f"127.0.0.1:{ports['GRPC_PORT']}")
+            generate2 = channel2.unary_stream(
+                "/llm.Chat/Generate",
+                request_serializer=lambda o: json.dumps(o).encode(),
+                response_deserializer=lambda raw: (json.loads(raw)
+                                                   if raw else {}),
+            )
+            async for _ in generate2(req(4)):   # warm compiles
+                pass
+            body = {"prompt_ids": rng.integers(1, vocab_hi,
+                                               (long_len,)).tolist(),
+                    "max_new_tokens": 4}
+            async for _ in generate2(body):     # warm the segment program
+                pass
+            jitter_chunked = await jitter_phase(generate2)
+            await channel2.close()
+            await app2.shutdown()
+        finally:
+            os.environ.pop("LLM_PREFILL_CHUNK", None)
 
     agg_tok_s = sum(token_counts) / elapsed
     emit(

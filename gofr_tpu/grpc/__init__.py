@@ -26,6 +26,29 @@ import grpc.aio
 __all__ = ["start_grpc_server", "JSONService", "RPCLog"]
 
 
+# HTTP-status -> gRPC-status mapping for typed framework errors: a client
+# mistake must reach gRPC callers as its own status with the real reason,
+# not a generic INTERNAL "panic" (the reference's interceptors keep the
+# same distinction between client errors and server recovery).
+_HTTP_TO_GRPC = {
+    400: grpc.StatusCode.INVALID_ARGUMENT,
+    401: grpc.StatusCode.UNAUTHENTICATED,
+    403: grpc.StatusCode.PERMISSION_DENIED,
+    404: grpc.StatusCode.NOT_FOUND,
+    408: grpc.StatusCode.DEADLINE_EXCEEDED,
+    409: grpc.StatusCode.ALREADY_EXISTS,
+    503: grpc.StatusCode.UNAVAILABLE,
+}
+
+
+def _grpc_status_of(exc: BaseException):
+    """(StatusCode, message, is_client_error) for a raised exception."""
+    status = getattr(exc, "status_code", None)
+    if status is not None and int(status) in _HTTP_TO_GRPC:
+        return _HTTP_TO_GRPC[int(status)], str(exc), True
+    return grpc.StatusCode.INTERNAL, "internal error", False
+
+
 @dataclass
 class RPCLog:
     """Structured RPC log entry (reference grpc/log.go RPCLog)."""
@@ -72,12 +95,17 @@ class _LoggingInterceptor(grpc.aio.ServerInterceptor):
                         result = await result
                     return result
                 except Exception as exc:
-                    code = 13  # INTERNAL
+                    status, message, client_err = _grpc_status_of(exc)
+                    code = status.value[0]
                     if span is not None:
                         span.record_exception(exc)
-                    logger.error("grpc panic recovered", method=method,
-                                 error=str(exc), stack=traceback.format_exc())
-                    await context.abort(grpc.StatusCode.INTERNAL, "internal error")
+                    if client_err:  # typed 4xx: not a panic, no stack spam
+                        logger.debug({"grpc": method, "rejected": str(exc)})
+                    else:
+                        logger.error("grpc panic recovered", method=method,
+                                     error=str(exc),
+                                     stack=traceback.format_exc())
+                    await context.abort(status, message)
                 finally:
                     if span is not None:
                         span.end()
@@ -100,12 +128,16 @@ class _LoggingInterceptor(grpc.aio.ServerInterceptor):
                     async for item in behavior(request, context):
                         yield item
                 except Exception as exc:
-                    code = 13
+                    status, message, client_err = _grpc_status_of(exc)
+                    code = status.value[0]
                     if span is not None:
                         span.record_exception(exc)
-                    logger.error("grpc stream panic recovered", method=method,
-                                 error=str(exc))
-                    await context.abort(grpc.StatusCode.INTERNAL, "internal error")
+                    if client_err:
+                        logger.debug({"grpc": method, "rejected": str(exc)})
+                    else:
+                        logger.error("grpc stream panic recovered",
+                                     method=method, error=str(exc))
+                    await context.abort(status, message)
                 finally:
                     if span is not None:
                         span.end()

@@ -28,6 +28,9 @@ class _CapturingLogger:
     def info(self, *args, **kw):
         self.entries.append(args[0] if args else kw)
 
+    def debug(self, *args, **kw):
+        self.entries.append(args[0] if args else kw)
+
     def error(self, *args, **kw):
         self.errors.append((args, kw))
 
@@ -248,5 +251,75 @@ def test_json_service_via_app_boot(run):
             await channel.close()
         finally:
             await app.shutdown()
+
+    run(scenario())
+
+
+def test_typed_client_errors_map_to_grpc_status(run):
+    """Framework 4xx errors reach gRPC callers as their own status with
+    the real message — INVALID_ARGUMENT for InvalidInput, NOT_FOUND for
+    EntityNotFound — and are logged as rejections, not panics; untyped
+    exceptions still map to INTERNAL with a panic log."""
+    from gofr_tpu.http import errors
+
+    logger = _CapturingLogger()
+    svc = JSONService("t.Errors")
+
+    async def bad_input(request, context):
+        raise errors.InvalidInput("prompt length 400 exceeds max_seq")
+
+    async def missing(request, context):
+        raise errors.EntityNotFound("thing", "42")
+
+    async def boom(request, context):
+        raise RuntimeError("kaboom")
+
+    async def bad_stream(request, context):
+        raise errors.InvalidInput("stream refused")
+        yield {}  # pragma: no cover — makes this an async generator
+
+    svc.unary("BadInput", bad_input)
+    svc.unary("Missing", missing)
+    svc.unary("Boom", boom)
+    svc.stream("BadStream", bad_stream)
+
+    async def scenario():
+        server, channel = await _start([(svc, None)], logger)
+        try:
+            async def call(name):
+                fn = channel.unary_unary(f"/t.Errors/{name}",
+                                         request_serializer=_json_serial,
+                                         response_deserializer=_json_deserial)
+                try:
+                    await fn({})
+                    raise AssertionError("expected AioRpcError")
+                except grpc.aio.AioRpcError as exc:
+                    return exc.code(), exc.details()
+
+            code, details = await call("BadInput")
+            assert code == grpc.StatusCode.INVALID_ARGUMENT
+            assert "max_seq" in details
+            code, _ = await call("Missing")
+            assert code == grpc.StatusCode.NOT_FOUND
+            code, details = await call("Boom")
+            assert code == grpc.StatusCode.INTERNAL
+            assert details == "internal error"  # internals stay unexposed
+
+            stream_fn = channel.unary_stream(
+                "/t.Errors/BadStream", request_serializer=_json_serial,
+                response_deserializer=_json_deserial)
+            try:
+                async for _ in stream_fn({}):
+                    pass
+                raise AssertionError("expected AioRpcError")
+            except grpc.aio.AioRpcError as exc:
+                assert exc.code() == grpc.StatusCode.INVALID_ARGUMENT
+                assert "refused" in exc.details()
+
+            # only the untyped failure produced a panic-level error log
+            assert len(logger.errors) == 1
+        finally:
+            await channel.close()
+            await server.stop(None)
 
     run(scenario())
