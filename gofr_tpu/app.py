@@ -71,6 +71,7 @@ class App:
         self._http_registered = False
         self._runner: web.AppRunner | None = None
         self._metrics_runner: web.AppRunner | None = None
+        self._gauge_sampler = None  # metrics.SamplerThread, started in start()
         self._grpc_server = None
         self._shutdown_event: asyncio.Event | None = None
         self._background_tasks: list[asyncio.Task] = []
@@ -199,22 +200,23 @@ class App:
     def add_file_store(self, fs: Any) -> None:
         self.container.add_datasource("file", fs)
 
+    def _ensure_ml(self):
+        from .ml import MLDatasource
+
+        if self.container.ml is None:
+            self.container.ml = MLDatasource(
+                self.logger, self.container.metrics_manager, tracer=self.tracer
+            )
+        return self.container.ml
+
     def register_llm(self, name: str, params: Any, cfg: Any, **kwargs: Any) -> None:
         """Mount a continuous-batching LLM (ml/llm.py): handlers stream
         tokens via ``ctx.ml.llm(name)`` (TPU-native; green-field)."""
-        from .ml import MLDatasource
-
-        if self.container.ml is None:
-            self.container.ml = MLDatasource(self.logger, self.container.metrics_manager)
-        self.container.ml.register_llm(name, params, cfg, **kwargs)
+        self._ensure_ml().register_llm(name, params, cfg, **kwargs)
 
     def register_model(self, name: str, model: Any, **kwargs: Any) -> None:
         """Mount a JAX model into the ml datasource (TPU-native; green-field)."""
-        from .ml import MLDatasource
-
-        if self.container.ml is None:
-            self.container.ml = MLDatasource(self.logger, self.container.metrics_manager)
-        self.container.ml.register(name, model, **kwargs)
+        self._ensure_ml().register(name, model, **kwargs)
 
     # -------------------------------------------------------------------- auth
     def enable_basic_auth(self, username: str, password: str) -> None:
@@ -298,6 +300,12 @@ class App:
         )
         app.router.add_get("/favicon.ico", self._favicon_handler)
         self._maybe_add_swagger(app)
+        # serving observability endpoints — always on, like /metrics: the
+        # snapshot answers from in-process state; the timed profile capture
+        # guards itself with a process-wide lock (gofr_tpu/debug.py)
+        from .debug import register_debug_routes
+
+        register_debug_routes(self, app)
         if (self.config.get("APP_ENV") or "").upper() == "DEBUG":
             # profiler routes, the TPU-native analogue of the reference's
             # pprof mount under APP_ENV=DEBUG (http_server.go:65-72):
@@ -362,7 +370,9 @@ class App:
 
         app.router.add_post("/debug/profile/start", start_profile)
         app.router.add_post("/debug/profile/stop", stop_profile)
-        app.router.add_get("/debug/profile", profile_status)
+        # GET /debug/profile is the timed one-shot capture (debug.py);
+        # the start/stop session's status lives beside its verbs
+        app.router.add_get("/debug/profile/status", profile_status)
 
     @staticmethod
     def _adapt_middleware(func) -> Any:
@@ -449,6 +459,19 @@ class App:
     async def start(self) -> None:
         """Start servers without blocking (used by run() and by tests)."""
         t0 = time.perf_counter()
+        # runtime gauges (HBM, queue depths) stay fresh between scrapes
+        from .metrics import SamplerThread
+
+        try:
+            sample_interval = float(
+                self.config.get_or_default("METRICS_SAMPLE_INTERVAL", "10"))
+        except ValueError:
+            sample_interval = 10.0  # optional knob must never fail startup
+        self._gauge_sampler = SamplerThread(
+            self.container.metrics_manager, sample_interval
+        )
+        self._gauge_sampler.start()
+
         self._metrics_runner = web.AppRunner(self._build_metrics_app())
         await self._metrics_runner.setup()
         await web.TCPSite(self._metrics_runner, "0.0.0.0", self.metrics_port).start()
@@ -506,6 +529,8 @@ class App:
         gofr.go:219-245 + shutdown.go:11-32)."""
 
         async def _drain() -> None:
+            if self._gauge_sampler is not None:
+                self._gauge_sampler.stop()
             if getattr(self, "_remote_level", None) is not None:
                 await self._remote_level.stop()
             for task in self._background_tasks:

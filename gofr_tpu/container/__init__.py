@@ -113,8 +113,20 @@ class Container:
             "app_llm_ttft_seconds", "LLM time to first token",
             buckets=(0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2),
         )
+        m.new_histogram(
+            "app_llm_tpot_seconds",
+            "LLM time per output token after the first (stream cadence)",
+            buckets=(0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0),
+        )
+        m.new_counter("app_llm_tokens_total", "LLM tokens streamed to consumers")
+        m.new_gauge("app_llm_active_slots", "decode slots currently live")
         m.new_histogram("app_llm_queue_seconds",
                         "LLM request wait before slot admission")
+        m.new_gauge(
+            "app_ml_queue_depth",
+            "pending work per serving component (engine dispatch queue, "
+            "batcher backlog, llm waiting requests)",
+        )
         m.new_histogram(
             "app_llm_spec_accept",
             "per-stream speculative draft acceptance rate [0, 1]",

@@ -1,0 +1,172 @@
+"""Serving debug + profiling endpoints.
+
+The reference mounts net/http/pprof under APP_ENV=DEBUG
+(pkg/gofr/http_server.go:65-72) so an operator can always answer "what is
+the server doing right now?". The TPU-native equivalents here:
+
+- ``GET /debug/serving`` — a JSON snapshot of the whole inference plane:
+  per-engine step counts and compiled shape buckets, batcher backlog, LLM
+  slot occupancy and KV-pool pressure, and in-process latency percentiles
+  (TTFT, TPOT, device step) read from the same histograms Prometheus
+  scrapes at :2121.
+- ``GET /debug/profile?seconds=N`` — captures a ``jax.profiler`` trace
+  (device + host timelines, viewable in XProf/TensorBoard) for N seconds
+  and streams it back as a zip. One capture at a time: the profiler is a
+  process-global singleton, so a second concurrent request answers 409
+  instead of corrupting the first trace.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import math
+import os
+import shutil
+import tempfile
+import threading
+import time
+import zipfile
+
+from aiohttp import web
+
+__all__ = ["register_debug_routes", "serving_snapshot"]
+
+# histograms worth quoting percentiles for, keyed by their label sets:
+# (name, labels) pairs resolved per registered model below
+_LATENCY_HISTOGRAMS = (
+    "app_tpu_step_seconds",
+    "app_ml_queue_seconds",
+    "app_llm_queue_seconds",
+    "app_llm_ttft_seconds",
+    "app_llm_tpot_seconds",
+)
+_QUANTILES = (0.5, 0.95, 0.99)
+
+# the jax profiler is process-global state: one capture at a time, ever
+_profile_lock = threading.Lock()
+MAX_PROFILE_SECONDS = 60.0
+
+
+def _histogram_percentiles(manager, model_names) -> dict:
+    """p50/p95/p99 per latency histogram per model, via Manager.percentile
+    (bucket-boundary approximations — Prometheus does the real math
+    server-side; these are for an operator's quick curl)."""
+    out: dict = {}
+    for name in _LATENCY_HISTOGRAMS:
+        if not manager.has(name):
+            continue
+        for model in model_names:
+            try:
+                vals = {
+                    f"p{int(q * 100)}": manager.percentile(name, q, model=model)
+                    for q in _QUANTILES
+                }
+            except Exception:
+                continue
+            vals = {k: v for k, v in vals.items() if not math.isnan(v)}
+            if vals:
+                out.setdefault(name, {})[model] = vals
+    return out
+
+
+def serving_snapshot(container) -> dict:
+    """Structured state of the inference plane (the /debug/serving body)."""
+    snap: dict = {"ts": time.time()}
+    ml = getattr(container, "ml", None)
+    if ml is not None and hasattr(ml, "serving_snapshot"):
+        snap.update(ml.serving_snapshot())
+        names = list(snap.get("models", {})) + list(snap.get("llms", {}))
+    else:
+        snap.update({"models": {}, "llms": {}})
+        names = []
+    manager = container.metrics_manager
+    run = getattr(manager, "run_samplers", None)
+    if run is not None:
+        run()  # queue depths / HBM gauges current, not stale
+    snap["percentiles"] = _histogram_percentiles(manager, names)
+    return snap
+
+
+def _run_profile_capture(trace_dir: str, seconds: float) -> None:
+    """Blocking capture, run off the event loop. Split out so tests can
+    monkeypatch it where ``jax.profiler`` has no backend to trace."""
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        time.sleep(seconds)
+    finally:
+        jax.profiler.stop_trace()
+
+
+def _zip_dir(root: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for base, _, files in os.walk(root):
+            for fname in files:
+                full = os.path.join(base, fname)
+                zf.write(full, os.path.relpath(full, root))
+    return buf.getvalue()
+
+
+def register_debug_routes(app, aio_app: web.Application) -> None:
+    """Mount /debug/serving and /debug/profile on the HTTP server. Always
+    on (like /metrics): they answer from in-process state, and they sit
+    behind whatever auth middleware the app enabled."""
+
+    async def serving_handler(_: web.Request) -> web.Response:
+        return web.json_response({"data": serving_snapshot(app.container)})
+
+    async def profile_handler(request: web.Request) -> web.Response:
+        try:
+            seconds = float(request.query.get("seconds", "2"))
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "seconds must be a number"}}, status=400)
+        if not 0 < seconds <= MAX_PROFILE_SECONDS:
+            return web.json_response(
+                {"error": {"message":
+                           f"seconds must be in (0, {MAX_PROFILE_SECONDS:g}]"}},
+                status=400)
+        if not _profile_lock.acquire(blocking=False):
+            return web.json_response(
+                {"error": {"message": "a profile capture is already running"}},
+                status=409)
+        try:
+            trace_dir = tempfile.mkdtemp(prefix="gofr-profile-")
+            loop = asyncio.get_running_loop()
+            capture = loop.run_in_executor(
+                None, _run_profile_capture, trace_dir, seconds)
+        except BaseException:
+            _profile_lock.release()
+            raise
+        # the lock must outlive THIS handler: a client disconnect cancels the
+        # coroutine, but the capture thread keeps running — and the profiler
+        # is process-global, so the next capture must keep seeing 409 until
+        # this one actually stops. Release from the executor future instead
+        # of a finally here.
+        capture.add_done_callback(lambda _: _profile_lock.release())
+        try:
+            await asyncio.shield(capture)
+            body = _zip_dir(trace_dir)
+        except asyncio.CancelledError:
+            capture.add_done_callback(
+                lambda _: shutil.rmtree(trace_dir, ignore_errors=True))
+            raise
+        except Exception as exc:
+            shutil.rmtree(trace_dir, ignore_errors=True)
+            app.logger.errorf("profile capture failed: %s", exc)
+            return web.json_response(
+                {"error": {"message": f"profile capture failed: {exc}"}},
+                status=503)
+        shutil.rmtree(trace_dir, ignore_errors=True)
+        return web.Response(
+            body=body,
+            content_type="application/zip",
+            headers={"Content-Disposition":
+                     'attachment; filename="jax-trace.zip"'},
+        )
+
+    aio_app.router.add_get("/debug/serving", serving_handler)
+    aio_app.router.add_get("/debug/profile", profile_handler)

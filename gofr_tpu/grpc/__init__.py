@@ -42,10 +42,21 @@ _HTTP_TO_GRPC = {
 
 
 def _grpc_status_of(exc: BaseException):
-    """(StatusCode, message, is_client_error) for a raised exception."""
-    status = getattr(exc, "status_code", None)
-    if status is not None and int(status) in _HTTP_TO_GRPC:
-        return _HTTP_TO_GRPC[int(status)], str(exc), True
+    """(StatusCode, message, is_client_error) for a raised exception.
+
+    Only the framework's typed errors map to client statuses with their
+    real message: duck-typing any exception carrying a ``status_code``
+    attribute would let a third-party library's exception (requests'
+    HTTPError, aiohttp's ClientResponseError, ...) masquerade as a client
+    mistake — and leak its message — instead of surfacing as INTERNAL
+    with a sanitized message and an error log.
+    """
+    from ..http.errors import GofrError
+
+    if isinstance(exc, GofrError):
+        status = getattr(exc, "status_code", None)
+        if status is not None and int(status) in _HTTP_TO_GRPC:
+            return _HTTP_TO_GRPC[int(status)], str(exc), True
     return grpc.StatusCode.INTERNAL, "internal error", False
 
 

@@ -109,6 +109,15 @@ def tracer_middleware(tracer: Tracer) -> Middleware:
             span.record_exception(exc)
             raise
         finally:
+            # rename to the route TEMPLATE once routing resolved: raw paths
+            # ("GET /things/42") explode span-name cardinality downstream;
+            # templates ("GET /things/{id}") aggregate (reference tracer.go
+            # names by mux template for the same reason)
+            route = getattr(request.match_info, "route", None)
+            template = getattr(getattr(route, "resource", None), "canonical", None)
+            if template:
+                span.name = f"{request.method} {template}"
+                span.set_attribute("http.route", template)
             span.end()
 
     return mw

@@ -23,6 +23,7 @@ __all__ = [
     "DuplicateMetricError",
     "MetricNotFoundError",
     "DEFAULT_BUCKETS",
+    "SamplerThread",
 ]
 
 DEFAULT_BUCKETS = (
@@ -190,6 +191,7 @@ class Manager:
     def __init__(self) -> None:
         self._metrics: dict[str, _Metric] = {}
         self._lock = threading.Lock()
+        self._samplers: list = []
 
     # -- registration -------------------------------------------------------
     def _register(self, metric: _Metric) -> None:
@@ -222,6 +224,10 @@ class Manager:
     def increment_counter(self, name: str, **labels: str) -> None:
         self._get(name, _Counter).add(1.0, labels)
 
+    def add_counter(self, name: str, delta: float, **labels: str) -> None:
+        """Counter += delta (token throughput counts tokens, not calls)."""
+        self._get(name, _Counter).add(delta, labels)
+
     def delta_updown_counter(self, name: str, delta: float, **labels: str) -> None:
         self._get(name, _UpDownCounter).add(delta, labels)
 
@@ -239,15 +245,64 @@ class Manager:
     def has(self, name: str) -> bool:
         return name in self._metrics
 
+    # -- gauge samplers -----------------------------------------------------
+    def register_sampler(self, fn) -> None:
+        """Register a zero-arg callable that refreshes gauges from live
+        runtime state (HBM occupancy, queue depths). Samplers run on every
+        scrape (``expose_text``) and from a ``SamplerThread`` between
+        scrapes, so dashboards never read minutes-stale device gauges."""
+        with self._lock:
+            self._samplers.append(fn)
+
+    def run_samplers(self) -> None:
+        with self._lock:
+            samplers = list(self._samplers)
+        for fn in samplers:
+            try:
+                fn()
+            except Exception:
+                pass  # a broken sampler must never break the scrape
+
     # -- exposition ---------------------------------------------------------
     def expose_text(self) -> str:
         """Render all metrics in Prometheus text exposition format 0.0.4."""
+        self.run_samplers()
         out: list[str] = []
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         for m in metrics:
             m.expose(out)
         return "\n".join(out) + "\n"
+
+
+class SamplerThread:
+    """Background loop running the manager's gauge samplers on an interval,
+    so runtime gauges stay fresh even when nothing scrapes :2121 (push
+    exporters, long scrape intervals, operators curling /debug/serving)."""
+
+    def __init__(self, manager: Manager, interval_s: float = 10.0) -> None:
+        self._manager = manager
+        self._interval = max(0.1, float(interval_s))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="gofr-metrics-sampler"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self._manager.run_samplers()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
 
 
 class Timer:

@@ -20,12 +20,27 @@ __all__ = ["MLDatasource", "Engine", "EngineConfig"]
 class MLDatasource:
     """Registry of named model engines, exposed to handlers as ``ctx.ml``."""
 
-    def __init__(self, logger=None, metrics=None) -> None:
+    def __init__(self, logger=None, metrics=None, tracer=None) -> None:
         self._logger = logger
         self._metrics = metrics
+        self._tracer = tracer
         self._engines: dict[str, Engine] = {}
         self._batchers: dict[str, Any] = {}
         self._llms: dict[str, Any] = {}
+        self._sampler_registered = False
+        self._maybe_register_sampler()
+
+    def _maybe_register_sampler(self) -> None:
+        """Hook runtime gauges (HBM, queue depths, slot occupancy) into the
+        manager's sampler set so every scrape — and the background
+        SamplerThread between scrapes — publishes fresh values."""
+        if self._sampler_registered or self._metrics is None:
+            return
+        register = getattr(self._metrics, "register_sampler", None)
+        if register is None:
+            return  # bare mocks in tests
+        register(self.sample_runtime_gauges)
+        self._sampler_registered = True
 
     # -- registration ----------------------------------------------------------
     def register(
@@ -59,6 +74,7 @@ class MLDatasource:
                 config=config,
                 logger=self._logger,
                 metrics=self._metrics,
+                tracer=self._tracer,
                 example_inputs=example_inputs,
             )
         self._engines[name] = engine
@@ -66,7 +82,8 @@ class MLDatasource:
             from .batching import DynamicBatcher
 
             if batching is True:
-                batching = DynamicBatcher(engine, metrics=self._metrics)
+                batching = DynamicBatcher(engine, metrics=self._metrics,
+                                          tracer=self._tracer)
             self._batchers[name] = batching
             engine.warmup_buckets()  # batcher pads to buckets: compile all now
         if self._logger is not None:
@@ -88,7 +105,7 @@ class MLDatasource:
                 # startup pays every decode/prefill compile, not a request
                 generator.warmup()
         server = LLMServer(generator, name=name, logger=self._logger,
-                           metrics=self._metrics)
+                           metrics=self._metrics, tracer=self._tracer)
         self._llms[name] = server
         if self._logger is not None:
             self._logger.infof("llm %s registered (%d slots)", name,
@@ -131,9 +148,10 @@ class MLDatasource:
 
     def use_metrics(self, metrics) -> None:
         self._metrics = metrics
+        self._maybe_register_sampler()
 
     def use_tracer(self, tracer) -> None:
-        pass
+        self._tracer = tracer
 
     def connect(self) -> None:
         pass
@@ -152,6 +170,56 @@ class MLDatasource:
                 metrics.set_gauge("app_tpu_hbm_bytes_in_use", stats["bytes_in_use"], device=label)
             if "bytes_limit" in stats:
                 metrics.set_gauge("app_tpu_hbm_bytes_limit", stats["bytes_limit"], device=label)
+
+    def sample_runtime_gauges(self, metrics=None) -> None:
+        """One sampler pass: HBM occupancy + per-component queue depths +
+        LLM slot occupancy. Registered with ``Manager.register_sampler`` so
+        it runs on every scrape and on the background SamplerThread."""
+        m = metrics if metrics is not None else self._metrics
+        if m is None:
+            return
+        self.refresh_device_metrics(m)
+        for name, engine in self._engines.items():
+            depth = getattr(engine, "queue_depth", None)
+            if depth is not None:
+                m.set_gauge("app_ml_queue_depth", depth(),
+                            component="engine", model=name)
+        for name, batcher in self._batchers.items():
+            depth = getattr(batcher, "queue_depth", None)
+            if depth is not None:
+                m.set_gauge("app_ml_queue_depth", depth(),
+                            component="batcher", model=name)
+        for name, server in self._llms.items():
+            m.set_gauge("app_ml_queue_depth", server.queue_depth(),
+                        component="llm", model=name)
+            m.set_gauge("app_llm_active_slots", float(server.gen.n_live),
+                        model=name)
+
+    def serving_snapshot(self) -> dict:
+        """Live structured state for the /debug/serving endpoint."""
+        snap: dict[str, Any] = {"models": {}, "llms": {}}
+        for name, engine in self._engines.items():
+            entry = {
+                "steps": engine.steps,
+                "device": str(engine.device),
+                "backend": engine.backend,
+                "batch_buckets": list(engine.config.batch_buckets),
+                "compiled_buckets": sorted(engine.compiled_buckets),
+                "queue_depth": engine.queue_depth(),
+            }
+            batcher = self._batchers.get(name)
+            if batcher is not None:
+                entry["batcher"] = {
+                    "queue_depth": batcher.queue_depth(),
+                    "max_batch": batcher._max_batch,
+                    "max_delay_s": batcher._max_delay,
+                }
+            snap["models"][name] = entry
+        for name, server in self._llms.items():
+            entry = dict(server.health_check()["details"])
+            entry["pool"] = server.gen.pool_stats()
+            snap["llms"][name] = entry
+        return snap
 
     def health_check(self) -> dict:
         import jax

@@ -23,16 +23,21 @@ from typing import Any
 
 import numpy as np
 
+from ..tracing import current_context
+
 __all__ = ["DynamicBatcher"]
 
 
 class _Pending:
-    __slots__ = ("inputs", "future", "enqueued_at")
+    __slots__ = ("inputs", "future", "enqueued_at", "trace_ctx", "queue_span")
 
-    def __init__(self, inputs: tuple, future: asyncio.Future) -> None:
+    def __init__(self, inputs: tuple, future: asyncio.Future,
+                 trace_ctx=None, queue_span=None) -> None:
         self.inputs = inputs
         self.future = future
         self.enqueued_at = time.perf_counter()
+        self.trace_ctx = trace_ctx    # request span ctx captured at enqueue
+        self.queue_span = queue_span  # ml.queue, open until batch formation
 
 
 class DynamicBatcher:
@@ -51,12 +56,14 @@ class DynamicBatcher:
         max_delay_s: float = 0.005,
         max_inflight: int = 2,
         metrics=None,
+        tracer=None,
     ) -> None:
         self._engine = engine
         self._max_batch = max_batch
         self._max_delay = max_delay_s
         self._max_inflight = max_inflight
         self._metrics = metrics
+        self._tracer = tracer
         self._queue: asyncio.Queue[_Pending] = asyncio.Queue()
         self._task: asyncio.Task | None = None
         self._inflight: set[asyncio.Task] = set()
@@ -74,8 +81,22 @@ class DynamicBatcher:
             raise RuntimeError("batcher is closed")
         self._ensure_collector()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put(_Pending(inputs, fut))
+        # capture the request span HERE: the collector task that later forms
+        # the batch runs in its own context, far from this request's
+        ctx = current_context()
+        queue_span = None
+        if self._tracer is not None:
+            queue_span = self._tracer.start_span(
+                "ml.queue", parent=ctx, activate=False,
+                attributes={"ml.model": self._engine.name},
+            )
+        await self._queue.put(_Pending(inputs, fut, ctx, queue_span))
         return await fut
+
+    def queue_depth(self) -> int:
+        """Requests waiting for batch formation (sampled as
+        ``app_ml_queue_depth{component="batcher"}``)."""
+        return self._queue.qsize()
 
     async def _collect(self) -> None:
         while not self._closed:
@@ -122,6 +143,10 @@ class DynamicBatcher:
         n = len(batch)
         bucket = self._engine.bucket_for(n)
         now = time.perf_counter()
+        for p in batch:
+            if p.queue_span is not None:
+                p.queue_span.set_attributes({"ml.batch": n, "ml.bucket": bucket})
+                p.queue_span.end()
         if self._metrics is not None:
             try:
                 self._metrics.record_histogram("app_ml_batch_size", n, model=self._engine.name)
@@ -131,6 +156,22 @@ class DynamicBatcher:
                     )
             except Exception:
                 pass
+        # one pad span + one device step per BATCH, parented to the first
+        # rider's request so the trace shows the real (shared) execution;
+        # co-batched riders' trace ids travel as an attribute.
+        pad_span = None
+        if self._tracer is not None:
+            pad_span = self._tracer.start_span(
+                "ml.pad", parent=batch[0].trace_ctx, activate=False,
+                attributes={"ml.model": self._engine.name,
+                            "ml.batch": n, "ml.bucket": bucket},
+            )
+            if n > 1:
+                pad_span.set_attribute(
+                    "ml.linked_traces",
+                    ",".join(p.trace_ctx.trace_id for p in batch[1:]
+                             if p.trace_ctx is not None),
+                )
         try:
             n_args = len(batch[0].inputs)
             stacked = []
@@ -141,8 +182,17 @@ class DynamicBatcher:
                     pad = np.zeros((bucket - n,) + arr.shape[1:], dtype=arr.dtype)
                     arr = np.concatenate([arr, pad], axis=0)
                 stacked.append(arr)
-            out = await self._engine.predict(*stacked)
+            if pad_span is not None:
+                pad_span.end()
+            if self._tracer is not None:
+                out = await self._engine.predict(
+                    *stacked, trace_parent=batch[0].trace_ctx)
+            else:  # keep duck-typed engines (tests, fakes) kwarg-free
+                out = await self._engine.predict(*stacked)
         except Exception as exc:
+            if pad_span is not None and pad_span.end_time is None:
+                pad_span.record_exception(exc)
+                pad_span.end()
             for p in batch:
                 if not p.future.done():
                     p.future.set_exception(exc)

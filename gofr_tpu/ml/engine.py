@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..tracing import current_context
+
 __all__ = ["Engine", "EngineConfig"]
 
 
@@ -67,6 +69,7 @@ class Engine:
         config: EngineConfig | None = None,
         logger=None,
         metrics=None,
+        tracer=None,
         example_inputs: tuple | None = None,
         out_sharding=None,
         backend: str = "jit",
@@ -76,7 +79,9 @@ class Engine:
         self.config = config or EngineConfig()
         self._logger = logger
         self._metrics = metrics
+        self._tracer = tracer
         self.backend = backend
+        self.compiled_buckets: set[int] = set()  # batch dims seen on device
         if backend == "pjrt":
             # native PJRT C-API path: jax traces, our binding executes
             from .pjrt_backend import PjrtExecutor
@@ -109,28 +114,53 @@ class Engine:
             item = self._work.get()
             if item is None:
                 return
-            fut, args = item
+            fut, args, parent_ctx = item
             if fut.set_running_or_notify_cancel():
                 try:
-                    fut.set_result(self._execute(*args))
+                    fut.set_result(self._execute(args, parent_ctx))
                 except BaseException as exc:  # noqa: BLE001 - relayed via future
                     fut.set_exception(exc)
 
-    def _execute(self, *inputs: Any) -> Any:
+    def _execute(self, inputs: tuple, parent_ctx=None) -> Any:
+        span = None
+        if self._tracer is not None:
+            # parent ctx was captured on the caller's thread at enqueue time
+            # (contextvars don't follow the executor hop); activate=False so
+            # the span can't leak into this thread's next work item.
+            span = self._tracer.start_span(
+                "ml.device_step", parent=parent_ctx, activate=False,
+                attributes={"ml.model": self.name, "ml.backend": self.backend},
+            )
         start = time.perf_counter()
-        if self._pjrt is not None:
-            # the native binding does its own host->device transfer; a
-            # jnp.asarray here would bounce each input through jax's device
-            arrays = [np.asarray(x) for x in inputs]
-        else:
-            arrays = [jnp.asarray(x) for x in inputs]
-        out = self._run(*arrays)
-        out = jax.tree.map(lambda a: np.asarray(a), out)  # blocks until done
+        arrays: list | None = None
+        try:
+            if self._pjrt is not None:
+                # the native binding does its own host->device transfer; a
+                # jnp.asarray here would bounce each input through jax's device
+                arrays = [np.asarray(x) for x in inputs]
+            else:
+                arrays = [jnp.asarray(x) for x in inputs]
+            out = self._run(*arrays)
+            out = jax.tree.map(lambda a: np.asarray(a), out)  # blocks until done
+        except BaseException as exc:
+            if span is not None:
+                span.record_exception(exc)
+            raise
+        finally:
+            if span is not None:
+                if arrays and getattr(arrays[0], "ndim", 0) > 0:
+                    span.set_attribute("ml.batch", int(arrays[0].shape[0]))
+                span.end()
+        # successful steps only: a failed execute must not count as served
+        # work or skew the step-latency histogram with its error path
+        if arrays and getattr(arrays[0], "ndim", 0) > 0:
+            self.compiled_buckets.add(int(arrays[0].shape[0]))
         self.steps += 1
         dur = time.perf_counter() - start
         if self._metrics is not None:
             try:
-                self._metrics.record_histogram("app_tpu_step_seconds", dur, model=self.name)
+                self._metrics.record_histogram(
+                    "app_tpu_step_seconds", dur, model=self.name)
             except Exception:
                 pass
         if self._logger is not None:
@@ -140,15 +170,20 @@ class Engine:
         return out
 
     # -- API -------------------------------------------------------------------
-    def predict_sync(self, *inputs: Any) -> Any:
+    def predict_sync(self, *inputs: Any, trace_parent=None) -> Any:
         fut: cf.Future = cf.Future()
-        self._work.put((fut, inputs))
+        self._work.put((fut, inputs, trace_parent or current_context()))
         return fut.result()
 
-    async def predict(self, *inputs: Any) -> Any:
+    async def predict(self, *inputs: Any, trace_parent=None) -> Any:
         fut: cf.Future = cf.Future()
-        self._work.put((fut, inputs))
+        self._work.put((fut, inputs, trace_parent or current_context()))
         return await asyncio.wrap_future(fut)
+
+    def queue_depth(self) -> int:
+        """Work items awaiting the executor thread (sampled as
+        ``app_ml_queue_depth{component="engine"}``)."""
+        return self._work.qsize()
 
     def bucket_for(self, n: int) -> int:
         return _next_bucket(n, self.config.batch_buckets)

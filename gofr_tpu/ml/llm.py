@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Any, AsyncIterator
 
+from ..tracing import current_context
 from .generate import PagePoolExhausted
 
 __all__ = ["LLMServer"]
@@ -43,9 +44,11 @@ class _Finish:
 
 class _Request:
     __slots__ = ("prompt", "max_new", "out_q", "loop", "enqueued_at", "slot",
-                 "first_token_at", "cancelled", "prefix")
+                 "first_token_at", "cancelled", "prefix", "trace_ctx",
+                 "queue_span", "decode_span")
 
-    def __init__(self, prompt, max_new, out_q, loop, prefix=None) -> None:
+    def __init__(self, prompt, max_new, out_q, loop, prefix=None,
+                 trace_ctx=None, queue_span=None) -> None:
         self.prompt = prompt
         self.max_new = max_new
         self.out_q = out_q
@@ -55,6 +58,18 @@ class _Request:
         self.first_token_at = None
         self.cancelled = False  # consumer went away: stop decoding the slot
         self.prefix = prefix    # registered shared-prefix id (paged mode)
+        self.trace_ctx = trace_ctx    # request span ctx from enqueue time
+        self.queue_span = queue_span  # ml.queue, ends at slot admission
+        self.decode_span = None       # ml.decode, admission -> finish
+
+    def finish_spans(self, status: str = "OK", message: str = "") -> None:
+        """End whichever phase spans are still open (admission rejects and
+        close-flush paths may finish a request that never decoded)."""
+        for span in (self.queue_span, self.decode_span):
+            if span is not None and span.end_time is None:
+                if status != "OK":
+                    span.set_status(status, message)
+                span.end()
 
 
 class LLMServer:
@@ -65,12 +80,13 @@ class LLMServer:
     """
 
     def __init__(self, generator, *, name: str = "llm", logger=None,
-                 metrics=None, idle_wait_s: float = 0.002,
+                 metrics=None, tracer=None, idle_wait_s: float = 0.002,
                  admit_window_s: float = 0.004) -> None:
         self.gen = generator
         self.name = name
         self._logger = logger
         self._metrics = metrics
+        self._tracer = tracer
         self._idle_wait = idle_wait_s
         self._idle_backoff = idle_wait_s
         self._admit_window = admit_window_s
@@ -228,6 +244,7 @@ class LLMServer:
             del self._active[slot]
         exc = RuntimeError("llm server closed")
         for req in leftovers:
+            req.finish_spans("ERROR", "llm server closed")
             try:
                 req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
                 req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
@@ -280,6 +297,7 @@ class LLMServer:
                     continue
                 batch.append((req, ids))
             for req, exc in rejected:
+                req.finish_spans("ERROR", str(exc))
                 req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
                 req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
             if not batch:
@@ -305,6 +323,7 @@ class LLMServer:
                 break
             except Exception as exc:  # device-side failure: relay to all
                 for req, _ in batch:
+                    req.finish_spans("ERROR", str(exc))
                     req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
                     req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
                 continue
@@ -312,6 +331,14 @@ class LLMServer:
             for (req, _), slot in zip(batch, slots):
                 req.slot = slot
                 self._active[slot] = req
+                if req.queue_span is not None:
+                    req.queue_span.set_attribute("ml.slot", slot)
+                    req.queue_span.end()
+                if self._tracer is not None:
+                    req.decode_span = self._tracer.start_span(
+                        "ml.decode", parent=req.trace_ctx, activate=False,
+                        attributes={"ml.model": self.name, "ml.slot": slot},
+                    )
                 if self._metrics is not None:
                     try:
                         self._metrics.record_histogram(
@@ -340,6 +367,10 @@ class LLMServer:
         ``call_soon_threadsafe`` wakeups/s on the event loop thread."""
         if req.first_token_at is None:
             req.first_token_at = time.perf_counter()
+            if req.decode_span is not None:
+                req.decode_span.add_event(
+                    "first_token",
+                    {"ttft_s": req.first_token_at - req.enqueued_at})
             if self._metrics is not None:
                 try:
                     self._metrics.record_histogram(
@@ -348,6 +379,12 @@ class LLMServer:
                     )
                 except Exception:
                     pass
+        if self._metrics is not None:
+            try:
+                self._metrics.add_counter(
+                    "app_llm_tokens_total", len(tokens), model=self.name)
+            except Exception:
+                pass
         req.loop.call_soon_threadsafe(req.out_q.put_nowait, list(tokens))
 
     def _reap_cancelled(self) -> None:
@@ -355,7 +392,13 @@ class LLMServer:
         stream abandoned): their slots would otherwise burn decode steps to
         max_new_tokens, delaying every waiting request."""
         if self._waiting:
-            self._waiting = [r for r in self._waiting if not r.cancelled]
+            kept = []
+            for r in self._waiting:
+                if r.cancelled:
+                    r.finish_spans("ERROR", "cancelled before admission")
+                else:
+                    kept.append(r)
+            self._waiting = kept
         for slot, req in self._active.items():
             if req.cancelled and self.gen.slots[slot].live:
                 self.gen.slots[slot].live = False
@@ -367,6 +410,8 @@ class LLMServer:
         if self._metrics is None:
             return
         try:
+            self._metrics.set_gauge("app_llm_active_slots",
+                                    float(self.gen.n_live), model=self.name)
             self._metrics.set_gauge("app_llm_evictions",
                                     float(self.gen.evictions),
                                     model=self.name)
@@ -404,6 +449,25 @@ class LLMServer:
                             "app_llm_spec_accept", rate, model=self.name)
                     except Exception:
                         pass
+                produced = s.produced
+                now = time.perf_counter()
+                if (self._metrics is not None and produced > 1
+                        and req.first_token_at is not None):
+                    # stream cadence AFTER the first token: the SLO pair to
+                    # TTFT (a request is "fast" iff both are)
+                    try:
+                        self._metrics.record_histogram(
+                            "app_llm_tpot_seconds",
+                            (now - req.first_token_at) / (produced - 1),
+                            model=self.name)
+                    except Exception:
+                        pass
+                if req.decode_span is not None:
+                    req.decode_span.set_attributes({
+                        "ml.tokens": produced,
+                        "ml.finish_reason": reason,
+                    })
+                req.finish_spans()
                 # all of the slot's tokens were streamed via the callback
                 self.gen.release(slot)
                 del self._active[slot]
@@ -489,8 +553,17 @@ class LLMServer:
             raise RuntimeError("llm server is closed")
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue = asyncio.Queue()
+        # capture the caller's span before the executor hop; the serving
+        # thread parents ml.queue/ml.decode to it explicitly
+        ctx = current_context()
+        queue_span = None
+        if self._tracer is not None:
+            queue_span = self._tracer.start_span(
+                "ml.queue", parent=ctx, activate=False,
+                attributes={"ml.model": self.name},
+            )
         req = _Request(prompt_ids, max_new_tokens, out_q, loop,
-                       prefix=prefix)
+                       prefix=prefix, trace_ctx=ctx, queue_span=queue_span)
         self._requests.put(req)
         if self._closed:
             # close() may have drained the queue before our put landed —
@@ -543,6 +616,11 @@ class LLMServer:
                                               prefix=prefix, info=info):
             out.extend(burst)
         return out
+
+    def queue_depth(self) -> int:
+        """Requests waiting for a decode slot (sampled as
+        ``app_ml_queue_depth{component="llm"}``)."""
+        return len(self._waiting) + self._requests.qsize()
 
     # -- datasource contract --------------------------------------------------
     def health_check(self) -> dict:

@@ -121,13 +121,19 @@ class _Conn:
     def _drain(self) -> None:
         while True:
             obj = self._q.get()
-            if obj is None or not self.alive:
-                return
             try:
-                send_frame(self.sock, obj)
-            except OSError:
-                self.alive = False
-                return
+                if obj is None or not self.alive:
+                    return
+                try:
+                    send_frame(self.sock, obj)
+                except OSError:
+                    self.alive = False
+                    return
+            finally:
+                # task_done AFTER send_frame returns: flush() keys off the
+                # unfinished-task counter, so "queue empty" can no longer
+                # race a frame that was popped but not yet on the wire
+                self._q.task_done()
 
     def send(self, obj: Any) -> None:
         """Non-blocking enqueue; a dead/overflowing connection flips
@@ -156,9 +162,12 @@ class _Conn:
     def flush(self, timeout_s: float = 5.0) -> None:
         """Best-effort wait for queued frames to hit the socket — the STOP
         path must deliver its final {"stopped"/"error"} frames before the
-        teardown close()s race the writer thread."""
+        teardown close()s race the writer thread. Waits on the queue's
+        unfinished-task counter, not emptiness: a frame the writer has
+        popped but not yet sent keeps the counter non-zero, so the final
+        frame can't be cut mid-write by sock.close()."""
         deadline = time.monotonic() + timeout_s
-        while self.alive and not self._q.empty():
+        while self.alive and self._q.unfinished_tasks:
             if time.monotonic() >= deadline:
                 return
             time.sleep(0.005)

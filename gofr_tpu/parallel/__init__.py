@@ -175,12 +175,27 @@ def shard_like(tree: Any, spec: PartitionSpec, mesh: Mesh) -> Any:
     return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), tree)
 
 
+def _active_mesh():
+    """The ambient mesh, or None. jax >= 0.4.38 exposes
+    ``jax.sharding.get_abstract_mesh``; older releases track the ``with
+    mesh:`` context on the thread-resources env instead."""
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get is not None:
+        return get()
+    try:
+        from jax._src.mesh import thread_resources
+
+        return thread_resources.env.physical_mesh
+    except Exception:
+        return None
+
+
 def constrain(x: Any, spec: PartitionSpec) -> Any:
     """with_sharding_constraint that is a no-op outside a mesh context
     (single-device unit tests, CPU paths). Inside a mesh, errors propagate —
     a typo'd axis or non-divisible dim must fail loudly, not silently
     replicate."""
-    env_mesh = jax.sharding.get_abstract_mesh()
+    env_mesh = _active_mesh()
     if env_mesh is None or env_mesh.empty:
         return x
     return jax.lax.with_sharding_constraint(x, spec)

@@ -15,7 +15,14 @@ import socket
 import sys
 from typing import Callable
 
-__all__ = ["stdout_output_for_func", "stderr_output_for_func", "get_free_port"]
+from ..tracing import Tracer
+
+__all__ = [
+    "stdout_output_for_func",
+    "stderr_output_for_func",
+    "get_free_port",
+    "RecordingTracer",
+]
 
 
 def stdout_output_for_func(func: Callable[[], None]) -> str:
@@ -36,3 +43,18 @@ def get_free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+class RecordingTracer(Tracer):
+    """A Tracer that collects finished spans synchronously (no batch-export
+    thread), so tests can assert on span parenting deterministically."""
+
+    def __init__(self) -> None:
+        super().__init__("test", None, 1.0)
+        self.finished: list = []
+
+    def _on_end(self, span) -> None:
+        self.finished.append(span)
+
+    def by_name(self, name: str) -> list:
+        return [s for s in self.finished if s.name == name]

@@ -31,6 +31,7 @@ __all__ = [
     "ZipkinJSONExporter",
     "new_tracer",
     "current_span",
+    "current_context",
     "parse_traceparent",
     "format_traceparent",
 ]
@@ -142,6 +143,19 @@ class Span:
 
 def current_span() -> Span | None:
     return _current_span.get()
+
+
+def current_context() -> SpanContext | None:
+    """Snapshot the active span's context for cross-thread parenting.
+
+    ``contextvars`` don't follow work handed to an executor or serving
+    thread, so the ML path captures this at enqueue time and passes it
+    explicitly as ``parent=`` when the worker later opens its span
+    (``activate=False`` there — activating would leak the span into the
+    worker thread's unrelated subsequent work).
+    """
+    span = _current_span.get()
+    return span.context if span is not None else None
 
 
 class SpanExporter:

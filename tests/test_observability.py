@@ -1,0 +1,307 @@
+"""End-to-end inference telemetry: phase spans parented across the
+executor-thread hop, LLM SLO metrics (TTFT/TPOT/tokens/slots), runtime
+gauge samplers, and the /debug/serving + /debug/profile endpoints.
+
+All hermetic under JAX_PLATFORMS=cpu (conftest pins the platform); the
+profile endpoint's jax.profiler capture is mocked where the CPU backend
+has nothing useful to trace.
+"""
+
+import asyncio
+import io
+import time
+import zipfile
+
+import jax
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gofr_tpu import debug as debug_mod
+from gofr_tpu.app import App
+from gofr_tpu.config import MapConfig
+from gofr_tpu.container import Container
+from gofr_tpu.metrics import Manager, SamplerThread
+from gofr_tpu.ml import MLDatasource
+from gofr_tpu.ml.batching import DynamicBatcher
+from gofr_tpu.ml.engine import Engine
+from gofr_tpu.ml.generate import Generator
+from gofr_tpu.ml.llm import LLMServer
+from gofr_tpu.models import llama
+from gofr_tpu.testutil import RecordingTracer
+
+
+def _manager() -> Manager:
+    c = Container(MapConfig({"APP_NAME": "obs-test"}))
+    c.register_framework_metrics()
+    return c.metrics_manager
+
+
+def _double(params, x):
+    return x * params
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.tiny_llama(use_flash=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+# ------------------------------------------------------------- phase spans
+def test_device_step_span_parents_across_executor_hop():
+    """Engine dispatch hops to a dedicated thread; the span still parents
+    to the request span captured at enqueue time via current_context()."""
+    tracer = RecordingTracer()
+    metrics = _manager()
+    engine = Engine("m", _double, 2.0, metrics=metrics, tracer=tracer,
+                    example_inputs=None)
+    try:
+        with tracer.start_span("GET /predict", kind="SERVER") as req_span:
+            out = engine.predict_sync(np.ones((2, 2), np.float32))
+        assert np.allclose(out, 2.0)
+        steps = tracer.by_name("ml.device_step")
+        assert len(steps) == 1
+        assert steps[0].trace_id == req_span.trace_id
+        assert steps[0].parent_span_id == req_span.span_id
+        assert steps[0].attributes["ml.model"] == "m"
+        assert steps[0].attributes["ml.batch"] == 2
+        assert 2 in engine.compiled_buckets
+    finally:
+        engine.close()
+
+
+def test_batcher_queue_pad_device_spans_and_metrics(run):
+    """DynamicBatcher -> Engine under a test tracer: ml.queue parented to
+    the request span, ml.pad + ml.device_step in the same trace, and
+    app_ml_queue_seconds / app_ml_batch_size series exposed."""
+    tracer = RecordingTracer()
+    metrics = _manager()
+    engine = Engine("m", _double, 2.0, metrics=metrics, tracer=tracer)
+    batcher = DynamicBatcher(engine, metrics=metrics, tracer=tracer,
+                             max_delay_s=0.001)
+
+    async def scenario():
+        with tracer.start_span("POST /predict", kind="SERVER") as req_span:
+            out = await batcher.submit(np.ones((3,), np.float32))
+        return req_span, out
+
+    try:
+        req_span, out = run(scenario())
+        assert np.allclose(out, 2.0)
+        queue = tracer.by_name("ml.queue")
+        assert len(queue) == 1
+        assert queue[0].trace_id == req_span.trace_id
+        assert queue[0].parent_span_id == req_span.span_id
+        pad = tracer.by_name("ml.pad")
+        assert len(pad) == 1 and pad[0].trace_id == req_span.trace_id
+        steps = tracer.by_name("ml.device_step")
+        assert len(steps) == 1
+        assert steps[0].trace_id == req_span.trace_id
+        text = metrics.expose_text()
+        assert 'app_ml_queue_seconds_count{model="m"}' in text
+        assert 'app_ml_batch_size_count{model="m"}' in text
+    finally:
+        batcher.close()
+        engine.close()
+
+
+def test_llm_slo_metrics_and_decode_spans(model, run):
+    """One simulated LLM request records TTFT, TPOT, token throughput and
+    slot occupancy, with ml.queue/ml.decode spans under the request."""
+    cfg, params = model
+    tracer = RecordingTracer()
+    metrics = _manager()
+
+    async def scenario():
+        server = LLMServer(
+            Generator(params, cfg, batch_slots=2, max_seq=64,
+                      prefill_buckets=(8,)),
+            name="chat", metrics=metrics, tracer=tracer)
+        try:
+            with tracer.start_span("POST /generate", kind="SERVER") as req:
+                toks = await server.generate([3, 1, 4], 6)
+            return req, toks
+        finally:
+            server.close()
+
+    req_span, toks = run(scenario())
+    assert len(toks) == 6
+
+    queue = tracer.by_name("ml.queue")
+    assert len(queue) == 1
+    assert queue[0].trace_id == req_span.trace_id
+    assert queue[0].parent_span_id == req_span.span_id
+    decode = tracer.by_name("ml.decode")
+    assert len(decode) == 1
+    assert decode[0].trace_id == req_span.trace_id
+    assert decode[0].parent_span_id == req_span.span_id
+    assert decode[0].attributes["ml.tokens"] == 6
+    assert decode[0].attributes["ml.finish_reason"] in ("stop", "length")
+    assert any(name == "first_token" for _, name, _ in decode[0].events)
+
+    text = metrics.expose_text()
+    assert 'app_llm_ttft_seconds_count{model="chat"} 1' in text
+    assert 'app_llm_tpot_seconds_count{model="chat"} 1' in text
+    assert 'app_llm_tokens_total{model="chat"} 6' in text
+    assert 'app_llm_active_slots{model="chat"}' in text
+    assert 'app_llm_queue_seconds_count{model="chat"} 1' in text
+    # acceptance: the HBM gauge series is part of the same exposition
+    assert "app_tpu_hbm_bytes_in_use" in text
+
+
+# --------------------------------------------------------- gauge samplers
+def test_runtime_gauge_sampler_publishes_queue_depths_and_hbm(monkeypatch):
+    metrics = _manager()
+    ml = MLDatasource(metrics=metrics)
+    engine = Engine("m", _double, 2.0, metrics=metrics)
+    try:
+        ml.register("m", engine, batching=True)
+
+        class FakeDev:
+            platform = "tpu"
+            id = 0
+
+            def memory_stats(self):
+                return {"bytes_in_use": 123456, "bytes_limit": 1 << 30}
+
+        monkeypatch.setattr(jax, "devices", lambda: [FakeDev()])
+        text = metrics.expose_text()  # expose_text runs registered samplers
+        assert ('app_tpu_hbm_bytes_in_use{device="tpu:0"} 123456') in text
+        assert ('app_tpu_hbm_bytes_limit{device="tpu:0"} 1073741824') in text
+        assert ('app_ml_queue_depth{component="engine",model="m"} 0') in text
+        assert ('app_ml_queue_depth{component="batcher",model="m"} 0') in text
+    finally:
+        ml.close()
+
+
+def test_sampler_thread_runs_between_scrapes():
+    metrics = Manager()
+    metrics.new_gauge("ticks", "sampler invocations")
+    box = {"n": 0}
+
+    def sample():
+        box["n"] += 1
+        metrics.set_gauge("ticks", box["n"])
+
+    metrics.register_sampler(sample)
+    thread = SamplerThread(metrics, interval_s=0.02)
+    thread.start()
+    try:
+        deadline = time.monotonic() + 2.0
+        while box["n"] < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        thread.stop()
+    assert box["n"] >= 3
+
+
+def test_broken_sampler_never_breaks_the_scrape():
+    metrics = Manager()
+    metrics.register_sampler(lambda: 1 / 0)
+    assert metrics.expose_text().endswith("\n")
+
+
+# --------------------------------------------------------- debug endpoints
+def _make_app() -> App:
+    return App(config=MapConfig({"APP_NAME": "obs-app"}))
+
+
+async def _client_for(app: App) -> TestClient:
+    server = TestServer(app._build_http_app())
+    client = TestClient(server)
+    await client.start_server()
+    return client
+
+
+def test_debug_serving_snapshot(run):
+    async def scenario():
+        app = _make_app()
+        app.register_model("m", None, apply_fn=_double, params=2.0,
+                           example_inputs=(np.ones((1, 2), np.float32),))
+        client = await _client_for(app)
+        try:
+            r = await client.get("/debug/serving")
+            assert r.status == 200
+            body = await r.json()
+        finally:
+            await client.close()
+            await app.container.close()
+        return body["data"]
+
+    data = run(scenario())
+    m = data["models"]["m"]
+    assert m["steps"] >= 1          # constructor warmup compiled bucket 1
+    assert 1 in m["compiled_buckets"]
+    assert m["queue_depth"] == 0
+    # warmup recorded a device step -> percentile quotable for this model
+    assert "m" in data["percentiles"]["app_tpu_step_seconds"]
+
+
+def test_debug_profile_capture_roundtrip(run, monkeypatch):
+    def fake_capture(trace_dir, seconds):
+        with open(f"{trace_dir}/trace.json", "w") as fh:
+            fh.write('{"ok": true}')
+
+    monkeypatch.setattr(debug_mod, "_run_profile_capture", fake_capture)
+
+    async def scenario():
+        app = _make_app()
+        client = await _client_for(app)
+        try:
+            r = await client.get("/debug/profile", params={"seconds": "0.01"})
+            assert r.status == 200
+            assert r.content_type == "application/zip"
+            raw = await r.read()
+        finally:
+            await client.close()
+            await app.container.close()
+        return raw
+
+    raw = run(scenario())
+    with zipfile.ZipFile(io.BytesIO(raw)) as zf:
+        assert zf.namelist() == ["trace.json"]
+
+
+def test_debug_profile_validation_concurrency_and_failure(run, monkeypatch):
+    async def scenario():
+        app = _make_app()
+        client = await _client_for(app)
+        try:
+            r = await client.get("/debug/profile", params={"seconds": "nope"})
+            assert r.status == 400
+            r = await client.get("/debug/profile", params={"seconds": "0"})
+            assert r.status == 400
+            r = await client.get("/debug/profile", params={"seconds": "600"})
+            assert r.status == 400
+
+            # single-capture guard: a held lock answers 409, not a second
+            # concurrent jax.profiler session
+            assert debug_mod._profile_lock.acquire(blocking=False)
+            try:
+                r = await client.get("/debug/profile",
+                                     params={"seconds": "0.01"})
+                assert r.status == 409
+            finally:
+                debug_mod._profile_lock.release()
+
+            # a failing capture answers 503 AND releases the lock
+            def boom(trace_dir, seconds):
+                raise RuntimeError("no profiler on this backend")
+
+            monkeypatch.setattr(debug_mod, "_run_profile_capture", boom)
+            r = await client.get("/debug/profile", params={"seconds": "0.01"})
+            assert r.status == 503
+
+            def ok(trace_dir, seconds):
+                with open(f"{trace_dir}/t.json", "w") as fh:
+                    fh.write("{}")
+
+            monkeypatch.setattr(debug_mod, "_run_profile_capture", ok)
+            r = await client.get("/debug/profile", params={"seconds": "0.01"})
+            assert r.status == 200
+        finally:
+            await client.close()
+            await app.container.close()
+
+    run(scenario())
