@@ -19,7 +19,7 @@ import time
 import uuid
 
 import gofr_tpu
-from gofr_tpu.ml.generate import PrefixEvicted, Sampler
+from gofr_tpu.ml.generate import Sampler
 from gofr_tpu.models import llama
 from gofr_tpu.native.tokenizer import BPETokenizer
 
@@ -93,118 +93,11 @@ def _prepare(ctx, prompt_text: str, body: dict):
     return ids, max_new, llm
 
 
-_PREFIX_CACHE_CAP = 8  # distinct system prompts cached per server
-
-
-async def _cached_prefix(llm, messages, prompt_text: str):
-    """Auto-cache leading system messages as a shared KV prefix when the
-    generator is paged: the common chat pattern reuses one system prompt
-    across every conversation, so its prefill compute and KV pages pay
-    once instead of per request.
-
-    Returns (prefix_id | None, prompt_or_suffix_ids, full_prompt_len).
-    Guard: the suffix split must re-tokenize identically to the full
-    prompt (merges could straddle the boundary on a trained vocab);
-    otherwise fall back to the plain path."""
-    import asyncio
-
-    if not getattr(llm.gen, "page_size", 0):
-        ids = TOKENIZER.encode(prompt_text)
-        return None, ids, len(ids)
-    n_sys = 0
-    while (n_sys < len(messages)
-           and messages[n_sys].get("role") == "system"):
-        n_sys += 1
-    if n_sys == 0:
-        ids = TOKENIZER.encode(prompt_text)
-        return None, ids, len(ids)
-    sys_text = "\n".join(
-        f"{m.get('role', 'user')}: {m.get('content', '')}"
-        for m in messages[:n_sys]) + "\n"
-    ids_full = TOKENIZER.encode(prompt_text)
-    ids_sys = TOKENIZER.encode(sys_text)
-    if ids_full[:len(ids_sys)] != ids_sys:
-        return None, ids_full, len(ids_full)
-    # per-server cache: a module-level map would hand a rebooted server
-    # prefix ids registered on a dead generator. Values: int pid, a
-    # Future (registration in flight — concurrent first requests await it
-    # instead of double-registering and leaking pages), or None
-    # (negative-cached: registration failed once, don't re-attempt).
-    cache = getattr(llm, "_openai_prefix_cache", None)
-    if cache is None:
-        cache = llm._openai_prefix_cache = {}
-    key = tuple(ids_sys)
-    if key in cache:
-        entry = cache[key]
-        if isinstance(entry, asyncio.Future):
-            entry = await entry
-        if isinstance(entry, int) and not llm.has_prefix(entry):
-            # the generator LRU-evicted it under pool pressure — treat as
-            # a miss and re-register below
-            cache.pop(key, None)
-        elif entry is None:
-            return None, ids_full, len(ids_full)
-        else:
-            cache[key] = cache.pop(key)  # LRU: re-insert at the tail
-            return entry, ids_full[len(ids_sys):], len(ids_full)
-    if len(cache) >= _PREFIX_CACHE_CAP:
-        # evict the least-recently-used idle entry so a rotating set of
-        # system prompts keeps caching instead of freezing the first N
-        for old_key, old_entry in list(cache.items()):
-            if isinstance(old_entry, asyncio.Future):
-                continue  # registration in flight
-            cache.pop(old_key, None)
-            if isinstance(old_entry, int):
-                try:
-                    await asyncio.to_thread(llm.drop_prefix, old_entry)
-                except Exception:
-                    pass  # still borrowed or already evicted — fine
-            break
-        if len(cache) >= _PREFIX_CACHE_CAP:
-            return None, ids_full, len(ids_full)  # everything in flight
-    fut = asyncio.get_running_loop().create_future()
-    cache[key] = fut  # reserve BEFORE awaiting: no check-then-act race
-
-    async def _register():
-        try:
-            # one-time prefill on the serving thread; don't block the loop
-            pid = await asyncio.to_thread(llm.register_prefix, ids_sys)
-        except Exception:
-            # caching is an optimization: the uncached path serves the same
-            # request (docs promise a silent fallback), and the negative
-            # entry stops every later request re-attempting a doomed prefill
-            pid = None
-        cache[key] = pid
-        if not fut.done():
-            fut.set_result(pid)
-        return pid
-
-    # An independent task, awaited through shield: if THIS request is
-    # cancelled (client disconnect) mid-registration, the task still runs
-    # to completion and resolves the Future — otherwise every later
-    # request with the same system prompt would await a forever-pending
-    # entry (CancelledError is a BaseException; an except Exception here
-    # would never resolve it).
-    task = asyncio.get_running_loop().create_task(_register())
-    pid = await asyncio.shield(task)
-    if pid is None:
-        return None, ids_full, len(ids_full)
-    return pid, ids_full[len(ids_sys):], len(ids_full)
-
-
-def _forget_prefix(llm, pid) -> None:
-    """Drop cache entries pointing at an evicted prefix id."""
-    cache = getattr(llm, "_openai_prefix_cache", None) or {}
-    for key, entry in list(cache.items()):
-        if entry == pid:
-            cache.pop(key, None)
-
-
-def _admissible_or_400(llm, ids, max_new, prefix=None) -> None:
+def _admissible_or_400(llm, ids, max_new) -> None:
     """Reject un-admittable requests BEFORE any stream opens — once SSE
     headers are on the wire a clean 400 is impossible."""
     try:
-        llm.check_admissible(ids, max_new, prefix=prefix)
+        llm.check_admissible(ids, max_new)
     except ValueError as exc:
         raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
 
@@ -240,9 +133,14 @@ async def chat_completions(ctx: gofr_tpu.Context):
         raise gofr_tpu.errors.MissingParam("messages")
     max_new = int(body.get("max_tokens") or 64)
     llm = ctx.ml.llm(MODEL_ID)
-    prefix, ids, n_prompt = await _cached_prefix(
-        llm, messages, _render_chat(messages))
-    _admissible_or_400(llm, ids, max_new, prefix=prefix)
+    # shared-prefix reuse (repeated system prompts, common chat history)
+    # is the FRAMEWORK's job now: with a paged generator the LLMServer's
+    # radix cache longest-matches this prompt at admission, prefills only
+    # the suffix, and auto-registers hot prefixes — the handler just
+    # submits the full token ids
+    ids = TOKENIZER.encode(_render_chat(messages))
+    n_prompt = len(ids)
+    _admissible_or_400(llm, ids, max_new)
     rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
     created = int(time.time())
 
@@ -254,32 +152,15 @@ async def chat_completions(ctx: gofr_tpu.Context):
             n_out = 0
             dec = _StreamDecoder()
             fin: dict = {}
-            try:
-                # one SSE chunk per decode-chunk burst (a delta may carry
-                # several tokens' text — valid OpenAI protocol, far fewer
-                # frames)
-                async for burst in llm.stream_chunks(ids, max_new,
-                                                     prefix=prefix,
-                                                     info=fin):
-                    n_out += len(burst)
-                    await stream.send(_chunk(
-                        "chat.completion.chunk", rid, created,
-                        [_choice_delta(0, content="".join(
-                            dec.push(t) for t in burst))]))
-            except PrefixEvicted:
-                # eviction raced our admission (nothing streamed yet):
-                # retry once with the full prompt, uncached. Mid-stream a
-                # clean 400 is impossible (SSE headers are sent);
-                # admission errors surface as the stream's error event.
-                _forget_prefix(llm, prefix)
-                ids = TOKENIZER.encode(_render_chat(messages))
-                async for burst in llm.stream_chunks(ids, max_new,
-                                                     info=fin):
-                    n_out += len(burst)
-                    await stream.send(_chunk(
-                        "chat.completion.chunk", rid, created,
-                        [_choice_delta(0, content="".join(
-                            dec.push(t) for t in burst))]))
+            # one SSE chunk per decode-chunk burst (a delta may carry
+            # several tokens' text — valid OpenAI protocol, far fewer
+            # frames)
+            async for burst in llm.stream_chunks(ids, max_new, info=fin):
+                n_out += len(burst)
+                await stream.send(_chunk(
+                    "chat.completion.chunk", rid, created,
+                    [_choice_delta(0, content="".join(
+                        dec.push(t) for t in burst))]))
             tail = dec.flush()
             if tail:
                 await stream.send(_chunk(
@@ -299,15 +180,10 @@ async def chat_completions(ctx: gofr_tpu.Context):
 
     fin: dict = {}
     try:
-        toks = await llm.generate(ids, max_new, prefix=prefix, info=fin)
-    except PrefixEvicted:
-        _forget_prefix(llm, prefix)
-        ids = TOKENIZER.encode(_render_chat(messages))
-        _admissible_or_400(llm, ids, max_new)  # the full prompt may not fit
         toks = await llm.generate(ids, max_new, info=fin)
     except ValueError as exc:
-        # backstop for admission races (e.g. a prefix pinned between the
-        # up-front check and the serving thread's admit)
+        # backstop for admission races between the up-front check and the
+        # serving thread's admit
         raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
     return gofr_tpu.Raw({
         "id": rid, "object": "chat.completion", "created": created,
@@ -412,7 +288,8 @@ def main() -> gofr_tpu.App:
         # prompt lookup
         spec_k=spec_k,
         draft_params=draft_params, draft_cfg=draft_cfg,
-        # paged pool enables automatic system-prompt prefix caching
+        # paged pool turns on the framework's automatic shared-prefix
+        # cache (LLMServer radix matching — no app-level registration)
         # LLM_PREFILL_CHUNK>0: segmented prefill interleaved with decode
         # chunks — a long prompt can't stall live streams (TTFT jitter)
         prefill_chunk=int(os.environ.get("LLM_PREFILL_CHUNK", "0")),

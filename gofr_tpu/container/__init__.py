@@ -132,6 +132,14 @@ class Container:
             "per-stream speculative draft acceptance rate [0, 1]",
             buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
         )
+        m.new_counter("app_ml_prefix_hits_total",
+                      "admissions served from a cached shared KV prefix")
+        m.new_counter("app_ml_prefix_misses_total",
+                      "admissions with no usable cached prefix")
+        m.new_counter("app_ml_prefix_evictions_total",
+                      "cached prefixes dropped (cap or pool pressure)")
+        m.new_counter("app_ml_prefill_tokens_saved_total",
+                      "prompt tokens NOT re-prefilled thanks to prefix reuse")
         m.new_gauge("app_llm_evictions",
                     "streams truncated because the KV page pool ran dry")
         m.new_gauge("app_llm_prefix_evictions",

@@ -98,6 +98,9 @@ class MLDatasource:
         from .generate import Generator
         from .llm import LLMServer
 
+        # server-level policy, not a Generator knob: False disables the
+        # framework shared-prefix cache, a PrefixCacheConfig tunes it
+        prefix_cache = gen_kwargs.pop("prefix_cache", None)
         if generator is None:
             warm = gen_kwargs.pop("warmup", True)
             generator = Generator(params, cfg, **gen_kwargs)
@@ -105,7 +108,8 @@ class MLDatasource:
                 # startup pays every decode/prefill compile, not a request
                 generator.warmup()
         server = LLMServer(generator, name=name, logger=self._logger,
-                           metrics=self._metrics, tracer=self._tracer)
+                           metrics=self._metrics, tracer=self._tracer,
+                           prefix_cache=prefix_cache)
         self._llms[name] = server
         if self._logger is not None:
             self._logger.infof("llm %s registered (%d slots)", name,
@@ -218,6 +222,9 @@ class MLDatasource:
         for name, server in self._llms.items():
             entry = dict(server.health_check()["details"])
             entry["pool"] = server.gen.pool_stats()
+            if getattr(server, "prefix_cache", None) is not None:
+                # prefix lengths, refcounts, hit counts + lifetime totals
+                entry["prefix_cache"] = server.prefix_cache.snapshot()
             snap["llms"][name] = entry
         return snap
 

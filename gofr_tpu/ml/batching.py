@@ -21,6 +21,7 @@ import asyncio
 import time
 from typing import Any
 
+import jax
 import numpy as np
 
 from ..tracing import current_context
@@ -68,6 +69,11 @@ class DynamicBatcher:
         self._task: asyncio.Task | None = None
         self._inflight: set[asyncio.Task] = set()
         self._inflight_slots: asyncio.Semaphore | None = None
+        # zero-pad blocks keyed by (rows, row shape, dtype): the pad rows
+        # for a (bucket, shape) pair are identical every batch, and
+        # np.concatenate copies them out — allocate each block once
+        # instead of a fresh np.zeros per padded batch
+        self._pad_cache: dict[tuple, np.ndarray] = {}
         self._closed = False
 
     def _ensure_collector(self) -> None:
@@ -179,7 +185,11 @@ class DynamicBatcher:
                 rows = [np.asarray(p.inputs[j]) for p in batch]
                 arr = np.stack(rows, axis=0)
                 if bucket > n:  # zero-pad to the shape bucket
-                    pad = np.zeros((bucket - n,) + arr.shape[1:], dtype=arr.dtype)
+                    key = (bucket - n, arr.shape[1:], arr.dtype.str)
+                    pad = self._pad_cache.get(key)
+                    if pad is None:
+                        pad = self._pad_cache[key] = np.zeros(
+                            (bucket - n,) + arr.shape[1:], dtype=arr.dtype)
                     arr = np.concatenate([arr, pad], axis=0)
                 stacked.append(arr)
             if pad_span is not None:
@@ -209,6 +219,4 @@ class DynamicBatcher:
 
 def _slice_row(out: Any, i: int):
     """Row i of every array leaf in the batched output."""
-    import jax
-
     return jax.tree.map(lambda a: a[i], out)

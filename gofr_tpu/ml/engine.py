@@ -92,9 +92,29 @@ class Engine:
             self._params = params
         elif backend == "jit":
             self._pjrt = None
-            self._apply = jax.jit(apply_fn)
             self._params = jax.device_put(params)
-            self._run = lambda *xs: self._apply(self._params, *xs)
+            if self.config.donate_inputs:
+                # donate the input buffers so XLA reuses the bucketed batch
+                # allocation for outputs instead of allocating fresh HBM per
+                # step (_execute transfers host inputs into fresh device
+                # arrays and copies caller-owned jax.Arrays, so the donated
+                # buffer is never one the caller still holds).
+                # donate_argnums needs concrete positions and apply_fn is
+                # (params, *xs): keep one jitted wrapper per input arity.
+                jitted: dict[int, Any] = {}
+
+                def run(*xs):
+                    fn = jitted.get(len(xs))
+                    if fn is None:
+                        fn = jitted[len(xs)] = jax.jit(
+                            apply_fn,
+                            donate_argnums=tuple(range(1, len(xs) + 1)))
+                    return fn(self._params, *xs)
+
+                self._run = run
+            else:
+                self._apply = jax.jit(apply_fn)
+                self._run = lambda *xs: self._apply(self._params, *xs)
         else:
             raise ValueError(f"unknown engine backend {backend!r}")
         self._work: queue.Queue = queue.Queue()
@@ -138,6 +158,12 @@ class Engine:
                 # the native binding does its own host->device transfer; a
                 # jnp.asarray here would bounce each input through jax's device
                 arrays = [np.asarray(x) for x in inputs]
+            elif self.config.donate_inputs:
+                # donation consumes the buffer: host inputs transfer into a
+                # fresh (safely donatable) device array anyway, but a caller
+                # passing a jax.Array would see it DELETED — copy those
+                arrays = [x.copy() if isinstance(x, jax.Array)
+                          else jnp.asarray(x) for x in inputs]
             else:
                 arrays = [jnp.asarray(x) for x in inputs]
             out = self._run(*arrays)

@@ -786,17 +786,24 @@ class Generator:
                 free_pages=self.free_pages,
                 prefix_evictions=getattr(self, "prefix_evictions", 0),
                 registered_prefixes=len(getattr(self, "_prefixes", {})),
+                pinned_prefixes=sum(
+                    1 for i in getattr(self, "_prefixes", {}).values()
+                    if i.get("pinned")),
             )
         return out
 
     # -- shared-prefix prefill (paged mode) ----------------------------------
-    def register_prefix(self, prefix_ids) -> int:
+    def register_prefix(self, prefix_ids, pinned: bool = False) -> int:
         """Compute a shared prefix's KV pages ONCE; requests then admit
         with ``prefix=<id>`` and prefill only their SUFFIX while attending
         the shared pages read-only. Sharing needs no copy-on-write: decode
         never writes below a slot's own start position, so the prefix
         pages are immutable by construction. Only WHOLE pages are shared —
         the remainder (< page_size tokens) re-prefills with each suffix.
+
+        ``pinned`` prefixes (the explicit registration API) are evicted
+        under pool pressure only as a LAST RESORT — after every unpinned
+        (auto-promoted) idle candidate; borrowed prefixes never evict.
 
         The vLLM-style system-prompt lever: N concurrent chat slots pay
         the prefix's HBM and prefill compute once instead of N times.
@@ -841,7 +848,8 @@ class Generator:
                                # full ids: spec-mode admission seeds the
                                # slot's device history row with these
                                "ids_full": [int(t) for t in ids],
-                               "refs": 0, "last_use": self._prefix_clock}
+                               "refs": 0, "last_use": self._prefix_clock,
+                               "pinned": bool(pinned)}
         return pid
 
     def has_prefix(self, pid: int) -> bool:
@@ -850,18 +858,22 @@ class Generator:
         return pid in self._prefixes
 
     def _reclaim_prefix_pages(self, n_need: int) -> bool:
-        """Evict idle (refs == 0) prefixes, least-recently-used first,
-        until at least ``n_need`` pages are free. Prefix pages are a
-        CACHE: under pool pressure an idle system prompt's pages are worth
-        less than a live stream's next tokens (VERDICT r4 #6 — without
-        this, rotating system prompts exhaust the pool forever)."""
+        """Evict idle (refs == 0) prefixes until at least ``n_need`` pages
+        are free: UNPINNED (auto-promoted cache entries) go first,
+        least-recently-used; PINNED (explicitly registered) ones only as a
+        last resort, so an operator's system prompt outlives the cache's
+        opportunistic registrations but can never brick the pool. Prefix
+        pages are a CACHE: under pool pressure an idle system prompt's
+        pages are worth less than a live stream's next tokens (VERDICT r4
+        #6 — without this, rotating system prompts exhaust the pool
+        forever). Borrowed prefixes (refs > 0) are never candidates."""
         while len(self._free_pages) < n_need:
-            idle = [(info["last_use"], pid)
+            idle = [(info.get("pinned", False), info["last_use"], pid)
                     for pid, info in self._prefixes.items()
                     if info["refs"] == 0]
             if not idle:
                 return False
-            _, pid = min(idle)
+            _, _, pid = min(idle)
             info = self._prefixes.pop(pid)
             self._free_pages.extend(info["pages"])
             self.prefix_evictions += 1

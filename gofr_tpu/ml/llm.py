@@ -12,6 +12,12 @@ on a thread-safe queue → the serving thread admits it into a free slot
 (prefill) or parks it until one frees → each sampled token is pushed back
 to the handler's asyncio queue via ``call_soon_threadsafe`` → slot release
 on completion. Metrics: queue wait, TTFT, tokens out.
+
+Paged generators additionally get the framework shared-prefix cache
+(prefix_cache.py): admission longest-matches each prompt against a radix
+trie of cached prefixes, prefills only the suffix on a hit, and
+auto-registers hot prefixes — no caller opt-in; ``register_prefix``
+remains as the pinning API on top.
 """
 
 from __future__ import annotations
@@ -23,7 +29,8 @@ import time
 from typing import Any, AsyncIterator
 
 from ..tracing import current_context
-from .generate import PagePoolExhausted
+from .generate import PagePoolExhausted, PrefixEvicted
+from .prefix_cache import PrefixCacheConfig, RadixPrefixCache
 
 __all__ = ["LLMServer"]
 
@@ -45,7 +52,7 @@ class _Finish:
 class _Request:
     __slots__ = ("prompt", "max_new", "out_q", "loop", "enqueued_at", "slot",
                  "first_token_at", "cancelled", "prefix", "trace_ctx",
-                 "queue_span", "decode_span")
+                 "queue_span", "decode_span", "full_prompt", "cache_seen")
 
     def __init__(self, prompt, max_new, out_q, loop, prefix=None,
                  trace_ctx=None, queue_span=None) -> None:
@@ -61,6 +68,8 @@ class _Request:
         self.trace_ctx = trace_ctx    # request span ctx from enqueue time
         self.queue_span = queue_span  # ml.queue, ends at slot admission
         self.decode_span = None       # ml.decode, admission -> finish
+        self.full_prompt = None  # original ids when the framework prefix
+        self.cache_seen = False  # cache split the prompt (eviction fallback)
 
     def finish_spans(self, status: str = "OK", message: str = "") -> None:
         """End whichever phase spans are still open (admission rejects and
@@ -81,12 +90,24 @@ class LLMServer:
 
     def __init__(self, generator, *, name: str = "llm", logger=None,
                  metrics=None, tracer=None, idle_wait_s: float = 0.002,
-                 admit_window_s: float = 0.004) -> None:
+                 admit_window_s: float = 0.004, prefix_cache=None) -> None:
         self.gen = generator
         self.name = name
         self._logger = logger
         self._metrics = metrics
         self._tracer = tracer
+        # Framework shared-prefix cache (prefix_cache.py): ON by default
+        # whenever the generator is paged — submit longest-matches the
+        # prompt against cached prefixes, prefills only the suffix, and
+        # hot prefixes auto-register with no caller opt-in. Pass
+        # ``prefix_cache=False`` to disable, or a PrefixCacheConfig to
+        # tune the promotion/eviction policy.
+        self.prefix_cache = None
+        if getattr(generator, "page_size", 0) and prefix_cache is not False:
+            cfg = (prefix_cache
+                   if isinstance(prefix_cache, PrefixCacheConfig) else None)
+            self.prefix_cache = RadixPrefixCache(
+                generator, cfg, metrics=metrics, model=name)
         self._idle_wait = idle_wait_s
         self._idle_backoff = idle_wait_s
         self._admit_window = admit_window_s
@@ -165,17 +186,24 @@ class LLMServer:
             work()
 
     def register_prefix(self, prefix_ids, timeout_s: float = 120.0) -> int:
-        """Register a shared prefix (system prompt) on the paged
-        Generator; returns the id to pass as ``prefix=`` to
-        stream/generate. Thread-safe: the prefill runs on the serving
-        thread (it may wait one idle-poll interval, <= 50 ms, plus the
-        prefix compile on first use)."""
+        """PIN a shared prefix (system prompt): registered through the
+        framework prefix cache when one is active, so the registration is
+        evicted under pool pressure only as a last resort (after every
+        auto-promoted candidate) and never while borrowed. Returns the id
+        to pass as ``prefix=`` to stream/generate — though with the cache
+        on, plain submissions longest-match automatically and the explicit
+        id is only needed to guarantee residency. Thread-safe: the prefill
+        runs on the serving thread (it may wait one idle-poll interval,
+        <= 50 ms, plus the prefix compile on first use)."""
         done = threading.Event()
         box: dict = {}
 
         def work() -> None:
             try:
-                box["pid"] = self.gen.register_prefix(prefix_ids)
+                if self.prefix_cache is not None:
+                    box["pid"] = self.prefix_cache.pin(prefix_ids)
+                else:
+                    box["pid"] = self.gen.register_prefix(prefix_ids)
             except Exception as exc:  # relayed to the caller below
                 box["err"] = exc
             finally:
@@ -202,7 +230,10 @@ class LLMServer:
 
         def work() -> None:
             try:
-                self.gen.drop_prefix(pid)
+                if self.prefix_cache is not None:
+                    self.prefix_cache.drop(pid)
+                else:
+                    self.gen.drop_prefix(pid)
             except Exception as exc:
                 box["err"] = exc
             finally:
@@ -295,6 +326,7 @@ class LLMServer:
                 except Exception as exc:
                     rejected.append((req, exc))
                     continue
+                ids = self._maybe_split_prefix(req, ids)
                 batch.append((req, ids))
             for req, exc in rejected:
                 req.finish_spans("ERROR", str(exc))
@@ -315,6 +347,28 @@ class LLMServer:
                          (lambda i, toks, r=req: self._emit(r, toks)))
                         for req, ids in batch
                     ])
+            except PrefixEvicted as exc:
+                # paged batches are size 1, so this is batch[0]'s prefix
+                req = batch[0][0]
+                if req.full_prompt is not None:
+                    # the FRAMEWORK cache split this prompt and the
+                    # generator evicted the prefix under pool pressure
+                    # before admission: clear the stale registration and
+                    # requeue with the original full prompt — the caller
+                    # never learns caching was attempted
+                    if self.prefix_cache is not None:
+                        self.prefix_cache.invalidate(req.prefix)
+                        self.prefix_cache.record_miss()  # nothing saved
+                    req.prompt = req.full_prompt
+                    req.prefix = None
+                    req.full_prompt = None
+                    self._waiting.insert(0, req)
+                    continue
+                # explicitly-passed prefix: the caller owns re-registration
+                req.finish_spans("ERROR", str(exc))
+                req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
+                req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
+                continue
             except PagePoolExhausted:
                 # transient paged-KV back-pressure: pages free as live
                 # slots finish, so requeue the whole batch (front, FIFO)
@@ -331,6 +385,10 @@ class LLMServer:
             for (req, _), slot in zip(batch, slots):
                 req.slot = slot
                 self._active[slot] = req
+                if req.full_prompt is not None and self.prefix_cache is not None:
+                    # the hit is real only now: the slot borrowed the
+                    # prefix pages and the suffix-only prefill happened
+                    self.prefix_cache.commit_hit(req.prefix)
                 if req.queue_span is not None:
                     req.queue_span.set_attribute("ml.slot", slot)
                     req.queue_span.end()
@@ -350,15 +408,37 @@ class LLMServer:
 
     def _validate(self, req) -> Any:
         """Shape-check the prompt on the serving thread so one bad request
-        rejects cleanly instead of failing the whole admission wave."""
+        rejects cleanly instead of failing the whole admission wave. A
+        prefixed request may carry an EMPTY suffix (the registered tail
+        still prefills); the generator rejects a truly token-free one."""
         import numpy as np
 
         ids = np.asarray(req.prompt, np.int32).reshape(-1)
         n = len(ids)
-        if n == 0 or n >= self.gen.max_seq:
+        if (n == 0 and req.prefix is None) or n >= self.gen.max_seq:
             raise ValueError(
                 f"prompt length {n} out of range (1..{self.gen.max_seq - 1})")
         return ids
+
+    def _maybe_split_prefix(self, req, ids):
+        """Admission-path radix lookup: longest-match the prompt against
+        the framework prefix cache and split it into (registered prefix,
+        suffix) so prefill covers only the suffix. Hot prefixes promote
+        inside ``observe`` — the request crossing the threshold already
+        reuses. Runs ONCE per request (``cache_seen``): a requeued request
+        keeps its split, and the PrefixEvicted fallback keeps its decision
+        to go uncached."""
+        cache = self.prefix_cache
+        if cache is None or req.prefix is not None or req.cache_seen:
+            return ids
+        req.cache_seen = True
+        pid, reg_len = cache.observe(ids)
+        if pid is None:
+            return ids
+        req.full_prompt = ids
+        req.prefix = pid
+        req.prompt = ids[reg_len:]
+        return req.prompt
 
     def _emit(self, req: _Request, tokens: list[int]) -> None:
         """Push one BURST of tokens (the slot's share of a processed chunk)
@@ -517,9 +597,16 @@ class LLMServer:
             return
         chunked = getattr(gen, "prefill_chunk", 0) and n > gen.prefill_chunk
         if not chunked and n > buckets[-1]:
-            raise ValueError(
-                f"prompt length {n} exceeds the largest prefill bucket "
-                f"{buckets[-1]}")
+            # a cached shared prefix can still admit this prompt — only
+            # the suffix prefills. Draft-model speculation can't (the
+            # draft must ingest the full history), and a cold prompt
+            # genuinely cannot prefill beyond the largest bucket.
+            covered = (not draft and self.prefix_cache is not None
+                       and self.prefix_cache.peek(ids)[0] is not None)
+            if not covered:
+                raise ValueError(
+                    f"prompt length {n} exceeds the largest prefill bucket "
+                    f"{buckets[-1]}")
         if chunked and draft and n > buckets[-1]:
             raise ValueError(
                 f"prompt length {n} exceeds the largest prefill bucket "

@@ -131,10 +131,11 @@ def test_streaming_chat_and_completions(run):
 
 
 def test_chat_system_prompt_prefix_caching(run):
-    """With a paged generator (LLM_PAGE_SIZE), leading system messages
-    auto-register as a shared KV prefix: two chats with the same system
-    prompt share it (one registration), and the completion equals the
-    uncached path's byte-for-byte."""
+    """With a paged generator (LLM_PAGE_SIZE), repeated prompts hit the
+    FRAMEWORK's radix prefix cache — the example carries no LRU of its
+    own: the second identical chat auto-promotes the shared prefix,
+    prefills only the suffix, and the completion equals the uncached
+    path's byte-for-byte."""
     async def scenario():
         import aiohttp
 
@@ -160,6 +161,10 @@ def test_chat_system_prompt_prefix_caching(run):
             base = await _booted(app)
             llm = app.container.ml.llm("gofr-llama")
             assert llm.gen.page_size == 8
+            # the bespoke app-level LRU is gone: the framework cache owns
+            # prefix reuse now
+            assert not hasattr(llm, "_openai_prefix_cache")
+            assert llm.prefix_cache is not None
             async with aiohttp.ClientSession() as s:
                 outs = []
                 for _ in range(2):
@@ -167,10 +172,12 @@ def test_chat_system_prompt_prefix_caching(run):
                                      json=body)
                     outs.append(
                         (await r.json())["choices"][0]["message"]["content"])
-            cache = getattr(llm, "_openai_prefix_cache", {})
-            assert len(cache) == 1          # registered exactly once
-            pid = next(iter(cache.values()))
-            assert llm.gen._prefixes[pid]["len"] > 0
+            snap = llm.prefix_cache.snapshot()
+            assert snap["misses"] == 1       # first chat inserts
+            assert snap["hits"] == 1         # second promotes AND reuses
+            assert snap["prefill_tokens_saved"] > 0
+            assert len(snap["prefixes"]) == 1
+            assert snap["prefixes"][0]["shared_page_tokens"] > 0
             await app.shutdown()
             return ref, outs
 

@@ -1,0 +1,290 @@
+"""Framework shared-prefix KV cache (ml/prefix_cache.py): radix
+longest-match, automatic promotion, ref-counted borrow protection,
+pressure-aware eviction ordering, metrics, and end-to-end equivalence
+through LLMServer.generate."""
+
+import asyncio
+
+import jax
+import pytest
+
+from gofr_tpu.ml.generate import Generator
+from gofr_tpu.ml.llm import LLMServer
+from gofr_tpu.ml.prefix_cache import PrefixCacheConfig, RadixPrefixCache
+from gofr_tpu.models import llama
+
+
+class StubGen:
+    """Generator double exposing exactly the surface the cache touches —
+    the pure trie/policy tests need no device."""
+
+    def __init__(self, page_size=4, max_seq=512, prefill_buckets=(64,),
+                 n_pages=64):
+        self.page_size = page_size
+        self.max_seq = max_seq
+        self.prefill_buckets = prefill_buckets
+        self.n_pages = n_pages
+        self._prefixes = {}
+        self._next = 1
+
+    def register_prefix(self, ids, pinned=False):
+        ids = [int(t) for t in ids]
+        shared = (len(ids) // self.page_size) * self.page_size
+        pid = self._next
+        self._next += 1
+        self._prefixes[pid] = {
+            "pages": list(range(shared // self.page_size)), "len": shared,
+            "tail": ids[shared:], "ids_full": ids, "refs": 0,
+            "last_use": pid, "pinned": bool(pinned),
+        }
+        return pid
+
+    def has_prefix(self, pid):
+        return pid in self._prefixes
+
+    def drop_prefix(self, pid):
+        info = self._prefixes[pid]
+        if info["refs"] > 0:
+            raise RuntimeError(f"prefix {pid} still borrowed")
+        del self._prefixes[pid]
+
+
+# --------------------------------------------------------------- radix match
+def test_longest_match_exact_partial_nested():
+    gen = StubGen(page_size=4)
+    cache = RadixPrefixCache(gen, PrefixCacheConfig(promote_hits=99))
+    short = list(range(1, 9))        # [1..8]
+    long = list(range(1, 17))        # [1..16] — nests the short prefix
+    p_short = cache.pin(short)
+    p_long = cache.pin(long)
+    assert p_short != p_long
+
+    # exact-path extension matches the DEEPEST registered prefix
+    pid, reg_len = cache.observe(long + [77])
+    assert (pid, reg_len) == (p_long, 16)
+
+    # diverging after the short prefix matches only the short one
+    pid, reg_len = cache.observe(short + [50, 51])
+    assert (pid, reg_len) == (p_short, 8)
+
+    # partial mid-edge overlap below any registration: miss
+    pid, reg_len = cache.observe([1, 2, 3, 99])
+    assert pid is None and reg_len == 0
+
+    # exact page-aligned prompt with no tail leaves nothing to prefill:
+    # reuse must be declined, not crash the admission path
+    pid, _ = cache.observe(list(short))
+    assert pid is None
+
+
+# ---------------------------------------------------------------- promotion
+def test_automatic_promotion_threshold():
+    gen = StubGen(page_size=4)
+    cache = RadixPrefixCache(gen, PrefixCacheConfig(promote_hits=3))
+    base = [5, 6, 7, 8, 9, 10]       # 6 shared tokens (>= page_size + 1)
+
+    assert cache.observe(base + [100]) == (None, 0)   # 1st sighting
+    assert cache.observe(base + [101]) == (None, 0)   # 2nd: still cold
+    pid, reg_len = cache.observe(base + [102])        # 3rd: promotes + hits
+    assert pid is not None and reg_len == 6
+    assert gen._prefixes[pid]["len"] == 4             # one whole page shared
+    cache.commit_hit(pid)                             # admission succeeded
+    assert cache.hits == 1 and cache.misses == 2
+    assert cache.tokens_saved == 4
+
+    # later prompts keep hitting without re-registering
+    pid2, _ = cache.observe(base + [103])
+    assert pid2 == pid
+
+
+def test_short_prefixes_never_promote():
+    gen = StubGen(page_size=8)
+    cache = RadixPrefixCache(gen, PrefixCacheConfig(promote_hits=1))
+    # shares < page_size + 1 tokens: zero whole pages would be shared
+    for i in range(4):
+        assert cache.observe([1, 2, 3, i + 10]) == (None, 0)
+    assert not gen._prefixes
+
+
+# ------------------------------------------------- borrow-protected eviction
+def test_borrowed_prefix_skipped_for_next_oldest():
+    """ADVICE r5: at the cache cap, a borrowed (refs > 0) LRU candidate is
+    SKIPPED in favor of the next-oldest — never popped-and-stranded."""
+    gen = StubGen(page_size=4)
+    cache = RadixPrefixCache(
+        gen, PrefixCacheConfig(promote_hits=1, max_prefixes=2))
+    pid_a, _ = cache.observe([1, 2, 3, 4, 5, 6])
+    pid_b, _ = cache.observe([21, 22, 23, 24, 25, 26])
+    assert pid_a and pid_b and len(gen._prefixes) == 2
+
+    gen._prefixes[pid_a]["refs"] = 1   # oldest is borrowed by a live slot
+    pid_c, _ = cache.observe([31, 32, 33, 34, 35, 36])
+    assert pid_c is not None
+    assert gen.has_prefix(pid_a)       # the borrowed one survived
+    assert not gen.has_prefix(pid_b)   # next-oldest idle one was dropped
+    assert cache.evictions == 1
+
+    # everything borrowed: promotion declines instead of stranding pages
+    gen._prefixes[pid_c]["refs"] = 1
+    pid_d, _ = cache.observe([41, 42, 43, 44, 45, 46])
+    assert pid_d is None
+    assert gen.has_prefix(pid_a) and gen.has_prefix(pid_c)
+
+
+def test_generator_side_eviction_detected():
+    """A prefix the generator reclaimed under pool pressure is a stale
+    cache entry: the next lookup detects it, counts an eviction, and the
+    still-hot prefix re-registers under a fresh id instead of looping on
+    the dead one."""
+    gen = StubGen(page_size=4)
+    cache = RadixPrefixCache(gen, PrefixCacheConfig(promote_hits=1))
+    pid, _ = cache.observe([1, 2, 3, 4, 5, 6])
+    del gen._prefixes[pid]             # generator-side reclamation
+    pid2, _ = cache.observe([1, 2, 3, 4, 5, 6, 7])
+    assert cache.evictions == 1
+    assert pid2 is not None and pid2 != pid
+    assert gen.has_prefix(pid2)
+
+
+# ------------------------------------------------------------------- metrics
+def test_metrics_counters_exported():
+    counts = {}
+
+    class _Metrics:
+        def add_counter(self, name, delta, **labels):
+            counts[name] = counts.get(name, 0) + delta
+
+    gen = StubGen(page_size=4)
+    cache = RadixPrefixCache(gen, PrefixCacheConfig(promote_hits=2),
+                             metrics=_Metrics(), model="m")
+    base = [5, 6, 7, 8, 9]
+    cache.observe(base + [100])
+    pid, _ = cache.observe(base + [101])   # promotes (5 tokens, 1 page)
+    cache.commit_hit(pid)
+    pid, _ = cache.observe(base + [102])
+    cache.commit_hit(pid)
+    assert counts["app_ml_prefix_misses_total"] == 1
+    assert counts["app_ml_prefix_hits_total"] == 2
+    assert counts["app_ml_prefill_tokens_saved_total"] == 8  # 2 hits x 4
+
+
+# ------------------------------------------- generator reclamation ordering
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.tiny_llama(use_flash=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_pressure_reclaim_unpinned_first_pinned_last(model):
+    """Generator._reclaim_prefix_pages ordering: idle UNPINNED prefixes go
+    first (LRU), PINNED ones only as a last resort, borrowed ones never."""
+    cfg, params = model
+    gen = Generator(params, cfg, batch_slots=2, max_seq=64,
+                    prefill_buckets=(8,), page_size=8, n_pages=8)
+    p_pin = gen.register_prefix([1] * 8, pinned=True)
+    p_auto1 = gen.register_prefix([2] * 8)
+    p_auto2 = gen.register_prefix([3] * 8)
+    p_borrowed = gen.register_prefix([4] * 8)
+    gen._prefixes[p_borrowed]["refs"] = 1
+
+    assert gen._reclaim_prefix_pages(len(gen._free_pages) + 1)
+    assert not gen.has_prefix(p_auto1)         # oldest unpinned went first
+    assert gen.has_prefix(p_pin) and gen.has_prefix(p_auto2)
+
+    assert gen._reclaim_prefix_pages(len(gen._free_pages) + 2)
+    assert not gen.has_prefix(p_auto2)
+    assert not gen.has_prefix(p_pin)           # pinned evicts last of all
+    assert gen.has_prefix(p_borrowed)          # borrowed NEVER evicts
+
+    gen._prefixes[p_borrowed]["refs"] = 0
+    assert not gen._reclaim_prefix_pages(gen.n_pages + 10)  # can't, honest
+
+
+# ------------------------------------------------------------- end to end
+def test_server_equivalence_and_tokens_saved(model, run):
+    """Acceptance bar: with the framework cache on, a repeat request
+    prefills only the suffix (tokens-saved counter moves), outputs are
+    bit-identical to the cache-off path, and the cache shows up in the
+    serving snapshot."""
+    cfg, params = model
+    prefix = [5, 9, 2, 7, 1, 4, 8, 3, 6]      # 9 tokens, page 4
+    suffixes = [[6, 2], [9, 1, 1], [6, 2]]
+
+    async def scenario(cache_on: bool):
+        server = LLMServer(
+            Generator(params, cfg, batch_slots=2, max_seq=64,
+                      prefill_buckets=(8, 16), chunk=2, page_size=4),
+            prefix_cache=None if cache_on else False)
+        try:
+            outs = []
+            for sfx in suffixes:
+                outs.append(await server.generate(prefix + sfx, 5))
+            snap = (server.prefix_cache.snapshot()
+                    if server.prefix_cache else None)
+            return outs, snap
+        finally:
+            server.close()
+
+    plain, no_snap = run(scenario(False))
+    cached, snap = run(scenario(True))
+    assert no_snap is None
+    assert cached == plain                     # bit-identical tokens
+    assert snap["misses"] == 1 and snap["hits"] == 2
+    # every hit skipped the shared whole pages of the 9-token prefix
+    assert snap["prefill_tokens_saved"] == 2 * 8
+    assert snap["prefixes"] and snap["prefixes"][0]["refs"] == 0
+
+
+def test_check_admissible_accepts_cache_covered_long_prompt(model, run):
+    """A prompt longer than the largest prefill bucket is impossible cold
+    (without chunked prefill) — but once its prefix is cached, only the
+    suffix prefills, so check_admissible accepts it and the request
+    decodes exactly like the dense whole-prompt path."""
+    cfg, params = model
+    pfx = list(range(1, 15))               # 14 tokens, page 4
+    long_prompt = pfx + [50, 51, 52, 53]   # 18 > largest bucket (16)
+    dense = Generator(params, cfg, batch_slots=1, max_seq=64,
+                      prefill_buckets=(32,))
+    ref = dense.generate(long_prompt, 5)
+
+    async def scenario():
+        server = LLMServer(Generator(params, cfg, batch_slots=2, max_seq=64,
+                                     prefill_buckets=(8, 16), chunk=2,
+                                     page_size=4))
+        try:
+            with pytest.raises(ValueError):
+                server.check_admissible(long_prompt, 4)   # cold: impossible
+            await asyncio.to_thread(server.register_prefix, pfx)
+            server.check_admissible(long_prompt, 4)       # warm: suffix fits
+            return await server.generate(long_prompt, 5)
+        finally:
+            server.close()
+
+    assert run(scenario()) == ref
+
+
+def test_explicit_pin_survives_cache_churn(model, run):
+    """register_prefix through the server is a PIN on the framework
+    cache: admission with prefix= still works, drop_prefix releases, and
+    a pinned registration outlives unpinned churn."""
+    cfg, params = model
+    pfx = [5, 9, 2, 7, 1, 4, 8, 3]
+
+    async def scenario():
+        server = LLMServer(Generator(params, cfg, batch_slots=2, max_seq=64,
+                                     prefill_buckets=(8, 16), chunk=2,
+                                     page_size=8))
+        try:
+            pid = await asyncio.to_thread(server.register_prefix, pfx)
+            assert server.gen._prefixes[pid]["pinned"]
+            out = await server.generate([6, 2], 5, prefix=pid)
+            ref = await server.generate(pfx + [6, 2], 5)
+            assert out == ref
+            await asyncio.to_thread(server.drop_prefix, pid)
+            assert not server.has_prefix(pid)
+            return True
+        finally:
+            server.close()
+
+    assert run(scenario())
