@@ -28,6 +28,12 @@ Three phases, all in one run so the numbers share the same tunnel weather:
                      prefill), plus the prefill-tokens-saved counter —
                      the north-star millions-of-users-few-system-prompts
                      win, visible in BENCH_*.json.
+  E. scheduler     — adaptive token-budget A/B: mixed load (steady decode
+                     streams + long prompts arriving) served by the
+                     fixed-chunk path (GOFR_ML_TOKEN_BUDGET=0) vs the
+                     adaptive scheduler; short-probe TTFT p50/p99,
+                     steady-stream tok/s, and a greedy token-identity
+                     check between the two boots.
 
 LLAMA_PRESET=1b on TPU by default (the 8B/8-chip per-chip share), tiny on CPU.
 """
@@ -326,6 +332,157 @@ async def main() -> None:
             if app3 is not None:
                 await app3.shutdown()
 
+    # ---- phase E: adaptive token-budget scheduler, fixed vs adaptive ----
+    # Same mixed-load interference as phase C plus STEADY decode streams,
+    # so the number pair is (TTFT under prefill pressure, sustained tok/s):
+    # the adaptive scheduler must improve the former without giving up the
+    # latter. Two boots (fixed via GOFR_ML_TOKEN_BUDGET=0, then adaptive) —
+    # skipped under the headline watchdog budget unless BENCH_SCHED_ARM=1
+    # (bench/run_all.py sets it).
+    sched_arm = None
+    if os.environ.get("BENCH_SCHED_ARM",
+                      "0" if skip_jitter else "1") == "1":
+        steady_new = int(os.environ.get("BENCH_SCHED_STEADY_NEW",
+                                        "128" if on_tpu else "24"))
+        # several segments per long prompt: the scheduler's batched-segment
+        # advantage scales with prefill length, and 3-segment prompts
+        # drown in CPU dispatch noise (7 * 16 = 112 stays inside the tiny
+        # preset's 128-token max_seq with decode room)
+        long_e = int(os.environ.get("BENCH_SCHED_LONG",
+                                    str(long_len) if on_tpu
+                                    else str(7 * seg)))
+        ident_prompt = rng.integers(1, vocab_hi, (prompt_len,)).tolist()
+
+        window_s = float(os.environ.get("BENCH_SCHED_WINDOW_S", "1.6"))
+        reps = int(os.environ.get("BENCH_SCHED_REPS", "2"))
+
+        async def sched_window(gen_fn) -> dict:
+            """One fixed-length window of mixed load: short-probe TTFT +
+            steady-stream tok/s under open-loop long-prompt arrivals (a
+            closed loop would let the faster arm generate more
+            interference for itself and bias the A/B). The window is
+            TIME-bounded so both arms face the same arrival count."""
+            stop = asyncio.Event()
+            steady_tokens = [0]
+            long_done = [0]
+
+            async def steady_loop():
+                while not stop.is_set():
+                    async for msg in gen_fn(req(steady_new)):
+                        steady_tokens[0] += n_toks(msg)
+                        if stop.is_set():
+                            break
+
+            async def one_long():
+                body = {"prompt_ids": rng.integers(
+                            1, vocab_hi, (long_e,)).tolist(),
+                        "max_new_tokens": 4}
+                async for _ in gen_fn(body):
+                    break  # the prefill is the interference
+                long_done[0] += 1
+
+            async def long_loop():
+                pending = []
+                while not stop.is_set():
+                    pending.append(asyncio.create_task(one_long()))
+                    await asyncio.sleep(0.06)
+                for t in pending:
+                    t.cancel()
+                await asyncio.gather(*pending, return_exceptions=True)
+
+            # one of each: with the CPU default of 4 slots, more
+            # interferers would make probe TTFT measure SLOT contention
+            # (admission queueing) instead of dispatch-iteration latency —
+            # the thing the scheduler actually changes
+            steady = [asyncio.create_task(steady_loop())]
+            longs = [asyncio.create_task(long_loop())]
+            ttfts: list[float] = []
+            t0 = time.perf_counter()
+            try:
+                while time.perf_counter() - t0 < window_s:
+                    t1 = time.perf_counter()
+                    async for _ in gen_fn(req(8)):
+                        ttfts.append(time.perf_counter() - t1)
+                        break
+                    await asyncio.sleep(0.05)
+            finally:
+                window = time.perf_counter() - t0
+                stop.set()
+                for t in steady + longs:
+                    t.cancel()
+                await asyncio.gather(*steady, *longs,
+                                     return_exceptions=True)
+            return {
+                "p50_ttft_ms": round(percentile(ttfts, 50) * 1e3, 1),
+                "p99_ttft_ms": round(percentile(ttfts, 99) * 1e3, 1),
+                "steady_tok_s": round(steady_tokens[0] / window, 1),
+                "long_prompts_served": long_done[0],
+                "probes": len(ttfts),
+            }
+
+        async def sched_phase(gen_fn) -> dict:
+            """Best of ``reps`` windows by steady tok/s — the same
+            selection rule for both arms picks each arm's least
+            OS-interfered window (this box shares 2 cores between the
+            serving thread, the event loop, and XLA; single windows swing
+            ~2x run to run)."""
+            runs = [await sched_window(gen_fn) for _ in range(reps)]
+            return max(runs, key=lambda r: r["steady_tok_s"])
+
+        arms: dict = {}
+        ident_tokens: dict = {}
+        for mode in ("fixed", "adaptive"):
+            os.environ["LLM_PREFILL_CHUNK"] = str(seg)
+            if mode == "fixed":
+                os.environ["GOFR_ML_TOKEN_BUDGET"] = "0"
+            else:
+                os.environ.pop("GOFR_ML_TOKEN_BUDGET", None)  # auto
+            appE = chE = None
+            try:
+                appE = build_app()
+                await boot(appE)
+                chE = grpc.aio.insecure_channel(
+                    f"127.0.0.1:{ports['GRPC_PORT']}")
+                genE = chE.unary_stream(
+                    "/llm.Chat/Generate",
+                    request_serializer=lambda o: json.dumps(o).encode(),
+                    response_deserializer=lambda raw: (json.loads(raw)
+                                                       if raw else {}),
+                )
+                async for _ in genE(req(4)):        # warm compiles
+                    pass
+                warm_long = {"prompt_ids": rng.integers(
+                                 1, vocab_hi, (long_e,)).tolist(),
+                             "max_new_tokens": 4}
+                async for _ in genE(warm_long):     # warm segment program
+                    pass
+                toks: list = []
+                async for msg in genE({"prompt_ids": ident_prompt,
+                                       "max_new_tokens": 16}):
+                    toks.extend(msg.get("tokens", ()))
+                ident_tokens[mode] = toks
+                arms[mode] = await sched_phase(genE)
+            except Exception as exc:    # optional arm: record, don't abort
+                arms[mode] = {"error": str(exc)}
+            finally:
+                os.environ.pop("GOFR_ML_TOKEN_BUDGET", None)
+                os.environ.pop("LLM_PREFILL_CHUNK", None)
+                if chE is not None:
+                    await chE.close()
+                if appE is not None:
+                    await appE.shutdown()
+        sched_arm = {
+            "prefill_chunk": seg,
+            "long_prompt_len": long_e,
+            "fixed": arms.get("fixed"),
+            "adaptive": arms.get("adaptive"),
+            # bit-identity of the greedy probe across the two boots — the
+            # scheduler only reshapes dispatches, never tokens
+            "tokens_identical": (ident_tokens.get("fixed")
+                                 == ident_tokens.get("adaptive")
+                                 if len(ident_tokens) == 2 else None),
+        }
+
     agg_tok_s = sum(token_counts) / elapsed
     emit(
         "llama_served_tok_per_s", agg_tok_s, "tok/s", 2000.0,
@@ -363,6 +520,10 @@ async def main() -> None:
             # phase D: shared-system-prompt arm — prefix cache cold vs warm
             "prefix_cache": (prefix_arm if prefix_arm is not None
                              else "skipped (headline budget)"),
+            # phase E: adaptive token-budget scheduler, fixed vs adaptive
+            # mixed-load TTFT/throughput + token identity
+            "scheduler": (sched_arm if sched_arm is not None
+                          else "skipped (headline budget)"),
             "preset": os.environ.get("LLAMA_PRESET", "tiny"),
             "backend": jax.default_backend(),
             "config": 4,

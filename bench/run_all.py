@@ -14,15 +14,18 @@ import subprocess
 import sys
 import time
 
+# (script, extra env) — per-config env keeps optional arms on in the suite
+# runs even when a config's own defaults would skip them under a tighter
+# budget (config4 phase E: the adaptive-scheduler fixed-vs-adaptive A/B)
 CONFIGS = [
-    "config1_echo.py",
-    "config2_mnist.py",
-    "config3_bert.py",
-    "config4_llama.py",
-    "config5_sdxl.py",
-    "config6_compute.py",
-    "config7_longcontext.py",
-    "config8_speculative.py",
+    ("config1_echo.py", {}),
+    ("config2_mnist.py", {}),
+    ("config3_bert.py", {}),
+    ("config4_llama.py", {"BENCH_SCHED_ARM": "1"}),
+    ("config5_sdxl.py", {}),
+    ("config6_compute.py", {}),
+    ("config7_longcontext.py", {}),
+    ("config8_speculative.py", {}),
 ]
 
 
@@ -33,11 +36,12 @@ def main() -> None:
         out_path = sys.argv[sys.argv.index("--out") + 1]
 
     results = []
-    for name in CONFIGS:
+    for name, extra_env in CONFIGS:
         t0 = time.time()
         proc = subprocess.run(
             [sys.executable, os.path.join(here, name)],
             capture_output=True, text=True, timeout=1200, cwd=here,
+            env={**os.environ, **extra_env},
         )
         parsed = None
         for line in reversed(proc.stdout.strip().splitlines()):

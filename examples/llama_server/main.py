@@ -16,6 +16,7 @@ import os
 import gofr_tpu
 from gofr_tpu.grpc import JSONService
 from gofr_tpu.ml.generate import Sampler
+from gofr_tpu.ml.scheduler import normalize_priority
 from gofr_tpu.models import llama
 from gofr_tpu.native.tokenizer import BPETokenizer
 
@@ -53,13 +54,22 @@ def _admissible(llm, ids, max_new) -> None:
         raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
 
 
+def _priority(body) -> int:
+    """Admission class from the request body (``"priority": "high" |
+    "normal" | "low"``); unknown values answer 400, not a demotion."""
+    try:
+        return normalize_priority(body.get("priority"))
+    except ValueError as exc:
+        raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
+
+
 async def generate(ctx: gofr_tpu.Context):
     body = await ctx.bind()
     ids = _prompt_ids(body)
     max_new = int(body.get("max_new_tokens", 64))
     llm = ctx.ml.llm("chat")
     _admissible(llm, ids, max_new)
-    tokens = await llm.generate(ids, max_new)
+    tokens = await llm.generate(ids, max_new, priority=_priority(body))
     out = {"tokens": tokens}
     if body.get("prompt"):  # text in -> text out
         out["text"] = TOKENIZER.decode(tokens)
@@ -72,7 +82,7 @@ async def stream_ws(ctx: gofr_tpu.Context):
     llm = ctx.ml.llm("chat")
     max_new = int(body.get("max_new_tokens", 64))
     _admissible(llm, ids, max_new)
-    async for tok in llm.stream(ids, max_new):
+    async for tok in llm.stream(ids, max_new, priority=_priority(body)):
         await ctx.write_message_to_socket({"token": tok})
     return {"done": True}
 
@@ -124,7 +134,8 @@ def main() -> gofr_tpu.App:
         max_new = int(request.get("max_new_tokens", 64))
         _admissible(llm, request["prompt_ids"], max_new)
         async for burst in llm.stream_chunks(request["prompt_ids"],
-                                             max_new):
+                                             max_new,
+                                             priority=_priority(request)):
             yield {"tokens": burst}
 
     svc.stream("Generate", grpc_generate)

@@ -20,6 +20,7 @@ import uuid
 
 import gofr_tpu
 from gofr_tpu.ml.generate import Sampler
+from gofr_tpu.ml.scheduler import normalize_priority
 from gofr_tpu.models import llama
 from gofr_tpu.native.tokenizer import BPETokenizer
 
@@ -102,6 +103,18 @@ def _admissible_or_400(llm, ids, max_new) -> None:
         raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
 
 
+def _priority_or_400(ctx) -> int:
+    """Admission class from the ``X-Request-Priority`` header (``high`` /
+    ``normal`` / ``low``) — the OpenAI wire format has no priority field,
+    so the transport carries it out-of-band; an API gateway typically
+    stamps it per tenant/tier. Unknown values answer 400."""
+    raw = ctx.headers.get("X-Request-Priority")
+    try:
+        return normalize_priority(raw)
+    except ValueError as exc:
+        raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
+
+
 def _openai_finish(info: dict, n_out: int, max_new: int) -> str:
     """Map the LLM server's finish reason onto OpenAI's vocabulary. An
     evicted (pool-dry, truncated) answer reports "length" — never the
@@ -141,6 +154,7 @@ async def chat_completions(ctx: gofr_tpu.Context):
     ids = TOKENIZER.encode(_render_chat(messages))
     n_prompt = len(ids)
     _admissible_or_400(llm, ids, max_new)
+    prio = _priority_or_400(ctx)
     rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
     created = int(time.time())
 
@@ -155,7 +169,8 @@ async def chat_completions(ctx: gofr_tpu.Context):
             # one SSE chunk per decode-chunk burst (a delta may carry
             # several tokens' text — valid OpenAI protocol, far fewer
             # frames)
-            async for burst in llm.stream_chunks(ids, max_new, info=fin):
+            async for burst in llm.stream_chunks(ids, max_new, info=fin,
+                                                 priority=prio):
                 n_out += len(burst)
                 await stream.send(_chunk(
                     "chat.completion.chunk", rid, created,
@@ -180,7 +195,7 @@ async def chat_completions(ctx: gofr_tpu.Context):
 
     fin: dict = {}
     try:
-        toks = await llm.generate(ids, max_new, info=fin)
+        toks = await llm.generate(ids, max_new, info=fin, priority=prio)
     except ValueError as exc:
         # backstop for admission races between the up-front check and the
         # serving thread's admit
@@ -214,6 +229,7 @@ async def completions(ctx: gofr_tpu.Context):
                 "prompt (batch/token-array prompts unsupported: send one string)")
     ids, max_new, llm = _prepare(ctx, prompt, body)
     _admissible_or_400(llm, ids, max_new)
+    prio = _priority_or_400(ctx)
     rid = f"cmpl-{uuid.uuid4().hex[:24]}"
     created = int(time.time())
 
@@ -222,7 +238,8 @@ async def completions(ctx: gofr_tpu.Context):
             n_out = 0
             dec = _StreamDecoder()
             fin: dict = {}
-            async for burst in llm.stream_chunks(ids, max_new, info=fin):
+            async for burst in llm.stream_chunks(ids, max_new, info=fin,
+                                                 priority=prio):
                 n_out += len(burst)
                 await stream.send(_chunk(
                     "text_completion", rid, created,
@@ -239,7 +256,7 @@ async def completions(ctx: gofr_tpu.Context):
 
     fin: dict = {}
     try:
-        toks = await llm.generate(ids, max_new, info=fin)
+        toks = await llm.generate(ids, max_new, info=fin, priority=prio)
     except ValueError as exc:
         raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
     return gofr_tpu.Raw({

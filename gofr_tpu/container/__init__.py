@@ -140,6 +140,20 @@ class Container:
                       "cached prefixes dropped (cap or pool pressure)")
         m.new_counter("app_ml_prefill_tokens_saved_total",
                       "prompt tokens NOT re-prefilled thanks to prefix reuse")
+        m.new_histogram(
+            "app_llm_priority_queue_seconds",
+            "LLM request wait before slot admission per priority class",
+        )
+        m.new_histogram(
+            "app_llm_chunk_tokens",
+            "decode steps per dispatch picked from the chunk ladder",
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+        )
+        m.new_gauge("app_llm_token_budget",
+                    "per-dispatch token budget (decode + chunked prefill)")
+        m.new_gauge("app_llm_prefill_share",
+                    "budget fraction reserved for chunked prefill "
+                    "(SLO-steered)")
         m.new_gauge("app_llm_evictions",
                     "streams truncated because the KV page pool ran dry")
         m.new_gauge("app_llm_prefix_evictions",

@@ -41,6 +41,10 @@ _LATENCY_HISTOGRAMS = (
     "app_llm_ttft_seconds",
     "app_llm_tpot_seconds",
 )
+# queue wait per admission-priority class: label sets are (model, priority),
+# so the per-model loop above can't reach them — resolved separately against
+# the scheduler's own class list
+_PRIORITY_HISTOGRAM = "app_llm_priority_queue_seconds"
 _QUANTILES = (0.5, 0.95, 0.99)
 
 # the jax profiler is process-global state: one capture at a time, ever
@@ -67,6 +71,26 @@ def _histogram_percentiles(manager, model_names) -> dict:
             vals = {k: v for k, v in vals.items() if not math.isnan(v)}
             if vals:
                 out.setdefault(name, {})[model] = vals
+    if manager.has(_PRIORITY_HISTOGRAM) and model_names:
+        # the scheduler's class list is the single source of truth for the
+        # label values; imported lazily — pulling in gofr_tpu.ml at module
+        # scope would cost every app jax's import time at startup
+        from .ml.scheduler import PRIORITIES
+        for model in model_names:
+            for prio in PRIORITIES:
+                try:
+                    vals = {
+                        f"p{int(q * 100)}": manager.percentile(
+                            _PRIORITY_HISTOGRAM, q, model=model,
+                            priority=prio)
+                        for q in _QUANTILES
+                    }
+                except Exception:
+                    continue
+                vals = {k: v for k, v in vals.items() if not math.isnan(v)}
+                if vals:
+                    out.setdefault(_PRIORITY_HISTOGRAM, {}).setdefault(
+                        model, {})[prio] = vals
     return out
 
 

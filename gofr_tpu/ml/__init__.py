@@ -225,6 +225,10 @@ class MLDatasource:
             if getattr(server, "prefix_cache", None) is not None:
                 # prefix lengths, refcounts, hit counts + lifetime totals
                 entry["prefix_cache"] = server.prefix_cache.snapshot()
+            if hasattr(server, "scheduler_snapshot"):
+                # token budget, chunk-size mix, SLO steering state, and
+                # per-priority ready-queue depth/age
+                entry["scheduler"] = server.scheduler_snapshot()
             snap["llms"][name] = entry
         return snap
 

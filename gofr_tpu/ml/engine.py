@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..tracing import current_context
+from .scheduler import maybe_enable_compilation_cache
 
 __all__ = ["Engine", "EngineConfig"]
 
@@ -81,6 +82,10 @@ class Engine:
         self._metrics = metrics
         self._tracer = tracer
         self.backend = backend
+        # GOFR_ML_COMPILATION_CACHE_DIR: persistent XLA compilation cache —
+        # restarts load the shape-bucket executables from disk instead of
+        # recompiling them (same knob Generator.warmup honors)
+        maybe_enable_compilation_cache()
         self.compiled_buckets: set[int] = set()  # batch dims seen on device
         if backend == "pjrt":
             # native PJRT C-API path: jax traces, our binding executes

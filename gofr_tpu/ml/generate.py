@@ -27,14 +27,32 @@ from __future__ import annotations
 
 import collections
 import functools
+import logging
+import os
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .scheduler import TokenBudgetScheduler, maybe_enable_compilation_cache
+
 __all__ = ["Sampler", "sample_logits", "greedy", "Generator",
            "PagePoolExhausted", "PrefixEvicted"]
+
+_log = logging.getLogger("gofr_tpu.ml.generate")
+
+
+def _chunk_ladder(chunk: int) -> tuple[int, ...]:
+    """Power-of-two dispatch sizes up to ``chunk`` (always including 1 and
+    ``chunk`` itself): the pre-jitted decode programs the budget scheduler
+    picks from. 16 -> (1, 2, 4, 8, 16); 3 -> (1, 2, 3)."""
+    ladder = [1]
+    while ladder[-1] * 2 < chunk:
+        ladder.append(ladder[-1] * 2)
+    if chunk > 1:
+        ladder.append(chunk)
+    return tuple(ladder)
 
 
 class PagePoolExhausted(RuntimeError):
@@ -136,7 +154,8 @@ class Generator:
                  shard_cache: bool = False, spec_k: int = 0,
                  spec_ngram: int = 3, page_size: int = 0,
                  n_pages: int | None = None, draft_params: Any = None,
-                 draft_cfg: Any = None, prefill_chunk: int = 0) -> None:
+                 draft_cfg: Any = None, prefill_chunk: int = 0,
+                 token_budget: int | None = None) -> None:
         import contextlib
 
         from ..models import llama
@@ -182,7 +201,9 @@ class Generator:
                     f"max_seq {max_seq} must be a multiple of "
                     f"prefill_chunk {self.prefill_chunk}")
         self._chunked: dict[int, dict] = {}   # slot -> chunked-prefill state
-        self._chunked_order: list[int] = []   # round-robin across slots
+        # round-robin across slots; deque: the hot path pops the head every
+        # interleaved segment (list.pop(0) is O(n) and this runs per chunk)
+        self._chunked_order: collections.deque[int] = collections.deque()
         self.evictions = 0  # slots truncated because the page pool ran dry
         if self.page_size:
             # Block-paged KV cache (llama.init_paged_cache): a shared page
@@ -280,6 +301,12 @@ class Generator:
         self._inflight: collections.deque = collections.deque()  # [chunk, B] arrays
         self._pending_first: collections.deque = collections.deque()  # (slot, dev scalar)
         self.steps = 0
+        # async-prefetch failures (satellite: the bare except around
+        # copy_to_host_async must be observable — a broken prefetch path
+        # degrades every dispatch silently otherwise)
+        self.prefetch_errors = 0
+        self._prefetch_warned = False
+        self.prefill_segments_run = 0  # chunked-prefill segments dispatched
 
         sampler_cfg = self.sampler
         host_visible = self._host_visible
@@ -332,12 +359,38 @@ class Generator:
             return jax.jit(paged_chunk_fn if self.page_size else chunk_fn,
                            donate_argnums=(2,))
 
-        self._chunk_fn = make_chunk_fn(self.chunk)
+        # Pre-jitted chunk ladder: one decode program per power-of-two size
+        # up to `chunk`. The fixed path only ever uses `chunk` and the
+        # 1-step TTFT mini-chunk; the token-budget scheduler picks the
+        # ladder entry that fills the per-dispatch budget given live slots.
+        self._chunk_ladder = _chunk_ladder(self.chunk)
+        self._chunk_fns = {n: make_chunk_fn(n) for n in self._chunk_ladder}
+        self._chunk_fn = self._chunk_fns[self.chunk]
         # TTFT path: a 1-step mini-chunk dispatched while first tokens are
         # pending, so a new request's first token reaches the host ~one full
         # chunk earlier instead of waiting out `chunk` decode steps.
-        self._mini_chunk_fn = self._chunk_fn if self.chunk == 1 \
-            else make_chunk_fn(1)
+        self._mini_chunk_fn = self._chunk_fns[1]
+        # Adaptive token budget: None -> env GOFR_ML_TOKEN_BUDGET
+        # ("auto"/unset picks a default; "0" disables). 0/negative ->
+        # fixed-chunk dispatch. The auto budget guarantees two invariants
+        # at the neutral 0.5 split: the decode share stays >= chunk *
+        # batch_slots (budget >= 2 * chunk * slots, so the steady-state
+        # dispatch never shrinks below the fixed path's while a prompt
+        # prefills), and a light batch can still fit two prefill segments
+        # in the remainder (budget >= decode cost + 2 * prefill_chunk) —
+        # a budget equal to the decode cost alone would make the
+        # scheduler strictly pay overhead without buying prefill progress.
+        if token_budget is None:
+            raw = os.environ.get("GOFR_ML_TOKEN_BUDGET", "auto")
+            token_budget = (max(2 * self.chunk * batch_slots,
+                                self.chunk * batch_slots
+                                + 2 * self.prefill_chunk)
+                            if raw.strip().lower() in ("", "auto")
+                            else int(raw))
+        self.scheduler = (
+            TokenBudgetScheduler(token_budget, self._chunk_ladder,
+                                 self.prefill_chunk, slots=batch_slots)
+            if token_budget > 0 else None)
 
         def post_prefill(tok_dev, logits, prefill_key, n_req, slot):
             """Sample the first token and park it in the device-resident
@@ -600,9 +653,13 @@ class Generator:
 
             return jax.jit(spec_chunk_fn, donate_argnums=(2, 3, 4))
 
-        self._chunk_fn = make_spec_chunk_fn(self.chunk)
-        self._mini_chunk_fn = self._chunk_fn if self.chunk == 1 \
-            else make_spec_chunk_fn(1)
+        # spec mode replaces the whole ladder: entries are verify WINDOWS
+        # (each emits 1..K+1 tokens); the budget scheduler plans in window
+        # units, which keeps the decode/prefill split meaningful
+        self._chunk_fns = {n: make_spec_chunk_fn(n)
+                           for n in self._chunk_ladder}
+        self._chunk_fn = self._chunk_fns[self.chunk]
+        self._mini_chunk_fn = self._chunk_fns[1]
 
         def spec_post_prefill(tok_dev, tokens_dev, logits, prompt, lens,
                               slot):
@@ -778,6 +835,8 @@ class Generator:
             "decode_steps": self.steps,
             "evictions": self.evictions,
             "chunked_prefills": len(self._chunked),
+            "prefill_segments": self.prefill_segments_run,
+            "prefetch_errors": self.prefetch_errors,
         }
         if self.page_size:
             out.update(
@@ -1026,10 +1085,27 @@ class Generator:
         compile would land on exactly the TTFT path the mini-chunk exists
         to shorten. All slots are dead during warmup, so the sampled
         garbage never reaches bookkeeping; admission overwrites slot state.
+
+        With the token-budget scheduler active, EVERY ladder entry compiles
+        here (any size may be dispatched under load); the fixed path keeps
+        its two-program warmup. GOFR_ML_COMPILATION_CACHE_DIR points jax's
+        persistent compilation cache at a directory so restarts load the
+        (now larger) ladder from disk instead of recompiling it.
         """
-        fns = [self._chunk_fn]
-        if self._mini_chunk_fn is not self._chunk_fn:
-            fns.append(self._mini_chunk_fn)
+        maybe_enable_compilation_cache()
+        if self.scheduler is not None and (
+                self.prefill_chunk
+                or self.scheduler.budget < self.chunk * self.batch_slots):
+            # any ladder entry may be dispatched under load — compile them
+            # all, largest first (the steady-state program is hot soonest)
+            fns = [self._chunk_fns[n] for n in reversed(self._chunk_ladder)]
+        else:
+            # without chunked prefill (and with a budget covering the full
+            # batch) plan() provably always picks `chunk`: the intermediate
+            # ladder entries are unreachable — don't pay their compiles
+            fns = [self._chunk_fn]
+            if self._mini_chunk_fn is not self._chunk_fn:
+                fns.append(self._mini_chunk_fn)
         with self._mesh_ctx():
             for fn in fns:
                 if self.spec_k and self.page_size:
@@ -1295,22 +1371,33 @@ class Generator:
             s.live and i not in self._chunked
             for i, s in enumerate(self.slots))
 
-    def _advance_chunked(self) -> None:
-        """Run the next prefill segment for one chunked slot (round-robin).
-        While nothing is decodable the segments run back-to-back — no
-        reason to interleave garbage decode chunks into an idle batch."""
+    def _n_decodable(self) -> int:
+        """Slots producing tokens this dispatch — the scheduler's live-work
+        count (a slot mid-chunked-prefill decodes garbage, not tokens)."""
+        return sum(1 for i, s in enumerate(self.slots)
+                   if s.live and i not in self._chunked)
+
+    def _advance_chunked(self, max_segments: int = 1) -> None:
+        """Run up to ``max_segments`` prefill segments across the chunked
+        slots (round-robin) before the next decode dispatch. The fixed path
+        interleaves exactly one; the token-budget scheduler passes the
+        budget's remainder — several segments when decode is light, the
+        single stall-free minimum when decode is saturated. While nothing
+        is decodable the segments run back-to-back regardless — no reason
+        to interleave garbage decode chunks into an idle batch."""
+        done = 0
         while self._chunked_order:
             slot = self._chunked_order[0]
             st = self._chunked.get(slot)
             if st is None:
                 # released elsewhere: drop ONLY the order entry — the slot
                 # may already host an unrelated new request
-                self._chunked_order.pop(0)
+                self._chunked_order.popleft()
                 continue
             if not self.slots[slot].live:
                 # cancelled mid-prefill: drop the bookkeeping
                 self._chunked.pop(slot, None)
-                self._chunked_order.pop(0)
+                self._chunked_order.popleft()
                 continue
             C = self.prefill_chunk
             start = st["done"]
@@ -1328,7 +1415,7 @@ class Generator:
                 if not self._alloc_pages_to(slot, start + len(seg)):
                     self.drain()
                     self._chunked.pop(slot)
-                    self._chunked_order.pop(0)
+                    self._chunked_order.popleft()
                     self.slots[slot].live = False
                     self.slots[slot].evicted = True
                     self.evictions += 1
@@ -1351,13 +1438,14 @@ class Generator:
                         self.params, toks, lens, self.cache, np.int32(slot),
                         np.int32(start), new_len)
             st["done"] += len(seg)
+            self.prefill_segments_run += 1
             if final:
                 # flush decode chunks dispatched while this slot was
                 # mid-prefill FIRST: their garbage rows for the slot must
                 # be dropped while the _chunked guard still holds
                 self.drain()
                 self._chunked.pop(slot)
-                self._chunked_order.pop(0)
+                self._chunked_order.popleft()
                 self._n_requests += 1
                 self._pending_first.append(slot)
                 self.slots[slot].produced = 1  # the pending first token
@@ -1371,9 +1459,14 @@ class Generator:
                 else:
                     self._after_prefill(logits, toks, lens, np.int32(slot))
             else:
-                self._chunked_order.append(self._chunked_order.pop(0))
-            if self._decodable():
-                return  # one segment per decode chunk: keep streams warm
+                self._chunked_order.append(self._chunked_order.popleft())
+            done += 1
+            if self._decodable() and (done >= max_segments
+                                      or self._pending_first):
+                # budget spent — or a final segment just queued a first
+                # token: surface it via the mini-chunk NOW instead of
+                # burning the remaining segment allowance on its TTFT
+                return
 
     def _admit_waves(self, prepped, out: list[int]) -> list[int]:
         for start in range(0, len(prepped), self._admit_cap):
@@ -1511,14 +1604,30 @@ class Generator:
 
     # -- decode ---------------------------------------------------------------
     def step(self) -> None:
-        """Dispatch one ``chunk`` of decode steps; process the previous
+        """Dispatch one chunk of decode steps; process the previous
         chunk's tokens (host bookkeeping lags one dispatch — the device
-        never waits for the ~40 ms tunnel round-trip)."""
+        never waits for the ~40 ms tunnel round-trip).
+
+        With the token-budget scheduler active, each dispatch spends ONE
+        budget: segmented prefill consumes its planned share first (several
+        segments when decode is light), then decode dispatches the ladder
+        entry that fills the rest given the live decodable slots. Without
+        it, exactly the fixed ``chunk`` program plus one interleaved
+        prefill segment — the original behavior. Greedy outputs are
+        bit-identical either way; sampling keys fold the ABSOLUTE step
+        counter, so sampled outputs also match whenever requests land on
+        the same steps (a shifted interleave under concurrent sampled
+        traffic redraws from the same distribution)."""
         if self.n_live == 0:
             self.drain()
             return
+        sched = self.scheduler
+        n_steps = self.chunk
+        if sched is not None:
+            n_steps, n_segments = sched.plan(self._n_decodable(),
+                                             bool(self._chunked))
         if self._chunked:
-            self._advance_chunked()
+            self._advance_chunked(n_segments if sched is not None else 1)
             if not self._decodable():
                 return  # everything live is still mid-prefill
         # Pending first tokens -> ONE 1-step mini-chunk so they surface a
@@ -1527,7 +1636,18 @@ class Generator:
         # the mini path drains synchronously below, so pending_first is
         # empty again before the next step() call.
         mini = bool(self._pending_first)
-        fn = self._mini_chunk_fn if mini else self._chunk_fn
+        if mini:
+            n_steps = 1
+            fn = self._mini_chunk_fn
+            if sched is not None:
+                # admission-driven, not a ladder pick: kept out of the
+                # dispatch-size mix so it can't read as 1-step collapse
+                sched.mini_dispatches += 1
+        elif sched is not None:
+            fn = self._chunk_fns[n_steps]
+            sched.note_dispatch(n_steps)
+        else:
+            fn = self._chunk_fn
         with self._mesh_ctx():
             if self.spec_k:
                 if self.page_size:
@@ -1555,7 +1675,7 @@ class Generator:
                     np.int32(self.steps), self._base_key,
                 )
                 item = toks
-        self.steps += 1 if mini else self.chunk
+        self.steps += n_steps
         try:
             # best-effort prefetch; on transports where this is itself a
             # blocking transfer (the axon tunnel) the cost is the same as
@@ -1563,8 +1683,18 @@ class Generator:
             # below is what keeps the device busy while the host reads.
             for arr in (item if isinstance(item, tuple) else (item,)):
                 arr.copy_to_host_async()
-        except Exception:
-            pass
+        except Exception as exc:
+            # losing the prefetch only costs latency (the blocking asarray
+            # in _process still lands the tokens), but a transport whose
+            # prefetch path broke should be visible, not silent: count
+            # every failure, log the first once per generator
+            self.prefetch_errors += 1
+            if not self._prefetch_warned:
+                self._prefetch_warned = True
+                _log.debug(
+                    "token prefetch (copy_to_host_async) failed; falling "
+                    "back to blocking reads [%s: %s]",
+                    type(exc).__name__, exc)
         self._inflight.append(item)
         if mini:
             # TTFT: the chunk carrying new requests' first tokens is read

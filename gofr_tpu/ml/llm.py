@@ -23,6 +23,7 @@ remains as the pinning API on top.
 from __future__ import annotations
 
 import asyncio
+import os
 import queue as _queue
 import threading
 import time
@@ -31,6 +32,8 @@ from typing import Any, AsyncIterator
 from ..tracing import current_context
 from .generate import PagePoolExhausted, PrefixEvicted
 from .prefix_cache import PrefixCacheConfig, RadixPrefixCache
+from .scheduler import (PRIORITIES, AgingPriorityQueue, SLOController,
+                        normalize_priority)
 
 __all__ = ["LLMServer"]
 
@@ -52,15 +55,18 @@ class _Finish:
 class _Request:
     __slots__ = ("prompt", "max_new", "out_q", "loop", "enqueued_at", "slot",
                  "first_token_at", "cancelled", "prefix", "trace_ctx",
-                 "queue_span", "decode_span", "full_prompt", "cache_seen")
+                 "queue_span", "decode_span", "full_prompt", "cache_seen",
+                 "priority", "last_burst_at")
 
     def __init__(self, prompt, max_new, out_q, loop, prefix=None,
-                 trace_ctx=None, queue_span=None) -> None:
+                 trace_ctx=None, queue_span=None, priority: int = 1) -> None:
         self.prompt = prompt
         self.max_new = max_new
         self.out_q = out_q
         self.loop = loop
+        self.priority = priority  # class index into scheduler.PRIORITIES
         self.enqueued_at = time.perf_counter()
+        self.last_burst_at = None  # SLO controller's live-cadence anchor
         self.slot = None
         self.first_token_at = None
         self.cancelled = False  # consumer went away: stop decoding the slot
@@ -113,7 +119,18 @@ class LLMServer:
         self._admit_window = admit_window_s
         self._requests: _queue.Queue[_Request | None] = _queue.Queue()
         self._setup_q: _queue.Queue = _queue.Queue()  # run-on-serving-thread
-        self._waiting: list[_Request] = []
+        # priority admission: weighted ready queues with aging (strict FIFO
+        # within a class, starvation-free across classes)
+        self._waiting = AgingPriorityQueue(
+            aging_s=float(os.environ.get("GOFR_ML_PRIORITY_AGING_S", "2.0")))
+        # SLO steering: when the generator runs the token-budget scheduler,
+        # close the loop from observed TTFT/TPOT percentiles to the
+        # prefill/decode budget split (targets from GOFR_ML_TTFT_TARGET_MS
+        # / GOFR_ML_TPOT_TARGET_MS). Serving-thread-only state.
+        self._controller = (
+            SLOController.from_env(generator.scheduler)
+            if getattr(generator, "scheduler", None) is not None else None)
+        self._steered_dispatches = -1  # ladder dispatches recorded so far
         self._active: dict[int, _Request] = {}
         self._closed = False
         self.served = 0
@@ -137,6 +154,7 @@ class LLMServer:
             if self.gen.n_live:
                 self.gen.step()
                 self._finish_dead_slots()
+                self._steer()
             else:
                 self.gen.drain()
                 self._finish_dead_slots()
@@ -156,7 +174,7 @@ class LLMServer:
                 self._idle_backoff = self._idle_wait
                 if req is None:
                     return
-                self._waiting.append(req)
+                self._waiting.push(req)
                 # collect the rest of the burst before admitting: concurrent
                 # clients arrive over a few ms, and one wave (one batched
                 # prefill + one mini-chunk) gives every stream the first
@@ -173,7 +191,7 @@ class LLMServer:
                     if more is None:
                         self._closed = True
                         return
-                    self._waiting.append(more)
+                    self._waiting.push(more)
 
     def _run_setup_tasks(self) -> None:
         """Drain device-touching setup work (e.g. register_prefix) onto
@@ -256,13 +274,34 @@ class LLMServer:
         pressure — callers re-register before admitting suffix-only ids."""
         return self.gen.has_prefix(pid)
 
+    def _steer(self) -> None:
+        """One controller pass per serve-loop iteration: record the realized
+        dispatch size and, at most every controller interval, re-steer the
+        prefill share from the observed TTFT/TPOT windows."""
+        sched = getattr(self.gen, "scheduler", None)
+        if sched is None:
+            return
+        dispatched = sum(sched.dispatches.values())
+        if self._metrics is not None and dispatched != self._steered_dispatches:
+            # only when step() made a LADDER dispatch — prefill-only
+            # passes and TTFT mini-chunks must not re-count the previous
+            # chunk size
+            self._steered_dispatches = dispatched
+            try:
+                self._metrics.record_histogram(
+                    "app_llm_chunk_tokens", float(sched.last_chunk),
+                    model=self.name)
+            except Exception:
+                pass
+        if self._controller is not None:
+            self._controller.maybe_update()
+
     def _flush_on_close(self) -> None:
         """The serving thread is exiting: every parked or still-queued
         consumer must be woken with an error + _DONE, or its
         ``await out_q.get()`` blocks forever."""
         self._closed = True
-        leftovers = list(self._waiting)
-        self._waiting = []
+        leftovers = self._waiting.drain()
         while True:
             try:
                 req = self._requests.get_nowait()
@@ -292,8 +331,8 @@ class LLMServer:
             if req is None:
                 self._closed = True
                 return
-            self._waiting.append(req)
-        while self._waiting:
+            self._waiting.push(req)
+        while len(self._waiting):
             if self.gen.free_slot() is None:
                 # no admission possible: break WITHOUT draining, so the
                 # chunk-decode pipeline stays one dispatch deep under
@@ -319,8 +358,11 @@ class LLMServer:
             if getattr(self.gen, "page_size", 0):
                 n_free = min(n_free, 1)
             batch, rejected = [], []
-            while self._waiting and len(batch) < n_free:
-                req = self._waiting.pop(0)
+            while len(self._waiting) and len(batch) < n_free:
+                # weighted-priority pop with aging, not FIFO: high beats
+                # normal beats low, but a parked request gains one class
+                # per aging interval so nothing starves
+                req = self._waiting.pop()
                 try:
                     ids = self._validate(req)
                 except Exception as exc:
@@ -362,7 +404,7 @@ class LLMServer:
                     req.prompt = req.full_prompt
                     req.prefix = None
                     req.full_prompt = None
-                    self._waiting.insert(0, req)
+                    self._waiting.push_front(req)
                     continue
                 # explicitly-passed prefix: the caller owns re-registration
                 req.finish_spans("ERROR", str(exc))
@@ -371,9 +413,11 @@ class LLMServer:
                 continue
             except PagePoolExhausted:
                 # transient paged-KV back-pressure: pages free as live
-                # slots finish, so requeue the whole batch (front, FIFO)
-                # and let decode progress instead of erroring clients
-                self._waiting[:0] = [req for req, _ in batch]
+                # slots finish, so requeue the whole batch at the FRONT of
+                # each request's class (retry order preserved) and let
+                # decode progress instead of erroring clients
+                for req, _ in reversed(batch):
+                    self._waiting.push_front(req)
                 break
             except Exception as exc:  # device-side failure: relay to all
                 for req, _ in batch:
@@ -402,6 +446,13 @@ class LLMServer:
                         self._metrics.record_histogram(
                             "app_llm_queue_seconds",
                             now - req.enqueued_at, model=self.name,
+                        )
+                        # per-class wait: the series an operator verifies
+                        # priority admission (and aging) against
+                        self._metrics.record_histogram(
+                            "app_llm_priority_queue_seconds",
+                            now - req.enqueued_at, model=self.name,
+                            priority=PRIORITIES[req.priority],
                         )
                     except Exception:
                         pass
@@ -445,8 +496,20 @@ class LLMServer:
         to the consumer — ONE loop wakeup per burst, not per token. At 64
         streams x chunk 16 the per-token version was ~38k
         ``call_soon_threadsafe`` wakeups/s on the event loop thread."""
+        now = time.perf_counter()
+        if (self._controller is not None and tokens
+                and req.last_burst_at is not None):
+            # live cadence per burst: waiting for stream FINISH would leave
+            # the controller TPOT-blind (and decode unprotected) for the
+            # whole lifetime of a long stream
+            self._controller.observe_tpot(
+                (now - req.last_burst_at) / len(tokens))
+        req.last_burst_at = now
         if req.first_token_at is None:
-            req.first_token_at = time.perf_counter()
+            req.first_token_at = now
+            if self._controller is not None:
+                self._controller.observe_ttft(
+                    req.first_token_at - req.enqueued_at)
             if req.decode_span is not None:
                 req.decode_span.add_event(
                     "first_token",
@@ -471,14 +534,8 @@ class LLMServer:
         """Stop decoding for consumers that went away (client disconnect /
         stream abandoned): their slots would otherwise burn decode steps to
         max_new_tokens, delaying every waiting request."""
-        if self._waiting:
-            kept = []
-            for r in self._waiting:
-                if r.cancelled:
-                    r.finish_spans("ERROR", "cancelled before admission")
-                else:
-                    kept.append(r)
-            self._waiting = kept
+        for r in self._waiting.prune(lambda r: r.cancelled):
+            r.finish_spans("ERROR", "cancelled before admission")
         for slot, req in self._active.items():
             if req.cancelled and self.gen.slots[slot].live:
                 self.gen.slots[slot].live = False
@@ -502,6 +559,14 @@ class LLMServer:
                     model=self.name)
                 self._metrics.set_gauge("app_llm_free_pages",
                                         float(self.gen.free_pages),
+                                        model=self.name)
+            sched = getattr(self.gen, "scheduler", None)
+            if sched is not None:
+                self._metrics.set_gauge("app_llm_token_budget",
+                                        float(sched.budget),
+                                        model=self.name)
+                self._metrics.set_gauge("app_llm_prefill_share",
+                                        float(sched.prefill_share),
                                         model=self.name)
         except Exception:
             pass
@@ -531,6 +596,9 @@ class LLMServer:
                         pass
                 produced = s.produced
                 now = time.perf_counter()
+                # (the SLO controller already sampled this stream's TPOT
+                # per burst in _emit — a lifetime average here would
+                # re-report stale slowness into a fresh window)
                 if (self._metrics is not None and produced > 1
                         and req.first_token_at is not None):
                     # stream cadence AFTER the first token: the SLO pair to
@@ -623,13 +691,19 @@ class LLMServer:
     # -- async API ------------------------------------------------------------
     async def stream_chunks(self, prompt_ids, max_new_tokens: int = 64,
                             prefix: int | None = None,
-                            info: dict | None = None
+                            info: dict | None = None,
+                            priority: int | str | None = None,
                             ) -> AsyncIterator[list[int]]:
         """Yield BURSTS of tokens — each list is the slot's share of one
         processed decode chunk (the first is ``[first_token]`` from the
         TTFT mini-chunk). The low-overhead surface for transports that can
         frame several tokens per message (gRPC streaming, SSE): one
         consumer wakeup and one wire frame per burst instead of per token.
+
+        ``priority`` selects the admission class (``"high"`` / ``"normal"``
+        / ``"low"`` or the class index; default normal): under slot
+        contention higher classes admit first, with aging so lower classes
+        can never starve. Unknown values raise ValueError before enqueue.
 
         Pass ``info={}`` to receive ``info["finish_reason"]`` on completion:
         ``"stop"`` (eos), ``"length"`` (budget), or ``"eviction"`` (page
@@ -638,6 +712,7 @@ class LLMServer:
         """
         if self._closed:
             raise RuntimeError("llm server is closed")
+        prio = normalize_priority(priority)  # raises BEFORE enqueue
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue = asyncio.Queue()
         # capture the caller's span before the executor hop; the serving
@@ -650,7 +725,8 @@ class LLMServer:
                 attributes={"ml.model": self.name},
             )
         req = _Request(prompt_ids, max_new_tokens, out_q, loop,
-                       prefix=prefix, trace_ctx=ctx, queue_span=queue_span)
+                       prefix=prefix, trace_ctx=ctx, queue_span=queue_span,
+                       priority=prio)
         self._requests.put(req)
         if self._closed:
             # close() may have drained the queue before our put landed —
@@ -680,11 +756,12 @@ class LLMServer:
 
     async def stream(self, prompt_ids, max_new_tokens: int = 64,
                      prefix: int | None = None,
-                     info: dict | None = None) -> AsyncIterator[int]:
+                     info: dict | None = None,
+                     priority: int | str | None = None) -> AsyncIterator[int]:
         """Yield tokens as the device produces them (token-at-a-time view
         of ``stream_chunks``)."""
         agen = self.stream_chunks(prompt_ids, max_new_tokens, prefix=prefix,
-                                  info=info)
+                                  info=info, priority=priority)
         try:
             async for burst in agen:
                 for tok in burst:
@@ -696,11 +773,13 @@ class LLMServer:
 
     async def generate(self, prompt_ids, max_new_tokens: int = 64,
                        prefix: int | None = None,
-                       info: dict | None = None) -> list[int]:
+                       info: dict | None = None,
+                       priority: int | str | None = None) -> list[int]:
         """Collect the full completion."""
         out: list[int] = []
         async for burst in self.stream_chunks(prompt_ids, max_new_tokens,
-                                              prefix=prefix, info=info):
+                                              prefix=prefix, info=info,
+                                              priority=priority):
             out.extend(burst)
         return out
 
@@ -708,6 +787,23 @@ class LLMServer:
         """Requests waiting for a decode slot (sampled as
         ``app_ml_queue_depth{component="llm"}``)."""
         return len(self._waiting) + self._requests.qsize()
+
+    def scheduler_snapshot(self) -> dict:
+        """Live scheduler state for ``/debug/serving``: the token budget
+        and realized chunk-size mix, the SLO controller's last percentiles
+        vs targets, and per-priority ready-queue depth/age. Reads simple
+        attributes only — safe from any thread."""
+        out: dict = {"waiting": self._waiting.snapshot()}
+        sched = getattr(self.gen, "scheduler", None)
+        if sched is not None:
+            out.update(sched.snapshot())
+        else:
+            out["budget"] = None  # fixed-chunk dispatch
+        out["prefill_segments"] = getattr(self.gen,
+                                          "prefill_segments_run", 0)
+        if self._controller is not None:
+            out["slo"] = self._controller.snapshot()
+        return out
 
     # -- datasource contract --------------------------------------------------
     def health_check(self) -> dict:
