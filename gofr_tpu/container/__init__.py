@@ -140,6 +140,16 @@ class Container:
                       "cached prefixes dropped (cap or pool pressure)")
         m.new_counter("app_ml_prefill_tokens_saved_total",
                       "prompt tokens NOT re-prefilled thanks to prefix reuse")
+        m.new_counter("app_ml_kv_offload_spills_total",
+                      "evicted prefix KV page sets copied device->host")
+        m.new_counter("app_ml_kv_offload_restores_total",
+                      "offloaded prefix KV page sets copied host->device "
+                      "on a cache hit")
+        m.new_gauge("app_ml_kv_offload_bytes",
+                    "bytes held by the host-RAM KV offload tier")
+        m.new_gauge("app_ml_host_rss_bytes",
+                    "current process resident set size (the offload "
+                    "tier's footprint lives here)")
         m.new_histogram(
             "app_llm_priority_queue_seconds",
             "LLM request wait before slot admission per priority class",

@@ -10,11 +10,28 @@ batching (batching.py), sharded multi-chip serving (gofr_tpu.parallel).
 
 from __future__ import annotations
 
+import os
 from typing import Any
 
 from .engine import Engine, EngineConfig
 
 __all__ = ["MLDatasource", "Engine", "EngineConfig"]
+
+
+def _host_rss_bytes() -> float | None:
+    """Current resident set size. /proc gives the LIVE value (the one
+    that moves when the KV offload tier fills); the getrusage fallback is
+    the lifetime peak — still useful, but a high-water mark."""
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            return float(int(f.read().split()[1])) * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        try:
+            import resource
+
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024.0
+        except Exception:
+            return None
 
 
 class MLDatasource:
@@ -61,7 +78,7 @@ class MLDatasource:
             engine = model
         else:
             if model is not None and apply_fn is None:
-                apply_fn = getattr(model, "apply", None) or getattr(model, "__call__")
+                apply_fn = getattr(model, "apply", None) or model.__call__
                 params = params if params is not None else getattr(model, "params", None)
                 if example_inputs is None:
                     example_inputs = getattr(model, "example_inputs", None)
@@ -183,6 +200,11 @@ class MLDatasource:
         if m is None:
             return
         self.refresh_device_metrics(m)
+        # process RSS next to the HBM gauge: the host KV offload tier
+        # lives in this process's heap, so its footprint is visible here
+        rss = _host_rss_bytes()
+        if rss is not None:
+            m.set_gauge("app_ml_host_rss_bytes", rss)
         for name, engine in self._engines.items():
             depth = getattr(engine, "queue_depth", None)
             if depth is not None:
@@ -222,6 +244,18 @@ class MLDatasource:
         for name, server in self._llms.items():
             entry = dict(server.health_check()["details"])
             entry["pool"] = server.gen.pool_stats()
+            host = getattr(server.gen, "host_kv", None)
+            if host is not None:
+                # the DRAM tier under the page pool: occupancy vs budget
+                # plus the spill/restore traffic through it
+                tier = host.stats()
+                tier.update(
+                    spills=getattr(server.gen, "kv_spills", 0),
+                    restores=getattr(server.gen, "kv_restores", 0),
+                    restore_fallbacks=getattr(server.gen,
+                                              "kv_restore_fallbacks", 0),
+                )
+                entry["kv_host_tier"] = tier
             if getattr(server, "prefix_cache", None) is not None:
                 # prefix lengths, refcounts, hit counts + lifetime totals
                 entry["prefix_cache"] = server.prefix_cache.snapshot()

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .kv_offload import HostKVStore
 from .scheduler import TokenBudgetScheduler, maybe_enable_compilation_cache
 
 __all__ = ["Sampler", "sample_logits", "greedy", "Generator",
@@ -155,7 +156,8 @@ class Generator:
                  spec_ngram: int = 3, page_size: int = 0,
                  n_pages: int | None = None, draft_params: Any = None,
                  draft_cfg: Any = None, prefill_chunk: int = 0,
-                 token_budget: int | None = None) -> None:
+                 token_budget: int | None = None,
+                 host_kv: Any = None) -> None:
         import contextlib
 
         from ..models import llama
@@ -241,6 +243,41 @@ class Generator:
             self._next_prefix = 1
             self._prefix_clock = 0   # LRU stamp for prefix eviction
             self.prefix_evictions = 0
+            # Host spill tier (kv_offload.py): evicting an idle prefix
+            # copies its pages device→host instead of discarding, so the
+            # next hit restores them with a DMA instead of a prefill.
+            # ``host_kv`` None -> env GOFR_ML_KV_HOST_BUDGET_MB (0/unset
+            # = tier off, today's discard behavior); False disables
+            # explicitly; a HostKVStore instance is used as-is.
+            if host_kv is None:
+                host_kv = HostKVStore.from_env()
+            # identity check, not truthiness: an EMPTY store is falsy
+            # (len 0) but very much enabled
+            self.host_kv = host_kv if host_kv is not False else None
+            self.kv_spills = 0            # prefixes copied device->host
+            self.kv_restores = 0          # prefixes copied host->device
+            self.kv_restore_fallbacks = 0  # restores lost to pool pressure
+            self.prefix_prefills = 0      # prefix KV builds actually paid
+
+            cache_keys = tuple(k for k in self.cache if k != "len")
+
+            def gather_pages(cache, pages):
+                """Copy ``pages`` ([n_pg] int32) out of the pool — a fresh
+                device buffer, so the pool pages are reusable the moment
+                this dispatches (the D2H copy streams from the copy)."""
+                return {k: jnp.take(cache[k], pages, axis=1)
+                        for k in cache_keys}
+
+            def scatter_pages(cache, pages, slabs):
+                out = {k: cache[k].at[:, pages].set(slabs[k])
+                       for k in cache_keys}
+                out["len"] = cache["len"]
+                return out
+
+            self._gather_pages = jax.jit(gather_pages)
+            # donate the pool: in-place page writes, no cache copy
+            self._scatter_pages = jax.jit(scatter_pages,
+                                          donate_argnums=(0,))
         elif shard_cache:
             # Multi-controller serving (ml/multihost.py): slots shard over
             # dp, kv heads over tp (matching SHARDING_RULES so decode never
@@ -848,6 +885,10 @@ class Generator:
                 pinned_prefixes=sum(
                     1 for i in getattr(self, "_prefixes", {}).values()
                     if i.get("pinned")),
+                kv_spills=self.kv_spills,
+                kv_restores=self.kv_restores,
+                kv_restore_fallbacks=self.kv_restore_fallbacks,
+                prefix_prefills=self.prefix_prefills,
             )
         return out
 
@@ -899,6 +940,9 @@ class Generator:
                     self.params, toks, np.array([shared_len], np.int32),
                     self.cache, row, np.int32(0), np.int32(0),
                 )
+            # the compute a restore avoids: re-registrations after a
+            # discard land here, restores land in kv_restores instead
+            self.prefix_prefills += 1
         pid = self._next_prefix
         self._next_prefix += 1
         self._prefix_clock += 1
@@ -925,7 +969,9 @@ class Generator:
         pages are a CACHE: under pool pressure an idle system prompt's
         pages are worth less than a live stream's next tokens (VERDICT r4
         #6 — without this, rotating system prompts exhaust the pool
-        forever). Borrowed prefixes (refs > 0) are never candidates."""
+        forever). Borrowed prefixes (refs > 0) are never candidates —
+        which also means a borrowed prefix can never be mid-spill: only
+        fully idle page sets ever reach the host tier."""
         while len(self._free_pages) < n_need:
             idle = [(info.get("pinned", False), info["last_use"], pid)
                     for pid, info in self._prefixes.items()
@@ -934,17 +980,115 @@ class Generator:
                 return False
             _, _, pid = min(idle)
             info = self._prefixes.pop(pid)
+            # spill before freeing: the gather snapshots the pages into a
+            # fresh device buffer, so reusing them right after is safe
+            self._spill_prefix(info)
             self._free_pages.extend(info["pages"])
             self.prefix_evictions += 1
         return True
 
-    def drop_prefix(self, pid: int) -> None:
-        """Return a prefix's pages to the pool (no live borrowers)."""
+    def _spill_prefix(self, info: dict) -> bool:
+        """Copy an evicted idle prefix's whole pages into the host tier
+        (device gather -> async D2H; the store settles the copy lazily so
+        this never blocks the dispatch loop). False when the tier is off,
+        the entry exceeds the host budget, or the prefix shares no whole
+        pages — the pages are then discarded exactly as before."""
+        if self.host_kv is None or not info["pages"] or not info["len"]:
+            return False
+        key = tuple(int(t) for t in info["ids_full"])
+        pages = np.asarray(info["pages"], np.int32)
+        with self._mesh_ctx():
+            slabs = self._gather_pages(self.cache, pages)
+        try:
+            for arr in slabs.values():
+                arr.copy_to_host_async()
+        except Exception:
+            # same contract as the token prefetch: losing the async copy
+            # only costs latency at settle time (np.asarray still lands
+            # the bytes); count it on the shared prefetch counter
+            self.prefetch_errors += 1
+        ok = self.host_kv.put(key, slabs, {
+            "len": info["len"], "tail": list(info["tail"]),
+            "ids_full": list(info["ids_full"]),
+            "pinned": bool(info.get("pinned", False)),
+        })
+        if ok:
+            self.kv_spills += 1
+        return ok
+
+    def has_offloaded(self, prefix_ids) -> bool:
+        """True when the host tier holds this exact prefix — the radix
+        cache uses it to mark a generator-evicted registration restorable
+        instead of gone."""
+        if self.host_kv is None:
+            return False
+        return tuple(int(t) for t in prefix_ids) in self.host_kv
+
+    def restore_prefix(self, prefix_ids) -> int:
+        """Bring an offloaded prefix back into pool pages: allocate, one
+        batched ``jax.device_put`` of the host slabs, jitted scatter into
+        the pool, and re-register under a fresh prefix id. The H2D copy
+        and the scatter dispatch asynchronously — they overlap the
+        in-flight decode chunk — and the restored tokens are charged to
+        the token-budget scheduler so the following dispatches yield the
+        device time the DMA+scatter consumed (restores interleave with
+        decode instead of stalling it).
+
+        Raises ``KeyError`` when the tier doesn't hold the prefix and
+        ``PagePoolExhausted`` when pool pressure wins the race (the entry
+        stays in the host tier; the caller falls back to full prefill —
+        the same contract as ``PrefixEvicted``). Restored pages are
+        bit-identical to the spilled ones, so decode after spill→restore
+        matches the never-evicted path exactly."""
+        if not self.page_size:
+            raise ValueError("kv offload requires page_size > 0")
+        if self.host_kv is None:
+            raise KeyError("host kv tier is disabled")
+        key = tuple(int(t) for t in prefix_ids)
+        popped = self.host_kv.pop(key)  # popped FIRST: a reclaim below may
+        if popped is None:              # spill others and LRU-evict us
+            raise KeyError(f"prefix {key[:8]}... not in the host tier")
+        arrays, meta = popped
+        n_need = meta["len"] // self.page_size
+        if len(self._free_pages) < n_need:
+            self._reclaim_prefix_pages(n_need)
+        if len(self._free_pages) < n_need:
+            self.host_kv.put_back(key, arrays, meta)
+            self.kv_restore_fallbacks += 1
+            raise PagePoolExhausted(
+                f"restore needs {n_need} pages, {self.free_pages} free")
+        pages = [self._free_pages.pop() for _ in range(n_need)]
+        if n_need:
+            dev_slabs = jax.device_put(arrays)  # one batched async H2D
+            with self._mesh_ctx():
+                self.cache = self._scatter_pages(
+                    self.cache, np.asarray(pages, np.int32), dev_slabs)
+        pid = self._next_prefix
+        self._next_prefix += 1
+        self._prefix_clock += 1
+        self._prefixes[pid] = {"pages": pages, "len": meta["len"],
+                               "tail": list(meta["tail"]),
+                               "ids_full": list(meta["ids_full"]),
+                               "refs": 0, "last_use": self._prefix_clock,
+                               "pinned": bool(meta.get("pinned", False))}
+        self.kv_restores += 1
+        if self.scheduler is not None:
+            self.scheduler.charge_restore(meta["len"])
+        return pid
+
+    def drop_prefix(self, pid: int, spill: bool = False) -> bool:
+        """Return a prefix's pages to the pool (no live borrowers).
+        ``spill=True`` (capacity evictions, e.g. the radix cache's
+        registered-set cap) offloads the pages to the host tier first;
+        returns whether they were actually stored. A plain drop (the
+        explicit release API) always discards."""
         info = self._prefixes[pid]
         if info["refs"] > 0:
             raise RuntimeError(f"prefix {pid} still used by {info['refs']} slots")
+        spilled = self._spill_prefix(info) if spill else False
         self._free_pages.extend(info["pages"])
         del self._prefixes[pid]
+        return spilled
 
     def _admit_prefixed(self, pid: int, ids: np.ndarray, max_new: int,
                         callback) -> int:
@@ -1062,7 +1206,7 @@ class Generator:
         from ..parallel import P as _P
 
         cfg, mesh = self.cfg, self.mesh
-        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape, strict=True))
         dp = "dp" if (sizes.get("dp", 1) > 1
                       and self.batch_slots % sizes["dp"] == 0) else None
         tp = "tp" if (sizes.get("tp", 1) > 1
@@ -1556,7 +1700,8 @@ class Generator:
                         self._free_slot_pages(j)
                 raise
             self._n_requests += len(wave)
-            for slot, (ids, n, max_new, callback) in zip(slots, wave):
+            for slot, (ids, n, max_new, callback) in zip(slots, wave,
+                                                          strict=True):
                 self._pending_first.append(slot)
                 s = _Slot()
                 s.live = True

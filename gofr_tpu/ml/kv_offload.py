@@ -1,0 +1,231 @@
+"""Host-RAM spill tier under the paged KV pool.
+
+HBM is the scarcest resource in the serving stack, and until this module
+it was the ONLY KV tier: when pool pressure forced
+``Generator._reclaim_prefix_pages`` (or the radix cache's capacity
+eviction) to drop an idle prefix, its pages were simply freed and the
+next hit on that prefix paid a full prefill recompute. Host RAM is
+orders of magnitude larger than the page pool, and a device→host→device
+round trip of the pages costs DMA bandwidth, not FLOPs — the same
+HBM→DRAM KV-tiering move as vLLM-style swap-out/swap-in and SGLang's
+hierarchical radix cache.
+
+``HostKVStore`` is the host side of that tier:
+
+- **put** takes the evicted prefix's page slabs as freshly *gathered*
+  DEVICE arrays (the Generator copies the pages out of the pool with a
+  jitted gather, so the pool pages are reusable immediately) on which
+  ``copy_to_host_async`` has already been issued. The store keeps the
+  device handles and materializes them to numpy lazily — everything but
+  the newest entry settles on the next ``put``/``get`` (double-buffered),
+  so eviction never blocks the decode dispatch loop on a D2H fence.
+- an **LRU budget** (``GOFR_ML_KV_HOST_BUDGET_MB``; 0 disables the tier
+  and restores the old discard behavior) bounds host bytes: inserting
+  past the budget drops the least-recently-used entries; an entry larger
+  than the whole budget is rejected and the caller discards as before.
+- **pop** hands the settled numpy slabs back for a restore
+  (``Generator.restore_prefix`` batches them to the device with one
+  ``jax.device_put`` and scatters them into freshly allocated pool
+  pages); ``put_back`` reinserts them when the restore loses the race to
+  pool pressure, so a failed restore costs nothing.
+
+Keys are the prefix's full registered token tuple — the identity the
+radix cache already matches prompts by, so an offloaded prefix is found
+by the same longest-match that found it when it was device-resident.
+
+Thread-safety: all mutation happens on the serving thread that owns the
+Generator; a small lock makes ``stats()``/``meta()`` safe from the
+event-loop thread (the /debug/serving reader). Settling (the potentially
+blocking ``np.asarray``) always runs OUTSIDE the lock.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import os
+import threading
+
+import numpy as np
+
+__all__ = ["OffloadConfig", "HostKVStore"]
+
+
+class OffloadConfig:
+    """Host-tier policy knobs.
+
+    - ``budget_mb``: host bytes the tier may hold; 0 disables offload
+      entirely (evictions discard, exactly the pre-tier behavior).
+    """
+
+    def __init__(self, *, budget_mb: float = 0.0) -> None:
+        self.budget_mb = float(budget_mb)
+
+    @classmethod
+    def from_env(cls) -> "OffloadConfig":
+        """``GOFR_ML_KV_HOST_BUDGET_MB`` (default 0 = off: spilling is an
+        explicit capacity decision — operators opt in with a budget)."""
+        raw = os.environ.get("GOFR_ML_KV_HOST_BUDGET_MB", "0").strip()
+        try:
+            budget = float(raw) if raw else 0.0
+        except ValueError:
+            budget = 0.0
+        return cls(budget_mb=max(0.0, budget))
+
+    @property
+    def budget_bytes(self) -> int:
+        return int(self.budget_mb * 1024 * 1024)
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget_bytes > 0
+
+
+class _Entry:
+    __slots__ = ("arrays", "meta", "nbytes", "settled")
+
+    def __init__(self, arrays: dict, meta: dict, nbytes: int,
+                 settled: bool) -> None:
+        self.arrays = arrays      # device arrays until settled, then numpy
+        self.meta = meta
+        self.nbytes = nbytes
+        self.settled = settled
+
+
+def _entry_nbytes(arrays: dict) -> int:
+    """Bytes an entry will occupy on host — computable from shape/dtype
+    before the async copy lands, so budget accounting never forces a
+    premature materialization."""
+    total = 0
+    for arr in arrays.values():
+        total += math.prod(arr.shape) * np.dtype(arr.dtype).itemsize
+    return total
+
+
+class HostKVStore:
+    """LRU-bounded host store of spilled prefix KV page slabs."""
+
+    def __init__(self, config: OffloadConfig | None = None) -> None:
+        self.config = config or OffloadConfig.from_env()
+        self.budget_bytes = self.config.budget_bytes
+        self._entries: collections.OrderedDict[tuple, _Entry] = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.bytes_used = 0
+        # lifetime totals for /debug/serving
+        self.puts = 0
+        self.hits = 0          # pops that fed a restore
+        self.rejects = 0       # entries larger than the whole budget
+        self.evictions = 0     # LRU drops under the byte budget
+
+    @classmethod
+    def from_env(cls) -> "HostKVStore | None":
+        """The Generator's default wiring: a store when the env budget is
+        positive, None (tier off, discard on eviction) otherwise."""
+        cfg = OffloadConfig.from_env()
+        return cls(cfg) if cfg.enabled else None
+
+    # -- write side (eviction path) ---------------------------------------
+    def put(self, key: tuple, arrays: dict, meta: dict) -> bool:
+        """Admit one spilled prefix. ``arrays`` are gathered device slabs
+        with ``copy_to_host_async`` already issued; they settle to numpy
+        lazily (see module docstring). False when the entry alone exceeds
+        the budget — the caller discards, as without the tier."""
+        nbytes = _entry_nbytes(arrays)
+        settle_now: list[_Entry] = []
+        with self._lock:
+            if nbytes > self.budget_bytes:
+                self.rejects += 1
+                return False
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.bytes_used -= old.nbytes
+            while self._entries and self.bytes_used + nbytes > self.budget_bytes:
+                _, victim = self._entries.popitem(last=False)
+                self.bytes_used -= victim.nbytes
+                self.evictions += 1
+            entry = _Entry(arrays, dict(meta), nbytes, settled=False)
+            self._entries[key] = entry
+            self.bytes_used += nbytes
+            self.puts += 1
+            # double-buffer: everything but the just-added entry has had a
+            # full put-to-put interval for its async copy to land — settle
+            # those now (outside the lock), keep the newest in flight
+            pending = [e for k, e in self._entries.items()
+                       if not e.settled and k != key]
+            settle_now.extend(pending)
+        for e in settle_now:
+            self._settle(e)
+        return True
+
+    def put_back(self, key: tuple, arrays: dict, meta: dict) -> None:
+        """Reinsert a popped (already settled) entry after a failed
+        restore — as most-recently-used, so the very restore attempt that
+        failed doesn't make it the next LRU victim."""
+        nbytes = _entry_nbytes(arrays)
+        with self._lock:
+            if nbytes > self.budget_bytes:
+                return  # oversize: drop it honestly, never evict for it
+            while self._entries and self.bytes_used + nbytes > self.budget_bytes:
+                _, victim = self._entries.popitem(last=False)
+                self.bytes_used -= victim.nbytes
+                self.evictions += 1
+            self._entries[key] = _Entry(arrays, dict(meta), nbytes,
+                                        settled=True)
+            self.bytes_used += nbytes
+
+    @staticmethod
+    def _settle(entry: _Entry) -> None:
+        """Materialize an entry's device slabs to host numpy. The async
+        copy was issued at spill time, so this usually just unwraps the
+        landed buffer; at worst it blocks on the tail of that DMA."""
+        if entry.settled:
+            return
+        entry.arrays = {name: np.asarray(arr)
+                        for name, arr in entry.arrays.items()}
+        entry.settled = True
+
+    # -- read side (restore path) -----------------------------------------
+    def pop(self, key: tuple) -> tuple[dict, dict] | None:
+        """Remove and return ``(arrays, meta)`` for a restore (numpy,
+        settled). A restore MOVES the entry device-ward — on the next
+        eviction it spills again — so host and HBM never double-hold."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                return None
+            self.bytes_used -= entry.nbytes
+            self.hits += 1
+        self._settle(entry)
+        return entry.arrays, entry.meta
+
+    def meta(self, key: tuple) -> dict | None:
+        """Entry metadata without disturbing LRU order — the radix
+        cache's usability check (suffix shape rules) reads this."""
+        with self._lock:
+            entry = self._entries.get(key)
+            return dict(entry.meta) if entry is not None else None
+
+    def __contains__(self, key: tuple) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        """Tier occupancy for gauges and /debug/serving."""
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self.bytes_used,
+                "budget_bytes": self.budget_bytes,
+                "store_hits": self.hits,
+                "puts": self.puts,
+                "rejects": self.rejects,
+                "store_evictions": self.evictions,
+                "pending_copies": sum(1 for e in self._entries.values()
+                                      if not e.settled),
+            }

@@ -131,6 +131,11 @@ class LLMServer:
             SLOController.from_env(generator.scheduler)
             if getattr(generator, "scheduler", None) is not None else None)
         self._steered_dispatches = -1  # ladder dispatches recorded so far
+        # offload-counter watermarks: the generator counts spills/restores
+        # monotonically; the gauge pass publishes the deltas as Prometheus
+        # counters so the generator itself stays metrics-free
+        self._kv_spills_seen = 0
+        self._kv_restores_seen = 0
         self._active: dict[int, _Request] = {}
         self._closed = False
         self.served = 0
@@ -426,7 +431,7 @@ class LLMServer:
                     req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
                 continue
             now = time.perf_counter()
-            for (req, _), slot in zip(batch, slots):
+            for (req, _), slot in zip(batch, slots, strict=True):
                 req.slot = slot
                 self._active[slot] = req
                 if req.full_prompt is not None and self.prefix_cache is not None:
@@ -560,6 +565,7 @@ class LLMServer:
                 self._metrics.set_gauge("app_llm_free_pages",
                                         float(self.gen.free_pages),
                                         model=self.name)
+                self._export_offload_metrics()
             sched = getattr(self.gen, "scheduler", None)
             if sched is not None:
                 self._metrics.set_gauge("app_llm_token_budget",
@@ -570,6 +576,37 @@ class LLMServer:
                                         model=self.name)
         except Exception:
             pass
+
+    def _export_offload_metrics(self) -> None:
+        """Host-tier visibility: spill/restore counter deltas + the bytes
+        the tier currently holds. Each delta publishes independently so a
+        missing metric (bare managers in tests) can't eat the others."""
+        host = getattr(self.gen, "host_kv", None)
+        if host is not None:
+            try:
+                self._metrics.set_gauge("app_ml_kv_offload_bytes",
+                                        float(host.bytes_used),
+                                        model=self.name)
+            except Exception:
+                pass
+        spills = int(getattr(self.gen, "kv_spills", 0))
+        if spills > self._kv_spills_seen:
+            try:
+                self._metrics.add_counter(
+                    "app_ml_kv_offload_spills_total",
+                    spills - self._kv_spills_seen, model=self.name)
+                self._kv_spills_seen = spills
+            except Exception:
+                pass
+        restores = int(getattr(self.gen, "kv_restores", 0))
+        if restores > self._kv_restores_seen:
+            try:
+                self._metrics.add_counter(
+                    "app_ml_kv_offload_restores_total",
+                    restores - self._kv_restores_seen, model=self.name)
+                self._kv_restores_seen = restores
+            except Exception:
+                pass
 
     def _finish_dead_slots(self) -> None:
         self._export_pool_gauges()

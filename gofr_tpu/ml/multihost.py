@@ -529,7 +529,7 @@ class MultiHostWorker:
                          {"id": r, "tokens": burst})))
                     for conn, rid, toks, max_new in wave
                 ])
-                for (conn, rid, _, _), slot in zip(wave, slots):
+                for (conn, rid, _, _), slot in zip(wave, slots, strict=True):
                     active[slot] = (conn, rid)
                 finish_dead()
             elif gen.n_live:

@@ -25,7 +25,14 @@ of vLLM's PagedAttention block reuse and SGLang's RadixAttention:
   (``_reclaim_prefix_pages``) spends idle prefix pages before truncating a
   live stream or rejecting a prefill — unpinned (auto-promoted) prefixes
   first, pinned ones as a last resort. The cache notices generator-side
-  evictions on the next lookup and clears its stale registration.
+  evictions on the next lookup and clears its stale registration;
+- **host-tier restore**: with the KV offload tier on (kv_offload.py),
+  an evicted prefix's pages live on in host RAM, and the trie node moves
+  to a third state — registered → *offloaded* → gone. A later prompt
+  matching an offloaded node restores the pages with a DMA
+  (``Generator.restore_prefix``) instead of re-prefilling; a restore that
+  loses the race to pool pressure falls back to the full prompt, exactly
+  like the ``PrefixEvicted`` race.
 
 All mutation happens on the LLMServer serving thread (the one thread
 allowed to touch the Generator); a small lock makes ``snapshot()`` and
@@ -77,10 +84,14 @@ class PrefixCacheConfig:
 
 class _Node:
     """One radix-trie node: ``edge`` is the token run INTO the node,
-    ``depth`` the total tokens from the root through it."""
+    ``depth`` the total tokens from the root through it.
+
+    Registration states: pid set (device-resident), ``offload_key`` set
+    (pages spilled to the host tier, restorable — ``reg_len`` survives so
+    a restore re-registers the same split), neither (plain trie node)."""
 
     __slots__ = ("edge", "children", "parent", "depth", "pid", "reg_len",
-                 "hits", "last_hit")
+                 "offload_key", "hits", "last_hit")
 
     def __init__(self, edge: tuple, parent, depth: int) -> None:
         self.edge = tuple(edge)
@@ -89,6 +100,7 @@ class _Node:
         self.depth = depth
         self.pid: int | None = None   # generator prefix id when registered
         self.reg_len = 0              # tokens actually registered (≤ depth)
+        self.offload_key: tuple | None = None  # host-tier key when spilled
         self.hits = 0
         self.last_hit = 0.0
 
@@ -120,6 +132,8 @@ class RadixPrefixCache:
         self.misses = 0
         self.evictions = 0
         self.tokens_saved = 0
+        self.offloads = 0   # registrations that moved to the host tier
+        self.restores = 0   # offloaded registrations brought back
 
     # -- admission path -------------------------------------------------------
     def observe(self, prompt_ids) -> tuple[int | None, int]:
@@ -138,6 +152,39 @@ class RadixPrefixCache:
         with self._lock:
             path = self._insert(ids[:self._track_cap], now)
             best = self._best_registered(path, len(ids))
+            # restore only buys something when it REUSES more than the
+            # registered match: compare reg_len (actual shared split),
+            # not trie depth — a page-aligned node registers one short
+            floor = best.reg_len if best is not None else 0
+            restore_node = self._best_offloaded(path, len(ids), floor)
+        if restore_node is not None:
+            # host->device DMA + scatter dispatch OUTSIDE the lock, like
+            # the register_prefix device work below; only the serving
+            # thread mutates the trie, so nothing races the release
+            pid = None
+            try:
+                pid = self.gen.restore_prefix(restore_node.offload_key)
+            except PagePoolExhausted:
+                # lost the race to pool pressure: the entry stays in the
+                # host tier, THIS request falls back to the shallower
+                # registered match (or full prefill) — same contract as
+                # the PrefixEvicted race
+                pass
+            except KeyError:
+                with self._lock:   # host tier dropped it (LRU): gone
+                    if restore_node.pid is None:
+                        restore_node.offload_key = None
+                        restore_node.reg_len = 0
+            if pid is not None:
+                with self._lock:
+                    restore_node.pid = pid
+                    restore_node.offload_key = None
+                    self._by_pid[pid] = restore_node
+                    self.restores += 1
+                if self._usable_for(restore_node, len(ids)):
+                    best = restore_node
+                self._make_room(skip=restore_node)  # cap holds on restores
+        with self._lock:
             node = self._promotion_candidate(path, best)
             reg_len = self._reg_len_for(node) if node is not None else 0
             if node is not None and (
@@ -145,9 +192,10 @@ class RadixPrefixCache:
                     # permanently impossible: more pages than the whole
                     # pool — don't wipe useful idle prefixes trying
                     or (reg_len // self.gen.page_size
-                        > self.gen.n_pages - 1)
-                    or not self._make_room(skip=node)):
+                        > self.gen.n_pages - 1)):
                 node = None
+        if node is not None and not self._make_room(skip=node):
+            node = None
         if node is not None:
             # DEVICE work (prefix prefill + possible first-use compile)
             # runs OUTSIDE the lock: peek()/snapshot() on the event-loop
@@ -237,27 +285,92 @@ class RadixPrefixCache:
         """Deepest registered node on the matched path whose reuse is
         admissible for an ``n``-token prompt. Registrations the generator
         evicted under pool pressure are detected (``has_prefix`` false)
-        and cleared here."""
+        here: spilled ones move to the offloaded state (restorable), the
+        rest are cleared."""
         best = None
         for node in path:
             if node.pid is None:
                 continue
             if not self.gen.has_prefix(node.pid):
-                self._evict(node.pid, node)  # evicted behind our back
+                self._note_stale(node)  # evicted behind our back
                 continue
             if self._usable_for(node, n):
                 best = node  # path is root→leaf ordered: keep the deepest
         return best
+
+    def _note_stale(self, node: _Node) -> None:
+        """A registration the generator evicted: if its pages landed in
+        the host tier, transition the node to the OFFLOADED state (the
+        registration split survives; a later hit restores); otherwise the
+        prefix is gone — clear the node, count the eviction."""
+        pid = node.pid
+        key = self._node_tokens(node)[:node.reg_len]
+        if (key and getattr(self.gen, "has_offloaded", None) is not None
+                and self.gen.has_offloaded(key)):
+            self._by_pid.pop(pid, None)
+            node.pid = None
+            node.offload_key = key   # reg_len survives for the restore
+            self.offloads += 1
+        else:
+            self._evict(pid, node)
+
+    def _best_offloaded(self, path: list[_Node], n: int,
+                        floor: int) -> _Node | None:
+        """Deepest offloaded node whose registration length beats
+        ``floor`` (the registered best's ``reg_len``) and whose restored
+        reuse would be admissible for an ``n``-token prompt — the restore
+        candidate. Entries the host tier LRU-dropped behind our back are
+        cleared here."""
+        store = getattr(self.gen, "host_kv", None)
+        if store is None:
+            return None
+        best = None
+        for node in path:
+            if node.offload_key is None or node.pid is not None:
+                continue
+            meta = store.meta(node.offload_key)
+            if meta is None:          # host tier dropped it: truly gone
+                node.offload_key = None
+                node.reg_len = 0
+                self._evict(None, node)
+                continue
+            if node.reg_len > floor and self._usable_meta(meta,
+                                                          node.reg_len, n):
+                best = node
+        return best
+
+    def _usable_meta(self, meta: dict, reg_len: int, n: int) -> bool:
+        """The offloaded twin of ``_usable_for``: admissibility of an
+        ``n``-token prompt on a restore of this host-tier entry."""
+        n_suf = len(meta["tail"]) + (n - reg_len)
+        return (n_suf >= 1 and meta["len"] + n_suf < self.gen.max_seq
+                and n_suf <= self.gen.prefill_buckets[-1])
+
+    def _node_tokens(self, node: _Node) -> tuple:
+        """Root→node token run (edges concatenated up the parent chain) —
+        the identity a spilled registration is keyed by in the host
+        tier."""
+        parts = []
+        while node is not None and node.parent is not None:
+            parts.append(node.edge)
+            node = node.parent
+        out: list[int] = []
+        for edge in reversed(parts):
+            out.extend(edge)
+        return tuple(out)
 
     def _promotion_candidate(self, path: list[_Node],
                              best: _Node | None) -> _Node | None:
         """Deepest hot unregistered node that would beat the current best
         match. ``hits`` counts distinct prompts through the node inside
         the decay window; ``promote_hits`` of them make it worth a
-        one-time prefix prefill."""
+        one-time prefix prefill. Offloaded nodes are excluded — their KV
+        already exists host-side; re-prefilling would orphan it (the
+        restore path in ``observe`` owns them)."""
         floor = best.depth if best is not None else 0
         for node in reversed(path):
-            if (node.pid is None and node.depth >= self._min_tokens
+            if (node.pid is None and node.offload_key is None
+                    and node.depth >= self._min_tokens
                     and node.depth > floor
                     and node.hits >= self.cfg.promote_hits):
                 return node
@@ -276,25 +389,55 @@ class RadixPrefixCache:
         """Hold the registered-prefix count under ``max_prefixes`` by
         dropping the least-recently-hit candidates. Borrowed (refs > 0)
         and pinned prefixes are SKIPPED in favor of the next-oldest —
-        never popped-and-stranded (the ADVICE r5 eviction bug)."""
-        while len(self._by_pid) >= self.cfg.max_prefixes:
-            evicted = False
-            for pid, victim in sorted(self._by_pid.items(),
-                                      key=lambda kv: kv[1].last_hit):
-                if victim is skip:
-                    continue
-                info = self.gen._prefixes.get(pid)
-                if info is not None and (info["refs"] > 0
-                                         or info.get("pinned")):
-                    continue  # borrowed or pinned: try the next-oldest
-                if info is not None:
-                    self.gen.drop_prefix(pid)
-                self._evict(pid, victim)
-                evicted = True
-                break
-            if not evicted:
-                return False
-        return True
+        never popped-and-stranded (the ADVICE r5 eviction bug). With the
+        host tier on, a capacity victim's pages spill device→host and
+        the node moves to the offloaded (restorable) state instead of
+        being forgotten.
+
+        Called WITHOUT the lock held (it locks internally): the spill's
+        device gather — and its possible first-use compile — must never
+        run under the lock that snapshot()/peek() readers take. Only the
+        serving thread mutates the trie, so the victim chosen under the
+        lock is still the victim after the unlocked device work."""
+        while True:
+            victim_pid = victim_node = victim_info = None
+            with self._lock:
+                if len(self._by_pid) < self.cfg.max_prefixes:
+                    return True
+                for pid, victim in sorted(self._by_pid.items(),
+                                          key=lambda kv: kv[1].last_hit):
+                    if victim is skip:
+                        continue
+                    info = self.gen._prefixes.get(pid)
+                    if info is not None and (info["refs"] > 0
+                                             or info.get("pinned")):
+                        continue  # borrowed or pinned: try the next-oldest
+                    victim_pid, victim_node, victim_info = pid, victim, info
+                    break
+                if victim_node is None:
+                    return False
+            spilled = False
+            if victim_info is not None:  # device work outside the lock
+                spilled = bool(self.gen.drop_prefix(victim_pid, spill=True))
+            with self._lock:
+                if spilled:
+                    self._offload(victim_pid, victim_node)
+                elif victim_info is None:
+                    # the generator already evicted it behind our back —
+                    # possibly spilling it host-side: preserve the
+                    # restorable state exactly like _best_registered would
+                    self._note_stale(victim_node)
+                else:
+                    self._evict(victim_pid, victim_node)
+
+    def _offload(self, pid: int, node: _Node) -> None:
+        """Move one registration to the offloaded state: its pages now
+        live in the host tier under the node's registered token run."""
+        self._by_pid.pop(pid, None)
+        if node.pid == pid:
+            node.pid = None
+            node.offload_key = self._node_tokens(node)[:node.reg_len]
+        self.offloads += 1
 
     def _evict(self, pid: int, node: _Node) -> None:
         """Clear one registration's bookkeeping BY KEY (the generator-side
@@ -344,14 +487,16 @@ class RadixPrefixCache:
                     self._by_pid.pop(node.pid, None)
                     node.pid = None
                     node.reg_len = 0
-            self._make_room(skip=node)
-        # device work outside the lock (see observe)
+        # device work outside the lock (see observe) — _make_room locks
+        # internally around its bookkeeping, not its victim's spill
+        self._make_room(skip=node)
         pid = self.gen.register_prefix(ids, pinned=True)
         with self._lock:
             if node is not None:
                 node.pid = pid
                 node.reg_len = len(ids)
-                self._by_pid[pid] = node
+                node.offload_key = None  # fresh device copy supersedes any
+                self._by_pid[pid] = node  # host-tier remnant (LRU drops it)
         return pid
 
     def drop(self, pid: int) -> None:
@@ -368,11 +513,12 @@ class RadixPrefixCache:
     def invalidate(self, pid: int) -> None:
         """The generator evicted this pid under pool pressure (a
         ``PrefixEvicted`` admission race): clear the stale registration
-        so the next lookup misses instead of looping."""
+        so the next lookup misses instead of looping — or, when the
+        eviction spilled the pages host-side, mark the node restorable."""
         with self._lock:
             node = self._by_pid.get(pid)
             if node is not None:
-                self._evict(pid, node)
+                self._note_stale(node)
 
     # -- trie -----------------------------------------------------------------
     def _insert(self, ids: tuple, now: float) -> list[_Node]:
@@ -437,15 +583,16 @@ class RadixPrefixCache:
 
     def _prune(self) -> None:
         """Drop cold unregistered leaves (least-recently-hit first) until
-        the trie is back under ``max_nodes``. Registered nodes and
-        interior nodes survive — they carry the reuse value."""
+        the trie is back under ``max_nodes``. Registered nodes, offloaded
+        nodes, and interior nodes survive — they carry the reuse value."""
         while self._n_nodes > self.cfg.max_nodes:
             coldest = None
             stack = [self._root]
             while stack:
                 n = stack.pop()
                 stack.extend(n.children.values())
-                if n.children or n.pid is not None or n is self._root:
+                if (n.children or n.pid is not None
+                        or n.offload_key is not None or n is self._root):
                     continue
                 if coldest is None or n.last_hit < coldest.last_hit:
                     coldest = n
@@ -477,6 +624,8 @@ class RadixPrefixCache:
                 "misses": self.misses,
                 "evictions": self.evictions,
                 "prefill_tokens_saved": self.tokens_saved,
+                "offloads": self.offloads,
+                "restores": self.restores,
                 "trie_nodes": self._n_nodes,
                 "prefixes": prefixes,
             }

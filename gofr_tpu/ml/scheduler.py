@@ -156,6 +156,22 @@ class TokenBudgetScheduler:
         self.mini_dispatches = 0
         self.last_chunk = self.ladder[-1]
         self.last_segments = 0
+        # KV-restore charging (generate.Generator.restore_prefix): a
+        # host->device prefix restore rides the device queue like prefill
+        # work; its token count lands here as DEBT that upcoming plans pay
+        # off before budgeting decode+prefill, so restores interleave with
+        # decode instead of stacking on top of a full dispatch.
+        self.restore_debt = 0
+        self.restores_charged = 0
+
+    def charge_restore(self, tokens: int) -> None:
+        """Debit ``tokens`` of restore DMA/scatter work against upcoming
+        dispatch budgets. Capped at a few budgets so a restore burst
+        throttles the next dispatches, never starves decode indefinitely
+        (plan() additionally repays at most half a budget per dispatch)."""
+        self.restore_debt = min(self.restore_debt + max(0, int(tokens)),
+                                4 * self.budget)
+        self.restores_charged += 1
 
     def set_share(self, share: float) -> float:
         self.prefill_share = min(self.max_share,
@@ -164,6 +180,12 @@ class TokenBudgetScheduler:
 
     def plan(self, n_decodable: int, prefill_pending: bool) -> tuple[int, int]:
         budget = self.budget
+        if self.restore_debt:
+            # pay down restore debt first — at most half a budget per
+            # dispatch, so decode keeps at least the ladder floor's cadence
+            paid = min(self.restore_debt, budget // 2)
+            self.restore_debt -= paid
+            budget -= paid
         if prefill_pending and self.prefill_chunk:
             # share-based reserve (flooring it at a full segment would
             # zero the decode budget whenever prefill_chunk ~ budget),
@@ -215,6 +237,8 @@ class TokenBudgetScheduler:
                            for k, v in sorted(dispatches.items())},
             "mini_dispatches": self.mini_dispatches,
             "last_segments": self.last_segments,
+            "restore_debt": self.restore_debt,
+            "restores_charged": self.restores_charged,
         }
 
 
@@ -387,7 +411,7 @@ class AgingPriorityQueue:
     def snapshot(self, now: float | None = None) -> dict:
         now = time.perf_counter() if now is None else now
         out = {}
-        for name, q in zip(PRIORITIES, self._queues):
+        for name, q in zip(PRIORITIES, self._queues, strict=True):
             try:
                 oldest = round(now - q[0].enqueued_at, 4)
             except IndexError:

@@ -56,11 +56,13 @@ class Trainer:
         *,
         mesh: Mesh | None = None,
         param_specs: Any = None,
-        batch_spec: P = P("dp"),
+        batch_spec: P | None = None,
         optimizer=None,
         learning_rate: float = 3e-4,
     ) -> None:
         self.mesh = mesh
+        if batch_spec is None:  # not a default arg: P() is a call (B008)
+            batch_spec = P("dp")
         # mu_dtype=f32: bf16 params must not drag the Adam moments down to
         # bf16, or second-moment accumulation underflows.
         self.optimizer = optimizer or optax.adamw(learning_rate, mu_dtype=jnp.float32)

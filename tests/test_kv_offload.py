@@ -1,0 +1,330 @@
+"""Tiered KV cache (ml/kv_offload.py + generator spill/restore hooks):
+host-budget LRU ordering, spill→restore bit-identity vs never-evicted
+decode, borrowed-prefix protection, budget=0 discard parity, the
+restore-vs-pool-pressure fallback, page-accounting conservation, token
+-budget charging, and the end-to-end LLMServer rotation flow."""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from gofr_tpu.ml.generate import PagePoolExhausted, Generator
+from gofr_tpu.ml.kv_offload import HostKVStore, OffloadConfig
+from gofr_tpu.ml.llm import LLMServer
+from gofr_tpu.ml.prefix_cache import PrefixCacheConfig
+from gofr_tpu.ml.scheduler import TokenBudgetScheduler
+from gofr_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.tiny_llama(use_flash=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _store(mb=64.0):
+    return HostKVStore(OffloadConfig(budget_mb=mb))
+
+
+def _entry(n_bytes):
+    # one float32 array of exactly n_bytes, plus trivial meta
+    arr = {"k": np.zeros((n_bytes // 4,), np.float32)}
+    meta = {"len": 8, "tail": [], "ids_full": list(range(8)),
+            "pinned": False}
+    return arr, meta
+
+
+# ----------------------------------------------------------- host store
+def test_host_store_lru_ordering_under_budget():
+    store = HostKVStore(OffloadConfig(budget_mb=2 / 1024))  # 2 KiB
+    a, am = _entry(1024)
+    b, bm = _entry(1024)
+    c, cm = _entry(1024)
+    assert store.put(("a",), a, am)
+    assert store.put(("b",), b, bm)
+    assert store.put(("c",), c, cm)     # budget 2: LRU "a" falls out
+    assert ("a",) not in store and ("b",) in store and ("c",) in store
+    assert store.evictions == 1
+
+    # pop refreshes nothing (it removes), but put_back reinserts as MRU
+    arrays, meta = store.pop(("b",))
+    store.put_back(("b",), arrays, meta)
+    d, dm = _entry(1024)
+    assert store.put(("d",), d, dm)     # now "c" is the LRU victim
+    assert ("c",) not in store and ("b",) in store and ("d",) in store
+
+    # an entry bigger than the whole budget is rejected, not admitted
+    big, bigm = _entry(4096)
+    assert not store.put(("big",), big, bigm)
+    assert store.rejects == 1
+    assert store.bytes_used <= store.budget_bytes
+
+
+def test_host_store_meta_and_stats():
+    store = _store()
+    arrays, meta = _entry(1024)
+    store.put(("x",), arrays, meta)
+    assert store.meta(("x",))["len"] == 8
+    assert store.meta(("y",)) is None
+    st = store.stats()
+    assert st["entries"] == 1 and st["bytes"] == 1024
+    assert store.pop(("y",)) is None
+
+
+def test_budget_env_zero_disables_tier(monkeypatch):
+    monkeypatch.delenv("GOFR_ML_KV_HOST_BUDGET_MB", raising=False)
+    assert not OffloadConfig.from_env().enabled
+    assert HostKVStore.from_env() is None
+    monkeypatch.setenv("GOFR_ML_KV_HOST_BUDGET_MB", "0")
+    assert HostKVStore.from_env() is None
+    monkeypatch.setenv("GOFR_ML_KV_HOST_BUDGET_MB", "128")
+    store = HostKVStore.from_env()
+    assert store is not None and store.budget_bytes == 128 * 1024 * 1024
+
+
+# ------------------------------------------------- generator spill/restore
+def _paged_gen(model, *, n_pages=16, host_kv=None, **kw):
+    cfg, params = model
+    return Generator(params, cfg, batch_slots=2, max_seq=64,
+                     prefill_buckets=(8, 16), page_size=4,
+                     n_pages=n_pages, host_kv=host_kv, **kw)
+
+
+def _held_pages(gen):
+    return (sum(len(i["pages"]) for i in gen._prefixes.values())
+            + sum(len(p) - s
+                  for p, s in zip(gen._slot_pages, gen._slot_shared)))
+
+
+PFX = [5, 9, 2, 7, 1, 4, 8, 3, 6]      # 9 tokens -> 2 whole pages @ 4
+
+
+def test_spill_restore_bit_identity_and_page_conservation(model):
+    """The acceptance bar: decode after spill→restore is bit-identical to
+    the never-evicted path, and pool pages are conserved across the
+    cycle (free + prefix-held + slot-owned is invariant)."""
+    gen = _paged_gen(model, host_kv=_store())
+    pid = gen.register_prefix(PFX)
+
+    def run(prefix):
+        slot = gen.add_request([6, 2], 6, prefix=prefix)
+        while gen.slots[slot].live:
+            gen.step()
+        gen.drain()
+        toks = list(gen.slots[slot].tokens)
+        gen.release(slot)
+        return toks
+
+    ref = run(pid)  # never-evicted reference
+    conserved = gen.free_pages + _held_pages(gen)
+
+    for _ in range(3):  # several spill/restore cycles
+        assert gen._reclaim_prefix_pages(len(gen._free_pages) + 2)
+        assert not gen.has_prefix(pid)
+        assert gen.has_offloaded(PFX)
+        assert gen.free_pages + _held_pages(gen) == conserved
+        pid = gen.restore_prefix(PFX)
+        assert gen.free_pages + _held_pages(gen) == conserved
+        assert not gen.has_offloaded(PFX)   # restore MOVES, never copies
+        assert run(pid) == ref
+    assert gen.kv_spills == 3 and gen.kv_restores == 3
+    stats = gen.pool_stats()
+    assert stats["kv_spills"] == 3 and stats["kv_restores"] == 3
+
+
+def test_spill_restore_int8_pages(model):
+    """kv_quant pages spill/restore too: the int8 values AND the
+    page-shaped scales ride the same gather/scatter (both page-major on
+    axis 1), and the round trip stays bit-identical."""
+    cfg = llama.tiny_llama(use_flash=False, kv_quant=True)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    gen = Generator(params, cfg, batch_slots=2, max_seq=64,
+                    prefill_buckets=(8, 16), page_size=4, n_pages=16,
+                    host_kv=_store())
+    pid = gen.register_prefix(PFX)
+
+    def run(prefix):
+        slot = gen.add_request([6, 2], 6, prefix=prefix)
+        while gen.slots[slot].live:
+            gen.step()
+        gen.drain()
+        toks = list(gen.slots[slot].tokens)
+        gen.release(slot)
+        return toks
+
+    ref = run(pid)
+    assert gen._reclaim_prefix_pages(len(gen._free_pages) + 2)
+    assert run(gen.restore_prefix(PFX)) == ref
+
+
+def test_borrowed_prefix_never_spilled(model):
+    """refs > 0 prefixes are never eviction candidates, so their pages
+    can never be mid-copy to the host while a slot still reads them."""
+    gen = _paged_gen(model, host_kv=_store())
+    p_borrowed = gen.register_prefix(PFX)
+    p_idle = gen.register_prefix([11, 12, 13, 14, 15, 16, 17, 18])
+    gen._prefixes[p_borrowed]["refs"] = 1
+
+    assert not gen._reclaim_prefix_pages(gen.n_pages + 10)  # honest fail
+    assert gen.has_prefix(p_borrowed)
+    assert not gen.has_offloaded(PFX)          # borrowed: not in the tier
+    assert gen.has_offloaded([11, 12, 13, 14, 15, 16, 17, 18])
+    assert not gen.has_prefix(p_idle)
+
+
+def test_budget_zero_discard_parity(model, monkeypatch):
+    """With the tier off (env unset/0), eviction discards exactly as
+    before: nothing stored, no spill counters, restore raises."""
+    monkeypatch.delenv("GOFR_ML_KV_HOST_BUDGET_MB", raising=False)
+    gen = _paged_gen(model)
+    assert gen.host_kv is None
+    pid = gen.register_prefix(PFX)
+    assert gen._reclaim_prefix_pages(len(gen._free_pages) + 2)
+    assert not gen.has_prefix(pid)
+    assert not gen.has_offloaded(PFX)
+    assert gen.kv_spills == 0
+    with pytest.raises(KeyError):
+        gen.restore_prefix(PFX)
+    assert "kv_spills" in gen.pool_stats()  # counters stay visible at 0
+
+
+def test_restore_pool_pressure_falls_back(model):
+    """A restore that cannot allocate pages raises PagePoolExhausted and
+    leaves the host entry intact — the caller falls back to full prefill
+    and a later, calmer attempt can still restore."""
+    gen = _paged_gen(model, n_pages=6, host_kv=_store())
+    pid = gen.register_prefix(PFX)
+    assert gen._reclaim_prefix_pages(len(gen._free_pages) + 2)
+    assert gen.has_offloaded(PFX)
+    # occupy most of the pool with a borrowed prefix: reclaim can't help
+    blocker = gen.register_prefix(list(range(101, 101 + 16)))
+    gen._prefixes[blocker]["refs"] = 1
+    free_before = gen.free_pages
+    with pytest.raises(PagePoolExhausted):
+        gen.restore_prefix(PFX)
+    assert gen.kv_restore_fallbacks == 1
+    assert gen.free_pages == free_before     # nothing leaked
+    assert gen.has_offloaded(PFX)            # entry survived the failure
+    gen._prefixes[blocker]["refs"] = 0
+    gen.drop_prefix(blocker)
+    assert gen.restore_prefix(PFX) > 0       # calm pool: restore works
+
+
+def test_scheduler_charged_for_restores(model):
+    """Restores debit the token-budget scheduler: the dispatch after a
+    restore plans against a reduced budget (smaller ladder pick), decode
+    never collapses below the 1-step floor, and the debt drains."""
+    sched = TokenBudgetScheduler(64, (1, 2, 4, 8, 16), 16, slots=8)
+    assert sched.plan(8, False) == (8, 0)    # 64 budget / 8 rows -> 8
+    sched.charge_restore(32)
+    assert sched.restore_debt == 32
+    size, _ = sched.plan(8, False)           # half the budget repays debt
+    assert size == 4 and sched.restore_debt == 0
+    assert sched.plan(8, False) == (8, 0)    # debt drained: back to full
+    # debt is capped — a restore storm can't starve decode forever
+    for _ in range(100):
+        sched.charge_restore(10_000)
+    assert sched.restore_debt <= 4 * sched.budget
+    assert sched.snapshot()["restores_charged"] == 101
+
+    # generator-side: restore_prefix charges the live scheduler
+    gen = _paged_gen(model, host_kv=_store(), chunk=2, token_budget=32)
+    pid = gen.register_prefix(PFX)
+    assert gen._reclaim_prefix_pages(len(gen._free_pages) + 2)
+    assert gen.has_prefix(gen.restore_prefix(PFX))
+    assert gen.scheduler.restores_charged == 1
+    assert gen.scheduler.restore_debt == 8   # two whole pages
+
+
+# ------------------------------------------------------------- end to end
+def test_server_rotation_restores_bit_identical(model, run):
+    """Rotating system prompts overflow the pool; with the host tier on,
+    warm hits restore offloaded pages (restore counters move, prefill
+    tokens saved counts the restored hits) and outputs stay bit-identical
+    to the cold pass."""
+    cfg, params = model
+    prefixes = [[10 * i + j for j in range(1, 10)] for i in range(1, 4)]
+    sfx = [6, 2]
+    counts = {}
+
+    class _Metrics:
+        def add_counter(self, name, delta, **labels):
+            counts[name] = counts.get(name, 0) + delta
+
+        def set_gauge(self, name, value, **labels):
+            counts[name] = value
+
+        def record_histogram(self, name, value, **labels):
+            pass
+
+    async def scenario():
+        store = _store()
+        gen = Generator(params, cfg, batch_slots=1, max_seq=64,
+                        prefill_buckets=(8, 16), chunk=2, page_size=4,
+                        n_pages=8, host_kv=store)
+        server = LLMServer(gen, metrics=_Metrics(),
+                           prefix_cache=PrefixCacheConfig(promote_hits=1))
+        try:
+            cold = [await server.generate(p + sfx, 5) for p in prefixes]
+            warm = [await server.generate(p + sfx, 5) for p in prefixes]
+            return cold, warm, gen, server.prefix_cache.snapshot()
+        finally:
+            server.close()
+
+    cold, warm, gen, snap = run(scenario())
+    assert cold == warm                      # bit-identical after restore
+    assert gen.kv_restores >= 1              # the restore path was used
+    assert snap["restores"] == gen.kv_restores
+    assert snap["offloads"] >= gen.kv_restores
+    assert counts.get("app_ml_kv_offload_restores_total", 0) == gen.kv_restores
+    assert counts.get("app_ml_kv_offload_spills_total", 0) == gen.kv_spills
+    # restore hits count as prefill savings: 8 shared tokens per warm hit
+    assert counts.get("app_ml_prefill_tokens_saved_total", 0) >= 8
+
+
+def test_host_rss_gauge_sampled():
+    """The sampler pass publishes app_ml_host_rss_bytes (current process
+    RSS) so operators see the offload tier's footprint next to HBM."""
+    from gofr_tpu.container import Container
+    from gofr_tpu.ml import MLDatasource
+
+    c = Container()
+    c.register_framework_metrics()
+    ml = MLDatasource(metrics=c.metrics_manager)
+    ml.sample_runtime_gauges()
+    text = c.metrics_manager.expose_text()
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("app_ml_host_rss_bytes"))
+    assert float(line.rsplit(" ", 1)[1]) > 0
+
+
+def test_serving_snapshot_exposes_host_tier(model, run):
+    """/debug/serving's per-LLM block: kv_host_tier appears with entries,
+    bytes, budget and traffic counters when the tier is on."""
+    cfg, params = model
+    from gofr_tpu.ml import MLDatasource
+
+    async def scenario():
+        ml = MLDatasource()
+        gen = Generator(params, cfg, batch_slots=1, max_seq=64,
+                        prefill_buckets=(8, 16), chunk=2, page_size=4,
+                        n_pages=8, host_kv=_store())
+        server = ml.register_llm("chat", None, None, generator=gen,
+                                 prefix_cache=PrefixCacheConfig(
+                                     promote_hits=1))
+        try:
+            pid = await asyncio.to_thread(server.register_prefix, PFX)
+            assert server.has_prefix(pid)
+            gen_snap = ml.serving_snapshot()["llms"]["chat"]
+            return gen_snap
+        finally:
+            server.close()
+
+    entry = run(scenario())
+    tier = entry["kv_host_tier"]
+    assert tier["budget_bytes"] == 64 * 1024 * 1024
+    assert {"entries", "bytes", "spills", "restores",
+            "restore_fallbacks"} <= set(tier)
