@@ -41,6 +41,11 @@ Three phases, all in one run so the numbers share the same tunnel weather:
                      tokens restored vs recomputed (tokens-saved +
                      restore counters), and a greedy token-identity
                      check between the two boots.
+  G. resilience    — fault arm (GOFR_ML_FAULT=step:0.05) vs clean arm
+                     under the same traffic: every client must end in
+                     valid output or a typed gRPC error (no hangs), the
+                     watchdog's recovered-restart count, shed/deadline
+                     counters, and the clean arm's zero-restart baseline.
 
 LLAMA_PRESET=1b on TPU by default (the 8B/8-chip per-chip share), tiny on CPU.
 """
@@ -101,6 +106,21 @@ async def _debug_pool(ports, llm: str = "chat") -> dict:
                 f"http://127.0.0.1:{ports['HTTP_PORT']}/debug/serving")
             body = await r.json()
         return body["data"]["llms"][llm]["pool"]
+    except Exception:
+        return {}
+
+
+async def _debug_resilience(ports, llm: str = "chat") -> dict:
+    """The per-LLM resilience block of /debug/serving (watchdog state,
+    restart history, shed/deadline counters, fault config)."""
+    import aiohttp
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.get(
+                f"http://127.0.0.1:{ports['HTTP_PORT']}/debug/serving")
+            body = await r.json()
+        return body["data"]["llms"][llm]["resilience"]
     except Exception:
         return {}
 
@@ -651,6 +671,120 @@ async def main() -> None:
                                  if len(ident_f) == 2 else None),
         }
 
+    # ---- phase G: resilience — fault arm vs clean arm -------------------
+    # Same mixed traffic against two boots: one with GOFR_ML_FAULT arming
+    # probabilistic step faults (the generator watchdog recovers between
+    # crashes), one clean. The invariant under test: every client ends in
+    # valid output or a TYPED gRPC error within the hang budget — never a
+    # hang — while the fault arm's restart counter moves and the clean
+    # arm's stays zero (the resilience layer priced at nothing when idle).
+    # Skipped under the headline watchdog budget unless BENCH_FAULT_ARM=1
+    # (bench/run_all.py sets it).
+    fault_arm = None
+    if os.environ.get("BENCH_FAULT_ARM",
+                      "0" if skip_jitter else "1") == "1":
+        n_req_g = int(os.environ.get("BENCH_FAULT_REQUESTS",
+                                     "48" if on_tpu else "12"))
+        new_g = max(8, max_new // 8) if on_tpu else 8
+        spec_g = os.environ.get("BENCH_FAULT_SPEC",
+                                "step:0.05:RuntimeError")
+        hang_s = float(os.environ.get("BENCH_FAULT_HANG_S", "180"))
+        typed_codes = {grpc.StatusCode.UNAVAILABLE,
+                       grpc.StatusCode.RESOURCE_EXHAUSTED,
+                       grpc.StatusCode.DEADLINE_EXCEEDED}
+
+        async def fault_window(gen_fn) -> dict:
+            outcome = {"ok": 0, "typed_errors": 0, "other_errors": 0}
+            tokens_box = [0]
+            t0 = time.perf_counter()
+
+            async def one() -> None:
+                body = {"prompt_ids": rng.integers(
+                            1, vocab_hi, (prompt_len,)).tolist(),
+                        "max_new_tokens": new_g}
+                try:
+                    got = 0
+                    async for msg in gen_fn(body):
+                        got += n_toks(msg)
+                    outcome["ok"] += 1
+                    tokens_box[0] += got
+                except grpc.aio.AioRpcError as exc:
+                    key = ("typed_errors" if exc.code() in typed_codes
+                           else "other_errors")
+                    outcome[key] += 1
+
+            tasks = [asyncio.create_task(one()) for _ in range(n_req_g)]
+            _, pending = await asyncio.wait(tasks, timeout=hang_s)
+            for t in pending:   # a pending task past the budget IS a hang
+                t.cancel()
+            elapsed_g = time.perf_counter() - t0
+            res = await _debug_resilience(ports)
+            restarts = (res.get("restarts") or {}).get("total", 0)
+            return {
+                **outcome,
+                "hangs": len(pending),
+                "requests": n_req_g,
+                "elapsed_s": round(elapsed_g, 2),
+                "tok_per_s": round(tokens_box[0] / elapsed_g, 1),
+                "generator_restarts": restarts,
+                "state": res.get("state"),
+                "shed": res.get("shed"),
+                "deadline_expired": res.get("deadline_expired"),
+                "fault": res.get("fault"),
+            }
+
+        arms_g: dict = {}
+        for mode in ("clean", "fault"):
+            if mode == "fault":
+                os.environ["GOFR_ML_FAULT"] = spec_g
+                # generous budget: the arm measures recovery, not death
+                os.environ["GOFR_ML_MAX_RESTARTS"] = os.environ.get(
+                    "BENCH_FAULT_MAX_RESTARTS", "1000")
+            appG = chG = None
+            try:
+                appG = build_app()
+                await boot(appG)
+                chG = grpc.aio.insecure_channel(
+                    f"127.0.0.1:{ports['GRPC_PORT']}")
+                genG = chG.unary_stream(
+                    "/llm.Chat/Generate",
+                    request_serializer=lambda o: json.dumps(o).encode(),
+                    response_deserializer=lambda raw: (json.loads(raw)
+                                                       if raw else {}),
+                )
+                try:
+                    async for _ in genG(req(4)):    # warm compiles
+                        pass
+                except grpc.aio.AioRpcError:
+                    # the fault arm may crash the very first dispatch —
+                    # that's the feature under test, not a boot failure
+                    # (warmup compiled everything server-side regardless)
+                    if mode != "fault":
+                        raise
+                arms_g[mode] = await fault_window(genG)
+            except Exception as exc:    # optional arm: record, don't abort
+                arms_g[mode] = {"error": str(exc)}
+            finally:
+                os.environ.pop("GOFR_ML_FAULT", None)
+                os.environ.pop("GOFR_ML_MAX_RESTARTS", None)
+                if chG is not None:
+                    await chG.close()
+                if appG is not None:
+                    await appG.shutdown()
+        clean_g, faulted_g = arms_g.get("clean", {}), arms_g.get("fault", {})
+        fault_arm = {
+            "fault_spec": spec_g,
+            "clean": clean_g,
+            "fault": faulted_g,
+            # the headline invariant: nobody hangs, in either arm, and
+            # the fault arm actually exercised recovery
+            "no_hangs": (clean_g.get("hangs") == 0
+                         and faulted_g.get("hangs") == 0
+                         if "hangs" in clean_g and "hangs" in faulted_g
+                         else None),
+            "recovered_crashes": faulted_g.get("generator_restarts"),
+        }
+
     agg_tok_s = sum(token_counts) / elapsed
     emit(
         "llama_served_tok_per_s", agg_tok_s, "tok/s", 2000.0,
@@ -695,6 +829,10 @@ async def main() -> None:
             # phase F: tiered KV cache — warm-hit TTFT with host offload
             # on vs off under rotating pool-overflowing system prompts
             "kv_offload": (offload_arm if offload_arm is not None
+                           else "skipped (headline budget)"),
+            # phase G: resilience — fault arm vs clean arm: no client
+            # hangs, watchdog recoveries counted, clean arm untouched
+            "resilience": (fault_arm if fault_arm is not None
                            else "skipped (headline budget)"),
             "preset": os.environ.get("LLAMA_PRESET", "tiny"),
             "backend": jax.default_backend(),

@@ -63,13 +63,33 @@ def _priority(body) -> int:
         raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
 
 
+def _deadline(body):
+    """Per-request TTL from the body (``"deadline_s": 2.5``): past it the
+    server reaps the request — queued or mid-decode — with a 504 /
+    DEADLINE_EXCEEDED. None defers to GOFR_ML_DEFAULT_DEADLINE_S."""
+    raw = body.get("deadline_s")
+    if raw is None:
+        return None
+    import math
+
+    try:
+        deadline = float(raw)
+        if not math.isfinite(deadline) or deadline < 0:
+            raise ValueError
+    except (TypeError, ValueError):
+        raise gofr_tpu.errors.InvalidInput(
+            f"deadline_s must be a finite number >= 0, got {raw!r}") from None
+    return deadline
+
+
 async def generate(ctx: gofr_tpu.Context):
     body = await ctx.bind()
     ids = _prompt_ids(body)
     max_new = int(body.get("max_new_tokens", 64))
     llm = ctx.ml.llm("chat")
     _admissible(llm, ids, max_new)
-    tokens = await llm.generate(ids, max_new, priority=_priority(body))
+    tokens = await llm.generate(ids, max_new, priority=_priority(body),
+                                deadline_s=_deadline(body))
     out = {"tokens": tokens}
     if body.get("prompt"):  # text in -> text out
         out["text"] = TOKENIZER.decode(tokens)
@@ -82,7 +102,8 @@ async def stream_ws(ctx: gofr_tpu.Context):
     llm = ctx.ml.llm("chat")
     max_new = int(body.get("max_new_tokens", 64))
     _admissible(llm, ids, max_new)
-    async for tok in llm.stream(ids, max_new, priority=_priority(body)):
+    async for tok in llm.stream(ids, max_new, priority=_priority(body),
+                                deadline_s=_deadline(body)):
         await ctx.write_message_to_socket({"token": tok})
     return {"done": True}
 
@@ -135,7 +156,8 @@ def main() -> gofr_tpu.App:
         _admissible(llm, request["prompt_ids"], max_new)
         async for burst in llm.stream_chunks(request["prompt_ids"],
                                              max_new,
-                                             priority=_priority(request)):
+                                             priority=_priority(request),
+                                             deadline_s=_deadline(request)):
             yield {"tokens": burst}
 
     svc.stream("Generate", grpc_generate)

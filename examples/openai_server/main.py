@@ -115,6 +115,27 @@ def _priority_or_400(ctx) -> int:
         raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
 
 
+def _deadline_or_400(ctx):
+    """Per-request TTL from the ``X-Request-Deadline-S`` header (seconds;
+    out-of-band like priority — a gateway stamps it from its own budget).
+    Past it the server answers 504 with the request reaped wherever it
+    sat. Absent -> GOFR_ML_DEFAULT_DEADLINE_S applies."""
+    raw = ctx.headers.get("X-Request-Deadline-S")
+    if raw is None:
+        return None
+    import math
+
+    try:
+        deadline = float(raw)
+        if not math.isfinite(deadline) or deadline < 0:
+            raise ValueError
+    except (TypeError, ValueError):
+        raise gofr_tpu.errors.InvalidInput(
+            f"X-Request-Deadline-S must be a finite number >= 0, "
+            f"got {raw!r}") from None
+    return deadline
+
+
 def _openai_finish(info: dict, n_out: int, max_new: int) -> str:
     """Map the LLM server's finish reason onto OpenAI's vocabulary. An
     evicted (pool-dry, truncated) answer reports "length" — never the
@@ -155,6 +176,7 @@ async def chat_completions(ctx: gofr_tpu.Context):
     n_prompt = len(ids)
     _admissible_or_400(llm, ids, max_new)
     prio = _priority_or_400(ctx)
+    ttl = _deadline_or_400(ctx)
     rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
     created = int(time.time())
 
@@ -170,7 +192,8 @@ async def chat_completions(ctx: gofr_tpu.Context):
             # several tokens' text — valid OpenAI protocol, far fewer
             # frames)
             async for burst in llm.stream_chunks(ids, max_new, info=fin,
-                                                 priority=prio):
+                                                 priority=prio,
+                                                 deadline_s=ttl):
                 n_out += len(burst)
                 await stream.send(_chunk(
                     "chat.completion.chunk", rid, created,
@@ -195,7 +218,8 @@ async def chat_completions(ctx: gofr_tpu.Context):
 
     fin: dict = {}
     try:
-        toks = await llm.generate(ids, max_new, info=fin, priority=prio)
+        toks = await llm.generate(ids, max_new, info=fin, priority=prio,
+                                  deadline_s=ttl)
     except ValueError as exc:
         # backstop for admission races between the up-front check and the
         # serving thread's admit
@@ -230,6 +254,7 @@ async def completions(ctx: gofr_tpu.Context):
     ids, max_new, llm = _prepare(ctx, prompt, body)
     _admissible_or_400(llm, ids, max_new)
     prio = _priority_or_400(ctx)
+    ttl = _deadline_or_400(ctx)
     rid = f"cmpl-{uuid.uuid4().hex[:24]}"
     created = int(time.time())
 
@@ -239,7 +264,8 @@ async def completions(ctx: gofr_tpu.Context):
             dec = _StreamDecoder()
             fin: dict = {}
             async for burst in llm.stream_chunks(ids, max_new, info=fin,
-                                                 priority=prio):
+                                                 priority=prio,
+                                                 deadline_s=ttl):
                 n_out += len(burst)
                 await stream.send(_chunk(
                     "text_completion", rid, created,
@@ -256,7 +282,8 @@ async def completions(ctx: gofr_tpu.Context):
 
     fin: dict = {}
     try:
-        toks = await llm.generate(ids, max_new, info=fin, priority=prio)
+        toks = await llm.generate(ids, max_new, info=fin, priority=prio,
+                                  deadline_s=ttl)
     except ValueError as exc:
         raise gofr_tpu.errors.InvalidInput(str(exc)) from exc
     return gofr_tpu.Raw({

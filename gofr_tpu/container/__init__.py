@@ -164,6 +164,15 @@ class Container:
         m.new_gauge("app_llm_prefill_share",
                     "budget fraction reserved for chunked prefill "
                     "(SLO-steered)")
+        m.new_counter("app_ml_generator_restarts_total",
+                      "LLM generator crashes recovered by the serving "
+                      "watchdog (decode state rebuilt, queue resumed)")
+        m.new_counter("app_llm_deadline_exceeded_total",
+                      "LLM requests reaped past their deadline (queued or "
+                      "mid-decode)")
+        m.new_counter("app_llm_shed_total",
+                      "LLM requests shed at admission under overload, per "
+                      "priority class")
         m.new_gauge("app_llm_evictions",
                     "streams truncated because the KV page pool ran dry")
         m.new_gauge("app_llm_prefix_evictions",

@@ -37,7 +37,9 @@ _HTTP_TO_GRPC = {
     404: grpc.StatusCode.NOT_FOUND,
     408: grpc.StatusCode.DEADLINE_EXCEEDED,
     409: grpc.StatusCode.ALREADY_EXISTS,
-    503: grpc.StatusCode.UNAVAILABLE,
+    429: grpc.StatusCode.RESOURCE_EXHAUSTED,  # Overloaded (load shed)
+    503: grpc.StatusCode.UNAVAILABLE,         # ServerClosed/GeneratorCrashed
+    504: grpc.StatusCode.DEADLINE_EXCEEDED,   # DeadlineExceeded (TTL)
 }
 
 

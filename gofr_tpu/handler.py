@@ -79,10 +79,23 @@ def wrap_handler(
 
 
 def health_handler(container: Container):
-    """Aggregated readiness at /.well-known/health (reference handler.go:110)."""
+    """Aggregated readiness at /.well-known/health (reference handler.go:110).
+
+    A datasource reporting DOWN (e.g. a dead LLM server whose generator
+    crash-looped past its restart budget) answers 503 with the full health
+    payload attached — a load balancer must stop routing here, and a 200
+    with "DOWN" buried in the body would keep traffic coming."""
 
     async def handler(ctx: Context) -> Any:
-        return await ctx.container.health()
+        health = await ctx.container.health()
+        if any(isinstance(v, dict) and v.get("status") == "DOWN"
+               for v in health.values()):
+            from .http.errors import ServiceUnavailable
+
+            err = ServiceUnavailable("one or more datasources are DOWN")
+            err.response = dict(health)  # full payload in the 503 envelope
+            raise err
+        return health
 
     return handler
 

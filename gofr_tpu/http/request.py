@@ -74,15 +74,17 @@ def _coerce(value: Any, annot: Any) -> Any:
                 return value.zip()
             except Exception as exc:
                 raise InvalidInput(
-                    f"field expects a zip archive, got {value.filename!r}: {exc}")
+                    f"field expects a zip archive, got {value.filename!r}: "
+                    f"{exc}") from exc
         if annot in (None, Any, bytes):
             return value.content
         if annot is str:
             try:
                 return value.content.decode()
-            except UnicodeDecodeError:
+            except UnicodeDecodeError as exc:
                 raise InvalidInput(
-                    f"uploaded file {value.filename!r} is not valid text")
+                    f"uploaded file {value.filename!r} is not valid "
+                    f"text") from exc
         raise InvalidInput(
             f"cannot bind uploaded file {value.filename!r} to {annot}")
     if annot in (Zip, UploadedFile):
@@ -96,8 +98,8 @@ def _coerce(value: Any, annot: Any) -> Any:
             return value.lower() in ("1", "true", "yes", "on")
         if annot in (int, float, str, bool) and not isinstance(value, annot):
             return annot(value)
-    except (TypeError, ValueError):
-        raise InvalidInput(f"cannot convert {value!r} to {annot}")
+    except (TypeError, ValueError) as exc:
+        raise InvalidInput(f"cannot convert {value!r} to {annot}") from exc
     return value
 
 
@@ -112,7 +114,7 @@ def bind_to_model(data: Mapping[str, Any], model: type) -> Any:
         try:
             return model(**kwargs)
         except TypeError as exc:
-            raise InvalidInput(str(exc))
+            raise InvalidInput(str(exc)) from exc
     obj = model()
     for k, v in data.items():
         if isinstance(v, UploadedFile):
@@ -178,7 +180,7 @@ class HTTPRequest:
                 try:
                     data = json.loads(raw)
                 except json.JSONDecodeError as exc:
-                    raise InvalidInput(f"invalid JSON body: {exc}")
+                    raise InvalidInput(f"invalid JSON body: {exc}") from exc
         elif ctype in ("application/x-www-form-urlencoded", "multipart/form-data"):
             post = await self.raw.post()
             data = {}

@@ -93,6 +93,12 @@ def respond(method: str, result: Any, err: BaseException | None) -> web.StreamRe
     status = _status_code(method, result, err)
     envelope: dict[str, Any] = {}
     if err is not None:
+        # typed errors may carry response headers (e.g. Overloaded's
+        # Retry-After computed from the queue drain rate)
+        extra_headers = getattr(err, "headers", None)
+        if isinstance(extra_headers, dict):
+            headers = {**headers,
+                       **{str(k): str(v) for k, v in extra_headers.items()}}
         error_obj: dict[str, Any] = {"message": str(err) or type(err).__name__}
         extra = getattr(err, "response", None)
         if isinstance(extra, dict):

@@ -115,9 +115,16 @@ class MLDatasource:
         from .generate import Generator
         from .llm import LLMServer
 
-        # server-level policy, not a Generator knob: False disables the
-        # framework shared-prefix cache, a PrefixCacheConfig tunes it
-        prefix_cache = gen_kwargs.pop("prefix_cache", None)
+        # server-level policy, not Generator knobs: the prefix cache and
+        # the resilience bounds ride the LLMServer (env defaults apply
+        # when unset), everything else goes to the Generator
+        server_kwargs = {
+            k: gen_kwargs.pop(k)
+            for k in ("prefix_cache", "max_restarts", "restart_window_s",
+                      "default_deadline_s", "max_queue",
+                      "max_queued_tokens", "fault")
+            if k in gen_kwargs
+        }
         if generator is None:
             warm = gen_kwargs.pop("warmup", True)
             generator = Generator(params, cfg, **gen_kwargs)
@@ -126,7 +133,7 @@ class MLDatasource:
                 generator.warmup()
         server = LLMServer(generator, name=name, logger=self._logger,
                            metrics=self._metrics, tracer=self._tracer,
-                           prefix_cache=prefix_cache)
+                           **server_kwargs)
         self._llms[name] = server
         if self._logger is not None:
             self._logger.infof("llm %s registered (%d slots)", name,
@@ -263,6 +270,10 @@ class MLDatasource:
                 # token budget, chunk-size mix, SLO steering state, and
                 # per-priority ready-queue depth/age
                 entry["scheduler"] = server.scheduler_snapshot()
+            if hasattr(server, "resilience_snapshot"):
+                # watchdog state, restart budget/history, shed + deadline
+                # counters, queue bounds, armed fault config
+                entry["resilience"] = server.resilience_snapshot()
             snap["llms"][name] = entry
         return snap
 
@@ -281,7 +292,12 @@ class MLDatasource:
             for name, server in self._llms.items():
                 h = server.health_check()
                 details["llms"][name] = h["details"]
-                if h["status"] != "UP":
+                if h["status"] == "DOWN":
+                    # a dead LLM server cannot complete anything: the
+                    # datasource is DOWN, and the health handler turns
+                    # that into a non-200 readiness answer
+                    status = "DOWN"
+                elif h["status"] != "UP" and status == "UP":
                     status = "DEGRADED"
         return {"status": status, "details": details}
 

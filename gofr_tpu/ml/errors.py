@@ -1,0 +1,80 @@
+"""Typed serving-plane errors for the LLM resilience layer.
+
+Subclasses of the framework's ``GofrError`` (http/errors.py) so the PR-1
+status machinery applies everywhere for free: the HTTP responder maps
+``status_code`` onto the JSON error envelope, and the gRPC interceptor
+maps the same codes onto gRPC statuses (429 → RESOURCE_EXHAUSTED,
+503 → UNAVAILABLE, 504 → DEADLINE_EXCEEDED) instead of collapsing every
+serving failure into a generic 500/INTERNAL "panic".
+
+These are the errors a CLIENT of the serving plane can receive; the
+transient control-flow signals the serving loop handles internally
+(``PagePoolExhausted``, ``PrefixEvicted``) stay in generate.py.
+"""
+
+from __future__ import annotations
+
+from http import HTTPStatus
+
+from ..http.errors import GofrError
+
+__all__ = [
+    "ServerClosed",
+    "GeneratorCrashed",
+    "DeadlineExceeded",
+    "Overloaded",
+]
+
+
+class ServerClosed(GofrError):
+    """The LLM server is shut down (or shutting down): no request can be
+    accepted or completed. 503 / UNAVAILABLE — a retry against another
+    replica is the right client move."""
+
+    status_code = HTTPStatus.SERVICE_UNAVAILABLE
+
+    def __init__(self, message: str = "llm server is closed") -> None:
+        super().__init__(message)
+
+
+class GeneratorCrashed(GofrError):
+    """A device dispatch failed underneath this request: its slot state is
+    gone and the generation cannot be resumed. The server recovers and
+    keeps serving queued traffic (or goes dead once the restart budget is
+    spent) — either way THIS request is over. 503 / UNAVAILABLE: safe to
+    retry, the prompt was not partially committed anywhere."""
+
+    status_code = HTTPStatus.SERVICE_UNAVAILABLE
+
+    def __init__(self, message: str = "llm generator crashed") -> None:
+        super().__init__(message)
+
+
+class DeadlineExceeded(GofrError):
+    """The request's deadline (``deadline_s=`` / ``GOFR_ML_DEFAULT_
+    DEADLINE_S``) passed before completion — while still queued (never
+    prefilled) or mid-decode (slot cancelled, pages freed).
+    504 / DEADLINE_EXCEEDED."""
+
+    status_code = HTTPStatus.GATEWAY_TIMEOUT
+
+    def __init__(self, message: str = "request deadline exceeded") -> None:
+        super().__init__(message)
+
+
+class Overloaded(GofrError):
+    """Admission was shed under overload (``GOFR_ML_MAX_QUEUE`` /
+    ``GOFR_ML_MAX_QUEUED_TOKENS``). Carries ``retry_after`` seconds
+    computed from the observed queue drain rate; the HTTP responder
+    publishes it as a ``Retry-After`` header next to the 429."""
+
+    status_code = HTTPStatus.TOO_MANY_REQUESTS
+
+    def __init__(self, message: str | None = None,
+                 retry_after: float = 1.0) -> None:
+        self.retry_after = max(0.0, float(retry_after))
+        super().__init__(message or "server overloaded; request shed")
+        # honored by http/responder.respond (headers) and surfaced in the
+        # JSON error envelope (response) so every transport carries it
+        self.headers = {"Retry-After": str(max(1, round(self.retry_after)))}
+        self.response = {"retry_after_s": round(self.retry_after, 3)}

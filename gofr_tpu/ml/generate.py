@@ -228,17 +228,10 @@ class Generator:
                         f"page_size")
             self._p_max = -(-max_seq // self.page_size)
             self.n_pages = n_pages or (1 + batch_slots * self._p_max)
-            self.cache = llama.init_paged_cache(
-                cfg, batch_slots, self.n_pages, self.page_size)
-            # page 0 is scratch; the free list is a stack of real pages
-            self._free_pages = list(range(self.n_pages - 1, 0, -1))
-            self._slot_pages: list[list[int]] = [
-                [] for _ in range(batch_slots)]
-            self._table = np.zeros((batch_slots, self._p_max), np.int32)
+            self._shard_cache = False
+            self._reset_cache_storage()
             # shared-prefix bookkeeping: per-slot count of BORROWED pages
             # (never freed back by this slot) and the owning prefix id
-            self._slot_shared = [0] * batch_slots
-            self._slot_prefix: list[int | None] = [None] * batch_slots
             self._prefixes: dict[int, dict] = {}
             self._next_prefix = 1
             self._prefix_clock = 0   # LRU stamp for prefix eviction
@@ -290,39 +283,12 @@ class Generator:
             from ..parallel import NamedSharding
             from ..parallel import P as _P
 
-            specs = self._serving_cache_specs()
-            self.cache = jax.jit(
-                lambda: llama.init_cache(cfg, batch_slots, max_seq),
-                out_shardings={
-                    key: NamedSharding(mesh, s) for key, s in specs.items()
-                },
-            )()
+            self._shard_cache = True
             self._repl = NamedSharding(mesh, _P())
-        elif mesh is not None and getattr(cfg, "sequence_parallel", False):
-            # long-context serving: KV cache sequence axis sharded over sp,
-            # decode attention combines shards via pmax/psum (ring.py)
-            from ..parallel import NamedSharding
-            from ..parallel import P as _P
-
-            self.cache = llama.init_cache(cfg, batch_slots, max_seq)
-            if getattr(cfg, "kv_quant", False):
-                # int8 layout (models/llama.init_cache): flat values
-                # [L, B, S, KV*D], seq-MINOR scales [L, B, KV, S]
-                specs = {"k": _P(None, "dp", "sp", None),
-                         "v": _P(None, "dp", "sp", None),
-                         "k_scale": _P(None, "dp", None, "sp"),
-                         "v_scale": _P(None, "dp", None, "sp"),
-                         "len": _P("dp")}
-            else:
-                specs = {"k": _P(None, "dp", "sp", None, None),
-                         "v": _P(None, "dp", "sp", None, None),
-                         "len": _P("dp")}
-            self.cache = {
-                key: jax.device_put(arr, NamedSharding(mesh, specs[key]))
-                for key, arr in self.cache.items()
-            }
+            self._reset_cache_storage()
         else:
-            self.cache = llama.init_cache(cfg, batch_slots, max_seq)
+            self._shard_cache = False
+            self._reset_cache_storage()
         self.slots = [_Slot() for _ in range(batch_slots)]
         # two independent streams: decode keys fold the step counter,
         # prefill keys fold a request counter — no collisions between the
@@ -338,6 +304,12 @@ class Generator:
         self._inflight: collections.deque = collections.deque()  # [chunk, B] arrays
         self._pending_first: collections.deque = collections.deque()  # (slot, dev scalar)
         self.steps = 0
+        self.restarts = 0  # successful crash recoveries (recover())
+        # chaos hook (testutil/faults.py): the serving layer installs a
+        # FaultInjector here when GOFR_ML_FAULT is set; every instrumented
+        # dispatch site guards with ``is not None`` so the disabled path
+        # costs one attribute test, nothing else
+        self.fault = None
         # async-prefetch failures (satellite: the bare except around
         # copy_to_host_async must be observable — a broken prefetch path
         # degrades every dispatch silently otherwise)
@@ -874,6 +846,7 @@ class Generator:
             "chunked_prefills": len(self._chunked),
             "prefill_segments": self.prefill_segments_run,
             "prefetch_errors": self.prefetch_errors,
+            "restarts": self.restarts,
         }
         if self.page_size:
             out.update(
@@ -995,6 +968,8 @@ class Generator:
         pages — the pages are then discarded exactly as before."""
         if self.host_kv is None or not info["pages"] or not info["len"]:
             return False
+        if self.fault is not None:
+            self.fault("spill")
         key = tuple(int(t) for t in info["ids_full"])
         pages = np.asarray(info["pages"], np.int32)
         with self._mesh_ctx():
@@ -1044,6 +1019,8 @@ class Generator:
             raise ValueError("kv offload requires page_size > 0")
         if self.host_kv is None:
             raise KeyError("host kv tier is disabled")
+        if self.fault is not None:
+            self.fault("restore")
         key = tuple(int(t) for t in prefix_ids)
         popped = self.host_kv.pop(key)  # popped FIRST: a reclaim below may
         if popped is None:              # spill others and LRU-evict us
@@ -1096,6 +1073,8 @@ class Generator:
         pages, prefill only the suffix at start=shared_len."""
         if pid not in self._prefixes:
             raise PrefixEvicted(f"prefix {pid} was evicted; re-register")
+        if self.fault is not None:
+            self.fault("prefill")
         info = self._prefixes[pid]
         self._prefix_clock += 1
         info["last_use"] = self._prefix_clock
@@ -1223,6 +1202,156 @@ class Generator:
                 "v": _P(None, dp, None, tp, None),
                 "len": _P()}
 
+    def _reset_cache_storage(self) -> None:
+        """(Re)create the KV cache arrays — and, in paged mode, the page
+        pool's host bookkeeping — in whichever of the four layouts this
+        generator runs (paged / multi-controller sharded / sequence-
+        parallel / dense). Shared by ``__init__`` and ``recover()``: a
+        crashed dispatch may have consumed the donated cache buffers, and
+        rebuilding must produce exactly the construction-time layout."""
+        llama = self._m
+        cfg = self.cfg
+        if self.page_size:
+            self.cache = llama.init_paged_cache(
+                cfg, self.batch_slots, self.n_pages, self.page_size)
+            # page 0 is scratch; the free list is a stack of real pages
+            self._free_pages = list(range(self.n_pages - 1, 0, -1))
+            self._slot_pages: list[list[int]] = [
+                [] for _ in range(self.batch_slots)]
+            self._table = np.zeros((self.batch_slots, self._p_max), np.int32)
+            self._slot_shared = [0] * self.batch_slots
+            self._slot_prefix: list[int | None] = [None] * self.batch_slots
+            return
+        if self._shard_cache:
+            from ..parallel import NamedSharding
+
+            specs = self._serving_cache_specs()
+            self.cache = jax.jit(
+                lambda: llama.init_cache(cfg, self.batch_slots, self.max_seq),
+                out_shardings={
+                    key: NamedSharding(self.mesh, s)
+                    for key, s in specs.items()
+                },
+            )()
+            return
+        if self.mesh is not None and getattr(cfg, "sequence_parallel", False):
+            # long-context serving: KV cache sequence axis sharded over sp,
+            # decode attention combines shards via pmax/psum (ring.py)
+            from ..parallel import NamedSharding
+            from ..parallel import P as _P
+
+            cache = llama.init_cache(cfg, self.batch_slots, self.max_seq)
+            if getattr(cfg, "kv_quant", False):
+                # int8 layout (models/llama.init_cache): flat values
+                # [L, B, S, KV*D], seq-MINOR scales [L, B, KV, S]
+                specs = {"k": _P(None, "dp", "sp", None),
+                         "v": _P(None, "dp", "sp", None),
+                         "k_scale": _P(None, "dp", None, "sp"),
+                         "v_scale": _P(None, "dp", None, "sp"),
+                         "len": _P("dp")}
+            else:
+                specs = {"k": _P(None, "dp", "sp", None, None),
+                         "v": _P(None, "dp", "sp", None, None),
+                         "len": _P("dp")}
+            self.cache = {
+                key: jax.device_put(arr,
+                                    NamedSharding(self.mesh, specs[key]))
+                for key, arr in cache.items()
+            }
+            return
+        self.cache = llama.init_cache(cfg, self.batch_slots, self.max_seq)
+
+    def recover(self) -> list[int]:
+        """Crash recovery for the serving watchdog (llm.py): discard
+        everything the crashed dispatch may have corrupted and rebuild
+        decode state so the WAITING queue can admit again.
+
+        In-flight slot state (tokens, callbacks, borrowed pages, chunked-
+        prefill progress, the async token pipeline) is dropped — the
+        serving layer has already failed those requests with a typed
+        error. Registered prefixes survive when their device pages were
+        untouched: BORROWED registrations (a crashed slot was attending
+        them) are invalidated, and when the crash consumed the donated
+        cache buffers every registration goes with the rebuilt pool. The
+        host KV tier is deliberately untouched — offloaded entries were
+        never device-resident during the crash, so they stay restorable.
+        Returns the invalidated prefix ids so the serving layer can clear
+        its radix cache.
+
+        Finishes with a 1-step re-warmup dispatch from the pre-jitted
+        ladder and a blocking fetch: recovery either proves the decode
+        path works end-to-end or raises (the watchdog then declares the
+        server dead)."""
+        self._inflight.clear()
+        self._pending_first.clear()
+        self._chunked.clear()
+        self._chunked_order.clear()
+        invalidated: list[int] = []
+        if self.page_size:
+            borrowed = [pid for pid, info in self._prefixes.items()
+                        if info["refs"] > 0]
+            for i in range(self.batch_slots):
+                self.slots[i].live = False
+                self._free_slot_pages(i)
+            for pid in borrowed:
+                info = self._prefixes.pop(pid, None)
+                if info is not None:
+                    self._free_pages.extend(info["pages"])
+                    invalidated.append(pid)
+        leaves = jax.tree_util.tree_leaves(self.cache)
+        if any(getattr(leaf, "is_deleted", lambda: False)()
+               for leaf in leaves):
+            # the crash consumed the donated cache: every device-resident
+            # prefix page went with it — rebuild the pool from scratch
+            if self.page_size:
+                invalidated.extend(self._prefixes)
+                self._prefixes.clear()
+            self._reset_cache_storage()
+        for i in range(self.batch_slots):
+            self.slots[i] = _Slot()
+        # the token row (and spec history) ride donated buffers too:
+        # always rebuild rather than probing their liveness
+        self._tok_dev = self._repl_zeros((self.batch_slots,))
+        if self.spec_k:
+            self._tokens_dev = self._repl_zeros(
+                (self.batch_slots, self._hist_cap))
+            if self.draft_params is not None:
+                self._draft_cache = self._m.init_cache(
+                    self.draft_cfg, self.batch_slots,
+                    self.max_seq + self.spec_k + 2)
+        self.restarts += 1
+        with self._mesh_ctx():
+            self._warm_dispatch(self._mini_chunk_fn)
+        np.asarray(self._tok_dev)
+        return invalidated
+
+    def _warm_dispatch(self, fn) -> None:
+        """One dead-batch dispatch of a chunk program (all slots garbage):
+        compiles it on first use (warmup) and proves a rebuilt decode
+        state executes (recover). Callers hold the mesh context."""
+        if self.spec_k and self.page_size:
+            (_row0, _e, _c, self._tok_dev, self.cache,
+             self._tokens_dev, self._draft_cache) = fn(
+                self.params, self._tok_dev, self.cache,
+                self._tokens_dev, self._draft_cache,
+                np.zeros_like(self._table))
+        elif self.spec_k:
+            (_row0, _e, _c, self._tok_dev, self.cache,
+             self._tokens_dev, self._draft_cache) = fn(
+                self.params, self._tok_dev, self.cache,
+                self._tokens_dev, self._draft_cache)
+        elif self.page_size:
+            _toks, self._tok_dev, self.cache = fn(
+                self.params, self._tok_dev, self.cache,
+                np.int32(0), self._base_key,
+                np.zeros_like(self._table),  # all-scratch tables
+            )
+        else:
+            _toks, self._tok_dev, self.cache = fn(
+                self.params, self._tok_dev, self.cache,
+                np.int32(0), self._base_key,
+            )
+
     def warmup(self) -> None:
         """Compile the decode programs (full chunk + TTFT mini-chunk) and
         the prefill buckets before the first request — a lazy first-use
@@ -1252,28 +1381,7 @@ class Generator:
                 fns.append(self._mini_chunk_fn)
         with self._mesh_ctx():
             for fn in fns:
-                if self.spec_k and self.page_size:
-                    (_row0, _e, _c, self._tok_dev, self.cache,
-                     self._tokens_dev, self._draft_cache) = fn(
-                        self.params, self._tok_dev, self.cache,
-                        self._tokens_dev, self._draft_cache,
-                        np.zeros_like(self._table))
-                elif self.spec_k:
-                    (_row0, _e, _c, self._tok_dev, self.cache,
-                     self._tokens_dev, self._draft_cache) = fn(
-                        self.params, self._tok_dev, self.cache,
-                        self._tokens_dev, self._draft_cache)
-                elif self.page_size:
-                    _toks, self._tok_dev, self.cache = fn(
-                        self.params, self._tok_dev, self.cache,
-                        np.int32(0), self._base_key,
-                        np.zeros_like(self._table),  # all-scratch tables
-                    )
-                else:
-                    _toks, self._tok_dev, self.cache = fn(
-                        self.params, self._tok_dev, self.cache,
-                        np.int32(0), self._base_key,
-                    )
+                self._warm_dispatch(fn)
             if self.prefill_chunk:
                 # segment program: startup pays the compile, not the first
                 # long prompt (len reset by the bucket prefills below)
@@ -1543,6 +1651,8 @@ class Generator:
                 self._chunked.pop(slot, None)
                 self._chunked_order.popleft()
                 continue
+            if self.fault is not None:
+                self.fault("prefill")
             C = self.prefill_chunk
             start = st["done"]
             seg = st["ids"][start:start + C]
@@ -1613,6 +1723,8 @@ class Generator:
                 return
 
     def _admit_waves(self, prepped, out: list[int]) -> list[int]:
+        if self.fault is not None and prepped:
+            self.fault("prefill")
         for start in range(0, len(prepped), self._admit_cap):
             wave = prepped[start:start + self._admit_cap]
             slots = []
@@ -1766,6 +1878,8 @@ class Generator:
         if self.n_live == 0:
             self.drain()
             return
+        if self.fault is not None:
+            self.fault("step")
         sched = self.scheduler
         n_steps = self.chunk
         if sched is not None:

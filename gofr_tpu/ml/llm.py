@@ -18,18 +18,31 @@ Paged generators additionally get the framework shared-prefix cache
 trie of cached prefixes, prefills only the suffix on a hit, and
 auto-registers hot prefixes — no caller opt-in; ``register_prefix``
 remains as the pinning API on top.
+
+Resilience (errors.py + the watchdog in ``_serve``): every device
+dispatch runs supervised — a crash fails only the in-flight slots with a
+typed error, rebuilds the generator, and resumes the waiting queue, with
+a restart budget against crash-loops; requests carry deadlines
+(``deadline_s=``), admission is bounded with lowest-priority-first
+shedding (429 + Retry-After), and ``GOFR_ML_FAULT`` arms the chaos hook
+that exercises all of it (testutil/faults.py).
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import os
 import queue as _queue
 import threading
 import time
+import traceback
 from typing import Any, AsyncIterator
 
+from ..testutil.faults import FaultInjector
 from ..tracing import current_context
+from .errors import (DeadlineExceeded, GeneratorCrashed, Overloaded,
+                     ServerClosed)
 from .generate import PagePoolExhausted, PrefixEvicted
 from .prefix_cache import PrefixCacheConfig, RadixPrefixCache
 from .scheduler import (PRIORITIES, AgingPriorityQueue, SLOController,
@@ -56,16 +69,27 @@ class _Request:
     __slots__ = ("prompt", "max_new", "out_q", "loop", "enqueued_at", "slot",
                  "first_token_at", "cancelled", "prefix", "trace_ctx",
                  "queue_span", "decode_span", "full_prompt", "cache_seen",
-                 "priority", "last_burst_at")
+                 "priority", "last_burst_at", "deadline_at", "deadline_hit",
+                 "n_tokens")
 
     def __init__(self, prompt, max_new, out_q, loop, prefix=None,
-                 trace_ctx=None, queue_span=None, priority: int = 1) -> None:
+                 trace_ctx=None, queue_span=None, priority: int = 1,
+                 deadline_s: float = 0.0) -> None:
         self.prompt = prompt
         self.max_new = max_new
         self.out_q = out_q
         self.loop = loop
         self.priority = priority  # class index into scheduler.PRIORITIES
         self.enqueued_at = time.perf_counter()
+        # absolute TTL: past it the request is reaped wherever it sits —
+        # queued (never prefilled) or mid-decode (slot cancelled)
+        self.deadline_at = (self.enqueued_at + deadline_s
+                            if deadline_s > 0 else None)
+        self.deadline_hit = False
+        try:  # queued-token accounting for the shedding bound
+            self.n_tokens = len(prompt)
+        except TypeError:
+            self.n_tokens = 0
         self.last_burst_at = None  # SLO controller's live-cadence anchor
         self.slot = None
         self.first_token_at = None
@@ -96,7 +120,13 @@ class LLMServer:
 
     def __init__(self, generator, *, name: str = "llm", logger=None,
                  metrics=None, tracer=None, idle_wait_s: float = 0.002,
-                 admit_window_s: float = 0.004, prefix_cache=None) -> None:
+                 admit_window_s: float = 0.004, prefix_cache=None,
+                 max_restarts: int | None = None,
+                 restart_window_s: float | None = None,
+                 default_deadline_s: float | None = None,
+                 max_queue: int | None = None,
+                 max_queued_tokens: int | None = None,
+                 fault: Any = None) -> None:
         self.gen = generator
         self.name = name
         self._logger = logger
@@ -139,6 +169,56 @@ class LLMServer:
         self._active: dict[int, _Request] = {}
         self._closed = False
         self.served = 0
+        # -- resilience layer -------------------------------------------------
+        # watchdog restart budget: at most GOFR_ML_MAX_RESTARTS generator
+        # recoveries per GOFR_ML_RESTART_WINDOW_S sliding window; past it
+        # the server goes ``dead`` instead of crash-looping
+        self._max_restarts = (int(os.environ.get("GOFR_ML_MAX_RESTARTS", "3"))
+                              if max_restarts is None else int(max_restarts))
+        self._restart_window = (
+            float(os.environ.get("GOFR_ML_RESTART_WINDOW_S", "60"))
+            if restart_window_s is None else float(restart_window_s))
+        # per-request TTL default (0 = off); deadline_s= on the request
+        # overrides it per call
+        self._default_deadline = (
+            float(os.environ.get("GOFR_ML_DEFAULT_DEADLINE_S", "0"))
+            if default_deadline_s is None else float(default_deadline_s))
+        # admission bounds (0 = unbounded): requests and/or queued prompt
+        # tokens; past either, lowest-priority-first shedding with a 429
+        self._max_queue = (int(os.environ.get("GOFR_ML_MAX_QUEUE", "0"))
+                           if max_queue is None else int(max_queue))
+        self._max_queued_tokens = (
+            int(os.environ.get("GOFR_ML_MAX_QUEUED_TOKENS", "0"))
+            if max_queued_tokens is None else int(max_queued_tokens))
+        self._state = "serving"  # serving | degraded | dead
+        # the restart deques are written by the serving thread mid-crash
+        # and read by health/debug endpoints on the event-loop thread —
+        # exactly when they matter most; the lock keeps a concurrent
+        # append from turning a health scrape into a RuntimeError
+        self._restart_lock = threading.Lock()
+        self._restart_times: collections.deque[float] = collections.deque()
+        self._restart_history: collections.deque[dict] = collections.deque(
+            maxlen=16)
+        self._restarts_total = 0
+        self._deadline_expired = 0
+        self._shed_counts = dict.fromkeys(PRIORITIES, 0)
+        # admission timestamps feed the Retry-After estimate (observed
+        # queue drain rate); serving-thread-only like the rest
+        self._admit_times: collections.deque[float] = collections.deque(
+            maxlen=64)
+        self.closed_cleanly = True  # False once close() leaks the thread
+        # chaos hook (GOFR_ML_FAULT / testutil.faults): installed on the
+        # generator's dispatch points + the emit path; None = zero overhead
+        self._fault = FaultInjector.from_env() if fault is None else (
+            fault or None)
+        if self._fault is not None:
+            generator.fault = self._fault
+            if logger is not None:
+                try:
+                    logger.warnf("llm %s: fault injection ARMED (%s)",
+                                 name, os.environ.get("GOFR_ML_FAULT", ""))
+                except Exception:
+                    pass
         self._thread = threading.Thread(
             target=self._serve_loop, daemon=True, name=f"gofr-llm-{name}"
         )
@@ -153,50 +233,66 @@ class LLMServer:
 
     def _serve(self) -> None:
         while not self._closed:
-            self._run_setup_tasks()
-            self._reap_cancelled()
-            self._admit_waiting()
-            if self.gen.n_live:
-                self.gen.step()
-                self._finish_dead_slots()
-                self._steer()
-            else:
+            # WATCHDOG: every device dispatch this pass makes (step, drain,
+            # batched/chunked/suffix prefill, offload spill/restore) plus
+            # the emit callbacks runs supervised. An unexpected exception
+            # fails only the in-flight requests bound to live slots,
+            # rebuilds the generator's decode state, and resumes draining
+            # the untouched waiting queue — until the restart budget is
+            # spent and the server goes dead instead of crash-looping.
+            try:
+                self._run_setup_tasks()
+                self._reap_cancelled()
+                self._admit_waiting()
+                if self._closed:
+                    return
+                if self.gen.n_live:
+                    self.gen.step()
+                    self._finish_dead_slots()
+                    self._steer()
+                    continue
                 self.gen.drain()
                 self._finish_dead_slots()
-                try:  # idle: block briefly for the next request, backing
-                    # off toward 50 ms so an idle server doesn't spin at
-                    # hundreds of wakeups/s (admission latency cost is at
-                    # most one backoff interval, well under a prefill)
-                    req = self._requests.get(timeout=self._idle_backoff)
-                except _queue.Empty:
-                    # floor keeps idle_wait_s=0 from spinning; ceiling never
-                    # clamps below a caller's own (larger) configured wait
-                    self._idle_backoff = min(
-                        max(self._idle_backoff * 2, 0.001),
-                        max(0.05, self._idle_wait),
-                    )
-                    continue
-                self._idle_backoff = self._idle_wait
-                if req is None:
+            except Exception as exc:
+                # a crash racing close() skips recovery: the finally-flush
+                # wakes every consumer with the typed closed error anyway
+                if self._closed or not self._recover_or_die(exc):
                     return
-                self._waiting.push(req)
-                # collect the rest of the burst before admitting: concurrent
-                # clients arrive over a few ms, and one wave (one batched
-                # prefill + one mini-chunk) gives every stream the first
-                # wave's TTFT instead of the second's
-                deadline = time.perf_counter() + self._admit_window
-                while True:
-                    remaining = deadline - time.perf_counter()
-                    if remaining <= 0:
-                        break
-                    try:
-                        more = self._requests.get(timeout=remaining)
-                    except _queue.Empty:
-                        break
-                    if more is None:
-                        self._closed = True
-                        return
-                    self._waiting.push(more)
+                continue
+            try:  # idle: block briefly for the next request, backing
+                # off toward 50 ms so an idle server doesn't spin at
+                # hundreds of wakeups/s (admission latency cost is at
+                # most one backoff interval, well under a prefill)
+                req = self._requests.get(timeout=self._idle_backoff)
+            except _queue.Empty:
+                # floor keeps idle_wait_s=0 from spinning; ceiling never
+                # clamps below a caller's own (larger) configured wait
+                self._idle_backoff = min(
+                    max(self._idle_backoff * 2, 0.001),
+                    max(0.05, self._idle_wait),
+                )
+                continue
+            self._idle_backoff = self._idle_wait
+            if req is None:
+                return
+            self._enqueue_waiting(req)
+            # collect the rest of the burst before admitting: concurrent
+            # clients arrive over a few ms, and one wave (one batched
+            # prefill + one mini-chunk) gives every stream the first
+            # wave's TTFT instead of the second's
+            deadline = time.perf_counter() + self._admit_window
+            while True:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    more = self._requests.get(timeout=remaining)
+                except _queue.Empty:
+                    break
+                if more is None:
+                    self._closed = True
+                    return
+                self._enqueue_waiting(more)
 
     def _run_setup_tasks(self) -> None:
         """Drain device-touching setup work (e.g. register_prefix) onto
@@ -233,14 +329,15 @@ class LLMServer:
                 done.set()
 
         if self._closed:
-            raise RuntimeError("llm server is closed")
+            raise self._closed_error()
         self._setup_q.put(work)
         deadline = time.monotonic() + timeout_s
         while not done.wait(0.1):
             if self._closed:  # serving thread gone: fail fast, not 120 s
-                raise RuntimeError("llm server is closed")
+                raise self._closed_error()
             if time.monotonic() > deadline:
-                raise TimeoutError("register_prefix timed out")
+                raise DeadlineExceeded(
+                    f"register_prefix timed out after {timeout_s:g}s")
         if "err" in box:
             raise box["err"]
         return box["pid"]
@@ -263,14 +360,15 @@ class LLMServer:
                 done.set()
 
         if self._closed:
-            raise RuntimeError("llm server is closed")
+            raise self._closed_error()
         self._setup_q.put(work)
         deadline = time.monotonic() + timeout_s
         while not done.wait(0.1):
             if self._closed:
-                raise RuntimeError("llm server is closed")
+                raise self._closed_error()
             if time.monotonic() > deadline:
-                raise TimeoutError("drop_prefix timed out")
+                raise DeadlineExceeded(
+                    f"drop_prefix timed out after {timeout_s:g}s")
         if "err" in box:
             raise box["err"]
 
@@ -304,7 +402,9 @@ class LLMServer:
     def _flush_on_close(self) -> None:
         """The serving thread is exiting: every parked or still-queued
         consumer must be woken with an error + _DONE, or its
-        ``await out_q.get()`` blocks forever."""
+        ``await out_q.get()`` blocks forever. The error is typed — a dead
+        server (crash-loop) flushes ``GeneratorCrashed``, a clean close
+        ``ServerClosed`` — so transports answer 503, not a 500 panic."""
         self._closed = True
         leftovers = self._waiting.drain()
         while True:
@@ -317,14 +417,190 @@ class LLMServer:
         for slot, req in list(self._active.items()):
             leftovers.append(req)
             del self._active[slot]
-        exc = RuntimeError("llm server closed")
+        exc = self._closed_error()
         for req in leftovers:
-            req.finish_spans("ERROR", "llm server closed")
+            self._reject(req, exc)
+
+    def _closed_error(self) -> Exception:
+        """The typed error consumers of a no-longer-serving server get."""
+        if self._state == "dead":
+            return GeneratorCrashed(
+                "llm server is dead: generator restart budget exhausted "
+                f"({self._max_restarts} restarts/"
+                f"{self._restart_window:g}s)")
+        return ServerClosed()
+
+    def _reject(self, req: _Request, exc: Exception) -> None:
+        """Terminate a request that will never (or no longer) decode: end
+        its spans and wake its consumer with the typed error + _DONE."""
+        req.finish_spans("ERROR", str(exc))
+        try:
+            req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
+            req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
+        except Exception:
+            pass  # consumer loop itself already gone
+
+    # -- watchdog / crash recovery --------------------------------------------
+    def _recover_or_die(self, exc: BaseException) -> bool:
+        """A supervised dispatch raised unexpectedly. Fail ONLY the
+        in-flight requests bound to live slots with ``GeneratorCrashed``,
+        rebuild the generator's decode state (``Generator.recover``:
+        re-warmup from the pre-jitted ladder, borrowed prefix
+        registrations invalidated, host-tier KV entries kept), and return
+        True so the serve loop resumes draining the waiting queue —
+        queued requests survive a crash untouched. Once the restart
+        budget (GOFR_ML_MAX_RESTARTS per GOFR_ML_RESTART_WINDOW_S) is
+        spent — or recovery itself fails — returns False: the server is
+        ``dead``, consumers flush with typed errors, health reports
+        unhealthy."""
+        if self._logger is not None:
             try:
-                req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
-                req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
+                self._logger.error(
+                    "llm generator crashed", model=self.name,
+                    error=str(exc), type=type(exc).__name__,
+                    stack=traceback.format_exc())
             except Exception:
-                pass  # consumer loop itself already gone
+                pass
+        crash = GeneratorCrashed(
+            f"generator dispatch failed ({type(exc).__name__}: {exc})")
+        for slot, req in list(self._active.items()):
+            self._reject(req, crash)
+            del self._active[slot]
+        now = time.monotonic()
+        with self._restart_lock:
+            while (self._restart_times
+                   and now - self._restart_times[0] > self._restart_window):
+                self._restart_times.popleft()
+            in_window = len(self._restart_times)
+        if in_window >= self._max_restarts:
+            self._state = "dead"
+            self._record_restart(exc, recovered=False)
+            if self._logger is not None:
+                try:
+                    self._logger.error(
+                        "llm restart budget exhausted; server is dead",
+                        model=self.name, restarts=self._restarts_total,
+                        budget=self._max_restarts,
+                        window_s=self._restart_window)
+                except Exception:
+                    pass
+            return False
+        with self._restart_lock:
+            self._restart_times.append(now)
+        t0 = time.perf_counter()
+        try:
+            invalidated = self.gen.recover()
+        except Exception as rexc:
+            self._state = "dead"
+            self._record_restart(exc, recovered=False)
+            if self._logger is not None:
+                try:
+                    self._logger.error(
+                        "llm generator recovery failed; server is dead",
+                        model=self.name, error=str(rexc),
+                        stack=traceback.format_exc())
+                except Exception:
+                    pass
+            return False
+        if self.prefix_cache is not None:
+            for pid in invalidated:
+                try:
+                    self.prefix_cache.invalidate(pid)
+                except Exception:
+                    pass
+        self._restarts_total += 1
+        self._state = "degraded"  # until the restart window drains
+        self._record_restart(
+            exc, recovered=True,
+            recovery_ms=round((time.perf_counter() - t0) * 1e3, 1))
+        self._steered_dispatches = -1
+        if self._metrics is not None:
+            try:
+                self._metrics.add_counter(
+                    "app_ml_generator_restarts_total", 1, model=self.name)
+            except Exception:
+                pass
+        if self._logger is not None:
+            try:
+                self._logger.warnf(
+                    "llm %s generator recovered (restart %d/%d in window); "
+                    "resuming the waiting queue (%d queued)", self.name,
+                    len(self._restart_times), self._max_restarts,
+                    len(self._waiting))
+            except Exception:
+                pass
+        return True
+
+    def _record_restart(self, exc: BaseException, recovered: bool,
+                        recovery_ms: float | None = None) -> None:
+        with self._restart_lock:
+            self._restart_history.append({
+                "at": time.time(),
+                "error": f"{type(exc).__name__}: {exc}",
+                "recovered": recovered,
+                "recovery_ms": recovery_ms,
+            })
+
+    # -- admission bounds / load shedding -------------------------------------
+    def _enqueue_waiting(self, req: _Request) -> None:
+        """Queue boundary admission control: within bounds the request
+        simply joins its priority class; past GOFR_ML_MAX_QUEUE /
+        GOFR_ML_MAX_QUEUED_TOKENS the LOWEST-priority queued request is
+        shed (newest first) when the arrival outranks it — high-priority
+        admission preempts queued low-priority work — otherwise the
+        arrival itself is shed. Shed consumers get a typed ``Overloaded``
+        (HTTP 429) carrying Retry-After from the observed drain rate.
+
+        The request-count bound measures BACKLOG, not staging: queued
+        requests covered by currently-free slots admit on the very next
+        pass, so they get a free-slot credit — an idle server never
+        sheds a burst it is about to serve."""
+        w = self._waiting
+        n_free = sum(1 for s in self.gen.slots if not s.live)
+        over = ((self._max_queue > 0
+                 and len(w) - n_free >= self._max_queue)
+                or (self._max_queued_tokens > 0 and len(w) > n_free
+                    and w.tokens + req.n_tokens > self._max_queued_tokens))
+        if not over:
+            w.push(req)
+            return
+        victim = w.shed_lowest(worse_than=req.priority)
+        if victim is None:
+            victim = req  # nothing queued is worse: shed the arrival
+        else:
+            w.push(req)
+        self._shed(victim)
+
+    def _shed(self, req: _Request) -> None:
+        retry_after = self._retry_after_s()
+        prio = PRIORITIES[req.priority]
+        self._shed_counts[prio] += 1
+        if self._metrics is not None:
+            try:
+                self._metrics.add_counter("app_llm_shed_total", 1,
+                                          model=self.name, priority=prio)
+            except Exception:
+                pass
+        self._reject(req, Overloaded(
+            f"server overloaded ({len(self._waiting)} queued, "
+            f"{self._waiting.tokens} queued tokens); "
+            f"retry in ~{retry_after:.1f}s", retry_after=retry_after))
+
+    def _retry_after_s(self) -> float:
+        """Retry-After from the observed queue drain rate: admissions per
+        second over the recent admission-timestamp window (the scheduler's
+        realized dispatch cadence), scaled by the backlog ahead of a
+        retry. Conservative 1 s floor before any drain was observed."""
+        depth = len(self._waiting) + 1
+        times = self._admit_times
+        rate = 0.0
+        if len(times) >= 2:
+            span = times[-1] - times[0]
+            if span > 0:
+                rate = (len(times) - 1) / span
+        if rate <= 0:
+            return 1.0
+        return min(max(depth / rate, 0.5), 300.0)
 
     def _admit_waiting(self) -> None:
         # pull everything queued, then admit as long as slots are free
@@ -336,7 +612,7 @@ class LLMServer:
             if req is None:
                 self._closed = True
                 return
-            self._waiting.push(req)
+            self._enqueue_waiting(req)
         while len(self._waiting):
             if self.gen.free_slot() is None:
                 # no admission possible: break WITHOUT draining, so the
@@ -363,22 +639,47 @@ class LLMServer:
             if getattr(self.gen, "page_size", 0):
                 n_free = min(n_free, 1)
             batch, rejected = [], []
-            while len(self._waiting) and len(batch) < n_free:
-                # weighted-priority pop with aging, not FIFO: high beats
-                # normal beats low, but a parked request gains one class
-                # per aging interval so nothing starves
-                req = self._waiting.pop()
-                try:
-                    ids = self._validate(req)
-                except Exception as exc:
-                    rejected.append((req, exc))
-                    continue
-                ids = self._maybe_split_prefix(req, ids)
-                batch.append((req, ids))
+            req = None
+            try:
+                while len(self._waiting) and len(batch) < n_free:
+                    # weighted-priority pop with aging, not FIFO: high
+                    # beats normal beats low, but a parked request gains
+                    # one class per aging interval so nothing starves
+                    req = self._waiting.pop()
+                    if (req.deadline_at is not None
+                            and time.perf_counter() >= req.deadline_at):
+                        # expired while queued: reaped at the admission
+                        # gate, never prefilled — the deadline contract
+                        self._expire(req, "while queued")
+                        req = None
+                        continue
+                    try:
+                        ids = self._validate(req)
+                    except Exception as exc:
+                        rejected.append((req, exc))
+                        req = None
+                        continue
+                    ids = self._maybe_split_prefix(req, ids)
+                    batch.append((req, ids))
+                    req = None
+            except Exception as exc:
+                # the radix lookup dispatches device work (KV restore,
+                # spill-on-eviction, prefix prefill): a crash there leaves
+                # the popped request and earlier batch members in neither
+                # _waiting nor _active, where the watchdog cannot see them
+                # — fail them typed HERE or their consumers hang forever
+                crash = GeneratorCrashed(
+                    f"admission dispatch failed "
+                    f"({type(exc).__name__}: {exc})")
+                if req is not None:
+                    self._reject(req, crash)
+                for r, _ in batch:
+                    self._reject(r, crash)
+                for r, rexc in rejected:
+                    self._reject(r, rexc)
+                raise
             for req, exc in rejected:
-                req.finish_spans("ERROR", str(exc))
-                req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
-                req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
+                self._reject(req, exc)
             if not batch:
                 continue
             try:
@@ -412,9 +713,7 @@ class LLMServer:
                     self._waiting.push_front(req)
                     continue
                 # explicitly-passed prefix: the caller owns re-registration
-                req.finish_spans("ERROR", str(exc))
-                req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
-                req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
+                self._reject(req, exc)
                 continue
             except PagePoolExhausted:
                 # transient paged-KV back-pressure: pages free as live
@@ -424,16 +723,29 @@ class LLMServer:
                 for req, _ in reversed(batch):
                     self._waiting.push_front(req)
                 break
-            except Exception as exc:  # device-side failure: relay to all
+            except ValueError as exc:
+                # a client mistake the generator's own admission checks
+                # caught (bucket overflow, draft-history limits): reject
+                # the batch, keep serving — nothing device-side broke
                 for req, _ in batch:
-                    req.finish_spans("ERROR", str(exc))
-                    req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
-                    req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
+                    self._reject(req, exc)
                 continue
+            except Exception as exc:
+                # device-side prefill failure: this batch's consumers get
+                # the typed crash error, then the WATCHDOG supervises the
+                # rest — the donated cache may be gone, so the in-flight
+                # slots must be failed and the decode state rebuilt
+                crash = GeneratorCrashed(
+                    f"prefill dispatch failed "
+                    f"({type(exc).__name__}: {exc})")
+                for req, _ in batch:
+                    self._reject(req, crash)
+                raise
             now = time.perf_counter()
             for (req, _), slot in zip(batch, slots, strict=True):
                 req.slot = slot
                 self._active[slot] = req
+                self._admit_times.append(now)
                 if req.full_prompt is not None and self.prefix_cache is not None:
                     # the hit is real only now: the slot borrowed the
                     # prefix pages and the suffix-only prefill happened
@@ -501,6 +813,8 @@ class LLMServer:
         to the consumer — ONE loop wakeup per burst, not per token. At 64
         streams x chunk 16 the per-token version was ~38k
         ``call_soon_threadsafe`` wakeups/s on the event loop thread."""
+        if self._fault is not None:
+            self._fault("emit")  # chaos point: a poisoned token callback
         now = time.perf_counter()
         if (self._controller is not None and tokens
                 and req.last_burst_at is not None):
@@ -535,14 +849,43 @@ class LLMServer:
                 pass
         req.loop.call_soon_threadsafe(req.out_q.put_nowait, list(tokens))
 
+    def _expire(self, req: _Request, where: str) -> None:
+        """One request past its deadline: typed 504 to the consumer plus
+        the counter the operator alarms on."""
+        self._deadline_expired += 1
+        if self._metrics is not None:
+            try:
+                self._metrics.add_counter("app_llm_deadline_exceeded_total",
+                                          1, model=self.name)
+            except Exception:
+                pass
+        self._reject(req, DeadlineExceeded(
+            f"request deadline exceeded {where}"))
+
     def _reap_cancelled(self) -> None:
         """Stop decoding for consumers that went away (client disconnect /
-        stream abandoned): their slots would otherwise burn decode steps to
-        max_new_tokens, delaying every waiting request."""
-        for r in self._waiting.prune(lambda r: r.cancelled):
-            r.finish_spans("ERROR", "cancelled before admission")
+        stream abandoned) and requests past their deadline: either would
+        otherwise burn decode steps to max_new_tokens, delaying every
+        waiting request. Queued expirations reject here — before any
+        prefill is paid; mid-decode expirations cancel the slot (pages
+        free on release) and complete with ``DeadlineExceeded``."""
+        now = time.perf_counter()
+        # ONE queue scan for both conditions (this runs every serve-loop
+        # pass); the removed items split by cause below
+        for r in self._waiting.prune(
+                lambda r: r.cancelled or (r.deadline_at is not None
+                                          and now >= r.deadline_at)):
+            if r.cancelled:
+                r.finish_spans("ERROR", "cancelled before admission")
+            else:
+                self._expire(r, "while queued")
         for slot, req in self._active.items():
-            if req.cancelled and self.gen.slots[slot].live:
+            if not self.gen.slots[slot].live:
+                continue
+            if req.cancelled:
+                self.gen.slots[slot].live = False
+            elif req.deadline_at is not None and now >= req.deadline_at:
+                req.deadline_hit = True
                 self.gen.slots[slot].live = False
 
     def _export_pool_gauges(self) -> None:
@@ -613,6 +956,14 @@ class LLMServer:
         for slot, req in list(self._active.items()):
             s = self.gen.slots[slot]
             if not s.live:
+                if req.deadline_hit:
+                    # cancelled mid-generation by its deadline: free the
+                    # slot (pages with it) and complete with the typed
+                    # 504 instead of a finish marker
+                    self.gen.release(slot)
+                    del self._active[slot]
+                    self._expire(req, "mid-generation")
+                    continue
                 if getattr(s, "evicted", False):
                     reason = "eviction"
                 elif s.eos_hit:
@@ -730,6 +1081,7 @@ class LLMServer:
                             prefix: int | None = None,
                             info: dict | None = None,
                             priority: int | str | None = None,
+                            deadline_s: float | None = None,
                             ) -> AsyncIterator[list[int]]:
         """Yield BURSTS of tokens — each list is the slot's share of one
         processed decode chunk (the first is ``[first_token]`` from the
@@ -742,14 +1094,23 @@ class LLMServer:
         contention higher classes admit first, with aging so lower classes
         can never starve. Unknown values raise ValueError before enqueue.
 
+        ``deadline_s`` is the request's TTL (default from
+        ``GOFR_ML_DEFAULT_DEADLINE_S``; 0 disables): past it the request
+        is reaped wherever it sits — still queued (rejected before any
+        prefill) or mid-decode (slot cancelled, pages freed) — with a
+        typed ``DeadlineExceeded`` (HTTP 504 / gRPC DEADLINE_EXCEEDED).
+
         Pass ``info={}`` to receive ``info["finish_reason"]`` on completion:
         ``"stop"`` (eos), ``"length"`` (budget), or ``"eviction"`` (page
         pool dry — the answer was truncated mid-thought and must not be
         presented as a natural stop).
         """
         if self._closed:
-            raise RuntimeError("llm server is closed")
+            raise self._closed_error()
         prio = normalize_priority(priority)  # raises BEFORE enqueue
+        ttl = self._default_deadline if deadline_s is None else deadline_s
+        if not ttl >= 0:  # rejects NaN too (NaN >= 0 is False)
+            raise ValueError(f"deadline_s must be >= 0, got {ttl}")
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue = asyncio.Queue()
         # capture the caller's span before the executor hop; the serving
@@ -763,7 +1124,7 @@ class LLMServer:
             )
         req = _Request(prompt_ids, max_new_tokens, out_q, loop,
                        prefix=prefix, trace_ctx=ctx, queue_span=queue_span,
-                       priority=prio)
+                       priority=prio, deadline_s=ttl)
         self._requests.put(req)
         if self._closed:
             # close() may have drained the queue before our put landed —
@@ -772,7 +1133,7 @@ class LLMServer:
             # into out_q, which we're abandoning; mark cancelled so the
             # serving thread reaps it if it was somehow admitted.
             req.cancelled = True
-            raise RuntimeError("llm server is closed")
+            raise self._closed_error()
         try:
             while True:
                 item = await out_q.get()
@@ -794,11 +1155,13 @@ class LLMServer:
     async def stream(self, prompt_ids, max_new_tokens: int = 64,
                      prefix: int | None = None,
                      info: dict | None = None,
-                     priority: int | str | None = None) -> AsyncIterator[int]:
+                     priority: int | str | None = None,
+                     deadline_s: float | None = None) -> AsyncIterator[int]:
         """Yield tokens as the device produces them (token-at-a-time view
         of ``stream_chunks``)."""
         agen = self.stream_chunks(prompt_ids, max_new_tokens, prefix=prefix,
-                                  info=info, priority=priority)
+                                  info=info, priority=priority,
+                                  deadline_s=deadline_s)
         try:
             async for burst in agen:
                 for tok in burst:
@@ -811,12 +1174,14 @@ class LLMServer:
     async def generate(self, prompt_ids, max_new_tokens: int = 64,
                        prefix: int | None = None,
                        info: dict | None = None,
-                       priority: int | str | None = None) -> list[int]:
+                       priority: int | str | None = None,
+                       deadline_s: float | None = None) -> list[int]:
         """Collect the full completion."""
         out: list[int] = []
         async for burst in self.stream_chunks(prompt_ids, max_new_tokens,
                                               prefix=prefix, info=info,
-                                              priority=priority):
+                                              priority=priority,
+                                              deadline_s=deadline_s):
             out.extend(burst)
         return out
 
@@ -843,16 +1208,67 @@ class LLMServer:
         return out
 
     # -- datasource contract --------------------------------------------------
-    def health_check(self) -> dict:
+    def health(self) -> str:
+        """Serving state for the health plane: ``serving`` (healthy),
+        ``degraded`` (the watchdog recovered a generator crash within the
+        current restart window — still serving, but an operator should
+        look), or ``dead`` (restart budget exhausted / recovery failed /
+        serving thread gone: nothing will complete)."""
+        if (self._state == "dead" or self._closed
+                or not self._thread.is_alive()):
+            return "dead"
+        now = time.monotonic()
+        with self._restart_lock:
+            degraded = any(now - t <= self._restart_window
+                           for t in self._restart_times)
+        return "degraded" if degraded else "serving"
+
+    def resilience_snapshot(self) -> dict:
+        """The ``resilience`` block of ``/debug/serving``: state, restart
+        budget + history, shed/deadline counters, queue bounds, and the
+        armed fault config. Reads simple attributes only — safe from any
+        thread."""
+        with self._restart_lock:
+            in_window = len(self._restart_times)
+            recent = list(self._restart_history)
         return {
-            "status": "UP" if self._thread.is_alive() and not self._closed else "DOWN",
+            "state": self.health(),
+            "closed_cleanly": self.closed_cleanly,
+            "restarts": {
+                "total": self._restarts_total,
+                "in_window": in_window,
+                "budget": self._max_restarts,
+                "window_s": self._restart_window,
+                "recent": recent,
+            },
+            "shed": dict(self._shed_counts),
+            "deadline_expired": self._deadline_expired,
+            "queue_bounds": {
+                "max_requests": self._max_queue or None,
+                "max_tokens": self._max_queued_tokens or None,
+                "queued": len(self._waiting),
+                "queued_tokens": self._waiting.tokens,
+            },
+            "default_deadline_s": self._default_deadline or None,
+            "fault": (self._fault.snapshot()
+                      if self._fault is not None else None),
+        }
+
+    def health_check(self) -> dict:
+        state = self.health()
+        status = {"serving": "UP", "degraded": "DEGRADED",
+                  "dead": "DOWN"}[state]
+        return {
+            "status": status,
             "details": {
                 "model": self.name,
+                "state": state,
                 "slots": self.gen.batch_slots,
                 "live": self.gen.n_live,
                 "queued": len(self._waiting) + self._requests.qsize(),
                 "served": self.served,
                 "decode_steps": self.gen.steps,
+                "restarts": self._restarts_total,
             },
         }
 
@@ -866,5 +1282,22 @@ class LLMServer:
             # once the thread is really gone — if join timed out (stuck
             # compile/dispatch), flushing here would mutate _active/_waiting
             # under the live thread; its own finally-flush runs on exit.
-            if not self._thread.is_alive():
+            if self._thread.is_alive():
+                # a wedged serving thread is an incident, not a clean
+                # shutdown: say so (with where it's stuck) instead of
+                # returning as if everything drained, and leave the
+                # breadcrumb in the debug snapshot (closed_cleanly)
+                self.closed_cleanly = False
+                if self._logger is not None:
+                    try:
+                        self._logger.error(
+                            "llm serving thread leaked on close",
+                            model=self.name, thread=self._thread.name,
+                            alive=True, state=self._state,
+                            live_slots=self.gen.n_live,
+                            queued=len(self._waiting)
+                            + self._requests.qsize())
+                    except Exception:
+                        pass
+            else:
                 self._flush_on_close()

@@ -355,6 +355,10 @@ class AgingPriorityQueue:
         self.aging_s = max(1e-6, float(aging_s))
         self._queues: tuple[collections.deque, ...] = tuple(
             collections.deque() for _ in PRIORITIES)
+        # queued prompt tokens (items' ``n_tokens``), maintained across
+        # push/pop/prune — the load-shedding bound GOFR_ML_MAX_QUEUED_TOKENS
+        # is enforced against this sum, so it must never drift
+        self.tokens = 0
 
     def __len__(self) -> int:
         return sum(len(q) for q in self._queues)
@@ -365,9 +369,11 @@ class AgingPriorityQueue:
 
     def push(self, item) -> None:
         self._queues[item.priority].append(item)
+        self.tokens += getattr(item, "n_tokens", 0)
 
     def push_front(self, item) -> None:
         self._queues[item.priority].appendleft(item)
+        self.tokens += getattr(item, "n_tokens", 0)
 
     def pop(self, now: float | None = None):
         """Next request to admit, or None when empty."""
@@ -382,7 +388,26 @@ class AgingPriorityQueue:
                 best_eff, best_class = eff, cls
         if best_class is None:
             return None
-        return self._queues[best_class].popleft()
+        item = self._queues[best_class].popleft()
+        self.tokens -= getattr(item, "n_tokens", 0)
+        return item
+
+    def shed_lowest(self, worse_than: int | None = None):
+        """Remove and return the shed victim under overload: the NEWEST
+        request of the lowest-priority non-empty class (the oldest of a
+        class is closest to admission and has the most wait invested —
+        shedding it would waste that). With ``worse_than`` set, only
+        classes strictly worse than that index are candidates (high-
+        priority admission may preempt queued low-priority work, never
+        peers); returns None when no such victim exists."""
+        floor = -1 if worse_than is None else int(worse_than)
+        for cls in range(len(self._queues) - 1, floor, -1):
+            q = self._queues[cls]
+            if q:
+                item = q.pop()
+                self.tokens -= getattr(item, "n_tokens", 0)
+                return item
+        return None
 
     def prune(self, predicate) -> list:
         """Remove and return every item matching ``predicate`` (cancelled
@@ -398,6 +423,8 @@ class AgingPriorityQueue:
             if len(kept) != len(q):
                 q.clear()
                 q.extend(kept)
+        for item in removed:
+            self.tokens -= getattr(item, "n_tokens", 0)
         return removed
 
     def drain(self) -> list:
@@ -406,6 +433,7 @@ class AgingPriorityQueue:
         for q in self._queues:
             out.extend(q)
             q.clear()
+        self.tokens = 0
         return out
 
     def snapshot(self, now: float | None = None) -> dict:

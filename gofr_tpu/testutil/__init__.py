@@ -16,12 +16,16 @@ import sys
 from typing import Callable
 
 from ..tracing import Tracer
+from .faults import FAULT_POINTS, FaultInjector, InjectedFault
 
 __all__ = [
     "stdout_output_for_func",
     "stderr_output_for_func",
     "get_free_port",
     "RecordingTracer",
+    "FAULT_POINTS",
+    "FaultInjector",
+    "InjectedFault",
 ]
 
 

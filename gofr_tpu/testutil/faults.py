@@ -1,0 +1,138 @@
+"""Fault injection for the LLM serving plane (chaos hook).
+
+``GOFR_ML_FAULT`` arms probabilistic faults at named points of the device
+dispatch path so the resilience layer (watchdog, crash recovery, typed
+errors) can actually be exercised — by tests/test_resilience.py and the
+bench's fault arm (config4 phase G). Spec grammar, comma-separated::
+
+    point:rate[:ExcName]
+
+    GOFR_ML_FAULT=step:0.02:RuntimeError
+    GOFR_ML_FAULT=step:0.05,restore:1:OSError
+
+Points (where the serving stack calls ``fire``):
+
+- ``step``     — a decode-chunk dispatch (Generator.step)
+- ``prefill``  — a prompt/suffix prefill or chunked-prefill segment
+- ``spill``    — a device→host KV offload (Generator._spill_prefix)
+- ``restore``  — a host→device KV restore (Generator.restore_prefix)
+- ``emit``     — the token-burst callback into the serving layer
+
+The injector only exists when the env var is set (``from_env`` returns
+``None`` otherwise) and the instrumented call sites guard with an
+``is not None`` check — the disabled path costs one attribute test per
+dispatch, nothing else. Draws come from a dedicated ``random.Random``
+seeded by ``GOFR_ML_FAULT_SEED`` (default 1234) so a fault sequence is
+reproducible run-to-run.
+"""
+
+from __future__ import annotations
+
+import builtins
+import os
+import random
+
+__all__ = ["FAULT_POINTS", "FaultInjector", "InjectedFault"]
+
+FAULT_POINTS = ("step", "prefill", "spill", "restore", "emit")
+
+
+class InjectedFault(RuntimeError):
+    """Default raised fault — recognizably synthetic in logs and error
+    payloads (a subclass of RuntimeError, so everything that supervises
+    real device failures supervises this too)."""
+
+
+def _resolve_exc(name: str) -> type[BaseException]:
+    exc = getattr(builtins, name, None)
+    if isinstance(exc, type) and issubclass(exc, BaseException):
+        if not issubclass(exc, Exception):
+            # KeyboardInterrupt/SystemExit/GeneratorExit would bypass the
+            # watchdog's ``except Exception`` and kill the serving thread
+            # outright — that tests thread-death, not recovery
+            raise ValueError(f"refusing to inject {name}: not supervisable")
+        return exc
+    raise ValueError(f"unknown exception type {name!r} in fault spec")
+
+
+class FaultInjector:
+    """Parsed ``GOFR_ML_FAULT`` spec + per-point fire counters.
+
+    Callable: serving code invokes ``injector(point)`` (or ``fire``) at
+    each instrumented site; with probability ``rate`` the configured
+    exception is raised there, otherwise the call is a counter bump.
+    """
+
+    def __init__(self, points: dict[str, tuple[float, type[BaseException]]],
+                 seed: int | None = None) -> None:
+        for name in points:
+            if name not in FAULT_POINTS:
+                raise ValueError(
+                    f"unknown fault point {name!r} (one of {FAULT_POINTS})")
+        self.points = dict(points)
+        self.seed = 1234 if seed is None else int(seed)
+        self._rng = random.Random(self.seed)
+        self.attempts: dict[str, int] = dict.fromkeys(FAULT_POINTS, 0)
+        self.injected: dict[str, int] = dict.fromkeys(FAULT_POINTS, 0)
+
+    @classmethod
+    def parse(cls, spec: str, seed: int | None = None) -> "FaultInjector":
+        """Parse a spec string; raises ValueError on malformed entries so a
+        typo'd chaos config fails loudly at startup, not silently never."""
+        points: dict[str, tuple[float, type[BaseException]]] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            if len(fields) not in (2, 3):
+                raise ValueError(
+                    f"bad fault entry {part!r} (want point:rate[:ExcName])")
+            point = fields[0].strip().lower()
+            try:
+                rate = float(fields[1])
+            except ValueError:
+                raise ValueError(
+                    f"bad fault rate {fields[1]!r} in {part!r}") from None
+            if not 0.0 < rate <= 1.0:
+                raise ValueError(
+                    f"fault rate {rate} out of range (0, 1] in {part!r}")
+            exc = (_resolve_exc(fields[2].strip())
+                   if len(fields) == 3 else InjectedFault)
+            points[point] = (rate, exc)
+        if not points:
+            raise ValueError(f"empty fault spec {spec!r}")
+        return cls(points, seed=seed)
+
+    @classmethod
+    def from_env(cls) -> "FaultInjector | None":
+        """Build from ``GOFR_ML_FAULT``; ``None`` (injection disabled,
+        zero overhead) when unset or empty."""
+        spec = os.environ.get("GOFR_ML_FAULT", "").strip()
+        if not spec:
+            return None
+        seed_raw = os.environ.get("GOFR_ML_FAULT_SEED", "").strip()
+        return cls.parse(spec, seed=int(seed_raw) if seed_raw else None)
+
+    def fire(self, point: str) -> None:
+        armed = self.points.get(point)
+        if armed is None:
+            return
+        self.attempts[point] += 1
+        rate, exc = armed
+        if rate >= 1.0 or self._rng.random() < rate:
+            self.injected[point] += 1
+            raise exc(f"injected fault at {point!r} "
+                      f"(#{self.injected[point]}, GOFR_ML_FAULT)")
+
+    __call__ = fire
+
+    def snapshot(self) -> dict:
+        """Chaos config + realized fire counts for /debug/serving."""
+        return {
+            "spec": {name: {"rate": rate, "raises": exc.__name__}
+                     for name, (rate, exc) in self.points.items()},
+            "seed": self.seed,
+            "attempts": {k: v for k, v in self.attempts.items() if v},
+            "injected": {k: v for k, v in self.injected.items() if v},
+        }
