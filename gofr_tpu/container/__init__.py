@@ -173,6 +173,18 @@ class Container:
         m.new_counter("app_llm_shed_total",
                       "LLM requests shed at admission under overload, per "
                       "priority class")
+        m.new_gauge("app_llm_replica_state",
+                    "per-replica serving state ordinal (0 serving, "
+                    "1 degraded, 2 recovering, 3 dead) — alert on >= 2")
+        m.new_gauge("app_llm_replica_outstanding",
+                    "requests in flight toward a replica from the fleet "
+                    "router (slots + staged margin)")
+        m.new_counter("app_llm_replica_routed_total",
+                      "requests routed to a replica, by routing reason "
+                      "(affinity / least_loaded / failover)")
+        m.new_counter("app_llm_replica_failovers_total",
+                      "requests re-admitted to a surviving replica after "
+                      "their first replica crashed or died")
         m.new_gauge("app_llm_evictions",
                     "streams truncated because the KV page pool ran dry")
         m.new_gauge("app_llm_prefix_evictions",

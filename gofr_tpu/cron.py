@@ -44,7 +44,8 @@ def _parse_field(expr: str, lo: int, hi: int, name: str) -> frozenset[int]:
             try:
                 step = int(step_s)
             except ValueError:
-                raise InvalidCronError(f"bad step in {name}: {step_s!r}")
+                raise InvalidCronError(
+                    f"bad step in {name}: {step_s!r}") from None
             if step <= 0:
                 raise InvalidCronError(f"step must be positive in {name}")
         if part in ("*", ""):
@@ -54,12 +55,14 @@ def _parse_field(expr: str, lo: int, hi: int, name: str) -> frozenset[int]:
             try:
                 lo2, hi2 = int(a), int(b)
             except ValueError:
-                raise InvalidCronError(f"bad range in {name}: {part!r}")
+                raise InvalidCronError(
+                    f"bad range in {name}: {part!r}") from None
         else:
             try:
                 lo2 = hi2 = int(part)
             except ValueError:
-                raise InvalidCronError(f"bad value in {name}: {part!r}")
+                raise InvalidCronError(
+                    f"bad value in {name}: {part!r}") from None
         if lo2 < lo or hi2 > hi or lo2 > hi2:
             raise InvalidCronError(
                 f"{name} value out of range [{lo},{hi}]: {part!r}"
@@ -107,7 +110,7 @@ def parse_schedule(expr: str) -> CronSchedule:
         )
     parsed = [
         _parse_field(f, lo, hi, name)
-        for f, (name, lo, hi) in zip(fields, _FIELD_RANGES)
+        for f, (name, lo, hi) in zip(fields, _FIELD_RANGES, strict=True)
     ]
     return CronSchedule(
         seconds=parsed[0],

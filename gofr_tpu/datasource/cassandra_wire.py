@@ -456,7 +456,7 @@ class CassandraWire:
                 f"statement has {len(specs)} bind markers, "
                 f"got {len(params)} params")
         out = []
-        for (name, tid, tparam), value in zip(specs, params):
+        for (name, tid, tparam), value in zip(specs, params, strict=True):
             try:
                 out.append(_encode_cql(tid, tparam, value))
             except CassandraWireError:

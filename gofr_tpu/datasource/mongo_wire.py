@@ -429,7 +429,8 @@ class MongoWire:
             (client_first_bare, server_first, without_proof)).encode()
         signature = hmac.new(stored_key, auth_message,
                              hashlib.sha256).digest()
-        proof = bytes(a ^ b for a, b in zip(client_key, signature))
+        proof = bytes(a ^ b for a, b in zip(client_key, signature,
+                                            strict=True))
         client_final = (without_proof
                         + ",p=" + base64.b64encode(proof).decode())
         final = await self._roundtrip({

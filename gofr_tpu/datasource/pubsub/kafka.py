@@ -720,7 +720,7 @@ class Kafka:
             return_exceptions=True)
         n = 0
         stale = False
-        for conn, presps in zip(by_conn, results):
+        for conn, presps in zip(by_conn, results, strict=True):
             if isinstance(presps, (OSError, EOFError)):
                 conn.close()  # leader died: refresh and pick up next round
                 stale = True

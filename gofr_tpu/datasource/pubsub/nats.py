@@ -186,9 +186,9 @@ class NATS:
         payload = json.dumps(obj).encode() if obj is not None else b""
         try:
             _, raw, _status, _desc = await self._request(f"$JS.API.{op}", payload)
-        except asyncio.TimeoutError:
+        except asyncio.TimeoutError as exc:
             raise NATSError(f"jetstream {op}: no responder (is the server "
-                            "running with JetStream enabled?)")
+                            "running with JetStream enabled?)") from exc
         resp = json.loads(raw.decode())
         err = resp.get("error")
         if err and err.get("err_code") not in ok_codes:
@@ -242,10 +242,10 @@ class NATS:
             await self._ensure_stream(topic)
             try:
                 _, raw, _status, _desc = await self._request(topic, payload)
-            except asyncio.TimeoutError:
+            except asyncio.TimeoutError as exc:
                 raise NATSError(
                     f"publish {topic}: no stream ack (stream deleted or "
-                    "server overloaded) — message not persisted")
+                    "server overloaded) — message not persisted") from exc
             resp = json.loads(raw.decode())
             if resp.get("error"):
                 raise NATSError(f"publish {topic}: {resp['error']}")

@@ -232,7 +232,7 @@ class Redis:
     def hgetall(self, key: str) -> dict[str, str]:
         flat = self.command("HGETALL", key) or []
         it = iter(flat)
-        return {k.decode(): v.decode() for k, v in zip(it, it)}
+        return {k.decode(): v.decode() for k, v in zip(it, it, strict=False)}
 
     def lpush(self, key: str, *values: Any) -> int:
         return self.command("LPUSH", key, *values)

@@ -132,7 +132,8 @@ class _Common:
             def run():
                 cur = self._conn().execute(query, args)
                 cols = [d[0] for d in cur.description] if cur.description else []
-                return [dict(zip(cols, row)) for row in cur.fetchall()]
+                return [dict(zip(cols, row, strict=True))
+                        for row in cur.fetchall()]
 
             return self._worker.call(run)
         finally:
@@ -370,7 +371,7 @@ class WireSQL(_Common):
 
     def query(self, query: str, *args: Any) -> list[dict]:
         cols, rows, _n, _l = self._execute(query, args)
-        return [dict(zip(cols, row)) for row in rows]
+        return [dict(zip(cols, row, strict=True)) for row in rows]
 
     def begin(self) -> WireTx:
         return WireTx(self)

@@ -95,7 +95,7 @@ def native_password_scramble(password: str, salt: bytes) -> bytes:
     h1 = hashlib.sha1(password.encode()).digest()
     h2 = hashlib.sha1(h1).digest()
     h3 = hashlib.sha1(salt + h2).digest()
-    return bytes(a ^ b for a, b in zip(h1, h3))
+    return bytes(a ^ b for a, b in zip(h1, h3, strict=True))
 
 
 def _lenenc_int(data: bytes, off: int) -> tuple[int, int]:

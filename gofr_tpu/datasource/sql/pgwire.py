@@ -260,7 +260,7 @@ class _Scram:
         final_bare = f"c=biws,r={r}"
         auth_msg = ",".join([self._client_first_bare, sf, final_bare]).encode()
         sig = hmac.new(stored, auth_msg, hashlib.sha256).digest()
-        proof = bytes(a ^ b for a, b in zip(client_key, sig))
+        proof = bytes(a ^ b for a, b in zip(client_key, sig, strict=True))
         server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
         self._server_signature = hmac.new(
             server_key, auth_msg, hashlib.sha256).digest()

@@ -10,6 +10,7 @@ batching (batching.py), sharded multi-chip serving (gofr_tpu.parallel).
 
 from __future__ import annotations
 
+import asyncio
 import os
 from typing import Any
 
@@ -108,12 +109,25 @@ class MLDatasource:
         return engine
 
     def register_llm(self, name: str, params: Any, cfg: Any, *,
-                     generator: Any = None, **gen_kwargs):
+                     generator: Any = None, replicas: int | None = None,
+                     **gen_kwargs):
         """Mount a continuous-batching LLM: ``ctx.ml.llm(name)`` gives the
         async generate/stream API (llm.py); pass a ready Generator or the
-        (params, cfg) to build one."""
+        (params, cfg) to build one.
+
+        ``replicas`` (default from ``GOFR_ML_REPLICAS``; 1) > 1 mounts a
+        ``ReplicaPool`` instead: N generators over distinct device subsets
+        behind one cache-aware routing/admission front (replica.py) —
+        same async API, fleet failure semantics. ``generator`` may also be
+        a list/tuple of ready Generators (one per replica). Routing knobs
+        (``depth_per_replica``, ``affinity_min_tokens``) reach the pool;
+        with a single replica there is no router and they do not apply.
+        With the default of 1, behavior is exactly the single-server
+        path."""
         from .generate import Generator
         from .llm import LLMServer
+        from .replica import (ReplicaPool, build_replica_generators,
+                              replicas_from_env)
 
         # server-level policy, not Generator knobs: the prefix cache and
         # the resilience bounds ride the LLMServer (env defaults apply
@@ -125,19 +139,70 @@ class MLDatasource:
                       "max_queued_tokens", "fault")
             if k in gen_kwargs
         }
-        if generator is None:
+        # pool-only knobs: meaningless on a single server (no router), so
+        # they ride separately instead of crashing Generator/LLMServer
+        pool_kwargs = {
+            k: gen_kwargs.pop(k)
+            for k in ("depth_per_replica", "affinity_min_tokens")
+            if k in gen_kwargs
+        }
+        explicit = (replicas is not None
+                    or os.environ.get("GOFR_ML_REPLICAS", "").strip() != "")
+        if replicas is None:
+            n = replicas_from_env(1)
+        else:
+            n = int(replicas)
+            if n < 1:
+                # same loud contract as GOFR_ML_REPLICAS: a plumbing bug
+                # that passes 0 must not silently mount a single server
+                raise ValueError(
+                    f"llm {name}: replicas must be >= 1, got {replicas}")
+        if isinstance(generator, (list, tuple)):
+            gens = list(generator)
+            if not gens:
+                # same loud contract as replicas<=0: an empty list is a
+                # plumbing bug, not a single-server request
+                raise ValueError(
+                    f"llm {name}: generator= was an empty list; pass one "
+                    f"ready generator per replica, or params/cfg")
+            if explicit and len(gens) != n:
+                raise ValueError(
+                    f"llm {name}: {n} replicas requested but {len(gens)} "
+                    f"ready generator(s) were passed; the list must have "
+                    f"one generator per replica")
+        elif generator is not None:
+            if n > 1:
+                # loud at startup, not silent single-replica during the
+                # incident the operator configured the fleet to survive
+                raise ValueError(
+                    f"llm {name}: {n} replicas requested but a single "
+                    f"ready generator was passed; pass a list of {n} "
+                    f"generators (one per replica) or (params, cfg) so "
+                    f"replicas can be built over distinct device subsets")
+            gens = [generator]
+        else:
             warm = gen_kwargs.pop("warmup", True)
-            generator = Generator(params, cfg, **gen_kwargs)
-            if warm:
-                # startup pays every decode/prefill compile, not a request
-                generator.warmup()
-        server = LLMServer(generator, name=name, logger=self._logger,
-                           metrics=self._metrics, tracer=self._tracer,
-                           **server_kwargs)
+            if n > 1:
+                gens = build_replica_generators(params, cfg, n,
+                                                warmup=warm, **gen_kwargs)
+            else:
+                gens = [Generator(params, cfg, **gen_kwargs)]
+                if warm:
+                    # startup pays every compile, not a request
+                    gens[0].warmup()
+        if len(gens) > 1:
+            server = ReplicaPool(gens, name=name, logger=self._logger,
+                                 metrics=self._metrics, tracer=self._tracer,
+                                 **pool_kwargs, **server_kwargs)
+        else:
+            server = LLMServer(gens[0], name=name, logger=self._logger,
+                               metrics=self._metrics, tracer=self._tracer,
+                               **server_kwargs)
         self._llms[name] = server
         if self._logger is not None:
-            self._logger.infof("llm %s registered (%d slots)", name,
-                               generator.batch_slots)
+            self._logger.infof("llm %s registered (%d replica(s), %d slots)",
+                               name, len(gens),
+                               sum(g.batch_slots for g in gens))
         return server
 
     def llm(self, name: str):
@@ -225,6 +290,10 @@ class MLDatasource:
         for name, server in self._llms.items():
             m.set_gauge("app_ml_queue_depth", server.queue_depth(),
                         component="llm", model=name)
+            if hasattr(server, "replicas"):
+                # replica pool: per-replica state/occupancy gauges
+                server.export_gauges(m)
+                continue
             m.set_gauge("app_llm_active_slots", float(server.gen.n_live),
                         model=name)
 
@@ -248,7 +317,7 @@ class MLDatasource:
                     "max_delay_s": batcher._max_delay,
                 }
             snap["models"][name] = entry
-        for name, server in self._llms.items():
+        def llm_entry(server) -> dict:
             entry = dict(server.health_check()["details"])
             entry["pool"] = server.gen.pool_stats()
             host = getattr(server.gen, "host_kv", None)
@@ -274,7 +343,22 @@ class MLDatasource:
                 # watchdog state, restart budget/history, shed + deadline
                 # counters, queue bounds, armed fault config
                 entry["resilience"] = server.resilience_snapshot()
-            snap["llms"][name] = entry
+            return entry
+
+        for name, server in self._llms.items():
+            if hasattr(server, "replicas"):
+                # replica pool: fleet health + routing state once, then
+                # one full per-replica row each (states, pools, caches,
+                # schedulers, resilience) keyed by replica index
+                entry = dict(server.health_check()["details"])
+                entry["routing"] = server.routing_snapshot()
+                entry["replicas"] = {
+                    str(i): llm_entry(core)
+                    for i, core in enumerate(server.replicas)
+                }
+                snap["llms"][name] = entry
+                continue
+            snap["llms"][name] = llm_entry(server)
         return snap
 
     def health_check(self) -> dict:
@@ -301,7 +385,23 @@ class MLDatasource:
                     status = "DEGRADED"
         return {"status": status, "details": details}
 
-    def close(self) -> None:
+    def close(self):
+        """Close every engine, batcher, and LLM server. In a sync context
+        this blocks until teardown completes and returns None. Called
+        with an event loop RUNNING (the container's async close), it
+        returns an awaitable that runs the teardown on a worker thread
+        instead: ``LLMServer.close`` may sit in its drain loop for
+        ``GOFR_ML_DRAIN_S`` seconds, and blocking the loop would freeze
+        token delivery for the very requests the drain is waiting on —
+        and the shutdown grace-period timer with them."""
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            self._close_now()
+            return None
+        return asyncio.to_thread(self._close_now)
+
+    def _close_now(self) -> None:
         for engine in self._engines.values():
             engine.close()
         for batcher in self._batchers.values():

@@ -1812,8 +1812,8 @@ class Generator:
                         self._free_slot_pages(j)
                 raise
             self._n_requests += len(wave)
-            for slot, (ids, n, max_new, callback) in zip(slots, wave,
-                                                          strict=True):
+            for slot, (_ids, n, max_new, callback) in zip(slots, wave,
+                                                           strict=True):
                 self._pending_first.append(slot)
                 s = _Slot()
                 s.live = True

@@ -39,18 +39,41 @@ import time
 import traceback
 from typing import Any, AsyncIterator
 
-from ..testutil.faults import FaultInjector
+from ..testutil.faults import FaultInjector, fault_snapshot
 from ..tracing import current_context
 from .errors import (DeadlineExceeded, GeneratorCrashed, Overloaded,
                      ServerClosed)
 from .generate import PagePoolExhausted, PrefixEvicted
 from .prefix_cache import PrefixCacheConfig, RadixPrefixCache
 from .scheduler import (PRIORITIES, AgingPriorityQueue, SLOController,
-                        normalize_priority)
+                        normalize_priority, retry_after_s)
 
-__all__ = ["LLMServer"]
+__all__ = ["LLMServer", "drain_s_from_env"]
 
 _DONE = object()
+
+
+def drain_s_from_env() -> float:
+    """``GOFR_ML_DRAIN_S`` as a drain budget in seconds (0 = immediate
+    close). The ONE parse behind ``LLMServer.close`` and
+    ``ReplicaPool.close`` so the two shutdown paths cannot diverge.
+    A malformed value fails loudly (like ``GOFR_ML_REPLICAS``) rather
+    than silently becoming the request-dropping immediate close the
+    operator set the knob to prevent."""
+    raw = os.environ.get("GOFR_ML_DRAIN_S", "").strip()
+    if not raw:
+        return 0.0
+    try:
+        drain_s = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"GOFR_ML_DRAIN_S must be seconds, got {raw!r}") from None
+    # reject sign typos, nan, and inf too — each silently degrades to an
+    # immediate drop (or an unbounded wait) instead of the intended drain
+    if not 0.0 <= drain_s < float("inf"):
+        raise ValueError(
+            f"GOFR_ML_DRAIN_S must be finite and >= 0, got {raw!r}")
+    return drain_s
 
 
 class _Finish:
@@ -190,7 +213,8 @@ class LLMServer:
         self._max_queued_tokens = (
             int(os.environ.get("GOFR_ML_MAX_QUEUED_TOKENS", "0"))
             if max_queued_tokens is None else int(max_queued_tokens))
-        self._state = "serving"  # serving | degraded | dead
+        self._state = "serving"  # serving | recovering | degraded | dead
+        self._draining = False  # close(drain_s=): admission stopped
         # the restart deques are written by the serving thread mid-crash
         # and read by health/debug endpoints on the event-loop thread —
         # exactly when they matter most; the lock keeps a concurrent
@@ -207,6 +231,9 @@ class LLMServer:
         self._admit_times: collections.deque[float] = collections.deque(
             maxlen=64)
         self.closed_cleanly = True  # False once close() leaks the thread
+        # parse the drain budget NOW so a malformed GOFR_ML_DRAIN_S is a
+        # loud startup error, not a silent drop-everything at SIGTERM
+        self._drain_default = drain_s_from_env()
         # chaos hook (GOFR_ML_FAULT / testutil.faults): installed on the
         # generator's dispatch points + the emit path; None = zero overhead
         self._fault = FaultInjector.from_env() if fault is None else (
@@ -487,6 +514,9 @@ class LLMServer:
             return False
         with self._restart_lock:
             self._restart_times.append(now)
+        # visible to routers for the whole rebuild: a replica pool skips a
+        # ``recovering`` replica instead of queueing behind its re-warmup
+        self._state = "recovering"
         t0 = time.perf_counter()
         try:
             invalidated = self.gen.recover()
@@ -587,20 +617,10 @@ class LLMServer:
             f"retry in ~{retry_after:.1f}s", retry_after=retry_after))
 
     def _retry_after_s(self) -> float:
-        """Retry-After from the observed queue drain rate: admissions per
-        second over the recent admission-timestamp window (the scheduler's
+        """Retry-After from the observed queue drain rate (the scheduler's
         realized dispatch cadence), scaled by the backlog ahead of a
-        retry. Conservative 1 s floor before any drain was observed."""
-        depth = len(self._waiting) + 1
-        times = self._admit_times
-        rate = 0.0
-        if len(times) >= 2:
-            span = times[-1] - times[0]
-            if span > 0:
-                rate = (len(times) - 1) / span
-        if rate <= 0:
-            return 1.0
-        return min(max(depth / rate, 0.5), 300.0)
+        retry — scheduler.retry_after_s over this instance's window."""
+        return retry_after_s(self._admit_times, len(self._waiting))
 
     def _admit_waiting(self) -> None:
         # pull everything queued, then admit as long as slots are free
@@ -614,6 +634,11 @@ class LLMServer:
                 return
             self._enqueue_waiting(req)
         while len(self._waiting):
+            if self._draining:
+                # graceful drain (close(drain_s=)): in-flight decode keeps
+                # stepping, but nothing new admits — still-queued requests
+                # flush typed at teardown
+                break
             if self.gen.free_slot() is None:
                 # no admission possible: break WITHOUT draining, so the
                 # chunk-decode pipeline stays one dispatch deep under
@@ -1105,7 +1130,7 @@ class LLMServer:
         pool dry — the answer was truncated mid-thought and must not be
         presented as a natural stop).
         """
-        if self._closed:
+        if self._closed or self._draining:
             raise self._closed_error()
         prio = normalize_priority(priority)  # raises BEFORE enqueue
         ttl = self._default_deadline if deadline_s is None else deadline_s
@@ -1210,6 +1235,8 @@ class LLMServer:
     # -- datasource contract --------------------------------------------------
     def health(self) -> str:
         """Serving state for the health plane: ``serving`` (healthy),
+        ``recovering`` (a crash recovery is rebuilding the generator RIGHT
+        NOW — a router should skip this replica until it finishes),
         ``degraded`` (the watchdog recovered a generator crash within the
         current restart window — still serving, but an operator should
         look), or ``dead`` (restart budget exhausted / recovery failed /
@@ -1217,6 +1244,8 @@ class LLMServer:
         if (self._state == "dead" or self._closed
                 or not self._thread.is_alive()):
             return "dead"
+        if self._state == "recovering":
+            return "recovering"
         now = time.monotonic()
         with self._restart_lock:
             degraded = any(now - t <= self._restart_window
@@ -1233,6 +1262,7 @@ class LLMServer:
             recent = list(self._restart_history)
         return {
             "state": self.health(),
+            "draining": self._draining,
             "closed_cleanly": self.closed_cleanly,
             "restarts": {
                 "total": self._restarts_total,
@@ -1250,14 +1280,13 @@ class LLMServer:
                 "queued_tokens": self._waiting.tokens,
             },
             "default_deadline_s": self._default_deadline or None,
-            "fault": (self._fault.snapshot()
-                      if self._fault is not None else None),
+            "fault": fault_snapshot(self._fault),
         }
 
     def health_check(self) -> dict:
         state = self.health()
         status = {"serving": "UP", "degraded": "DEGRADED",
-                  "dead": "DOWN"}[state]
+                  "recovering": "DEGRADED", "dead": "DOWN"}[state]
         return {
             "status": status,
             "details": {
@@ -1272,7 +1301,31 @@ class LLMServer:
             },
         }
 
-    def close(self) -> None:
+    def close(self, drain_s: float | None = None) -> None:
+        """Shut the server down. With ``drain_s`` > 0 (default from
+        ``GOFR_ML_DRAIN_S``; 0 = immediate) this is a GRACEFUL drain:
+        admission stops first (new submissions fail fast with the typed
+        closed error, queued requests stay parked), in-flight decode runs
+        to completion up to the deadline, then the serving thread tears
+        down and flushes whatever remains. Wired into app shutdown via
+        ``MLDatasource.close`` so SIGTERM is a drain, not a drop."""
+        if drain_s is None:
+            drain_s = self._drain_default
+        if drain_s > 0 and not self._closed and self._thread.is_alive():
+            self._draining = True
+            deadline = time.monotonic() + drain_s
+            while time.monotonic() < deadline:
+                if not self._active and self.gen.n_live == 0:
+                    break  # every admitted request completed
+                time.sleep(0.005)
+            if self._logger is not None and self._active:
+                try:
+                    self._logger.warnf(
+                        "llm %s drain deadline (%.1fs) hit with %d "
+                        "request(s) still in flight", self.name, drain_s,
+                        len(self._active))
+                except Exception:
+                    pass
         if not self._closed:
             self._closed = True
             self._requests.put(None)

@@ -49,9 +49,19 @@ import threading
 import time
 from typing import Any, AsyncIterator, Iterable
 
+from .errors import GeneratorCrashed, ServerClosed
+
 __all__ = ["MultiHostWorker", "MultiHostLLMClient", "send_frame", "recv_frame"]
 
 _OP_STOP = 0
+
+# error-frame sentinels: the model-port protocol carries only an "error"
+# text field, so the client's typed-error mapping and the worker's emit
+# sites MUST share these literals — match/emit through the constants, not
+# inline strings, or a reworded message silently downgrades a 503-class
+# serving failure to a client-error ValueError
+_ERR_CONN_LOST = "model connection lost"
+_ERR_STOPPED = "server stopped"
 _OP_ADMIT = 1
 _OP_STEP = 2
 _OP_CANCEL = 3
@@ -475,10 +485,10 @@ class MultiHostWorker:
             for kind, conn, payload in items:
                 if kind == "stop":
                     self._broadcast(self._zero_cmd())  # STOP
-                    for slot, (c, rid) in active.items():
-                        c.send({"id": rid, "error": "server stopped"})
+                    for c, rid in active.values():
+                        c.send({"id": rid, "error": _ERR_STOPPED})
                     for c, rid, _, _ in pending:
-                        c.send({"id": rid, "error": "server stopped"})
+                        c.send({"id": rid, "error": _ERR_STOPPED})
                     conn.send({"stopped": True})
                     for c in list(self._conns):  # deliver final frames
                         c.flush()                # before teardown close()s
@@ -566,9 +576,15 @@ class MultiHostLLMClient:
         self._ids = itertools.count(1)
         self._streams: dict[int, asyncio.Queue] = {}
         self._stop_waiter: asyncio.Future | None = None
+        self._closed = False
 
     async def _ensure(self) -> None:
         async with self._conn_lock:
+            if self._closed:
+                # checked UNDER the lock (close() takes it too): the
+                # not-yet-yielded retry path must not resurrect a closed
+                # client with a fresh connection and reader task
+                raise ServerClosed("model client closed")
             # a live connection needs BOTH a writable transport and a live
             # dispatcher: after the worker dies, the reader task exits on
             # EOF while the writer still looks open (first write after FIN
@@ -580,6 +596,19 @@ class MultiHostLLMClient:
                 return
             if self._writer is not None:
                 self._writer.close()
+            # retire the old reader BEFORE the new connection accepts
+            # registrations: fail over every stream still bound to the
+            # dead connection here, and null the task reference so the
+            # old reader's finally (it may still be mid-death) sees it
+            # has been superseded and does NOT re-broadcast into queues
+            # registered on the NEW connection
+            if self._reader_task is not None and not self._reader_task.done():
+                self._reader_task.cancel()
+            self._reader_task = None
+            for q in list(self._streams.values()):
+                q.put_nowait({"error": _ERR_CONN_LOST})
+            if self._stop_waiter and not self._stop_waiter.done():
+                self._stop_waiter.set_result(False)
             self._reader, self._writer = await asyncio.open_connection(
                 self.host, self.port)
             self._reader_task = asyncio.create_task(self._read_frames())
@@ -609,44 +638,100 @@ class MultiHostLLMClient:
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
-            # connection died: wake every in-flight consumer with an error
-            for q in list(self._streams.values()):
-                q.put_nowait({"error": "model connection lost"})
-            if self._stop_waiter and not self._stop_waiter.done():
-                self._stop_waiter.set_result(False)
+            # connection died: wake every in-flight consumer with an
+            # error — but ONLY if this reader is still the current one;
+            # a superseded reader's streams were already failed over by
+            # _ensure, and the live ones belong to the new connection
+            if self._reader_task is asyncio.current_task():
+                for q in list(self._streams.values()):
+                    q.put_nowait({"error": _ERR_CONN_LOST})
+                if self._stop_waiter and not self._stop_waiter.done():
+                    self._stop_waiter.set_result(False)
 
     async def stream_chunks(self, prompt_ids: Iterable[int],
                             max_new: int) -> AsyncIterator[list[int]]:
         """Yield BURSTS of generated tokens (one list per decode-chunk
         share, mirroring LLMServer.stream_chunks). Many calls may run
-        concurrently — each occupies one Generator slot on the mesh."""
-        await self._ensure()
-        rid = next(self._ids)
-        q: asyncio.Queue = asyncio.Queue()
-        self._streams[rid] = q
-        finished = False
-        try:
-            await self._send({"op": "generate", "id": rid,
-                              "tokens": list(prompt_ids),
-                              "max_new": max_new})
-            while True:
-                frame = await q.get()
-                if "error" in frame:
-                    finished = True
-                    raise RuntimeError(frame["error"])
-                if frame.get("done"):
-                    finished = True
-                    return
-                yield [int(t) for t in frame.get("tokens", [])]
-        finally:
-            self._streams.pop(rid, None)
-            if not finished:
-                # abandoned mid-stream: tell the mesh to free the slot
-                # instead of decoding to max_new for nobody
+        concurrently — each occupies one Generator slot on the mesh.
+
+        Failure mapping (ml/errors.py, so the HTTP/gRPC status machinery
+        applies): a lost model connection raises ``GeneratorCrashed``
+        (503 — safe to retry, nothing was committed), a stopped mesh
+        ``ServerClosed`` (503). A request that has NOT yet yielded a
+        token gets ONE transparent reconnect-and-resend first — a
+        front-end riding out a worker restart never surfaces the blip."""
+        prompt = list(prompt_ids)
+        retried = False
+        while True:
+            try:
+                await self._ensure()
+            except OSError as exc:
+                raise GeneratorCrashed(
+                    f"model worker connection failed "
+                    f"({self.host}:{self.port}: {exc})") from exc
+            rid = next(self._ids)
+            q: asyncio.Queue = asyncio.Queue()
+            self._streams[rid] = q
+            finished = False
+            yielded = False
+            retrying = False
+            try:
                 try:
-                    await self._send({"op": "cancel", "id": rid})
-                except Exception:
-                    await self.close()
+                    await self._send({"op": "generate", "id": rid,
+                                      "tokens": prompt,
+                                      "max_new": max_new})
+                except (ConnectionError, OSError) as exc:
+                    finished = True  # never reached the mesh: no cancel
+                    if not retried:
+                        retrying = True
+                    else:
+                        raise GeneratorCrashed(
+                            f"model connection lost ({exc})") from exc
+                while not retrying:
+                    frame = await q.get()
+                    if "error" in frame:
+                        finished = True
+                        err = str(frame["error"])
+                        if err == _ERR_CONN_LOST:
+                            if not yielded and not retried:
+                                retrying = True
+                                break
+                            raise GeneratorCrashed(
+                                _ERR_CONN_LOST +
+                                (" mid-stream" if yielded else ""))
+                        if err == _ERR_STOPPED:
+                            raise ServerClosed("model workers stopped")
+                        # protocol/validation rejects from the model port
+                        # stay client errors, not serving failures
+                        raise ValueError(err)
+                    if frame.get("done"):
+                        finished = True
+                        return
+                    yielded = True
+                    yield [int(t) for t in frame.get("tokens", [])]
+            finally:
+                self._streams.pop(rid, None)
+                if not finished and not retrying:
+                    # abandoned mid-stream: tell the mesh to free the slot
+                    # instead of decoding to max_new for nobody
+                    try:
+                        await self._send({"op": "cancel", "id": rid})
+                    except Exception:
+                        await self.close()
+                elif retrying:
+                    # the lost-connection notice may have been a STALE
+                    # broadcast raced by a peer's reconnect while our send
+                    # was parked on the send lock — in which case the
+                    # original request DID land on the new connection and
+                    # would decode to max_new for nobody. Cancel it best-
+                    # effort before resending: unknown rids are a no-op on
+                    # the worker, and over a truly dead socket this just
+                    # fails (the resend path reconnects anyway).
+                    try:
+                        await self._send({"op": "cancel", "id": rid})
+                    except Exception:
+                        pass
+            retried = True
 
     async def stream(self, prompt_ids: Iterable[int],
                      max_new: int) -> AsyncIterator[int]:
@@ -674,12 +759,23 @@ class MultiHostLLMClient:
         await self._stop_waiter
 
     async def close(self) -> None:
-        if self._writer is not None:
-            self._writer.close()
-            self._writer = None
-        if self._reader_task is not None:
-            self._reader_task.cancel()
-            self._reader_task = None
+        async with self._conn_lock:  # serialize against an in-flight _ensure
+            self._closed = True
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None
+            if self._reader_task is not None:
+                self._reader_task.cancel()
+                self._reader_task = None
+        # the cancelled reader is superseded (its finally won't fire the
+        # death broadcast): fail in-flight consumers here instead — with
+        # the STOPPED sentinel (typed ServerClosed, no reconnect), not
+        # CONN_LOST, which would send un-yielded requests down the retry
+        # path against a client that is going away
+        for q in list(self._streams.values()):
+            q.put_nowait({"error": _ERR_STOPPED})
+        if self._stop_waiter and not self._stop_waiter.done():
+            self._stop_waiter.set_result(False)
 
     async def health_check(self) -> dict:
         up = {"status": "UP",
@@ -690,7 +786,7 @@ class MultiHostLLMClient:
         try:
             await self._ensure()
             return up
-        except OSError as exc:
+        except (OSError, ServerClosed) as exc:
             return {"status": "DOWN",
                     "details": {"model_addr": f"{self.host}:{self.port}",
                                 "error": str(exc)[:200]}}

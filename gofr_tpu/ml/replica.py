@@ -1,0 +1,1011 @@
+"""Replica pool: cache-aware routing, crash failover, fleet-wide admission.
+
+One ``LLMServer``/``Generator`` pair — however resilient (PR 5 watchdog,
+deadlines, shedding) — is still a single point of failure: a generator
+going ``dead`` is a full outage, and every admission decision is made with
+one instance's view of load. ``ReplicaPool`` turns that into fleet-level
+resilience: N per-replica serving cores (each a full ``LLMServer`` — its
+own dispatch loop, token-budget scheduler, watchdog, radix prefix cache)
+behind ONE routing/admission front.
+
+The front owns the request plane once, fleet-wide:
+
+- **Admission & shedding.** A single ``AgingPriorityQueue`` holds every
+  waiting request; the PR 3 priority classes, the PR 5 queue bounds
+  (``GOFR_ML_MAX_QUEUE`` / ``GOFR_ML_MAX_QUEUED_TOKENS``), lowest-priority
+  -first shedding, and request deadlines apply to the FLEET, not per
+  replica — Retry-After comes from the aggregate drain rate. Per-replica
+  cores run with their own bounds disabled.
+- **Cache-aware routing** (SGLang-style): at dispatch time the router
+  longest-matches the prompt against every live replica's radix trie
+  (``RadixPrefixCache.peek`` — read-only, lock-cheap) and routes to the
+  replica with the deepest reusable prefix so KV locality is preserved;
+  on an affinity miss it falls back to the least-loaded replica. Requests
+  only leave the front when the chosen replica has capacity, so routing
+  always sees fresh trie/load state.
+- **Failure semantics** — the headline. A replica whose watchdog is
+  mid-rebuild reports ``recovering`` and is skipped by the router. A
+  replica entering ``dead`` (restart budget exhausted, PR 5 state) is a
+  drain-and-reroute event, not an outage: its in-flight slots fail with
+  the typed ``GeneratorCrashed``, while every request that has not yet
+  yielded a token — queued in the front OR staged inside the dead core —
+  transparently re-admits to a surviving replica with priority and
+  deadline preserved. A prefix that lived only on the dead replica's trie
+  simply misses on the survivor and falls back to a full prefill; greedy
+  outputs are bit-identical either way. ``health()`` reports ``degraded``
+  while ANY replica is down and ``dead`` only when ALL are.
+
+``GOFR_ML_REPLICAS=1`` (the default) never constructs a pool —
+``register_llm`` returns a plain ``LLMServer``, byte-identical to the
+single-replica behavior.
+
+In-process replicas place their generators on distinct device subsets
+(``split_devices`` + ``parallel``'s mesh machinery); the cross-host seam
+is ``ml/multihost.py``'s framing, which a future front can drive with the
+same router.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import concurrent.futures
+import os
+import threading
+import time
+from typing import Any, AsyncIterator
+
+from ..testutil.faults import FaultInjector, fault_snapshot
+from .errors import (DeadlineExceeded, GeneratorCrashed, Overloaded,
+                     ServerClosed)
+from .generate import PrefixEvicted
+from .llm import LLMServer, drain_s_from_env
+from .scheduler import (PRIORITIES, AgingPriorityQueue, normalize_priority,
+                        retry_after_s)
+
+__all__ = ["ReplicaPool", "split_devices", "build_replica_generators",
+           "replicas_from_env"]
+
+# health-state ordinal for the app_llm_replica_state gauge (alert on >= 2)
+_STATE_VALUE = {"serving": 0, "degraded": 1, "recovering": 2, "dead": 3}
+
+
+def replicas_from_env(default: int = 1) -> int:
+    """``GOFR_ML_REPLICAS`` as a replica count (>= 1). Malformed values
+    fail loudly at startup, like a malformed fault spec."""
+    raw = os.environ.get("GOFR_ML_REPLICAS", "").strip()
+    if not raw:
+        return max(1, int(default))
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"GOFR_ML_REPLICAS must be an integer, got {raw!r}") from None
+    if n < 1:
+        raise ValueError(f"GOFR_ML_REPLICAS must be >= 1, got {n}")
+    return n
+
+
+def split_devices(n: int, devices=None) -> list[list]:
+    """Partition the visible accelerators into ``n`` contiguous subsets,
+    one per replica — contiguous so a multi-chip replica's tensor axis
+    stays on physically adjacent chips. With fewer devices than replicas
+    (CPU test mode), replicas share devices round-robin; leftover devices
+    that don't divide evenly go unused rather than unbalancing replicas."""
+    import jax
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n < 1:
+        raise ValueError(f"need at least one replica, got {n}")
+    if len(devs) < n:
+        return [[devs[i % len(devs)]] for i in range(n)]
+    per = len(devs) // n
+    return [devs[i * per:(i + 1) * per] for i in range(n)]
+
+
+def build_replica_generators(params, cfg, n: int, *, warmup: bool = True,
+                             devices=None, **gen_kwargs) -> list:
+    """Build N Generators over distinct device subsets. A single-device
+    subset gets the params committed to its device; a multi-device subset
+    gets a tp mesh over the subset via ``parallel``'s machinery (the same
+    Megatron split ``multihost.py`` uses per host), so each replica's
+    compute and KV cache live entirely on its own chips."""
+    import jax
+
+    from .. import parallel as par
+    from ..models import llama
+    from .generate import Generator
+
+    gens = []
+    for subset in split_devices(n, devices):
+        if len(subset) == 1:
+            rep_params = jax.device_put(params, subset[0])
+            mesh = None
+        else:
+            mesh = par.make_mesh(
+                par.mesh_shape_for(len(subset), tp=len(subset)),
+                devices=subset)
+            specs = par.specs_from_rules(params, llama.SHARDING_RULES)
+            rep_params = par.shard_params(params, specs, mesh)
+        gen = Generator(rep_params, cfg, mesh=mesh, **gen_kwargs)
+        if warmup:
+            gen.warmup()
+        gens.append(gen)
+    return gens
+
+
+class _FrontRequest:
+    """One request parked at (or transiting) the fleet front."""
+
+    __slots__ = ("prompt", "max_new", "priority", "enqueued_at",
+                 "deadline_at", "n_tokens", "future", "loop", "prefix",
+                 "attempts", "cancelled", "streamed", "routed_idx",
+                 "last_replica")
+
+    def __init__(self, prompt, max_new: int, priority: int,
+                 deadline_s: float, prefix: int | None) -> None:
+        # materialized: the prompt is replayed verbatim on failover (and
+        # longest-matched against every replica trie), so a one-shot
+        # iterable must be pinned down at admission
+        self.prompt = list(prompt)
+        self.max_new = max_new
+        self.priority = priority
+        self.enqueued_at = time.perf_counter()
+        self.deadline_at = (self.enqueued_at + deadline_s
+                            if deadline_s > 0 else None)
+        self.n_tokens = len(self.prompt)
+        self.future: asyncio.Future | None = None  # resolves to replica idx
+        self.loop: asyncio.AbstractEventLoop | None = None  # owns future
+        self.prefix = prefix          # FRONT pid (pool-level registration)
+        self.attempts = 0             # completed failover reroutes
+        self.cancelled = False        # consumer went away while queued
+        self.streamed = False         # a token reached the consumer
+        self.routed_idx: int | None = None  # replica slot reserved for us
+        self.last_replica: int | None = None  # avoid on reroute
+
+
+class ReplicaPool:
+    """N per-replica serving cores behind one routing/admission front.
+
+    Drop-in for ``LLMServer`` everywhere the datasource plane touches it:
+    same async API (``generate``/``stream``/``stream_chunks`` with
+    ``priority=``/``deadline_s=``/``prefix=``/``info=``), same sync prefix
+    pinning API, same health/snapshot contract. Construction takes ready
+    Generators (one per replica) — ``build_replica_generators`` builds
+    them over distinct device subsets.
+    """
+
+    def __init__(self, generators, *, name: str = "llm", logger=None,
+                 metrics=None, tracer=None, max_queue: int | None = None,
+                 max_queued_tokens: int | None = None,
+                 default_deadline_s: float | None = None,
+                 depth_per_replica: int | None = None,
+                 affinity_min_tokens: int | None = None,
+                 fault: Any = None, **server_kwargs) -> None:
+        generators = list(generators)
+        if not generators:
+            raise ValueError("a replica pool needs at least one generator")
+        self.name = name
+        self._logger = logger
+        self._metrics = metrics
+        # fleet-wide admission policy (env defaults mirror LLMServer's)
+        self._max_queue = (int(os.environ.get("GOFR_ML_MAX_QUEUE", "0"))
+                           if max_queue is None else int(max_queue))
+        self._max_queued_tokens = (
+            int(os.environ.get("GOFR_ML_MAX_QUEUED_TOKENS", "0"))
+            if max_queued_tokens is None else int(max_queued_tokens))
+        self._default_deadline = (
+            float(os.environ.get("GOFR_ML_DEFAULT_DEADLINE_S", "0"))
+            if default_deadline_s is None else float(default_deadline_s))
+        # per-replica pipeline depth: how many requests may be in flight
+        # toward one replica (its slots + a small staged margin so the
+        # core can overlap prefill with decode). Routing freshness argues
+        # small; slot utilization argues >= 1 extra wave.
+        depth = (int(os.environ.get("GOFR_ML_REPLICA_DEPTH", "2"))
+                 if depth_per_replica is None else int(depth_per_replica))
+        depth = max(1, depth)
+        # minimum trie match (tokens) that counts as cache affinity; below
+        # it the router prefers balancing load over locality
+        self._affinity_min = (
+            int(os.environ.get("GOFR_ML_AFFINITY_MIN_TOKENS", "1"))
+            if affinity_min_tokens is None else int(affinity_min_tokens))
+        # the front's own chaos point ("route"); replica-independent
+        self._fault = (FaultInjector.from_env() if fault is None
+                       else (fault or None))
+        # per-replica cores: bounds/deadline/shedding DISABLED — the front
+        # is the one place those policies run. The fault spec — env OR the
+        # programmatic ``fault=`` injector — arms each core through the
+        # same per-replica derivation (GOFR_ML_FAULT_REPLICA narrowing,
+        # independent seed per replica).
+        self.replicas: list[LLMServer] = []
+        for idx, gen in enumerate(generators):
+            ck = dict(server_kwargs)
+            if fault is None:
+                core_fault = FaultInjector.from_env_for_replica(idx)
+            elif self._fault is None:
+                core_fault = None
+            elif hasattr(self._fault, "for_replica"):
+                core_fault = self._fault.for_replica(idx)
+            else:
+                # a bare callable hook (the LLMServer fault= contract):
+                # no per-replica derivation to do — arm every core with it
+                core_fault = self._fault
+            ck.setdefault("fault", core_fault or False)
+            self.replicas.append(LLMServer(
+                gen, name=f"{name}/{idx}", logger=logger, metrics=metrics,
+                tracer=tracer, max_queue=0, max_queued_tokens=0,
+                default_deadline_s=0.0, **ck))
+        self._capacity = [max(1, g.batch_slots) * depth for g in generators]
+        self._outstanding = [0] * len(generators)
+        # fleet ready queue — priority classes + aging, exactly once
+        self._queue = AgingPriorityQueue(
+            aging_s=float(os.environ.get("GOFR_ML_PRIORITY_AGING_S", "2.0")))
+        self._admit_times: collections.deque[float] = collections.deque(
+            maxlen=64)
+        self._shed_counts = dict.fromkeys(PRIORITIES, 0)
+        self._deadline_expired = 0
+        self._routed = [collections.Counter() for _ in generators]
+        self._failovers = 0
+        self._dead_seen = [False] * len(generators)
+        self._last_states = ["serving"] * len(generators)
+        self.served = 0
+        self._closed = False
+        # parse the drain budget NOW so a malformed GOFR_ML_DRAIN_S is a
+        # loud startup error, not a silent drop-everything at SIGTERM
+        self._drain_default = drain_s_from_env()
+        # prefix map is touched from executor threads (sync pin API) and
+        # the event loop (routing) — it keeps its own lock
+        self._prefix_lock = threading.Lock()
+        self._next_pid = 1
+        self._prefixes: dict[int, dict] = {}
+        # request-plane lock: the fleet queue, per-replica slot accounting,
+        # and shed/failover counters are touched from EVERY loop that
+        # drives the pool (LLMServer supports one pool shared across
+        # threads each running its own loop — so must the front). All
+        # guarded sections are deque/int ops; futures are still resolved
+        # on their owning loop, never under a foreign one.
+        self._lock = threading.Lock()
+        # dispatcher: pinned to the first loop that submits; consumers on
+        # other loops enqueue through the lock and are woken on their own
+        # loop by _resolve
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._wake: asyncio.Event | None = None
+        self._dispatcher: asyncio.Task | None = None
+
+    # -- dispatcher -----------------------------------------------------------
+    def _ensure_dispatcher(self) -> None:
+        if self._closed:
+            return  # close() already flushed; never spawn a new router
+        loop = asyncio.get_running_loop()
+        with self._lock:
+            bound = self._loop
+            if (self._dispatcher is not None and not self._dispatcher.done()
+                    and bound is not None and not bound.is_closed()
+                    and (bound is loop or bound.is_running())):
+                return  # pinned dispatcher is alive — never rebind under it
+            self._loop = loop
+            self._wake = asyncio.Event()
+            self._dispatcher = loop.create_task(
+                self._dispatch_loop(), name=f"gofr-replica-router-{self.name}")
+
+    def _kick(self) -> None:
+        loop, wake = self._loop, self._wake
+        if loop is None or wake is None:
+            return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            wake.set()
+        else:
+            try:
+                loop.call_soon_threadsafe(wake.set)
+            except RuntimeError:
+                pass  # dispatcher loop already shut down
+
+    @staticmethod
+    def _resolve(fr: _FrontRequest, *, result=None, exc=None,
+                 cancel: bool = False) -> None:
+        """Resolve a front request's future ON ITS OWNING LOOP — futures
+        are not thread-safe, and with consumers on several loops the
+        dispatcher must not touch a foreign loop's future directly."""
+        fut, loop = fr.future, fr.loop
+        if fut is None or loop is None:
+            return
+
+        def _do() -> None:
+            if fut.done():
+                return
+            if cancel:
+                fut.cancel()
+            elif exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            _do()
+        else:
+            try:
+                loop.call_soon_threadsafe(_do)
+            except RuntimeError:
+                pass  # consumer loop is gone; its requests died with it
+
+    async def _dispatch_loop(self) -> None:
+        """The router: wake on submissions/completions, reap cancelled and
+        expired queued requests, refresh replica states, and hand each
+        admissible request to the replica the routing policy picks. Shared
+        request-plane state is touched only under ``self._lock`` (consumers
+        may live on other loops); futures resolve via ``_resolve``."""
+        wake = self._wake
+        while not self._closed:
+            if len(self._queue):
+                # saturated: poll at 50 Hz so deadlines, recoveries, and
+                # replica deaths are noticed even with no request events
+                try:
+                    await asyncio.wait_for(wake.wait(), 0.02)
+                except asyncio.TimeoutError:
+                    pass
+            else:
+                await wake.wait()
+            wake.clear()
+            if self._closed:
+                return
+            self._reap_queued()
+            self._refresh_replicas()
+            self._pump()
+
+    def _reap_queued(self) -> None:
+        """Front-queue hygiene: drop abandoned consumers, expire deadlines
+        at the gate (never dispatched — the PR 5 contract, fleet-wide)."""
+        now = time.perf_counter()
+        with self._lock:
+            reaped = self._queue.prune(
+                lambda fr: fr.cancelled or (fr.deadline_at is not None
+                                            and now >= fr.deadline_at))
+            self._deadline_expired += sum(
+                1 for fr in reaped if not fr.cancelled)
+        for fr in reaped:
+            if fr.cancelled:
+                self._resolve(fr, cancel=True)
+                continue
+            self._count("app_llm_deadline_exceeded_total", 1,
+                        model=self.name)
+            self._resolve(fr, exc=DeadlineExceeded(
+                "request deadline exceeded while queued (fleet)"))
+
+    def _refresh_replicas(self) -> None:
+        """Observe per-replica health; a replica newly seen ``dead`` is a
+        drain-and-reroute event (logged once) — its flushed requests come
+        back through the failover path; the router just stops picking it.
+        Runs on every dispatcher wake (up to 50 Hz with a backlog), so
+        the state gauge is only written on a TRANSITION — the sampler
+        pass (export_gauges) keeps it fresh between transitions."""
+        for idx, core in enumerate(self.replicas):
+            state = core.health()
+            if state == self._last_states[idx]:
+                continue
+            self._last_states[idx] = state
+            if state == "dead" and not self._dead_seen[idx]:
+                self._dead_seen[idx] = True
+                if self._logger is not None:
+                    try:
+                        self._logger.error(
+                            "llm replica dead; draining and rerouting",
+                            model=self.name, replica=idx,
+                            survivors=sum(
+                                1 for c in self.replicas
+                                if c.health() != "dead") )
+                    except Exception:
+                        pass
+            if self._metrics is not None:
+                try:
+                    self._metrics.set_gauge(
+                        "app_llm_replica_state",
+                        float(_STATE_VALUE.get(state, 3)),
+                        model=self.name, replica=str(idx))
+                except Exception:
+                    pass
+
+    def _routable(self, idx: int) -> bool:
+        core = self.replicas[idx]
+        return (not core._closed and not core._draining
+                and core.health() in ("serving", "degraded"))
+
+    def _load(self, idx: int) -> tuple[int, int]:
+        # in-flight toward the replica plus anything it has internally
+        # queued; the index breaks exact ties deterministically
+        return (self._outstanding[idx] + self.replicas[idx].queue_depth(),
+                idx)
+
+    def _pump(self) -> None:
+        parked: list[_FrontRequest] = []
+        try:
+            self._pump_inner(parked)
+        finally:
+            if parked:
+                # skipped-this-round requests (pin holder at capacity) go
+                # back to the FRONT of their class, original order kept;
+                # the dispatcher's 50 Hz backlog poll retries them
+                with self._lock:
+                    for fr in reversed(parked):
+                        self._queue.push_front(fr)
+
+    def _pump_inner(self, parked: list[_FrontRequest]) -> None:
+        while True:
+            flushed: list[_FrontRequest] | None = None
+            fr = None
+            with self._lock:
+                if not len(self._queue):
+                    return
+                candidates = [i for i in range(len(self.replicas))
+                              if self._routable(i)
+                              and self._outstanding[i] < self._capacity[i]]
+                if not candidates:
+                    if all(c.health() == "dead" for c in self.replicas):
+                        # total fleet loss: nothing will ever route — flush
+                        # the queue typed instead of parking consumers
+                        flushed = self._queue.drain()
+                else:
+                    fr = self._queue.pop()
+            if flushed is not None:
+                err = self._dead_error()
+                for dead_fr in flushed:
+                    self._resolve(dead_fr, exc=err)
+                return
+            if fr is None:
+                return  # capacity will free (or a recovery will finish)
+            try:
+                if self._fault is not None:
+                    self._fault("route")  # chaos point: a poisoned router
+                picked = self._route(fr, candidates)
+            except Exception as exc:
+                self._resolve(fr, exc=GeneratorCrashed(
+                    f"routing dispatch failed "
+                    f"({type(exc).__name__}: {exc})"))
+                continue
+            if picked is None:
+                # holder busy: skip THIS request for the round but keep
+                # pumping the rest of the queue (deadline reaping still
+                # applies while it waits)
+                parked.append(fr)
+                continue
+            idx, reason = picked
+            with self._lock:
+                if (fr.cancelled or fr.future is None or fr.future.done()):
+                    continue  # consumer raced away after the pop
+                fr.routed_idx = idx
+                self._outstanding[idx] += 1
+                self._routed[idx][reason] += 1
+                self._admit_times.append(time.perf_counter())
+                if fr.attempts:
+                    self._failovers += 1
+            if fr.attempts:
+                self._count("app_llm_replica_failovers_total", 1,
+                            model=self.name)
+            self._count("app_llm_replica_routed_total", 1, model=self.name,
+                        replica=str(idx), reason=reason)
+            self._resolve(fr, result=idx)
+
+    def _route(self, fr: _FrontRequest,
+               candidates: list[int]) -> tuple[int, str] | None:
+        """Pick a replica for one request, or ``None`` to keep it parked.
+        Explicit prefix pins route to a live holder; otherwise the
+        deepest radix-trie match (>= the affinity floor) wins — that
+        replica already holds the prompt's KV prefix — and ties/misses go
+        least-loaded. A rerouted request avoids the replica that just
+        failed it when any peer exists."""
+        if fr.prefix is not None:
+            with self._prefix_lock:
+                info = self._prefixes.get(fr.prefix)
+            by_replica = dict(info["by_replica"]) if info is not None else {}
+            live = [i for i in by_replica
+                    if self._routable(i)
+                    and self.replicas[i].has_prefix(by_replica[i])]
+            holders = [i for i in live if i in candidates]
+            if holders:
+                return min(holders, key=self._load), "affinity"
+            if live:
+                # a live holder exists but is at capacity: wait for its
+                # slot instead of dispatching to a non-holder, which
+                # could only answer with a spurious PrefixEvicted
+                return None
+            # no live holder anywhere: least-loaded replica raises the
+            # PrefixEvicted contract at admission — the caller owns
+            # re-registration
+            return min(candidates, key=self._load), "least_loaded"
+        best, best_len = None, 0
+        for i in candidates:
+            cache = self.replicas[i].prefix_cache
+            if cache is None:
+                continue
+            pid, reg_len = cache.peek(fr.prompt)
+            if pid is not None and reg_len > best_len:
+                best, best_len = i, reg_len
+        if (best is not None and best_len >= self._affinity_min
+                and (best != fr.last_replica or len(candidates) == 1)):
+            return best, "affinity"
+        pool = [i for i in candidates if i != fr.last_replica] or candidates
+        return (min(pool, key=self._load),
+                "failover" if fr.attempts else "least_loaded")
+
+    # -- fleet admission bounds / shedding ------------------------------------
+    def _admit(self, fr: _FrontRequest) -> None:
+        """Fleet-wide queue-boundary admission control: same policy as the
+        single-instance server (backlog-not-staging credit, lowest-priority
+        -first shedding with preemption) but measured against the WHOLE
+        fleet's queue and free capacity. Raises ``Overloaded`` when the
+        arrival itself is the victim."""
+        with self._lock:
+            w = self._queue
+            n_free = sum(
+                max(0, self._capacity[i] - self._outstanding[i])
+                for i in range(len(self.replicas)) if self._routable(i))
+            over = ((self._max_queue > 0
+                     and len(w) - n_free >= self._max_queue)
+                    or (self._max_queued_tokens > 0 and len(w) > n_free
+                        and w.tokens + fr.n_tokens > self._max_queued_tokens))
+            if not over:
+                return
+            victim = w.shed_lowest(worse_than=fr.priority)
+            self._note_shed(fr if victim is None else victim)
+        if victim is None:
+            raise self._overloaded()
+        self._resolve(victim, exc=self._overloaded())
+
+    def _note_shed(self, fr: _FrontRequest) -> None:
+        prio = PRIORITIES[fr.priority]
+        self._shed_counts[prio] += 1
+        self._count("app_llm_shed_total", 1, model=self.name, priority=prio)
+
+    def _overloaded(self) -> Overloaded:
+        retry_after = self._retry_after_s()
+        return Overloaded(
+            f"fleet overloaded ({len(self._queue)} queued, "
+            f"{self._queue.tokens} queued tokens across "
+            f"{len(self.replicas)} replicas); "
+            f"retry in ~{retry_after:.1f}s", retry_after=retry_after)
+
+    def _retry_after_s(self) -> float:
+        """Retry-After from the AGGREGATE drain rate: the front's window
+        holds dispatches across every replica, so scheduler.retry_after_s
+        over it prices the fleet backlog, not one instance's."""
+        return retry_after_s(self._admit_times, len(self._queue))
+
+    def _flush_queue(self, exc: Exception) -> None:
+        """Drain every parked request and fail it typed — each future on
+        its own loop. Safe from any thread; used by close() and by
+        waiters that outlive the dispatcher."""
+        with self._lock:
+            flushed = self._queue.drain()
+        for fr in flushed:
+            self._resolve(fr, exc=exc)
+
+    # -- errors ---------------------------------------------------------------
+    def _dead_error(self) -> GeneratorCrashed:
+        return GeneratorCrashed(
+            f"replica pool is dead: all {len(self.replicas)} replicas "
+            f"exhausted their restart budgets")
+
+    def _closed_error(self) -> Exception:
+        if not self._closed and all(
+                c.health() == "dead" for c in self.replicas):
+            return self._dead_error()
+        return ServerClosed()
+
+    # -- async API ------------------------------------------------------------
+    async def stream_chunks(self, prompt_ids, max_new_tokens: int = 64,
+                            prefix: int | None = None,
+                            info: dict | None = None,
+                            priority: int | str | None = None,
+                            deadline_s: float | None = None,
+                            ) -> AsyncIterator[list[int]]:
+        """Yield BURSTS of tokens, like ``LLMServer.stream_chunks``, with
+        fleet semantics: the request parks in the fleet queue, routes to
+        the best replica when one has capacity, and — if that replica
+        crashes or dies before the first token reaches the consumer —
+        transparently re-admits to a survivor with priority and deadline
+        preserved (greedy reroutes are bit-identical). Once a token has
+        been yielded a crash surfaces as the typed ``GeneratorCrashed``:
+        the stream cannot be resumed mid-generation."""
+        if self._closed:
+            raise self._closed_error()
+        prio = normalize_priority(priority)
+        ttl = self._default_deadline if deadline_s is None else deadline_s
+        if not ttl >= 0:  # rejects NaN too
+            raise ValueError(f"deadline_s must be >= 0, got {ttl}")
+        self._ensure_dispatcher()
+        fr = _FrontRequest(prompt_ids, max_new_tokens, prio, ttl, prefix)
+        fr.loop = asyncio.get_running_loop()
+        self._admit(fr)  # fleet shedding; may raise Overloaded
+        try:
+            while True:
+                fr.future = fr.loop.create_future()
+                with self._lock:
+                    if self._closed:
+                        # close() won the race to the flag: its flush has
+                        # (or will have) drained the queue — joining it
+                        # now would park this request forever
+                        raise self._closed_error()
+                    fr.routed_idx = None
+                    if fr.attempts:
+                        # rerouted work keeps its place at the head of its
+                        # class (enqueued_at preserved, so aging continues)
+                        self._queue.push_front(fr)
+                    else:
+                        self._queue.push(fr)
+                self._kick()
+                idx = await self._await_routing(fr)
+                core = self.replicas[idx]
+                agen = None
+                try:
+                    agen = core.stream_chunks(
+                        fr.prompt, fr.max_new,
+                        prefix=self._core_pid(fr.prefix, idx),
+                        info=info, priority=fr.priority,
+                        deadline_s=self._remaining(fr))
+                    async for burst in agen:
+                        fr.streamed = True
+                        yield burst
+                    with self._lock:
+                        self.served += 1
+                    return
+                except (GeneratorCrashed, ServerClosed) as exc:
+                    if fr.streamed or self._closed:
+                        raise
+                    others = [i for i, c in enumerate(self.replicas)
+                              if i != idx and c.health() != "dead"]
+                    if not others or fr.attempts >= 2 * len(self.replicas):
+                        if all(c.health() == "dead"
+                               for c in self.replicas):
+                            raise self._dead_error() from exc
+                        raise
+                    fr.attempts += 1
+                    fr.last_replica = idx
+                    if self._logger is not None:
+                        try:
+                            self._logger.warnf(
+                                "llm %s: rerouting request off replica %d "
+                                "(%s); attempt %d", self.name, idx,
+                                type(exc).__name__, fr.attempts)
+                        except Exception:
+                            pass
+                    continue
+                finally:
+                    if agen is not None:
+                        # close the core stream DETERMINISTICALLY so an
+                        # abandoned consumer's slot is reclaimed now, not
+                        # whenever async-generator GC finalization runs
+                        await agen.aclose()
+                    with self._lock:
+                        self._outstanding[idx] -= 1
+                        fr.routed_idx = None
+                    self._kick()
+        finally:
+            with self._lock:
+                fr.cancelled = True
+                if fr.routed_idx is not None:
+                    # the router reserved a slot but the consumer never
+                    # resumed (cancelled between assignment and wakeup)
+                    self._outstanding[fr.routed_idx] -= 1
+                    fr.routed_idx = None
+            self._kick()
+
+    async def _await_routing(self, fr: _FrontRequest) -> int:
+        """Wait for the router's verdict. The dispatcher is pinned to the
+        first loop that drove the pool; if that loop exits — or the
+        dispatcher task dies — while requests from OTHER loops are still
+        parked, the first waiter to notice re-homes the dispatcher onto
+        its own loop, so nobody hangs on a dead router."""
+        while True:
+            try:
+                return await asyncio.wait_for(asyncio.shield(fr.future), 0.25)
+            except asyncio.TimeoutError:
+                if self._closed:
+                    # close() may have raced our push past its flush (or
+                    # its flush never ran — dispatcher loop gone): flush
+                    # here so every parked consumer resolves typed
+                    self._flush_queue(ServerClosed())
+                    continue  # our future now resolves on this very loop
+                self._ensure_dispatcher()
+                self._kick()
+
+    async def stream(self, prompt_ids, max_new_tokens: int = 64,
+                     prefix: int | None = None, info: dict | None = None,
+                     priority: int | str | None = None,
+                     deadline_s: float | None = None) -> AsyncIterator[int]:
+        """Token-at-a-time view of ``stream_chunks``."""
+        agen = self.stream_chunks(prompt_ids, max_new_tokens, prefix=prefix,
+                                  info=info, priority=priority,
+                                  deadline_s=deadline_s)
+        try:
+            async for burst in agen:
+                for tok in burst:
+                    yield tok
+        finally:
+            await agen.aclose()
+
+    async def generate(self, prompt_ids, max_new_tokens: int = 64,
+                       prefix: int | None = None, info: dict | None = None,
+                       priority: int | str | None = None,
+                       deadline_s: float | None = None) -> list[int]:
+        """Collect the full completion."""
+        out: list[int] = []
+        async for burst in self.stream_chunks(prompt_ids, max_new_tokens,
+                                              prefix=prefix, info=info,
+                                              priority=priority,
+                                              deadline_s=deadline_s):
+            out.extend(burst)
+        return out
+
+    def _remaining(self, fr: _FrontRequest) -> float:
+        """The request's remaining deadline as the chosen core sees it
+        (0 = none).
+        Routing never dispatches an expired request, but the core enforces
+        the mid-decode half of the contract with what's left."""
+        if fr.deadline_at is None:
+            return 0.0
+        return max(fr.deadline_at - time.perf_counter(), 1e-3)
+
+    # -- prefix pinning (sync API, mirrors LLMServer) -------------------------
+    def _core_pid(self, front_pid: int | None, idx: int) -> int | None:
+        if front_pid is None:
+            return None
+        with self._prefix_lock:
+            info = self._prefixes.get(front_pid)
+            core_pid = (info or {}).get("by_replica", {}).get(idx)
+        if core_pid is None:
+            raise PrefixEvicted(
+                f"prefix {front_pid} has no live registration on replica "
+                f"{idx} (its holder died); re-register and retry")
+        return core_pid
+
+    def register_prefix(self, prefix_ids, timeout_s: float = 120.0) -> int:
+        """PIN a shared prefix on EVERY live replica (so affinity routing
+        is free to pick any of them) and return one pool-level id. A
+        replica that is dead — or fails the registration — is skipped; the
+        pin succeeds if at least one replica holds it. The per-replica
+        prefills fan out CONCURRENTLY (each core has its own serving
+        thread), so the pin costs ~one prefill of wall time and one wedged
+        replica cannot serialize the rest behind its timeout."""
+        if self._closed:
+            raise self._closed_error()
+        ids = tuple(int(t) for t in prefix_ids)
+        live = [(idx, core) for idx, core in enumerate(self.replicas)
+                if core.health() != "dead"]
+        by_replica: dict[int, int] = {}
+        last_exc: Exception | None = None
+        if live:
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=len(live)) as pool:
+                futs = {idx: pool.submit(core.register_prefix, ids, timeout_s)
+                        for idx, core in live}
+                for idx, fut in futs.items():
+                    try:
+                        by_replica[idx] = fut.result()
+                    except Exception as exc:
+                        last_exc = exc
+        if not by_replica:
+            raise last_exc if last_exc is not None else self._dead_error()
+        with self._prefix_lock:
+            pid = self._next_pid
+            self._next_pid += 1
+            self._prefixes[pid] = {
+                "ids": ids,
+                "by_replica": by_replica,
+            }
+        return pid
+
+    def drop_prefix(self, pid: int, timeout_s: float = 30.0) -> None:
+        """Release the pin on every replica that still holds it. The first
+        per-replica failure is re-raised AFTER every replica was tried
+        (a dead replica's pages are gone anyway)."""
+        with self._prefix_lock:
+            info = self._prefixes.pop(pid, None)
+        if info is None:
+            raise KeyError(f"unknown prefix id {pid}")
+        first_exc: Exception | None = None
+        for idx, core_pid in info["by_replica"].items():
+            core = self.replicas[idx]
+            if core.health() == "dead" or not core.has_prefix(core_pid):
+                continue
+            try:
+                core.drop_prefix(core_pid, timeout_s)
+            except Exception as exc:
+                if first_exc is None:
+                    first_exc = exc
+        if first_exc is not None:
+            raise first_exc
+
+    def has_prefix(self, pid: int) -> bool:
+        """True while at least one LIVE replica still holds the pin."""
+        with self._prefix_lock:
+            info = self._prefixes.get(pid)
+            if info is None:
+                return False
+            by_replica = dict(info["by_replica"])
+        return any(self.replicas[idx].health() != "dead"
+                   and self.replicas[idx].has_prefix(core_pid)
+                   for idx, core_pid in by_replica.items())
+
+    def check_admissible(self, prompt_ids, max_new_tokens: int = 1,
+                         prefix: int | None = None) -> None:
+        """Static shape admission check against one live replica (the
+        replicas are homogeneous, so one answer covers the fleet). No
+        replica able to answer is itself an admission failure — a dead
+        fleet or a pin with no surviving holder must reject HERE, not
+        deep inside the stream."""
+        for idx, core in enumerate(self.replicas):
+            if core.health() == "dead":
+                continue
+            core_pid = None
+            if prefix is not None:
+                with self._prefix_lock:
+                    info = self._prefixes.get(prefix)
+                    core_pid = (info or {}).get("by_replica", {}).get(idx)
+                if core_pid is None:
+                    continue  # this replica lost the pin; try a holder
+            core.check_admissible(prompt_ids, max_new_tokens,
+                                  prefix=core_pid)
+            return
+        if all(c.health() == "dead" for c in self.replicas):
+            raise self._dead_error()
+        raise PrefixEvicted(
+            f"prefix {prefix} has no live registration on any replica "
+            f"(its holders died); re-register and retry")
+
+    # -- observability / datasource contract ----------------------------------
+    def queue_depth(self) -> int:
+        with self._lock:
+            fleet = len(self._queue)
+        return fleet + sum(c.queue_depth() for c in self.replicas)
+
+    def health(self) -> str:
+        """``serving`` — every replica healthy; ``degraded`` — ANY replica
+        dead, recovering, or degraded (capacity is reduced but requests
+        still complete); ``dead`` — every replica dead (or the pool is
+        closed): nothing will complete."""
+        states = [c.health() for c in self.replicas]
+        if self._closed or all(s == "dead" for s in states):
+            return "dead"
+        if any(s != "serving" for s in states):
+            return "degraded"
+        return "serving"
+
+    def health_check(self) -> dict:
+        state = self.health()
+        status = {"serving": "UP", "degraded": "DEGRADED",
+                  "dead": "DOWN"}[state]
+        return {
+            "status": status,
+            "details": {
+                "model": self.name,
+                "state": state,
+                "replicas": {str(i): c.health()
+                             for i, c in enumerate(self.replicas)},
+                "queued": self.queue_depth(),
+                "served": self.served,
+                "failovers": self._failovers,
+            },
+        }
+
+    def routing_snapshot(self) -> dict:
+        """The ``routing`` block of ``/debug/serving``: fleet queue state,
+        per-replica capacity/load/states, realized routing-reason mix,
+        failover and shed counters, and the armed fault config. Reads
+        simple attributes only — safe from any thread."""
+        with self._prefix_lock:
+            pinned = len(self._prefixes)
+        fault_snap = fault_snapshot(self._fault)
+        with self._lock:
+            return {
+                "replicas": len(self.replicas),
+                "states": {str(i): c.health()
+                           for i, c in enumerate(self.replicas)},
+                "capacity": list(self._capacity),
+                "outstanding": list(self._outstanding),
+                "waiting": self._queue.snapshot(),
+                "queued": len(self._queue),
+                "queued_tokens": self._queue.tokens,
+                "routed": {str(i): dict(counts)
+                           for i, counts in enumerate(self._routed)},
+                "failovers": self._failovers,
+                "shed": dict(self._shed_counts),
+                "deadline_expired": self._deadline_expired,
+                "queue_bounds": {
+                    "max_requests": self._max_queue or None,
+                    "max_tokens": self._max_queued_tokens or None,
+                },
+                "affinity_min_tokens": self._affinity_min,
+                "pinned_prefixes": pinned,
+                "default_deadline_s": self._default_deadline or None,
+                "fault": fault_snap,
+                "fault_replica": FaultInjector.armed_replica(),
+            }
+
+    def export_gauges(self, metrics) -> None:
+        """Per-replica gauges for the sampler pass (states are also kept
+        fresh by the dispatcher between scrapes). ``app_llm_active_slots``
+        keeps its single-server label (``model=<name>``, now the fleet
+        total) so existing dashboards and alerts survive flipping
+        replicas on; per-replica occupancy is the ``replica``-labelled
+        series."""
+        total_live = 0
+        for idx, core in enumerate(self.replicas):
+            try:
+                total_live += core.gen.n_live
+                metrics.set_gauge(
+                    "app_llm_replica_state",
+                    float(_STATE_VALUE.get(core.health(), 3)),
+                    model=self.name, replica=str(idx))
+                metrics.set_gauge(
+                    "app_llm_replica_outstanding",
+                    float(self._outstanding[idx]),
+                    model=self.name, replica=str(idx))
+            except Exception:
+                pass
+        try:
+            metrics.set_gauge("app_llm_active_slots", float(total_live),
+                              model=self.name)
+        except Exception:
+            pass
+
+    def _count(self, name: str, value: int, **labels) -> None:
+        if self._metrics is None:
+            return
+        try:
+            self._metrics.add_counter(name, value, **labels)
+        except Exception:
+            pass
+
+    def close(self, drain_s: float | None = None) -> None:
+        """Close the whole fleet. ``drain_s`` (default ``GOFR_ML_DRAIN_S``)
+        drains the replicas gracefully — admission stops, in-flight decode
+        finishes — before teardown; queued front requests flush with the
+        typed closed error. The deadline is ONE shared budget: every
+        replica decodes toward it concurrently (each has its own serving
+        thread), and each close call gets only what remains, so SIGTERM
+        teardown is bounded by ``drain_s``, not ``N * drain_s``."""
+        if self._closed:
+            return
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if drain_s is None:
+            drain_s = self._drain_default
+        drain_deadline = time.monotonic() + max(0.0, drain_s)
+        loop, dispatcher = self._loop, self._dispatcher
+
+        def _flush() -> None:
+            self._kick()
+            self._flush_queue(ServerClosed())
+            if dispatcher is not None:
+                dispatcher.cancel()
+
+        scheduled = False
+        if loop is not None and not loop.is_closed():
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            if running is loop:
+                _flush()
+                scheduled = True
+            else:
+                try:
+                    loop.call_soon_threadsafe(_flush)
+                    scheduled = True
+                except RuntimeError:
+                    pass  # loop shut down between the check and the call
+        if not scheduled:
+            # dispatcher loop gone (or never bound): flush inline so
+            # consumers parked from OTHER loops still resolve typed
+            self._flush_queue(ServerClosed())
+        for core in self.replicas:
+            core.close(max(0.0, drain_deadline - time.monotonic()))

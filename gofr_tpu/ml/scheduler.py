@@ -43,7 +43,26 @@ import time
 __all__ = [
     "PRIORITIES", "normalize_priority", "TokenBudgetScheduler",
     "SLOController", "AgingPriorityQueue", "maybe_enable_compilation_cache",
+    "retry_after_s",
 ]
+
+
+def retry_after_s(admit_times, backlog: int) -> float:
+    """Retry-After from the observed drain rate: admissions per second
+    over the recent admission-timestamp window, scaled by the ``backlog``
+    ahead of a retry. Conservative 1 s floor before any drain was
+    observed; clamped to [0.5, 300] s. The ONE computation behind both
+    the single-server and the replica-pool 429s — an instance's window
+    holds its own admissions, a fleet front's the aggregate."""
+    depth = backlog + 1
+    rate = 0.0
+    if len(admit_times) >= 2:
+        span = admit_times[-1] - admit_times[0]
+        if span > 0:
+            rate = (len(admit_times) - 1) / span
+    if rate <= 0:
+        return 1.0
+    return min(max(depth / rate, 0.5), 300.0)
 
 # priority classes, best first; index == class number
 PRIORITIES = ("high", "normal", "low")

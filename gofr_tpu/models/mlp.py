@@ -33,7 +33,8 @@ class MLP:
         self.sizes = sizes
         keys = jax.random.split(jax.random.PRNGKey(seed), len(sizes) - 1)
         self.params = [
-            _init_linear(k, a, b) for k, a, b in zip(keys, sizes[:-1], sizes[1:])
+            _init_linear(k, a, b)
+            for k, a, b in zip(keys, sizes[:-1], sizes[1:], strict=True)
         ]
         self.example_inputs = (np.zeros((1, sizes[0]), np.float32),)
 

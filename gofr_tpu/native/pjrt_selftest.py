@@ -85,7 +85,7 @@ def main() -> int:
         outs = exe.execute(*inputs)
         ok = len(outs) == len(expected) and all(
             np.allclose(o, e, atol=2e-2, rtol=2e-2)
-            for o, e in zip(outs, expected)
+            for o, e in zip(outs, expected, strict=True)
         )
         result = {
             "ok": bool(ok),
@@ -96,7 +96,7 @@ def main() -> int:
             "num_outputs": exe.num_outputs,
             "max_abs_err": max(
                 float(np.abs(np.asarray(o, np.float32) - e).max())
-                for o, e in zip(outs, expected)
+                for o, e in zip(outs, expected, strict=True)
             ) if len(outs) == len(expected) else None,
         }
         exe.destroy()

@@ -211,7 +211,7 @@ def train_bpe(corpus: Iterable[str | bytes], vocab_size: int,
     while len(vocab) < vocab_size - len(specials):
         counts: dict[tuple[int, int], int] = {}
         for seq in seqs:
-            for a, b in zip(seq, seq[1:]):
+            for a, b in zip(seq, seq[1:], strict=False):
                 counts[(a, b)] = counts.get((a, b), 0) + 1
         if not counts:
             break

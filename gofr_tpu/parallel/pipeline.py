@@ -44,7 +44,7 @@ def stack_stages(layer_params: Any, n_stages: int) -> Any:
 
 def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jnp.ndarray,
                    mesh, *, axis_name: str = "pp",
-                   data_spec: P = P("dp")) -> jnp.ndarray:
+                   data_spec: P | None = None) -> jnp.ndarray:
     """Run ``x`` through the staged network on the mesh's pp ring.
 
     stage_fn(params_one_stage, activation [B_m, ...]) -> activation;
@@ -53,6 +53,8 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jnp.ndarray,
     Returns [n_micro, B_m, ...] outputs (the last stage's results,
     broadcast back to every stage so downstream specs stay simple).
     """
+    if data_spec is None:
+        data_spec = P("dp")
     n_stages = mesh.shape[axis_name]
     n_micro = x.shape[0]
 

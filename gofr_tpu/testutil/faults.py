@@ -17,6 +17,7 @@ Points (where the serving stack calls ``fire``):
 - ``spill``    — a device→host KV offload (Generator._spill_prefix)
 - ``restore``  — a host→device KV restore (Generator.restore_prefix)
 - ``emit``     — the token-burst callback into the serving layer
+- ``route``    — a ReplicaPool routing decision (ml/replica.py)
 
 The injector only exists when the env var is set (``from_env`` returns
 ``None`` otherwise) and the instrumented call sites guard with an
@@ -24,6 +25,12 @@ The injector only exists when the env var is set (``from_env`` returns
 dispatch, nothing else. Draws come from a dedicated ``random.Random``
 seeded by ``GOFR_ML_FAULT_SEED`` (default 1234) so a fault sequence is
 reproducible run-to-run.
+
+With a replica pool, ``GOFR_ML_FAULT_REPLICA=<idx>`` narrows the blast
+radius to exactly one replica: only that replica's serving core gets an
+injector (``from_env_for_replica``), so a failover test or bench arm can
+kill replica N deterministically while its peers stay clean. The front's
+own ``route`` point is replica-independent and stays armed.
 """
 
 from __future__ import annotations
@@ -32,9 +39,10 @@ import builtins
 import os
 import random
 
-__all__ = ["FAULT_POINTS", "FaultInjector", "InjectedFault"]
+__all__ = ["FAULT_POINTS", "FaultInjector", "InjectedFault",
+           "fault_snapshot"]
 
-FAULT_POINTS = ("step", "prefill", "spill", "restore", "emit")
+FAULT_POINTS = ("step", "prefill", "spill", "restore", "emit", "route")
 
 
 class InjectedFault(RuntimeError):
@@ -114,6 +122,45 @@ class FaultInjector:
         seed_raw = os.environ.get("GOFR_ML_FAULT_SEED", "").strip()
         return cls.parse(spec, seed=int(seed_raw) if seed_raw else None)
 
+    @classmethod
+    def armed_replica(cls) -> int | None:
+        """``GOFR_ML_FAULT_REPLICA`` as an index, or None (all replicas).
+        A malformed value fails loudly like a malformed spec would."""
+        raw = os.environ.get("GOFR_ML_FAULT_REPLICA", "").strip()
+        if not raw:
+            return None
+        try:
+            return int(raw)
+        except ValueError:
+            raise ValueError(
+                f"GOFR_ML_FAULT_REPLICA must be a replica index, "
+                f"got {raw!r}") from None
+
+    @classmethod
+    def from_env_for_replica(cls, idx: int) -> "FaultInjector | None":
+        """Per-replica arming for the pool: the env spec applies to
+        replica ``idx`` only when ``GOFR_ML_FAULT_REPLICA`` is unset or
+        names it. Each armed replica gets its OWN injector (independent,
+        deterministically seeded draw sequence: base seed + idx)."""
+        armed = cls.armed_replica()
+        if armed is not None and armed != idx:
+            return None
+        inj = cls.from_env()
+        if inj is None:
+            return None
+        return inj.for_replica(idx)
+
+    def for_replica(self, idx: int) -> "FaultInjector | None":
+        """Derive THIS injector for replica ``idx`` — the programmatic
+        twin of ``from_env_for_replica``: same ``GOFR_ML_FAULT_REPLICA``
+        narrowing, same independent per-replica seeding, so an injector
+        handed to ``register_llm(..., fault=...)`` arms the replica cores
+        exactly like the env spec would."""
+        armed = self.armed_replica()
+        if armed is not None and armed != idx:
+            return None
+        return type(self)(self.points, seed=self.seed + idx)
+
     def fire(self, point: str) -> None:
         armed = self.points.get(point)
         if armed is None:
@@ -136,3 +183,15 @@ class FaultInjector:
             "attempts": {k: v for k, v in self.attempts.items() if v},
             "injected": {k: v for k, v in self.injected.items() if v},
         }
+
+
+def fault_snapshot(hook) -> dict | None:
+    """Render an armed fault hook for /debug/serving — an injector's own
+    ``snapshot()`` when it has one, a bare callable's identity otherwise.
+    The ONE renderer behind ``LLMServer.resilience_snapshot`` and
+    ``ReplicaPool.routing_snapshot`` so the two debug planes agree."""
+    if hook is None:
+        return None
+    if hasattr(hook, "snapshot"):
+        return hook.snapshot()
+    return {"hook": getattr(hook, "__qualname__", repr(hook))}

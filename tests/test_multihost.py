@@ -10,12 +10,16 @@ tp=4 (local) mesh must produce the globally-correct value in BOTH
 processes — proving the collective actually crossed the process boundary.
 """
 
+import asyncio
+import json
 import os
+import struct
 import subprocess
 import sys
 
 import pytest
 
+from gofr_tpu.ml.errors import GeneratorCrashed, ServerClosed
 from gofr_tpu.testutil import get_free_port
 
 _WORKER = r"""
@@ -332,11 +336,13 @@ def test_multihost_serving_topology(tmp_path, run):
 
             # malformed request: out-of-vocab ids get an error FRAME (the
             # r4 hardening — an unvalidated frame once int32-overflowed
-            # the broadcast and tore the mesh down); mesh keeps serving
+            # the broadcast and tore the mesh down); mesh keeps serving.
+            # Validation rejects stay client errors (ValueError), not the
+            # typed serving failures
             try:
                 await llm.generate([10**7], 4)
                 raise AssertionError("out-of-vocab prompt was accepted")
-            except RuntimeError as exc:
+            except ValueError as exc:
                 assert "token ids" in str(exc)
             assert await llm.generate([3, 1], 4) == toks2
 
@@ -428,6 +434,170 @@ print(f"OK proc={pid}", flush=True)
 """
 
 
+# ----------------------------------------------- client reconnect (PR 6)
+class _FakeModelPort:
+    """In-process stand-in for rank 0's model port speaking the
+    length-prefixed JSON framing — one scripted behavior per accepted
+    connection, so the client's one-shot reconnect-and-resend state
+    machine is exercised without spawning a mesh."""
+
+    def __init__(self, behaviors):
+        self._behaviors = list(behaviors)
+        self.requests = []  # every generate op seen, across connections
+        self._server = None
+        self.port = None
+
+    async def __aenter__(self):
+        self._server = await asyncio.start_server(
+            self._handle, "127.0.0.1", 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def __aexit__(self, *exc):
+        self._server.close()
+        await self._server.wait_closed()
+
+    @staticmethod
+    async def _read_frame(reader):
+        header = await reader.readexactly(4)
+        (size,) = struct.unpack(">I", header)
+        return json.loads(await reader.readexactly(size))
+
+    @staticmethod
+    def send(writer, obj):
+        raw = json.dumps(obj).encode()
+        writer.write(struct.pack(">I", len(raw)) + raw)
+
+    async def _handle(self, reader, writer):
+        behavior = self._behaviors.pop(0) if self._behaviors else None
+        try:
+            frame = await self._read_frame(reader)
+            if frame.get("op") == "generate":
+                self.requests.append(frame)
+            if behavior is not None:
+                await behavior(self, frame, reader, writer)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+
+async def _drop_conn(port, frame, reader, writer):
+    """Connection dies before any token frame (worker crash/restart)."""
+
+
+def _serve(bursts, *, then_drop=False):
+    """Stream the given token bursts; end with done (natural finish) or a
+    dropped connection (mid-stream loss)."""
+
+    async def _behavior(port, frame, reader, writer):
+        rid = frame["id"]
+        for burst in bursts:
+            port.send(writer, {"id": rid, "tokens": burst})
+        if not then_drop:
+            port.send(writer, {"id": rid, "done": True})
+        await writer.drain()
+
+    return _behavior
+
+
+def test_client_reconnects_and_resends_before_first_token(run):
+    """A connection lost BEFORE the first token gets ONE transparent
+    reconnect-and-resend: the caller sees only the tokens, and the model
+    port sees the identical request twice (nothing was committed, so the
+    resend cannot double-decode)."""
+
+    async def scenario():
+        from gofr_tpu.ml.multihost import MultiHostLLMClient
+
+        async with _FakeModelPort(
+                [_drop_conn, _serve([[1, 2], [3]])]) as port:
+            llm = MultiHostLLMClient("127.0.0.1", port.port)
+            try:
+                assert await llm.generate([5, 9], 8) == [1, 2, 3]
+            finally:
+                await llm.close()
+            assert [r["tokens"] for r in port.requests] == [[5, 9], [5, 9]]
+            assert [r["max_new"] for r in port.requests] == [8, 8]
+
+    run(scenario())
+
+
+def test_client_no_retry_once_tokens_yielded(run):
+    """A connection lost AFTER a token was yielded must surface as the
+    typed mid-stream GeneratorCrashed, never a silent re-decode — the
+    consumer already committed those tokens downstream."""
+
+    async def scenario():
+        from gofr_tpu.ml.multihost import MultiHostLLMClient
+
+        async with _FakeModelPort(
+                [_serve([[7]], then_drop=True)]) as port:
+            llm = MultiHostLLMClient("127.0.0.1", port.port)
+            got = []
+            try:
+                with pytest.raises(GeneratorCrashed) as ei:
+                    async for burst in llm.stream_chunks([4, 4], 16):
+                        got.append(burst)
+                assert got == [[7]]
+                assert "mid-stream" in str(ei.value)
+                assert len(port.requests) == 1  # no resend
+            finally:
+                await llm.close()
+
+    run(scenario())
+
+
+def test_client_close_does_not_resurrect_connection(run):
+    """close() while a request is still awaiting its FIRST token must
+    surface the typed ServerClosed — never send the request down the
+    reconnect path, which would re-open a connection (and leak a reader
+    task) on a client the caller just tore down."""
+
+    async def scenario():
+        from gofr_tpu.ml.multihost import MultiHostLLMClient
+
+        async def _hang(port, frame, reader, writer):
+            await asyncio.sleep(30)  # never answers; close() interrupts
+
+        async with _FakeModelPort([_hang]) as port:
+            llm = MultiHostLLMClient("127.0.0.1", port.port)
+
+            async def consume():
+                return await llm.generate([5, 9], 8)
+
+            task = asyncio.create_task(consume())
+            await asyncio.sleep(0.1)          # parked awaiting token 1
+            await llm.close()
+            with pytest.raises(ServerClosed):
+                await asyncio.wait_for(task, 10)
+            assert len(port.requests) == 1    # no resend after close
+            assert llm._writer is None        # and no resurrected conn
+
+    run(scenario())
+
+
+def test_client_retry_budget_is_one(run):
+    """Two consecutive pre-token connection losses exhaust the single
+    retry: the second loss surfaces as GeneratorCrashed after exactly two
+    attempts (no infinite reconnect loop against a flapping worker)."""
+
+    async def scenario():
+        from gofr_tpu.ml.multihost import MultiHostLLMClient
+
+        async with _FakeModelPort([_drop_conn, _drop_conn]) as port:
+            llm = MultiHostLLMClient("127.0.0.1", port.port)
+            try:
+                with pytest.raises(GeneratorCrashed):
+                    await llm.generate([5], 4)
+                assert len(port.requests) == 2
+            finally:
+                await llm.close()
+
+    run(scenario())
+
+
 def test_four_rank_serving_and_rank_kill(tmp_path, run):
     """VERDICT r4 #8: the serving mesh at 4 ranks (dp=4 hosts x tp=2
     virtual chips each), concurrent DISTINCT prompts matching their
@@ -464,7 +634,9 @@ def test_four_rank_serving_and_rank_kill(tmp_path, run):
             # rank-kill mid-stream: start long generations, let the first
             # burst arrive, then kill rank 0 (any rank loss kills the
             # mesh by design — no drain/restart). Every in-flight
-            # request must ERROR promptly, not hang.
+            # request must ERROR promptly — with the TYPED serving
+            # errors (503-mapped GeneratorCrashed / ServerClosed, not a
+            # bare RuntimeError) — never hang.
             async def doomed(p):
                 got = []
                 try:
@@ -473,7 +645,7 @@ def test_four_rank_serving_and_rank_kill(tmp_path, run):
                         if len(got) == 1:
                             started.set_result(None) if not started.done() \
                                 else None
-                except RuntimeError as exc:
+                except (GeneratorCrashed, ServerClosed) as exc:
                     return got, str(exc)
                 return got, None
 

@@ -1,0 +1,632 @@
+"""Replica pool: cache-aware routing, crash failover, and fleet-wide
+admission (tier-1, CPU).
+
+The headline contract under test: with ``GOFR_ML_REPLICAS=2`` and a
+``step``-point fault killing one replica past its restart budget, no
+request hangs, queued requests complete on the survivor with
+bit-identical greedy tokens, and ``health()`` reports ``degraded`` (not
+``dead``) while any replica is down.
+"""
+
+import asyncio
+import concurrent.futures
+import time
+
+import jax
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gofr_tpu.app import App
+from gofr_tpu.config import MapConfig
+from gofr_tpu.ml import MLDatasource
+from gofr_tpu.ml.errors import (DeadlineExceeded, GeneratorCrashed,
+                                Overloaded, ServerClosed)
+from gofr_tpu.ml.generate import Generator
+from gofr_tpu.ml.llm import LLMServer
+from gofr_tpu.ml.prefix_cache import PrefixCacheConfig
+from gofr_tpu.ml.replica import (ReplicaPool, replicas_from_env,
+                                 split_devices)
+from gofr_tpu.models import llama
+from gofr_tpu.testutil.faults import FaultInjector
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.tiny_llama(use_flash=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _gen(model, **kw):
+    cfg, params = model
+    kw.setdefault("batch_slots", 1)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("prefill_buckets", (8, 16))
+    return Generator(params, cfg, **kw)
+
+
+def _expected(model, prompt, n):
+    return _gen(model).generate(prompt, n)
+
+
+def _fail_after(point: str, ok: int):
+    """Chaos hook: let the point fire ``ok`` times, then raise forever."""
+    left = {"n": ok}
+
+    def hook(p):
+        if p == point:
+            if left["n"] > 0:
+                left["n"] -= 1
+            else:
+                raise RuntimeError(f"injected at {p}")
+
+    return hook
+
+
+def _sleep_hook(point: str, seconds: float):
+    def hook(p):
+        if p == point:
+            time.sleep(seconds)
+
+    return hook
+
+
+# ------------------------------------------------------------ construction
+def test_replicas_from_env(monkeypatch):
+    assert replicas_from_env() == 1
+    assert replicas_from_env(3) == 3
+    monkeypatch.setenv("GOFR_ML_REPLICAS", "2")
+    assert replicas_from_env() == 2
+    for bad in ("zero", "0", "-1"):
+        monkeypatch.setenv("GOFR_ML_REPLICAS", bad)
+        with pytest.raises(ValueError):
+            replicas_from_env()
+
+
+def test_drain_s_from_env_fails_loudly(monkeypatch):
+    """A malformed GOFR_ML_DRAIN_S is a startup error, never a silent
+    zero-second drain (which would drop the very requests the knob is
+    there to protect)."""
+    from gofr_tpu.ml.llm import drain_s_from_env
+    monkeypatch.delenv("GOFR_ML_DRAIN_S", raising=False)
+    assert drain_s_from_env() == 0.0
+    monkeypatch.setenv("GOFR_ML_DRAIN_S", "2.5")
+    assert drain_s_from_env() == 2.5
+    for bad in ("5s", "-30", "nan", "inf"):
+        monkeypatch.setenv("GOFR_ML_DRAIN_S", bad)
+        with pytest.raises(ValueError, match="GOFR_ML_DRAIN_S"):
+            drain_s_from_env()
+
+
+def test_split_devices():
+    devs = list("abcdefgh")  # stand-ins: split never touches the devices
+    assert split_devices(2, devs) == [list("abcd"), list("efgh")]
+    assert split_devices(3, devs) == [["a", "b"], ["c", "d"], ["e", "f"]]
+    # fewer devices than replicas (CPU test mode): share round-robin
+    assert split_devices(3, ["a"]) == [["a"], ["a"], ["a"]]
+    assert split_devices(3, ["a", "b"]) == [["a"], ["b"], ["a"]]
+    with pytest.raises(ValueError):
+        split_devices(0, devs)
+
+
+def test_fault_per_replica_arming(monkeypatch):
+    monkeypatch.setenv("GOFR_ML_FAULT", "step:1")
+    monkeypatch.setenv("GOFR_ML_FAULT_REPLICA", "1")
+    assert FaultInjector.from_env_for_replica(0) is None
+    inj = FaultInjector.from_env_for_replica(1)
+    assert inj is not None and "step" in inj.points
+    monkeypatch.delenv("GOFR_ML_FAULT_REPLICA")
+    # unset: every replica armed, each with an independent seed
+    a, b = (FaultInjector.from_env_for_replica(i) for i in (0, 1))
+    assert a is not None and b is not None and a.seed != b.seed
+    monkeypatch.setenv("GOFR_ML_FAULT_REPLICA", "not-an-idx")
+    with pytest.raises(ValueError):
+        FaultInjector.from_env_for_replica(0)
+
+
+def test_register_llm_single_replica_passthrough(model, monkeypatch):
+    """GOFR_ML_REPLICAS=1 (and unset) must preserve today's behavior
+    exactly: register_llm mounts a plain LLMServer, no pool anywhere."""
+    monkeypatch.delenv("GOFR_ML_REPLICAS", raising=False)
+    ml = MLDatasource()
+    server = ml.register_llm("chat", None, None, generator=_gen(model))
+    assert isinstance(server, LLMServer)
+    server.close()
+    monkeypatch.setenv("GOFR_ML_REPLICAS", "1")
+    server = ml.register_llm("chat2", None, None, generator=_gen(model))
+    assert isinstance(server, LLMServer)
+    server.close()
+    # N replicas + ONE ready generator cannot be honored: fail loudly at
+    # startup instead of silently mounting a single-replica server
+    monkeypatch.setenv("GOFR_ML_REPLICAS", "2")
+    gen = _gen(model)
+    with pytest.raises(ValueError, match="replicas requested"):
+        ml.register_llm("chat3", None, None, generator=gen)
+    # an explicit replicas<=0 fails as loudly as GOFR_ML_REPLICAS=0 would
+    monkeypatch.delenv("GOFR_ML_REPLICAS")
+    with pytest.raises(ValueError, match="replicas must be >= 1"):
+        ml.register_llm("chat4", None, None, generator=gen, replicas=0)
+
+
+def test_register_llm_env_replicas_builds_pool(model, monkeypatch, run):
+    """GOFR_ML_REPLICAS=2 + ready generators mounts a ReplicaPool behind
+    the same name; the serving snapshot gains per-replica rows."""
+    monkeypatch.setenv("GOFR_ML_REPLICAS", "2")
+    ml = MLDatasource()
+    pool = ml.register_llm("chat", None, None,
+                           generator=[_gen(model), _gen(model)])
+    assert isinstance(pool, ReplicaPool)
+    assert ml.llm("chat") is pool
+
+    async def scenario():
+        out = await pool.generate([3, 1], 4)
+        assert out == _expected(model, [3, 1], 4)
+        snap = ml.serving_snapshot()["llms"]["chat"]
+        assert set(snap["replicas"]) == {"0", "1"}
+        for row in snap["replicas"].values():
+            assert "pool" in row and "resilience" in row
+        assert snap["routing"]["replicas"] == 2
+        assert snap["state"] == "serving"
+
+    try:
+        run(scenario())
+    finally:
+        pool.close()
+
+
+# ----------------------------------------------------------------- routing
+def test_pool_bit_identical_and_balanced(model, run):
+    """Concurrent requests spread across both replicas and every output
+    matches the single-generator greedy decode bit-for-bit."""
+    prompts = [[5, 9, 2, 7], [3, 1], [8, 6, 4], [2, 2, 9, 1]]
+    expects = [_expected(model, p, 6) for p in prompts]
+    pool = ReplicaPool([_gen(model), _gen(model)], name="chat")
+
+    async def scenario():
+        outs = await asyncio.gather(*(pool.generate(p, 6) for p in prompts))
+        for o, exp in zip(outs, expects, strict=True):
+            assert o == exp
+        snap = pool.routing_snapshot()
+        # both replicas took work (batch_slots=1, so one replica cannot
+        # have absorbed all four)
+        assert all(sum(c.values()) >= 1 for c in snap["routed"].values())
+        assert pool.health() == "serving"
+        assert pool.served == 4
+
+    try:
+        run(scenario())
+    finally:
+        pool.close()
+
+
+def test_cache_affinity_routing_and_dead_holder_fallback(model, run):
+    """A prompt whose prefix lives in one replica's radix trie routes to
+    that replica (KV locality); when the holder dies, the same prompt
+    falls back to a full prefill on the survivor — bit-identically."""
+    gens = [_gen(model, page_size=4, chunk=2) for _ in range(2)]
+    pool = ReplicaPool(gens, name="chat", max_restarts=0,
+                       prefix_cache=PrefixCacheConfig(promote_hits=1))
+    base = [7, 3, 9, 1, 4, 2, 8, 5]          # promoted on first sight
+    follow = base + [6, 6]
+
+    async def scenario():
+        exp = _expected(model, follow, 4)
+        await pool.generate(base, 4)         # least-loaded -> replica 0
+        holder = max(range(2), key=lambda i: (
+            pool.replicas[i].prefix_cache.peek(follow)[1]))
+        out = await pool.generate(follow, 4)  # affinity -> the holder
+        assert out == exp
+        snap = pool.routing_snapshot()
+        assert snap["routed"][str(holder)].get("affinity", 0) >= 1
+        # kill the holder: the prefix only lived on its trie — the same
+        # prompt must complete on the survivor via a full prefill
+        pool.replicas[holder].gen.fault = _fail_after("step", 0)
+        with pytest.raises(GeneratorCrashed):
+            # burn the holder: first dispatch is fatal (budget 0)
+            await pool.replicas[holder].generate([1, 2], 2)
+        assert pool.replicas[holder].health() == "dead"
+        assert await pool.generate(follow, 4) == exp
+        assert pool.health() == "degraded"
+
+    try:
+        run(scenario())
+    finally:
+        pool.close()
+
+
+def test_explicit_prefix_pin_fleet_wide(model, run):
+    """register_prefix pins on EVERY replica behind one pool-level id;
+    requests carrying it route to a live holder and decode from the
+    shared pages; drop_prefix releases everywhere."""
+    gens = [_gen(model, batch_slots=2, page_size=8) for _ in range(2)]
+    pool = ReplicaPool(gens, name="chat")
+    prefix = list(range(1, 9))
+
+    async def scenario():
+        pid = await asyncio.to_thread(pool.register_prefix, prefix)
+        assert pool.has_prefix(pid)
+        for core in pool.replicas:           # pinned on both tries
+            assert core.prefix_cache.peek(prefix + [30])[0] is not None
+        exp = _expected(model, prefix + [30, 31], 4)
+        outs = await asyncio.gather(
+            *(pool.generate([30, 31], 4, prefix=pid) for _ in range(3)))
+        assert all(o == exp for o in outs)
+        snap = pool.routing_snapshot()
+        assert sum(c.get("affinity", 0)
+                   for c in snap["routed"].values()) >= 3
+        await asyncio.to_thread(pool.drop_prefix, pid)
+        assert not pool.has_prefix(pid)
+        with pytest.raises(KeyError):
+            pool.drop_prefix(pid)
+
+    try:
+        run(scenario())
+    finally:
+        pool.close()
+
+
+def test_pool_concurrent_event_loops(model):
+    """Two threads, EACH running its own asyncio loop, drive one shared
+    pool concurrently — the pattern LLMServer supports via its
+    thread-safe request queue, so flipping GOFR_ML_REPLICAS on must not
+    break it: every request completes bit-identically, nothing hangs,
+    and the slot accounting returns to zero."""
+    prompts = [[5, 9, 2, 7], [3, 1], [8, 6, 4], [2, 2, 9, 1]]
+    expects = [_expected(model, p, 6) for p in prompts]
+    pool = ReplicaPool([_gen(model), _gen(model)], name="chat")
+
+    def drive(mine):
+        async def scenario():
+            return await asyncio.wait_for(
+                asyncio.gather(*(pool.generate(p, 6) for p in mine)),
+                timeout=120)  # a hang here IS the regression
+
+        return asyncio.run(scenario())
+
+    try:
+        with concurrent.futures.ThreadPoolExecutor(2) as ex:
+            futs = [ex.submit(drive, prompts[:2]), ex.submit(drive, prompts[2:])]
+            outs = [o for f in futs for o in f.result(timeout=180)]
+        for o, exp in zip(outs, expects, strict=True):
+            assert o == exp
+        assert pool.served == 4
+        snap = pool.routing_snapshot()
+        assert snap["outstanding"] == [0, 0]
+        assert snap["queued"] == 0
+    finally:
+        pool.close()
+
+
+def test_pool_accepts_plain_callable_fault(model, run):
+    """fault= takes the same bare-callable hooks LLMServer does: the pool
+    arms every core (and its own route point) with the hook instead of
+    crashing at construction, and the debug snapshot stays servable."""
+    seen: list[str] = []
+    pool = ReplicaPool([_gen(model), _gen(model)], name="chat",
+                       fault=seen.append)
+
+    async def scenario():
+        out = await pool.generate([3, 1], 4)
+        assert out == _expected(model, [3, 1], 4)
+        assert "route" in seen            # the front fired the hook
+        assert "step" in seen             # ... and so did a replica core
+        snap = pool.routing_snapshot()
+        assert snap["fault"] == {"hook": "list.append"}
+        assert pool.replicas[0].resilience_snapshot()["fault"] is not None
+
+    try:
+        run(scenario())
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------- failover
+def test_failover_acceptance(model, monkeypatch, run):
+    """THE acceptance scenario: GOFR_ML_REPLICAS=2, a step fault arms
+    replica 0 only (GOFR_ML_FAULT_REPLICA=0) past its restart budget —
+    no request hangs, every request completes on the survivor with
+    bit-identical greedy tokens, health is 'degraded' (not 'dead'), and
+    the per-replica metrics/debug rows reflect the transition."""
+    monkeypatch.setenv("GOFR_ML_REPLICAS", "2")
+    monkeypatch.setenv("GOFR_ML_FAULT", "step:1")
+    monkeypatch.setenv("GOFR_ML_FAULT_REPLICA", "0")
+    prompts = [[5, 9, 2, 7], [3, 1], [8, 6, 4], [2, 2, 9, 1]]
+    expects = [_expected(model, p, 6) for p in prompts]
+
+    ml = MLDatasource()
+    pool = ml.register_llm("chat", None, None,
+                           generator=[_gen(model), _gen(model)],
+                           max_restarts=0)
+    assert isinstance(pool, ReplicaPool)
+
+    async def scenario():
+        results = await asyncio.wait_for(
+            asyncio.gather(*(pool.generate(p, 6) for p in prompts),
+                           return_exceptions=True),
+            timeout=120)  # a hang here IS the regression
+        for r, exp in zip(results, expects, strict=True):
+            assert r == exp, results
+        assert pool.replicas[0].health() == "dead"
+        assert pool.replicas[1].health() == "serving"
+        assert pool.health() == "degraded"
+        assert pool.health_check()["status"] == "DEGRADED"
+        snap = pool.routing_snapshot()
+        assert snap["states"] == {"0": "dead", "1": "serving"}
+        assert snap["failovers"] >= 1
+        assert snap["fault_replica"] == 0
+        assert sum(c.get("failover", 0)
+                   for c in snap["routed"].values()) >= 1
+        # the whole fleet keeps serving on the survivor
+        assert await pool.generate([3, 1], 6) == expects[1]
+
+    try:
+        run(scenario())
+    finally:
+        pool.close()
+
+
+def test_streamed_request_fails_typed_on_crash(model, run):
+    """Once a token reached the consumer the stream cannot move replicas:
+    a crash then surfaces as the typed GeneratorCrashed (503), with the
+    partial output already delivered; a fresh request reroutes fine."""
+    gens = [_gen(model, chunk=1), _gen(model, chunk=1)]
+    pool = ReplicaPool(gens, name="chat", max_restarts=0)
+
+    async def scenario():
+        # the first request lands on replica 0 (least-loaded tie): let it
+        # stream two tokens, then kill the replica under it
+        pool.replicas[0].gen.fault = _fail_after("step", 2)
+        got: list[int] = []
+        with pytest.raises(GeneratorCrashed) as ei:
+            async for burst in pool.stream_chunks([5, 9, 2, 7], 30,
+                                                  priority="high",
+                                                  deadline_s=60):
+                got.extend(burst)
+        assert got and len(got) < 30      # partial output was streamed
+        assert int(ei.value.status_code) == 503
+        assert pool.replicas[0].health() == "dead"
+        # fresh traffic reroutes to the survivor, bit-identically
+        assert await pool.generate([3, 1], 4) == _expected(model, [3, 1], 4)
+        assert pool.health() == "degraded"
+
+    try:
+        run(scenario())
+    finally:
+        pool.close()
+
+
+def test_all_replicas_dead_pool_dead(model, run):
+    """Total fleet loss: every consumer gets the typed error (nobody
+    hangs), health reports dead/DOWN, new submissions fail fast."""
+    pool = ReplicaPool([_gen(model), _gen(model)], name="chat",
+                       max_restarts=0)
+
+    async def scenario():
+        for core in pool.replicas:
+            core.gen.fault = _fail_after("step", 0)
+        results = await asyncio.wait_for(
+            asyncio.gather(*(pool.generate([1, 2], 4) for _ in range(5)),
+                           return_exceptions=True),
+            timeout=120)
+        assert all(isinstance(r, GeneratorCrashed) for r in results), results
+        assert pool.health() == "dead"
+        assert pool.health_check()["status"] == "DOWN"
+        with pytest.raises(GeneratorCrashed) as ei:
+            await pool.generate([1, 2], 2)
+        assert int(ei.value.status_code) == 503
+
+    try:
+        run(scenario())
+    finally:
+        pool.close()
+
+
+# ------------------------------------------------- fleet admission control
+def test_fleet_wide_shedding_with_retry_after(model, run):
+    """The queue bound applies ONCE, fleet-wide: with both replicas busy
+    and the fleet queue full, the newest lowest-priority request sheds
+    with a typed 429 whose Retry-After comes from the aggregate drain
+    rate — and a high-priority arrival preempts queued low work."""
+    pool = ReplicaPool([_gen(model), _gen(model)], name="chat",
+                       max_queue=2, depth_per_replica=1)
+
+    async def scenario():
+        for core in pool.replicas:
+            core.gen.fault = _sleep_hook("step", 0.01)
+        longs = [asyncio.create_task(pool.generate([9, i + 1], 40))
+                 for i in range(2)]
+        await asyncio.sleep(0.15)            # both slots owned
+        lows = [asyncio.create_task(
+            pool.generate([i + 1, i + 2], 4, priority="low"))
+            for i in range(2)]
+        await asyncio.sleep(0.05)            # both queued at the front
+        high = asyncio.create_task(
+            pool.generate([5, 6], 4, priority="high"))
+        results = await asyncio.gather(*lows, high, *longs,
+                                       return_exceptions=True)
+        shed = [r for r in results if isinstance(r, Overloaded)]
+        assert len(shed) == 1, results
+        assert isinstance(results[1], Overloaded), results  # newest low
+        assert isinstance(results[0], list)                 # older low
+        assert isinstance(results[2], list)                 # the high
+        err = shed[0]
+        assert int(err.status_code) == 429
+        assert err.retry_after > 0 and "Retry-After" in err.headers
+        snap = pool.routing_snapshot()
+        assert snap["shed"] == {"high": 0, "normal": 0, "low": 1}
+
+    try:
+        run(scenario())
+    finally:
+        pool.close()
+
+
+def test_fleet_queue_deadline_expiry(model, run):
+    """A request expiring while queued at the FRONT is reaped with the
+    typed 504 — it never dispatches toward any replica."""
+    pool = ReplicaPool([_gen(model), _gen(model)], name="chat",
+                       depth_per_replica=1)
+
+    async def scenario():
+        for core in pool.replicas:
+            core.gen.fault = _sleep_hook("step", 0.01)
+        longs = [asyncio.create_task(pool.generate([9, i + 1], 40))
+                 for i in range(2)]
+        await asyncio.sleep(0.15)            # both slots owned
+        requests_before = [c.gen._n_requests for c in pool.replicas]
+        with pytest.raises(DeadlineExceeded) as ei:
+            await pool.generate([1, 2], 4, deadline_s=0.05)
+        assert int(ei.value.status_code) == 504
+        assert pool.routing_snapshot()["deadline_expired"] == 1
+        # it never reached a replica: no new prefill on either core
+        assert [c.gen._n_requests for c in pool.replicas] == requests_before
+        await asyncio.gather(*longs)
+
+    try:
+        run(scenario())
+    finally:
+        pool.close()
+
+
+# --------------------------------------------------- observability plane
+def test_debug_serving_and_metrics_reflect_failover(model, run):
+    """/debug/serving grows the per-replica rows + routing block, the
+    health endpoint stays 200 while degraded, and the app_llm_replica_*
+    series reflect the dead replica."""
+
+    async def scenario():
+        app = App(config=MapConfig({"APP_NAME": "replica-test"}))
+        metrics = app.container.metrics_manager
+        ml = app._ensure_ml()
+        pool = ReplicaPool([_gen(model), _gen(model)], name="chat",
+                           metrics=metrics, max_restarts=0)
+        ml._llms["chat"] = pool
+        http_server = TestServer(app._build_http_app())
+        client = TestClient(http_server)
+        await client.start_server()
+        try:
+            out = await pool.generate([3, 1], 4)
+            assert out == _expected(model, [3, 1], 4)
+
+            r = await client.get("/debug/serving")
+            data = (await r.json())["data"]
+            entry = data["llms"]["chat"]
+            assert set(entry["replicas"]) == {"0", "1"}
+            assert entry["routing"]["states"] == {"0": "serving",
+                                                  "1": "serving"}
+            for row in entry["replicas"].values():
+                assert row["resilience"]["state"] == "serving"
+
+            # kill replica 0 (budget 0: first crash is fatal)
+            pool.replicas[0].gen.fault = _fail_after("step", 0)
+            with pytest.raises(GeneratorCrashed):
+                await pool.replicas[0].generate([1, 2], 2)
+
+            r = await client.get("/debug/serving")
+            entry = (await r.json())["data"]["llms"]["chat"]
+            assert entry["routing"]["states"]["0"] == "dead"
+            assert entry["replicas"]["0"]["resilience"]["state"] == "dead"
+            assert entry["replicas"]["1"]["resilience"]["state"] == "serving"
+
+            # degraded is NOT down: the health endpoint keeps answering 200
+            r = await client.get("/.well-known/health")
+            assert r.status == 200
+            body = (await r.json())["data"]
+            assert body["ml"]["status"] == "DEGRADED"
+            details = body["ml"]["details"]["llms"]["chat"]
+            assert details["state"] == "degraded"
+            assert details["replicas"] == {"0": "dead", "1": "serving"}
+
+            ml.refresh_device_metrics(metrics)
+            text = metrics.expose_text()
+            assert "app_llm_replica_routed_total" in text
+            assert "app_llm_replica_state" in text
+            state_lines = [ln for ln in text.splitlines()
+                           if ln.startswith("app_llm_replica_state")]
+            dead_vals = [ln.rsplit(" ", 1)[1] for ln in state_lines
+                         if 'replica="0"' in ln]
+            assert dead_vals and float(dead_vals[0]) == 3.0  # dead ordinal
+            # the single-server slot gauge keeps its label (fleet total):
+            # dashboards on model="chat" survive flipping replicas on
+            assert any(ln.startswith('app_llm_active_slots{model="chat"}')
+                       for ln in text.splitlines())
+        finally:
+            await client.close()
+            pool.close()
+
+    run(scenario())
+
+
+# ----------------------------------------------------------- graceful drain
+def test_graceful_drain_lets_inflight_finish(model, run):
+    """close(drain_s=): admission stops (typed ServerClosed), the
+    in-flight decode runs to completion and delivers its full greedy
+    output, queued-but-never-admitted requests flush typed."""
+
+    async def scenario():
+        server = LLMServer(_gen(model))
+        server.gen.fault = _sleep_hook("step", 0.005)
+        exp = _expected(model, [9, 9], 20)
+        got: list[int] = []
+        first = asyncio.get_running_loop().create_future()
+
+        async def long_req():
+            async for burst in server.stream_chunks([9, 9], 20):
+                got.extend(burst)
+                if not first.done():
+                    first.set_result(None)
+
+        long_task = asyncio.create_task(long_req())
+        await asyncio.wait_for(first, 60)    # PROVABLY in the only slot
+        queued = asyncio.create_task(server.generate([1, 2], 4))
+        await asyncio.sleep(0.02)            # parked behind it
+        drain = asyncio.create_task(asyncio.to_thread(server.close, 5.0))
+        await asyncio.sleep(0.02)
+        with pytest.raises(ServerClosed):    # admission is stopped
+            await server.generate([3, 1], 4)
+        await long_task
+        assert got == exp                    # in-flight ran to completion
+        with pytest.raises(ServerClosed):    # queued flushed typed
+            await queued
+        await drain
+        assert server.closed_cleanly
+
+    run(scenario())
+
+
+def test_drain_deadline_bounds_teardown(model, run):
+    """A drain that cannot finish by the deadline still tears down: the
+    in-flight request gets the typed close error, never a hang."""
+
+    async def scenario():
+        server = LLMServer(_gen(model))
+        server.gen.fault = _sleep_hook("step", 0.02)
+        long_task = asyncio.create_task(server.generate([9, 9], 500))
+        await asyncio.sleep(0.1)
+        t0 = time.perf_counter()
+        await asyncio.to_thread(server.close, 0.2)
+        assert time.perf_counter() - t0 < 5.0
+        with pytest.raises(ServerClosed):
+            await long_task
+
+    run(scenario())
+
+
+def test_drain_env_default_and_pool_drain(model, monkeypatch, run):
+    """GOFR_ML_DRAIN_S wires the drain into every close() — including app
+    shutdown's — and ReplicaPool.close drains each replica."""
+    monkeypatch.setenv("GOFR_ML_DRAIN_S", "5.0")
+
+    async def scenario():
+        pool = ReplicaPool([_gen(model), _gen(model)], name="chat")
+        for core in pool.replicas:
+            core.gen.fault = _sleep_hook("step", 0.005)
+        exp = _expected(model, [9, 9], 20)
+        long_task = asyncio.create_task(pool.generate([9, 9], 20))
+        await asyncio.sleep(0.1)             # streaming on a replica
+        await asyncio.to_thread(pool.close)  # no args: env default drains
+        assert await long_task == exp
+        with pytest.raises((ServerClosed, GeneratorCrashed)):
+            await pool.generate([1, 2], 4)
+
+    run(scenario())
