@@ -185,6 +185,17 @@ class Container:
         m.new_counter("app_llm_replica_failovers_total",
                       "requests re-admitted to a surviving replica after "
                       "their first replica crashed or died")
+        m.new_histogram(
+            "app_llm_dispatch_phase_seconds",
+            "serving dispatch wall time per phase (flight recorder: "
+            "queue_pop / decide / assemble / dispatch / device_wait / "
+            "emit / route / other)",
+            # phases run from microseconds (a scheduler plan) to a whole
+            # device step — the default buckets' 1 ms floor would flatten
+            # every host-side phase into one bucket
+            buckets=(5e-5, 2e-4, 5e-4, 1e-3, 3e-3, 5e-3, 0.01, 0.02,
+                     0.03, 0.05, 0.1, 0.2, 0.5, 1.0),
+        )
         m.new_gauge("app_llm_evictions",
                     "streams truncated because the KV page pool ran dry")
         m.new_gauge("app_llm_prefix_evictions",

@@ -14,6 +14,16 @@ the server doing right now?". The TPU-native equivalents here:
   and streams it back as a zip. One capture at a time: the profiler is a
   process-global singleton, so a second concurrent request answers 409
   instead of corrupting the first trace.
+- ``GET /debug/events?since=<cursor>&model=…&kind=…`` — the serving
+  flight recorder's fleet event log (flight_recorder.py): typed
+  admission/routing/spill/shed/deadline/crash events with a monotonic
+  cursor, so an operator (or a poller) replays exactly what the serving
+  plane decided, in order, across every model and replica.
+- ``GET /debug/crash`` / ``GET /debug/crash/<id>`` — crash forensics
+  bundles the watchdog snapshots when a generator crashes or a replica
+  dies: the triggering event, the preceding fleet events, the scheduler
+  and pool state, and the in-flight slot table — the postmortem without a
+  live repro.
 """
 
 from __future__ import annotations
@@ -192,5 +202,45 @@ def register_debug_routes(app, aio_app: web.Application) -> None:
                      'attachment; filename="jax-trace.zip"'},
         )
 
+    async def events_handler(request: web.Request) -> web.Response:
+        # lazy import: flight_recorder is stdlib-only, but going through
+        # the gofr_tpu.ml package at module scope would cost every app
+        # jax's import time at startup
+        from .flight_recorder import event_log
+
+        try:
+            since = int(request.query.get("since", "0"))
+            limit = int(request.query.get("limit", "256"))
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "since/limit must be integers"}},
+                status=400)
+        if limit < 1:
+            return web.json_response(
+                {"error": {"message": "limit must be >= 1"}}, status=400)
+        return web.json_response({"data": event_log().query(
+            since=since, model=request.query.get("model") or None,
+            kind=request.query.get("kind") or None, limit=limit)})
+
+    async def crash_list_handler(_: web.Request) -> web.Response:
+        from .flight_recorder import crash_vault
+
+        return web.json_response(
+            {"data": {"crashes": crash_vault().list()}})
+
+    async def crash_handler(request: web.Request) -> web.Response:
+        from .flight_recorder import crash_vault
+
+        crash_id = request.match_info["crash_id"]
+        bundle = crash_vault().get(crash_id)
+        if bundle is None:
+            return web.json_response(
+                {"error": {"message": f"unknown crash id {crash_id!r}"}},
+                status=404)
+        return web.json_response({"data": bundle})
+
     aio_app.router.add_get("/debug/serving", serving_handler)
     aio_app.router.add_get("/debug/profile", profile_handler)
+    aio_app.router.add_get("/debug/events", events_handler)
+    aio_app.router.add_get("/debug/crash", crash_list_handler)
+    aio_app.router.add_get("/debug/crash/{crash_id}", crash_handler)

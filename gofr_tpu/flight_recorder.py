@@ -1,0 +1,311 @@
+"""Serving flight recorder: stall attribution, fleet events, crash forensics.
+
+The serving plane grew a scheduler (scheduler.py), a tiered KV cache
+(kv_offload.py), a watchdog (llm.py) and a replica router (replica.py) —
+and with them, failure and latency stories that span several components: a
+routed, spilled, rerouted request used to show up as four disconnected
+counters. This module is the shared memory those components write into, so
+one curl can answer "where did the step time go?" and "what happened right
+before the crash?":
+
+- ``DispatchRecorder`` — per-dispatch **stall attribution**. The serving
+  thread stamps monotonic phase durations (queue pop, scheduler decide,
+  batch assemble, device dispatch, device wait, emit) as it works; every
+  device dispatch commits one record into a bounded ring, with the
+  unattributed remainder of the pass recorded honestly as ``other`` — the
+  phases of a record always sum to its wall time. Rolling per-phase
+  shares (over the ring) feed the ``llms.<name>.stalls`` block of
+  ``/debug/serving`` and the ``app_llm_dispatch_phase_seconds{phase=…}``
+  histogram; ``top_stall`` names the top *host-side* phase so ROADMAP-3c
+  work knows what to kill first. ``GOFR_ML_FLIGHT_RECORDER=0`` disables
+  recording entirely (the instrumented sites guard on ``is not None``).
+- ``EventLog`` — the **fleet event log**: one process-global bounded ring
+  of typed serving events (admit, route, failover, spill, restore, shed,
+  deadline, crash, recover, dead, drain) written by ``LLMServer``,
+  ``ReplicaPool``, ``RadixPrefixCache`` and ``HostKVStore``, and read by
+  ``GET /debug/events?since=<cursor>&model=…``. Appends are O(1) under a
+  tiny lock; the ring (``GOFR_ML_EVENT_RING``, default 2048) bounds
+  memory, and the monotonic ``seq`` cursor lets a poller resume without
+  missing or re-reading events that are still in the ring.
+- ``CrashVault`` — **crash forensics**: when the watchdog trips (or a
+  replica dies), the server snapshots the triggering event, the last N
+  fleet events, the scheduler/queue state and the in-flight slot table
+  into an in-memory bundle served at ``GET /debug/crash/<id>`` — the
+  postmortem survives the recovery, so reading it never needs a live
+  repro.
+
+Everything here is host-side stdlib — no jax imports, safe to import from
+the debug endpoints without paying the ml package's startup cost.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+
+__all__ = ["PHASES", "DispatchRecorder", "EventLog", "CrashVault",
+           "event_log", "crash_vault", "recorder_enabled"]
+
+# the dispatch-phase taxonomy (the label set of
+# app_llm_dispatch_phase_seconds). ``route`` is recorded by the replica
+# pool's router; everything else by one LLMServer serving thread.
+# ``other`` is the honest remainder: wall time of a dispatch pass no
+# instrumented site claimed (host bookkeeping loops, GC, OS scheduling).
+PHASES = ("queue_pop", "decide", "assemble", "dispatch", "device_wait",
+          "emit", "route", "other")
+# phases that burn HOST time; ``device_wait`` is the one phase where the
+# host is merely blocked on device compute, so it never names a stall
+_HOST_PHASES = tuple(p for p in PHASES if p != "device_wait")
+
+
+def recorder_enabled() -> bool:
+    """``GOFR_ML_FLIGHT_RECORDER`` (default on): 0 disables the dispatch
+    recorder — the overhead A/B knob the bench stall arm flips."""
+    return os.environ.get("GOFR_ML_FLIGHT_RECORDER", "1").strip() != "0"
+
+
+class DispatchRecorder:
+    """Per-dispatch phase breakdown for one serving core.
+
+    The serving thread calls ``note(phase, seconds)`` as it works and
+    ``commit()`` once per device dispatch; ``reset()`` discards a pure
+    idle pass (an idle server's poll wait is not a stall of any
+    dispatch). ``snapshot()`` is safe from any thread.
+    """
+
+    def __init__(self, *, model: str = "llm", metrics=None,
+                 ring: int = 256) -> None:
+        self.model = model
+        self._metrics = metrics
+        self._ring: collections.deque[dict] = collections.deque(maxlen=ring)
+        # guards the ring and lifetime totals only — note() is
+        # serving-thread-private and takes no lock at all
+        self._lock = threading.Lock()
+        self._pending: dict[str, float] = {}
+        self._anchor: float | None = None  # pass start (perf_counter)
+        self.dispatches = 0
+        self.totals = dict.fromkeys(PHASES, 0.0)  # lifetime seconds
+
+    @property
+    def pending(self) -> bool:
+        return bool(self._pending)
+
+    @property
+    def pending_total(self) -> float:
+        """Seconds already attributed in the current pass — callers timing
+        a COMPOSITE section (e.g. the admission wave, whose internal drain
+        notes device_wait/emit itself) subtract the delta so nested notes
+        are never double-counted against the section's own phase."""
+        return sum(self._pending.values())
+
+    @property
+    def pending_device_work(self) -> bool:
+        """True when the current pass actually touched the device (a
+        dispatch, a blocking read-back, or token emission) — the gate for
+        the serve loop's tail-flush commit, so idle passes that merely
+        glanced at an empty queue never pollute the dispatch ring."""
+        return any(k in self._pending
+                   for k in ("dispatch", "device_wait", "emit"))
+
+    def note(self, phase: str, seconds: float) -> None:
+        """Attribute ``seconds`` of the current pass to ``phase``.
+        Serving-thread only; one dict update, no lock."""
+        self._pending[phase] = self._pending.get(phase, 0.0) + seconds
+
+    def reset(self) -> None:
+        """Drop the current pass unrecorded (idle poll: no dispatch to
+        attribute the wait to) and re-anchor the wall clock."""
+        self._pending.clear()
+        self._anchor = time.perf_counter()
+
+    def commit(self) -> None:
+        """Close one dispatch record: phases noted since the last
+        commit/reset plus the unattributed remainder as ``other``, so a
+        record's phases always sum to its wall time."""
+        now = time.perf_counter()
+        attributed = sum(self._pending.values())
+        wall = (now - self._anchor if self._anchor is not None
+                else attributed)
+        phases = dict(self._pending)
+        phases["other"] = max(0.0, wall - attributed)
+        rec = {"wall_s": wall, "phases": phases}
+        with self._lock:
+            self._ring.append(rec)
+            self.dispatches += 1
+            for name, v in phases.items():
+                self.totals[name] = self.totals.get(name, 0.0) + v
+        self._pending.clear()
+        self._anchor = now
+        m = self._metrics
+        if m is not None:
+            try:
+                for name, v in phases.items():
+                    if v > 0.0:
+                        m.record_histogram("app_llm_dispatch_phase_seconds",
+                                           v, model=self.model, phase=name)
+            except Exception:
+                pass  # bare managers in tests: recording stays optional
+
+    def snapshot(self) -> dict:
+        """The ``stalls`` block of ``/debug/serving``: rolling per-phase
+        seconds and share-of-wall over the ring, the top host-side phase
+        by share, and how much of the wall the instrumented phases (i.e.
+        everything but ``other``) actually explained."""
+        with self._lock:
+            records = list(self._ring)
+            dispatches = self.dispatches
+            totals = {name: round(v, 6)
+                      for name, v in self.totals.items() if v > 0.0}
+        wall = sum(r["wall_s"] for r in records)
+        sums: dict[str, float] = {}
+        for r in records:
+            for name, v in r["phases"].items():
+                sums[name] = sums.get(name, 0.0) + v
+        phases = {
+            name: {"s": round(v, 6),
+                   "share": round(v / wall, 4) if wall > 0 else 0.0}
+            for name, v in sorted(sums.items(), key=lambda kv: -kv[1])
+        }
+        host = {n: v for n, v in sums.items() if n in _HOST_PHASES}
+        top = max(host, key=host.get) if host and wall > 0 else None
+        attributed = sum(v for n, v in sums.items() if n != "other")
+        return {
+            "dispatches": dispatches,
+            "window": {
+                "records": len(records),
+                "wall_s": round(wall, 6),
+                "per_dispatch_ms": (round(wall / len(records) * 1e3, 3)
+                                    if records else None),
+                "phases": phases,
+            },
+            "top_stall": top,
+            "attributed_share": (round(attributed / wall, 4)
+                                 if wall > 0 else None),
+            # lifetime per-phase seconds: the ring answers "what's slow
+            # NOW", this answers "where has the wall gone since boot"
+            "totals_s": totals,
+        }
+
+
+class EventLog:
+    """Bounded ring of typed serving events with a monotonic cursor."""
+
+    def __init__(self, capacity: int | None = None) -> None:
+        if capacity is None:
+            raw = os.environ.get("GOFR_ML_EVENT_RING", "").strip()
+            try:
+                capacity = int(raw) if raw else 2048
+            except ValueError:
+                capacity = 2048
+        self._buf: collections.deque[dict] = collections.deque(
+            maxlen=max(16, capacity))
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    @property
+    def cursor(self) -> int:
+        """Seq of the newest event (pass it back as ``since=``)."""
+        with self._lock:
+            return self._seq
+
+    def emit(self, kind: str, model: str | None = None, **data) -> dict:
+        """Append one event; returns the stored record (its ``seq`` is
+        the cursor callers quote, e.g. a crash bundle's trigger)."""
+        with self._lock:
+            self._seq += 1
+            rec = {"seq": self._seq, "ts": round(time.time(), 6),
+                   "kind": kind, "model": model, **data}
+            self._buf.append(rec)
+            return rec
+
+    @staticmethod
+    def _model_match(ev_model: str | None, want: str) -> bool:
+        # "chat" also matches its replica cores "chat/0", "chat/1", …
+        return (ev_model == want
+                or (ev_model is not None and ev_model.startswith(want + "/")))
+
+    def query(self, since: int = 0, *, model: str | None = None,
+              kind: str | None = None, limit: int = 256) -> dict:
+        """Events with ``seq > since`` (oldest first), optionally filtered
+        by model (a pool name matches its replica cores too) and kind.
+        ``cursor`` is what the next poll passes as ``since=``: past the
+        whole ring normally, or the last returned event when ``limit``
+        truncated the page (so pagination never skips events)."""
+        with self._lock:
+            events = [e for e in self._buf if e["seq"] > since]
+            cursor = self._seq
+        if model is not None:
+            events = [e for e in events
+                      if self._model_match(e.get("model"), model)]
+        if kind is not None:
+            events = [e for e in events if e["kind"] == kind]
+        truncated = len(events) > max(1, limit)
+        if truncated:
+            events = events[:max(1, limit)]
+            cursor = events[-1]["seq"]
+        return {"cursor": cursor, "truncated": truncated, "events": events}
+
+    def tail(self, n: int = 128) -> list[dict]:
+        """Newest ``n`` events, oldest first (crash-bundle context)."""
+        with self._lock:
+            return list(self._buf)[-max(0, n):]
+
+
+class CrashVault:
+    """Bounded in-memory store of crash bundles, keyed by id."""
+
+    def __init__(self, capacity: int = 8) -> None:
+        self._bundles: collections.OrderedDict[str, dict] = \
+            collections.OrderedDict()
+        self._capacity = max(1, capacity)
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def capture(self, *, model: str, trigger: dict, state: dict,
+                events: list[dict]) -> str:
+        """Store one bundle; returns its id (``/debug/crash/<id>``).
+        Oldest bundles roll off past the capacity — postmortems read the
+        bundle soon after the incident, not weeks later."""
+        with self._lock:
+            self._n += 1
+            # replica core names carry a slash ("chat/0") that would split
+            # the URL path — flatten it for the id, keep it in the body
+            crash_id = f"{model.replace('/', '-')}-{self._n}"
+            self._bundles[crash_id] = {
+                "id": crash_id,
+                "at": round(time.time(), 6),
+                "model": model,
+                "trigger": trigger,
+                "state": state,
+                "events": events,
+            }
+            while len(self._bundles) > self._capacity:
+                self._bundles.popitem(last=False)
+            return crash_id
+
+    def get(self, crash_id: str) -> dict | None:
+        with self._lock:
+            return self._bundles.get(crash_id)
+
+    def list(self) -> list[dict]:
+        """Summaries, oldest first (full bundles via ``get``)."""
+        with self._lock:
+            return [{"id": b["id"], "at": b["at"], "model": b["model"],
+                     "error": b["trigger"].get("error")}
+                    for b in self._bundles.values()]
+
+
+# the process-global instances every serving component shares — ONE fleet
+# event stream and ONE crash vault per process, like the metrics registry
+_EVENTS = EventLog()
+_CRASHES = CrashVault()
+
+
+def event_log() -> EventLog:
+    return _EVENTS
+
+
+def crash_vault() -> CrashVault:
+    return _CRASHES

@@ -343,6 +343,11 @@ class MLDatasource:
                 # watchdog state, restart budget/history, shed + deadline
                 # counters, queue bounds, armed fault config
                 entry["resilience"] = server.resilience_snapshot()
+            if getattr(server, "recorder", None) is not None:
+                # flight recorder: rolling per-dispatch phase breakdown
+                # (queue pop / decide / assemble / dispatch / device wait
+                # / emit / other) and the top host-side stall by share
+                entry["stalls"] = server.recorder.snapshot()
             return entry
 
         for name, server in self._llms.items():

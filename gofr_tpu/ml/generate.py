@@ -29,6 +29,7 @@ import collections
 import functools
 import logging
 import os
+import time
 from typing import Any
 
 import jax
@@ -310,6 +311,11 @@ class Generator:
         # dispatch site guards with ``is not None`` so the disabled path
         # costs one attribute test, nothing else
         self.fault = None
+        # flight recorder (gofr_tpu/flight_recorder.py): the serving layer
+        # installs a DispatchRecorder here so step()/drain() can stamp the
+        # decide/dispatch/device_wait/emit phase durations; every site
+        # guards with ``is not None`` — disabled costs one attribute test
+        self.recorder = None
         # async-prefetch failures (satellite: the bare except around
         # copy_to_host_async must be observable — a broken prefetch path
         # degrades every dispatch silently otherwise)
@@ -1880,13 +1886,22 @@ class Generator:
             return
         if self.fault is not None:
             self.fault("step")
+        rec = self.recorder
         sched = self.scheduler
         n_steps = self.chunk
         if sched is not None:
+            t0 = time.perf_counter() if rec is not None else 0.0
             n_steps, n_segments = sched.plan(self._n_decodable(),
                                              bool(self._chunked))
+            if rec is not None:
+                rec.note("decide", time.perf_counter() - t0)
         if self._chunked:
+            # segmented prefill rides the same device queue as the decode
+            # chunk — its launch cost is dispatch time of this pass
+            t0 = time.perf_counter() if rec is not None else 0.0
             self._advance_chunked(n_segments if sched is not None else 1)
+            if rec is not None:
+                rec.note("dispatch", time.perf_counter() - t0)
             if not self._decodable():
                 return  # everything live is still mid-prefill
         # Pending first tokens -> ONE 1-step mini-chunk so they surface a
@@ -1907,6 +1922,7 @@ class Generator:
             sched.note_dispatch(n_steps)
         else:
             fn = self._chunk_fn
+        t_disp = time.perf_counter() if rec is not None else 0.0
         with self._mesh_ctx():
             if self.spec_k:
                 if self.page_size:
@@ -1955,6 +1971,11 @@ class Generator:
                     "back to blocking reads [%s: %s]",
                     type(exc).__name__, exc)
         self._inflight.append(item)
+        if rec is not None:
+            # program launch + arg staging + the async D2H prefetch issue:
+            # host cost of getting the chunk onto the device queue (the
+            # blocking read-back is device_wait, in _pop_process)
+            rec.note("dispatch", time.perf_counter() - t_disp)
         if mini:
             # TTFT: the chunk carrying new requests' first tokens is read
             # back NOW instead of lagging one dispatch — one blocking
@@ -1972,11 +1993,18 @@ class Generator:
 
     def _pop_process(self) -> None:
         item = self._inflight.popleft()
+        rec = self.recorder
+        t0 = time.perf_counter() if rec is not None else 0.0
         if self.spec_k:
             row0, emits, counts = (np.asarray(x) for x in item)
+            if rec is not None:
+                rec.note("device_wait", time.perf_counter() - t0)
             self._process_spec(row0, emits, counts)
         else:
-            self._process(np.asarray(item))
+            toks = np.asarray(item)
+            if rec is not None:
+                rec.note("device_wait", time.perf_counter() - t0)
+            self._process(toks)
 
     def _process_spec(self, row0: np.ndarray, emits: np.ndarray,
                       counts: np.ndarray) -> None:
@@ -2004,10 +2032,7 @@ class Generator:
                     self._maybe_finish(i)
                     if not s.live:
                         break
-        for i, burst in bursts.items():
-            cb = self.slots[i].callback
-            if cb is not None:
-                cb(i, burst)
+        self._fire_bursts(bursts)
 
     def _process(self, toks: np.ndarray) -> None:
         """Apply one [1 input + chunk sampled, B] token block to slot
@@ -2033,10 +2058,20 @@ class Generator:
                 if s.callback is not None:
                     bursts.setdefault(i, []).append(t)
                 self._maybe_finish(i)
+        self._fire_bursts(bursts)
+
+    def _fire_bursts(self, bursts: dict[int, list[int]]) -> None:
+        """Deliver each slot's token burst to its callback — the emit
+        phase of the dispatch breakdown (in the serving stack every call
+        is a ``call_soon_threadsafe`` wakeup of the consumer's loop)."""
+        rec = self.recorder
+        t0 = time.perf_counter() if rec is not None and bursts else 0.0
         for i, burst in bursts.items():
             cb = self.slots[i].callback
             if cb is not None:
                 cb(i, burst)
+        if rec is not None and bursts:
+            rec.note("emit", time.perf_counter() - t0)
 
     def release(self, i: int) -> None:
         """Return a finished slot to the free pool (its tokens are consumed)."""

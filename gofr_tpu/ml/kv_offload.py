@@ -48,6 +48,8 @@ import threading
 
 import numpy as np
 
+from ..flight_recorder import event_log
+
 __all__ = ["OffloadConfig", "HostKVStore"]
 
 
@@ -111,6 +113,10 @@ class HostKVStore:
         self._entries: collections.OrderedDict[tuple, _Entry] = \
             collections.OrderedDict()
         self._lock = threading.Lock()
+        # fleet event log labeling: the owning LLMServer stamps its model
+        # name here so this tier's spill/restore events are attributable
+        self.model = "llm"
+        self._events = event_log()
         self.bytes_used = 0
         # lifetime totals for /debug/serving
         self.puts = 0
@@ -154,6 +160,8 @@ class HostKVStore:
             pending = [e for k, e in self._entries.items()
                        if not e.settled and k != key]
             settle_now.extend(pending)
+        self._events.emit("spill", model=self.model, tokens=len(key),
+                          bytes=nbytes, tier_bytes=self.bytes_used)
         for e in settle_now:
             self._settle(e)
         return True
@@ -196,6 +204,8 @@ class HostKVStore:
                 return None
             self.bytes_used -= entry.nbytes
             self.hits += 1
+        self._events.emit("restore", model=self.model, tokens=len(key),
+                          bytes=entry.nbytes, tier_bytes=self.bytes_used)
         self._settle(entry)
         return entry.arrays, entry.meta
 

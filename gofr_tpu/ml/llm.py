@@ -43,6 +43,8 @@ from ..testutil.faults import FaultInjector, fault_snapshot
 from ..tracing import current_context
 from .errors import (DeadlineExceeded, GeneratorCrashed, Overloaded,
                      ServerClosed)
+from ..flight_recorder import (DispatchRecorder, crash_vault, event_log,
+                              recorder_enabled)
 from .generate import PagePoolExhausted, PrefixEvicted
 from .prefix_cache import PrefixCacheConfig, RadixPrefixCache
 from .scheduler import (PRIORITIES, AgingPriorityQueue, SLOController,
@@ -51,6 +53,19 @@ from .scheduler import (PRIORITIES, AgingPriorityQueue, SLOController,
 __all__ = ["LLMServer", "drain_s_from_env"]
 
 _DONE = object()
+
+
+def _abort_reason(exc: Exception) -> str | None:
+    """``ml.finish_reason`` for a request terminated by a typed error —
+    the abort-side extension of the generator's stop|length|eviction
+    (replica.py adds ``rerouted`` for requests that moved on instead)."""
+    if isinstance(exc, DeadlineExceeded):
+        return "deadline"
+    if isinstance(exc, Overloaded):
+        return "shed"
+    if isinstance(exc, GeneratorCrashed):
+        return "crashed"
+    return None
 
 
 def drain_s_from_env() -> float:
@@ -234,6 +249,19 @@ class LLMServer:
         # parse the drain budget NOW so a malformed GOFR_ML_DRAIN_S is a
         # loud startup error, not a silent drop-everything at SIGTERM
         self._drain_default = drain_s_from_env()
+        # flight recorder (flight_recorder.py): per-dispatch stall
+        # attribution (the generator stamps decide/dispatch/device_wait/
+        # emit through the shared recorder; this thread stamps queue_pop/
+        # assemble and commits once per dispatch), the fleet event log,
+        # and the crash vault the watchdog snapshots bundles into
+        self.recorder = (DispatchRecorder(model=name, metrics=metrics)
+                         if recorder_enabled() else None)
+        generator.recorder = self.recorder
+        self._events = event_log()
+        self._crashes = crash_vault()
+        if getattr(generator, "host_kv", None) is not None:
+            # label the host tier's spill/restore events with this model
+            generator.host_kv.model = name
         # chaos hook (GOFR_ML_FAULT / testutil.faults): installed on the
         # generator's dispatch points + the emit path; None = zero overhead
         self._fault = FaultInjector.from_env() if fault is None else (
@@ -267,25 +295,58 @@ class LLMServer:
             # rebuilds the generator's decode state, and resumes draining
             # the untouched waiting queue — until the restart budget is
             # spent and the server goes dead instead of crash-looping.
+            rec = self.recorder
             try:
                 self._run_setup_tasks()
                 self._reap_cancelled()
-                self._admit_waiting()
+                if rec is not None:
+                    # assemble: admission-wave work — validation, radix
+                    # split, batch build, and the prefill dispatches.
+                    # _admit_waiting's internal gen.drain() notes its own
+                    # device_wait/emit; subtract that nested share so the
+                    # record's phases still sum to (not past) its wall
+                    t0 = time.perf_counter()
+                    nested0 = rec.pending_total
+                    self._admit_waiting()
+                    nested = rec.pending_total - nested0
+                    rec.note("assemble", max(
+                        0.0, time.perf_counter() - t0 - nested))
+                else:
+                    self._admit_waiting()
                 if self._closed:
                     return
                 if self.gen.n_live:
                     self.gen.step()
                     self._finish_dead_slots()
                     self._steer()
+                    if rec is not None:
+                        # one record per device dispatch: whatever this
+                        # pass didn't stamp lands honestly in "other"
+                        rec.commit()
                     continue
                 self.gen.drain()
                 self._finish_dead_slots()
+                if rec is not None and rec.pending_device_work:
+                    # tail flush of the last in-flight chunk: its
+                    # device_wait/emit belong to a record, not the void
+                    # (an idle pass's empty-queue glance does NOT commit —
+                    # junk records would flush real dispatches from the
+                    # ring at idle-poll frequency)
+                    rec.commit()
             except Exception as exc:
                 # a crash racing close() skips recovery: the finally-flush
                 # wakes every consumer with the typed closed error anyway
                 if self._closed or not self._recover_or_die(exc):
                     return
+                if rec is not None:
+                    # the crashed pass and the whole recovery (pool
+                    # rebuild + re-warmup, possibly seconds) must not be
+                    # billed to the next dispatch's record — one such
+                    # record would dominate the rolling window and report
+                    # a phantom "other" stall
+                    rec.reset()
                 continue
+            t_pop = time.perf_counter()
             try:  # idle: block briefly for the next request, backing
                 # off toward 50 ms so an idle server doesn't spin at
                 # hundreds of wakeups/s (admission latency cost is at
@@ -298,6 +359,10 @@ class LLMServer:
                     max(self._idle_backoff * 2, 0.001),
                     max(0.05, self._idle_wait),
                 )
+                if rec is not None:
+                    # pure idle: nothing arrived, no dispatch to charge
+                    # the wait to — drop the pass from the attribution
+                    rec.reset()
                 continue
             self._idle_backoff = self._idle_wait
             if req is None:
@@ -320,6 +385,10 @@ class LLMServer:
                     self._closed = True
                     return
                 self._enqueue_waiting(more)
+            if rec is not None:
+                # queue pop: blocking for the arrival that woke us plus
+                # the burst-collection window before the admission wave
+                rec.note("queue_pop", time.perf_counter() - t_pop)
 
     def _run_setup_tasks(self) -> None:
         """Drain device-touching setup work (e.g. register_prefix) onto
@@ -459,7 +528,15 @@ class LLMServer:
 
     def _reject(self, req: _Request, exc: Exception) -> None:
         """Terminate a request that will never (or no longer) decode: end
-        its spans and wake its consumer with the typed error + _DONE."""
+        its spans — stamped with the typed outcome as ``ml.finish_reason``
+        (``deadline`` | ``shed`` | ``crashed``), so a trace reads the same
+        story as the error counters — and wake its consumer with the
+        typed error + _DONE."""
+        reason = _abort_reason(exc)
+        if reason is not None:
+            for span in (req.queue_span, req.decode_span):
+                if span is not None and span.end_time is None:
+                    span.set_attribute("ml.finish_reason", reason)
         req.finish_spans("ERROR", str(exc))
         try:
             req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
@@ -488,6 +565,12 @@ class LLMServer:
                     stack=traceback.format_exc())
             except Exception:
                 pass
+        # FORENSICS FIRST, while the wreck is still intact: the slot table
+        # below is about to be failed and cleared, and recovery rebuilds
+        # the decode state — snapshot the last events + scheduler/pool
+        # state + in-flight slots into a crash bundle an operator reads at
+        # /debug/crash/<id> long after the server recovered (or died)
+        crash_id = self._capture_crash(exc)
         crash = GeneratorCrashed(
             f"generator dispatch failed ({type(exc).__name__}: {exc})")
         for slot, req in list(self._active.items()):
@@ -501,7 +584,10 @@ class LLMServer:
             in_window = len(self._restart_times)
         if in_window >= self._max_restarts:
             self._state = "dead"
-            self._record_restart(exc, recovered=False)
+            self._record_restart(exc, recovered=False, crash_id=crash_id)
+            self._events.emit("dead", model=self.name, crash_id=crash_id,
+                              restarts=self._restarts_total,
+                              budget=self._max_restarts)
             if self._logger is not None:
                 try:
                     self._logger.error(
@@ -522,7 +608,9 @@ class LLMServer:
             invalidated = self.gen.recover()
         except Exception as rexc:
             self._state = "dead"
-            self._record_restart(exc, recovered=False)
+            self._record_restart(exc, recovered=False, crash_id=crash_id)
+            self._events.emit("dead", model=self.name, crash_id=crash_id,
+                              error=f"recovery failed: {rexc}")
             if self._logger is not None:
                 try:
                     self._logger.error(
@@ -540,9 +628,12 @@ class LLMServer:
                     pass
         self._restarts_total += 1
         self._state = "degraded"  # until the restart window drains
-        self._record_restart(
-            exc, recovered=True,
-            recovery_ms=round((time.perf_counter() - t0) * 1e3, 1))
+        recovery_ms = round((time.perf_counter() - t0) * 1e3, 1)
+        self._record_restart(exc, recovered=True, recovery_ms=recovery_ms,
+                             crash_id=crash_id)
+        self._events.emit("recover", model=self.name, crash_id=crash_id,
+                          recovery_ms=recovery_ms,
+                          queued=len(self._waiting))
         self._steered_dispatches = -1
         if self._metrics is not None:
             try:
@@ -562,14 +653,52 @@ class LLMServer:
         return True
 
     def _record_restart(self, exc: BaseException, recovered: bool,
-                        recovery_ms: float | None = None) -> None:
+                        recovery_ms: float | None = None,
+                        crash_id: str | None = None) -> None:
         with self._restart_lock:
             self._restart_history.append({
                 "at": time.time(),
                 "error": f"{type(exc).__name__}: {exc}",
                 "recovered": recovered,
                 "recovery_ms": recovery_ms,
+                "crash_id": crash_id,  # the /debug/crash/<id> bundle
             })
+
+    def _capture_crash(self, exc: BaseException) -> str | None:
+        """Snapshot the crash into an in-memory forensics bundle (the
+        trigger event, the last fleet events, the scheduler/pool state,
+        and the in-flight slot table about to be failed) and return its
+        ``/debug/crash/<id>`` id. Runs on the serving thread BEFORE the
+        slots are rejected; a failure here must never block recovery."""
+        try:
+            now = time.perf_counter()
+            slot_table = [{
+                "slot": slot,
+                "prompt_tokens": req.n_tokens,
+                "produced": getattr(self.gen.slots[slot], "produced", 0),
+                "priority": PRIORITIES[req.priority],
+                "age_s": round(now - req.enqueued_at, 4),
+                "streamed": req.first_token_at is not None,
+            } for slot, req in sorted(self._active.items())]
+            trigger = self._events.emit(
+                "crash", model=self.name,
+                error=f"{type(exc).__name__}: {exc}",
+                in_flight=len(slot_table), queued=len(self._waiting))
+            state: dict = {
+                "server_state": self._state,
+                "restarts_total": self._restarts_total,
+                "slots": slot_table,
+                "scheduler": self.scheduler_snapshot(),
+            }
+            try:  # the pool counters may be mid-wreck; best effort
+                state["pool"] = self.gen.pool_stats()
+            except Exception:
+                pass
+            return self._crashes.capture(
+                model=self.name, trigger=trigger, state=state,
+                events=self._events.tail(128))
+        except Exception:
+            return None
 
     # -- admission bounds / load shedding -------------------------------------
     def _enqueue_waiting(self, req: _Request) -> None:
@@ -605,6 +734,10 @@ class LLMServer:
         retry_after = self._retry_after_s()
         prio = PRIORITIES[req.priority]
         self._shed_counts[prio] += 1
+        self._events.emit("shed", model=self.name, priority=prio,
+                          queued=len(self._waiting),
+                          queued_tokens=self._waiting.tokens,
+                          retry_after_s=round(retry_after, 3))
         if self._metrics is not None:
             try:
                 self._metrics.add_counter("app_llm_shed_total", 1,
@@ -771,6 +904,11 @@ class LLMServer:
                 req.slot = slot
                 self._active[slot] = req
                 self._admit_times.append(now)
+                self._events.emit(
+                    "admit", model=self.name, slot=slot,
+                    priority=PRIORITIES[req.priority],
+                    prompt_tokens=req.n_tokens,
+                    queued_ms=round((now - req.enqueued_at) * 1e3, 2))
                 if req.full_prompt is not None and self.prefix_cache is not None:
                     # the hit is real only now: the slot borrowed the
                     # prefix pages and the suffix-only prefill happened
@@ -878,6 +1016,8 @@ class LLMServer:
         """One request past its deadline: typed 504 to the consumer plus
         the counter the operator alarms on."""
         self._deadline_expired += 1
+        self._events.emit("deadline", model=self.name, where=where,
+                          priority=PRIORITIES[req.priority])
         if self._metrics is not None:
             try:
                 self._metrics.add_counter("app_llm_deadline_exceeded_total",
@@ -1313,6 +1453,9 @@ class LLMServer:
             drain_s = self._drain_default
         if drain_s > 0 and not self._closed and self._thread.is_alive():
             self._draining = True
+            self._events.emit("drain", model=self.name,
+                              drain_s=drain_s, in_flight=len(self._active),
+                              queued=len(self._waiting))
             deadline = time.monotonic() + drain_s
             while time.monotonic() < deadline:
                 if not self._active and self.gen.n_live == 0:

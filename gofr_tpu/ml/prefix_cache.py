@@ -51,6 +51,7 @@ import threading
 import time
 from typing import Any
 
+from ..flight_recorder import event_log
 from .generate import PagePoolExhausted
 
 __all__ = ["PrefixCacheConfig", "RadixPrefixCache"]
@@ -127,6 +128,7 @@ class RadixPrefixCache:
         self._by_pid: dict[int, _Node] = {}
         self._n_nodes = 0
         self._lock = threading.Lock()
+        self._events = event_log()  # fleet event log (flight_recorder.py)
         # lifetime totals (also pushed as Prometheus counters)
         self.hits = 0
         self.misses = 0
@@ -449,6 +451,8 @@ class RadixPrefixCache:
             node.reg_len = 0
         self.evictions += 1
         self._count("app_ml_prefix_evictions_total", 1)
+        self._events.emit("evict", model=self._model,
+                          prefix_tokens=node.depth)
 
     # -- pinning API (explicit register_prefix) -------------------------------
     def pin(self, prefix_ids) -> int:

@@ -56,8 +56,10 @@ import time
 from typing import Any, AsyncIterator
 
 from ..testutil.faults import FaultInjector, fault_snapshot
+from ..tracing import current_context
 from .errors import (DeadlineExceeded, GeneratorCrashed, Overloaded,
                      ServerClosed)
+from ..flight_recorder import event_log
 from .generate import PrefixEvicted
 from .llm import LLMServer, drain_s_from_env
 from .scheduler import (PRIORITIES, AgingPriorityQueue, normalize_priority,
@@ -188,6 +190,13 @@ class ReplicaPool:
         self.name = name
         self._logger = logger
         self._metrics = metrics
+        self._tracer = tracer   # ml.route spans (one per routing attempt)
+        self._events = event_log()  # fleet event log (flight_recorder.py)
+        # routing-decision wall time: the pool's contribution to the
+        # dispatch-phase breakdown (phase="route" of
+        # app_llm_dispatch_phase_seconds) and the routing debug block
+        self._route_decisions = 0
+        self._route_time_s = 0.0
         # fleet-wide admission policy (env defaults mirror LLMServer's)
         self._max_queue = (int(os.environ.get("GOFR_ML_MAX_QUEUE", "0"))
                            if max_queue is None else int(max_queue))
@@ -374,6 +383,9 @@ class ReplicaPool:
             if fr.cancelled:
                 self._resolve(fr, cancel=True)
                 continue
+            self._events.emit("deadline", model=self.name,
+                              where="while queued (fleet)",
+                              priority=PRIORITIES[fr.priority])
             self._count("app_llm_deadline_exceeded_total", 1,
                         model=self.name)
             self._resolve(fr, exc=DeadlineExceeded(
@@ -460,6 +472,7 @@ class ReplicaPool:
                 return
             if fr is None:
                 return  # capacity will free (or a recovery will finish)
+            t_route = time.perf_counter()
             try:
                 if self._fault is not None:
                     self._fault("route")  # chaos point: a poisoned router
@@ -469,6 +482,8 @@ class ReplicaPool:
                     f"routing dispatch failed "
                     f"({type(exc).__name__}: {exc})"))
                 continue
+            finally:
+                self._note_route_time(time.perf_counter() - t_route)
             if picked is None:
                 # holder busy: skip THIS request for the round but keep
                 # pumping the rest of the queue (deadline reaping still
@@ -485,12 +500,31 @@ class ReplicaPool:
                 self._admit_times.append(time.perf_counter())
                 if fr.attempts:
                     self._failovers += 1
+            self._events.emit("route", model=self.name, replica=idx,
+                              reason=reason, attempt=fr.attempts)
             if fr.attempts:
+                self._events.emit("failover", model=self.name, replica=idx,
+                                  from_replica=fr.last_replica,
+                                  attempt=fr.attempts)
                 self._count("app_llm_replica_failovers_total", 1,
                             model=self.name)
             self._count("app_llm_replica_routed_total", 1, model=self.name,
                         replica=str(idx), reason=reason)
-            self._resolve(fr, result=idx)
+            self._resolve(fr, result=(idx, reason))
+
+    def _note_route_time(self, seconds: float) -> None:
+        """One routing decision's wall time: the pool-side phase of the
+        dispatch breakdown (LLMServer's recorder owns the rest)."""
+        with self._lock:
+            self._route_decisions += 1
+            self._route_time_s += seconds
+        if self._metrics is not None:
+            try:
+                self._metrics.record_histogram(
+                    "app_llm_dispatch_phase_seconds", seconds,
+                    model=self.name, phase="route")
+            except Exception:
+                pass
 
     def _route(self, fr: _FrontRequest,
                candidates: list[int]) -> tuple[int, str] | None:
@@ -561,6 +595,9 @@ class ReplicaPool:
     def _note_shed(self, fr: _FrontRequest) -> None:
         prio = PRIORITIES[fr.priority]
         self._shed_counts[prio] += 1
+        self._events.emit("shed", model=self.name, priority=prio,
+                          queued=len(self._queue),
+                          queued_tokens=self._queue.tokens)
         self._count("app_llm_shed_total", 1, model=self.name, priority=prio)
 
     def _overloaded(self) -> Overloaded:
@@ -623,69 +660,107 @@ class ReplicaPool:
         fr = _FrontRequest(prompt_ids, max_new_tokens, prio, ttl, prefix)
         fr.loop = asyncio.get_running_loop()
         self._admit(fr)  # fleet shedding; may raise Overloaded
+        # the caller's request span, captured BEFORE any executor hop: the
+        # per-attempt ml.route spans (and, through the core, ml.queue/
+        # ml.decode) all parent here — so a rerouted request stays ONE
+        # trace end-to-end, with the failover visible as a span event
+        ctx = current_context()
         try:
             while True:
                 fr.future = fr.loop.create_future()
-                with self._lock:
-                    if self._closed:
-                        # close() won the race to the flag: its flush has
-                        # (or will have) drained the queue — joining it
-                        # now would park this request forever
-                        raise self._closed_error()
-                    fr.routed_idx = None
+                route_span = None
+                if self._tracer is not None:
+                    route_span = self._tracer.start_span(
+                        "ml.route", parent=ctx, activate=False,
+                        attributes={"ml.model": self.name})
                     if fr.attempts:
-                        # rerouted work keeps its place at the head of its
-                        # class (enqueued_at preserved, so aging continues)
-                        self._queue.push_front(fr)
-                    else:
-                        self._queue.push(fr)
-                self._kick()
-                idx = await self._await_routing(fr)
-                core = self.replicas[idx]
-                agen = None
+                        # re-admission after a replica loss: the same
+                        # trace carries the hop onto the survivor
+                        route_span.add_event("ml.failover", {
+                            "from_replica": fr.last_replica,
+                            "attempt": fr.attempts})
                 try:
-                    agen = core.stream_chunks(
-                        fr.prompt, fr.max_new,
-                        prefix=self._core_pid(fr.prefix, idx),
-                        info=info, priority=fr.priority,
-                        deadline_s=self._remaining(fr))
-                    async for burst in agen:
-                        fr.streamed = True
-                        yield burst
                     with self._lock:
-                        self.served += 1
-                    return
-                except (GeneratorCrashed, ServerClosed) as exc:
-                    if fr.streamed or self._closed:
-                        raise
-                    others = [i for i, c in enumerate(self.replicas)
-                              if i != idx and c.health() != "dead"]
-                    if not others or fr.attempts >= 2 * len(self.replicas):
-                        if all(c.health() == "dead"
-                               for c in self.replicas):
-                            raise self._dead_error() from exc
-                        raise
-                    fr.attempts += 1
-                    fr.last_replica = idx
-                    if self._logger is not None:
-                        try:
-                            self._logger.warnf(
-                                "llm %s: rerouting request off replica %d "
-                                "(%s); attempt %d", self.name, idx,
-                                type(exc).__name__, fr.attempts)
-                        except Exception:
-                            pass
-                    continue
-                finally:
-                    if agen is not None:
-                        # close the core stream DETERMINISTICALLY so an
-                        # abandoned consumer's slot is reclaimed now, not
-                        # whenever async-generator GC finalization runs
-                        await agen.aclose()
-                    with self._lock:
-                        self._outstanding[idx] -= 1
+                        if self._closed:
+                            # close() won the race to the flag: its flush
+                            # has (or will have) drained the queue —
+                            # joining it now would park this request
+                            # forever
+                            raise self._closed_error()
                         fr.routed_idx = None
+                        if fr.attempts:
+                            # rerouted work keeps its place at the head of
+                            # its class (enqueued_at preserved, so aging
+                            # continues)
+                            self._queue.push_front(fr)
+                        else:
+                            self._queue.push(fr)
                     self._kick()
+                    idx, reason = await self._await_routing(fr)
+                    if route_span is not None:
+                        route_span.set_attributes({
+                            "ml.replica": idx, "ml.route_reason": reason})
+                    core = self.replicas[idx]
+                    agen = None
+                    try:
+                        agen = core.stream_chunks(
+                            fr.prompt, fr.max_new,
+                            prefix=self._core_pid(fr.prefix, idx),
+                            info=info, priority=fr.priority,
+                            deadline_s=self._remaining(fr))
+                        async for burst in agen:
+                            fr.streamed = True
+                            yield burst
+                        with self._lock:
+                            self.served += 1
+                        return
+                    except (GeneratorCrashed, ServerClosed) as exc:
+                        if fr.streamed or self._closed:
+                            raise
+                        others = [i for i, c in enumerate(self.replicas)
+                                  if i != idx and c.health() != "dead"]
+                        if (not others
+                                or fr.attempts >= 2 * len(self.replicas)):
+                            if all(c.health() == "dead"
+                                   for c in self.replicas):
+                                raise self._dead_error() from exc
+                            raise
+                        fr.attempts += 1
+                        fr.last_replica = idx
+                        if route_span is not None:
+                            # this attempt's outcome: the request moved
+                            # on (the next attempt's span carries the
+                            # ml.failover event), it did not fail
+                            route_span.set_attribute(
+                                "ml.finish_reason", "rerouted")
+                        if self._logger is not None:
+                            try:
+                                self._logger.warnf(
+                                    "llm %s: rerouting request off "
+                                    "replica %d (%s); attempt %d",
+                                    self.name, idx,
+                                    type(exc).__name__, fr.attempts)
+                            except Exception:
+                                pass
+                        continue
+                    finally:
+                        if agen is not None:
+                            # close the core stream DETERMINISTICALLY so
+                            # an abandoned consumer's slot is reclaimed
+                            # now, not whenever async-generator GC
+                            # finalization runs
+                            await agen.aclose()
+                        with self._lock:
+                            self._outstanding[idx] -= 1
+                            fr.routed_idx = None
+                        self._kick()
+                except Exception as exc:
+                    if route_span is not None and route_span.end_time is None:
+                        route_span.record_exception(exc)
+                    raise
+                finally:
+                    if route_span is not None and route_span.end_time is None:
+                        route_span.end()
         finally:
             with self._lock:
                 fr.cancelled = True
@@ -696,8 +771,9 @@ class ReplicaPool:
                     fr.routed_idx = None
             self._kick()
 
-    async def _await_routing(self, fr: _FrontRequest) -> int:
-        """Wait for the router's verdict. The dispatcher is pinned to the
+    async def _await_routing(self, fr: _FrontRequest) -> tuple[int, str]:
+        """Wait for the router's verdict — ``(replica index, route
+        reason)``. The dispatcher is pinned to the
         first loop that drove the pool; if that loop exits — or the
         dispatcher task dies — while requests from OTHER loops are still
         parked, the first waiter to notice re-homes the dispatcher onto
@@ -921,6 +997,12 @@ class ReplicaPool:
                     "max_requests": self._max_queue or None,
                     "max_tokens": self._max_queued_tokens or None,
                 },
+                "route_stall": {
+                    # the pool's phase of the dispatch breakdown (the
+                    # per-core phases live in replicas.<idx>.stalls)
+                    "decisions": self._route_decisions,
+                    "total_s": round(self._route_time_s, 6),
+                },
                 "affinity_min_tokens": self._affinity_min,
                 "pinned_prefixes": pinned,
                 "default_deadline_s": self._default_deadline or None,
@@ -979,6 +1061,9 @@ class ReplicaPool:
             self._closed = True
         if drain_s is None:
             drain_s = self._drain_default
+        if drain_s > 0:
+            self._events.emit("drain", model=self.name, drain_s=drain_s,
+                              queued=len(self._queue))
         drain_deadline = time.monotonic() + max(0.0, drain_s)
         loop, dispatcher = self._loop, self._dispatcher
 

@@ -1,0 +1,57 @@
+"""Doc-drift guard for the serving metric inventory (tier-1, no jax).
+
+Every ``app_ml_*`` / ``app_llm_*`` metric name that appears in
+``gofr_tpu/`` must have a row in ``docs/tpu/observability.md`` — and
+every such name in the doc must still exist in the code. A metric an
+operator cannot look up is invisible; a documented metric that no longer
+exists sends an incident responder grepping for a ghost. The guard greps
+both sides, so adding a metric without its doc row (or deleting one
+without its row) fails tier-1 instead of rotting silently.
+
+``app_tpu_*`` gauges are device-runtime metrics with compound doc rows
+(e.g. ``app_tpu_hbm_bytes_in_use / ..._limit``) — out of scope here.
+"""
+
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DOC = REPO / "docs" / "tpu" / "observability.md"
+# full metric names only: the char class excludes "*"/"…", so prose like
+# "registered app_ml_* metrics" can never register a phantom name
+NAME_RE = re.compile(r"app_(?:ml|llm)_[a-z0-9_]+")
+# exposition suffixes are series of their base histogram, not metrics
+SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _strip_suffix(name: str) -> str:
+    for suffix in SUFFIXES:
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def _code_names() -> set[str]:
+    names: set[str] = set()
+    for path in (REPO / "gofr_tpu").rglob("*.py"):
+        names.update(_strip_suffix(m)
+                     for m in NAME_RE.findall(path.read_text()))
+    return names
+
+
+def _doc_names() -> set[str]:
+    return {_strip_suffix(m) for m in NAME_RE.findall(DOC.read_text())}
+
+
+def test_every_registered_metric_has_a_doc_row():
+    undocumented = _code_names() - _doc_names()
+    assert not undocumented, (
+        f"metrics in gofr_tpu/ missing from {DOC.relative_to(REPO)}: "
+        f"{sorted(undocumented)} — add a row to the metric inventory")
+
+
+def test_every_documented_metric_still_exists():
+    ghosts = _doc_names() - _code_names()
+    assert not ghosts, (
+        f"metrics documented in {DOC.relative_to(REPO)} but absent from "
+        f"gofr_tpu/: {sorted(ghosts)} — delete the stale rows")

@@ -365,6 +365,61 @@ def test_failover_acceptance(model, monkeypatch, run):
         pool.close()
 
 
+def test_failover_trace_continuity(model, monkeypatch, run):
+    """A rerouted request keeps ONE trace end-to-end: kill replica 0 via
+    GOFR_ML_FAULT_REPLICA, and the re-admitted request's spans — the
+    per-attempt ml.route spans and the surviving core's ml.queue/
+    ml.decode — all share the original request's trace id, the failed
+    attempt is stamped ml.finish_reason=rerouted, and the re-admission
+    attempt carries the ml.failover span event."""
+    monkeypatch.setenv("GOFR_ML_FAULT", "step:1")
+    monkeypatch.setenv("GOFR_ML_FAULT_REPLICA", "0")
+    from gofr_tpu.flight_recorder import event_log
+    from gofr_tpu.testutil import RecordingTracer
+
+    tracer = RecordingTracer()
+    exp = _expected(model, [3, 1, 4], 6)
+    cursor = event_log().cursor
+    pool = ReplicaPool([_gen(model), _gen(model)], name="trace-pool",
+                       tracer=tracer, max_restarts=0)
+
+    async def scenario():
+        with tracer.start_span("POST /generate", kind="SERVER") as req:
+            out = await pool.generate([3, 1, 4], 6)
+        assert out == exp  # bit-identical on the survivor
+        return req
+
+    try:
+        req = run(scenario())
+        routes = tracer.by_name("ml.route")
+        assert len(routes) == 2
+        assert all(s.trace_id == req.trace_id for s in routes)
+        assert all(s.parent_span_id == req.span_id for s in routes)
+        first, retry = routes
+        # attempt 1 landed on the armed replica and moved on
+        assert first.attributes["ml.replica"] == 0
+        assert first.attributes["ml.finish_reason"] == "rerouted"
+        # attempt 2 is the failover re-admission, same trace
+        assert retry.attributes["ml.replica"] == 1
+        assert retry.attributes["ml.route_reason"] == "failover"
+        failover_events = [(name, attrs) for _, name, attrs in retry.events
+                           if name == "ml.failover"]
+        assert failover_events == [("ml.failover",
+                                    {"from_replica": 0, "attempt": 1})]
+        # the core-side spans continue the SAME trace across the reroute
+        decodes = tracer.by_name("ml.decode")
+        assert decodes and all(s.trace_id == req.trace_id for s in decodes)
+        queues = tracer.by_name("ml.queue")
+        assert queues and all(s.trace_id == req.trace_id for s in queues)
+        # and the fleet event log tells the same story, in order
+        kinds = [e["kind"] for e in event_log().query(
+            since=cursor, model="trace-pool")["events"]]
+        assert kinds.index("crash") < kinds.index("failover")
+        assert "route" in kinds and "dead" in kinds
+    finally:
+        pool.close()
+
+
 def test_streamed_request_fails_typed_on_crash(model, run):
     """Once a token reached the consumer the stream cannot move replicas:
     a crash then surfaces as the typed GeneratorCrashed (503), with the
