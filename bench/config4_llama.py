@@ -48,13 +48,26 @@ Three phases, all in one run so the numbers share the same tunnel weather:
                      counters, and the clean arm's zero-restart baseline.
   H. stalls        — flight-recorder arm: mixed load with the dispatch
                      recorder ON records the per-phase breakdown of step
-                     wall time (queue pop / decide / assemble / dispatch
-                     / device wait / emit / other) + the named top
-                     host-side stall from /debug/serving, A/B'd against
-                     a GOFR_ML_FLIGHT_RECORDER=0 reboot to price the
-                     recorder itself (acceptance <= 2% on steady tok/s).
-                     This is the ledger ROADMAP 3c reads to attribute
-                     the non-device share of step_ms.
+                     wall time (queue pop / decide / assemble / launch /
+                     d2h issue / device wait / emit / other) + the named
+                     top host-side stall from /debug/serving, A/B'd
+                     against a GOFR_ML_FLIGHT_RECORDER=0 reboot to price
+                     the recorder itself (acceptance <= 2% on steady
+                     tok/s). This is the ledger ROADMAP 3c reads to
+                     attribute the non-device share of step_ms.
+  I. speculation   — spec x KV-precision grid: speculative decoding off
+                     vs on (LLM_SPEC_K, adaptive floor armed) at each of
+                     GOFR_ML_KV_BITS=16/8/4 over the paged pool. Per
+                     cell: steady decode tok/s, realized step_ms and
+                     per-phase breakdown, the accept rate + adaptive
+                     disable state from /debug/serving, and a greedy
+                     token-identity check spec-on vs spec-off at the
+                     SAME precision (speculation is lossless; precisions
+                     legitimately differ). The raw-speed ROADMAP-3 arm:
+                     spec-on tok/s must beat spec-off at the tiny
+                     preset, and kv4's page VALUE bytes are exactly half
+                     kv8's (total page bytes carry the scale+zero plane
+                     overhead; see pool_stats).
 
 LLAMA_PRESET=1b on TPU by default (the 8B/8-chip per-chip share), tiny on CPU.
 """
@@ -145,6 +158,21 @@ async def _debug_stalls(ports, llm: str = "chat") -> dict:
                 f"http://127.0.0.1:{ports['HTTP_PORT']}/debug/serving")
             body = await r.json()
         return body["data"]["llms"][llm].get("stalls", {})
+    except Exception:
+        return {}
+
+
+async def _debug_llm(ports, llm: str = "chat") -> dict:
+    """The whole per-LLM block of /debug/serving (speculation block,
+    pool stats — the phase-I grid reads both)."""
+    import aiohttp
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.get(
+                f"http://127.0.0.1:{ports['HTTP_PORT']}/debug/serving")
+            body = await r.json()
+        return body["data"]["llms"][llm]
     except Exception:
         return {}
 
@@ -947,6 +975,188 @@ async def main() -> None:
             "recorder_overhead_pct": overhead,
         }
 
+    # ---- phase I: speculative serving — spec x KV-precision grid --------
+    # For each KV precision (fp16 reference / int8 / packed int4) over
+    # the SAME paged pool, boot spec-off and spec-on (LLM_SPEC_K with the
+    # adaptive floor armed) and measure steady decode tok/s, realized
+    # step_ms + per-phase breakdown, and the accept-rate/disable state.
+    # Greedy token identity is asserted spec-on vs spec-off per precision
+    # (speculation is lossless by construction; precisions differ).
+    # Skipped under the headline watchdog budget unless BENCH_SPEC_ARM=1
+    # (bench/run_all.py sets it).
+    spec_arm = None
+    if os.environ.get("BENCH_SPEC_ARM",
+                      "0" if skip_jitter else "1") == "1":
+        window_i = float(os.environ.get("BENCH_SPEC_WINDOW_S", "1.6"))
+        # best-of-3 windows per cell (the phase-E selection rule): single
+        # windows swing ~2x on this shared box and the A/B sign must not
+        reps_i = int(os.environ.get("BENCH_SPEC_REPS", "3"))
+        steady_new_i = int(os.environ.get("BENCH_SPEC_STEADY_NEW",
+                                          "128" if on_tpu else "96"))
+        spec_k_i = os.environ.get("BENCH_SPEC_K", "4")
+        page_i = "16" if on_tpu else "8"
+        kv_grid = [b.strip() for b in os.environ.get(
+            "BENCH_SPEC_KV_GRID", "16,8,4").split(",") if b.strip()]
+        # draft source for the spec-on arms: "" = prompt lookup (default)
+        # or "self" (the draft-model machinery at its acceptance ceiling).
+        # The steady workload below is repetition-heavy — prompt-lookup
+        # decoding's target workload (extractive/templated generation);
+        # fully-random streams are the ADVERSARIAL case, which is what
+        # the adaptive per-slot disable handles (tests cover it)
+        draft_i = os.environ.get("BENCH_SPEC_DRAFT", "")
+        # identity dtype: bf16 rounding can flip near-tie argmaxes
+        # BETWEEN program shapes (window vs step) — numeric noise. The
+        # tiny/CPU grid runs f32 so the lossless check is exact; on TPU
+        # the preset's serving dtype stands
+        dtype_i = os.environ.get("BENCH_SPEC_DTYPE",
+                                 "" if on_tpu else "float32")
+        ident_prompt_i = rng.integers(1, vocab_hi, (prompt_len,)).tolist()
+        # repetition-heavy steady prompt: a short motif tiled to 3x the
+        # probe prompt length — trailing-n-gram lookup finds real matches
+        motif_i = rng.integers(1, vocab_hi, (4,)).tolist()
+        steady_prompt_i = (motif_i * (3 * max(prompt_len, 8)))[
+            :3 * max(prompt_len, 8)]
+
+        # concurrent steady streams: fill the slot batch so the window
+        # measures aggregate decode throughput, not one stream's latency
+        streams_i = int(os.environ.get("BENCH_SPEC_STREAMS",
+                                       "8" if on_tpu else "4"))
+
+        async def spec_window(gen_fn) -> dict:
+            """One time-bounded steady-decode window (pure decode load —
+            the number speculation is supposed to move)."""
+            stop = asyncio.Event()
+            steady_tokens = [0]
+
+            async def steady_loop():
+                while not stop.is_set():
+                    body = {"prompt_ids": steady_prompt_i,
+                            "max_new_tokens": steady_new_i}
+                    async for msg in gen_fn(body):
+                        steady_tokens[0] += n_toks(msg)
+                        if stop.is_set():
+                            break
+
+            tasks = [asyncio.create_task(steady_loop())
+                     for _ in range(streams_i)]
+            t0 = time.perf_counter()
+            try:
+                await asyncio.sleep(window_i)
+            finally:
+                window = time.perf_counter() - t0
+                stop.set()
+                for task in tasks:
+                    task.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+            return {"steady_tok_s": round(steady_tokens[0] / window, 1)}
+
+        grid: dict = {}
+        for bits in kv_grid:
+            cells: dict = {}
+            ident_i: dict = {}
+            for mode in ("off", "on"):
+                os.environ["LLM_PAGE_SIZE"] = page_i  # int4 needs paging;
+                # paged everywhere so the grid varies ONE thing per axis
+                if dtype_i:
+                    os.environ["LLAMA_DTYPE"] = dtype_i
+                if bits != "16":
+                    os.environ["GOFR_ML_KV_BITS"] = bits
+                if mode == "on":
+                    os.environ["LLM_SPEC_K"] = spec_k_i
+                    if draft_i:
+                        os.environ["LLM_DRAFT_PRESET"] = draft_i
+                    os.environ["GOFR_ML_SPEC_MIN_ACCEPT"] = os.environ.get(
+                        "BENCH_SPEC_MIN_ACCEPT", "0.05")
+                appI = chI = None
+                try:
+                    appI = build_app()
+                    await boot(appI)
+                    chI = grpc.aio.insecure_channel(
+                        f"127.0.0.1:{ports['GRPC_PORT']}")
+                    genI = chI.unary_stream(
+                        "/llm.Chat/Generate",
+                        request_serializer=lambda o: json.dumps(o).encode(),
+                        response_deserializer=lambda raw: (json.loads(raw)
+                                                           if raw else {}),
+                    )
+                    async for _ in genI(req(4)):        # warm compiles
+                        pass
+                    toks_i: list = []
+                    async for msg in genI({"prompt_ids": ident_prompt_i,
+                                           "max_new_tokens": 16}):
+                        toks_i.extend(msg.get("tokens", ()))
+                    ident_i[mode] = toks_i
+                    # warm the steady shape TWICE: the second sighting
+                    # promotes the shared prompt in the radix cache, so
+                    # the suffix-prefill program compiles here and not
+                    # inside the timed window (int4's compile is the
+                    # slowest of the grid)
+                    for _ in range(2):
+                        async for _ in genI({"prompt_ids": steady_prompt_i,
+                                             "max_new_tokens": 8}):
+                            pass
+                    runs_i = [await spec_window(genI)
+                              for _ in range(reps_i)]
+                    cell = max(runs_i, key=lambda r: r["steady_tok_s"])
+                    entry = await _debug_llm(ports)
+                    stalls = entry.get("stalls", {})
+                    win = stalls.get("window", {})
+                    cell.update({
+                        "step_ms": win.get("per_dispatch_ms"),
+                        "phases": {name: p.get("share")
+                                   for name, p in
+                                   win.get("phases", {}).items()},
+                        "top_stall": stalls.get("top_stall"),
+                    })
+                    pool = entry.get("pool", {})
+                    cell["page_bytes"] = pool.get("page_bytes")
+                    if mode == "on":
+                        spec_block = entry.get("speculation", {})
+                        cell["accept_rate"] = spec_block.get("accept_rate")
+                        cell["spec_windows"] = spec_block.get("windows")
+                        cell["disables"] = spec_block.get("disables_total")
+                        cell["reprobes"] = spec_block.get("reprobes_total")
+                    cells[mode] = cell
+                except Exception as exc:  # optional arm: record, don't abort
+                    cells[mode] = {"error": str(exc)}
+                finally:
+                    os.environ.pop("GOFR_ML_KV_BITS", None)
+                    os.environ.pop("LLM_SPEC_K", None)
+                    os.environ.pop("LLM_DRAFT_PRESET", None)
+                    os.environ.pop("GOFR_ML_SPEC_MIN_ACCEPT", None)
+                    os.environ.pop("LLM_PAGE_SIZE", None)
+                    os.environ.pop("LLAMA_DTYPE", None)
+                    if chI is not None:
+                        await chI.close()
+                    if appI is not None:
+                        await appI.shutdown()
+            off_i, on_i = cells.get("off", {}), cells.get("on", {})
+            speedup = None
+            if off_i.get("steady_tok_s") and on_i.get("steady_tok_s"):
+                speedup = round(
+                    on_i["steady_tok_s"] / off_i["steady_tok_s"], 3)
+            identical = (ident_i.get("off") == ident_i.get("on")
+                         if len(ident_i) == 2 else None)
+            grid[f"kv{bits}"] = {
+                "off": off_i,
+                "on": on_i,
+                # spec-on vs spec-off at the SAME precision must be
+                # token-identical — speculation is lossless under greedy
+                "tokens_identical": identical,
+                "spec_speedup": speedup,
+            }
+            if identical is False:
+                # a lossless-contract violation is a bug report: keep the
+                # evidence in the artifact
+                grid[f"kv{bits}"]["ident_tokens"] = ident_i
+        spec_arm = {
+            "spec_k": int(spec_k_i),
+            "page_size": int(page_i),
+            "draft": draft_i or "lookup",
+            "dtype": dtype_i or "preset-default",
+            "grid": grid,
+        }
+
     agg_tok_s = sum(token_counts) / elapsed
     emit(
         "llama_served_tok_per_s", agg_tok_s, "tok/s", 2000.0,
@@ -1000,6 +1210,10 @@ async def main() -> None:
             # (where the step wall time goes) + recorder on/off overhead
             "stalls": (stall_arm if stall_arm is not None
                        else "skipped (headline budget)"),
+            # phase I: speculative serving — spec on/off x kv 16/8/4 grid
+            # (steady tok/s, step_ms, phases, accept rate, token identity)
+            "speculation": (spec_arm if spec_arm is not None
+                            else "skipped (headline budget)"),
             "preset": os.environ.get("LLAMA_PRESET", "tiny"),
             "backend": jax.default_backend(),
             "config": 4,

@@ -19,7 +19,7 @@ import time
 import uuid
 
 import gofr_tpu
-from gofr_tpu.ml.generate import Sampler
+from gofr_tpu.ml.generate import Sampler, spec_k_from_env
 from gofr_tpu.ml.scheduler import normalize_priority
 from gofr_tpu.models import llama
 from gofr_tpu.native.tokenizer import BPETokenizer
@@ -318,7 +318,12 @@ def main() -> gofr_tpu.App:
     # (shared with llama_server)
     cfg = llama.config_from_env(tiny_vocab_size=TOKENIZER.vocab_size)
     params = llama.params_from_config(cfg)
-    spec_k = int(os.environ.get("LLM_SPEC_K", "0"))
+    # LLM_SPEC_K, falling back to the framework-wide GOFR_ML_SPEC_K
+    # knob — the fallback goes through the loudly-validated parse
+    # (named error at boot), and the Generator re-validates the
+    # final value either way
+    raw_spec = os.environ.get("LLM_SPEC_K", "").strip()
+    spec_k = int(raw_spec) if raw_spec else spec_k_from_env()
     draft_params, draft_cfg = (llama.draft_from_env(cfg, params)
                                if spec_k else (None, None))
     app.register_llm(

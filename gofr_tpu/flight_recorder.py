@@ -10,7 +10,8 @@ before the crash?":
 
 - ``DispatchRecorder`` — per-dispatch **stall attribution**. The serving
   thread stamps monotonic phase durations (queue pop, scheduler decide,
-  batch assemble, device dispatch, device wait, emit) as it works; every
+  batch assemble, program launch, async-D2H issue, device wait, emit) as
+  it works; every
   device dispatch commits one record into a bounded ring, with the
   unattributed remainder of the pass recorded honestly as ``other`` — the
   phases of a record always sum to its wall time. Rolling per-phase
@@ -51,10 +52,15 @@ __all__ = ["PHASES", "DispatchRecorder", "EventLog", "CrashVault",
 # the dispatch-phase taxonomy (the label set of
 # app_llm_dispatch_phase_seconds). ``route`` is recorded by the replica
 # pool's router; everything else by one LLMServer serving thread.
-# ``other`` is the honest remainder: wall time of a dispatch pass no
-# instrumented site claimed (host bookkeeping loops, GC, OS scheduling).
-PHASES = ("queue_pop", "decide", "assemble", "dispatch", "device_wait",
-          "emit", "route", "other")
+# ``launch`` (program launch + arg staging, incl. chunked-prefill
+# segments) and ``d2h_issue`` (issuing the async token prefetch) split
+# what used to be one ``dispatch`` phase, so the PR-7 "launch is ~59% of
+# step time" finding is directly attributable before/after the fusion
+# work. ``other`` is the honest remainder: wall time of a dispatch pass
+# no instrumented site claimed (host bookkeeping loops, GC, OS
+# scheduling).
+PHASES = ("queue_pop", "decide", "assemble", "launch", "d2h_issue",
+          "device_wait", "emit", "route", "other")
 # phases that burn HOST time; ``device_wait`` is the one phase where the
 # host is merely blocked on device compute, so it never names a stall
 _HOST_PHASES = tuple(p for p in PHASES if p != "device_wait")
@@ -107,7 +113,7 @@ class DispatchRecorder:
         the serve loop's tail-flush commit, so idle passes that merely
         glanced at an empty queue never pollute the dispatch ring."""
         return any(k in self._pending
-                   for k in ("dispatch", "device_wait", "emit"))
+                   for k in ("launch", "d2h_issue", "device_wait", "emit"))
 
     def note(self, phase: str, seconds: float) -> None:
         """Attribute ``seconds`` of the current pass to ``phase``.

@@ -335,6 +335,12 @@ class MLDatasource:
             if getattr(server, "prefix_cache", None) is not None:
                 # prefix lengths, refcounts, hit counts + lifetime totals
                 entry["prefix_cache"] = server.prefix_cache.snapshot()
+            spec = getattr(server.gen, "spec_stats", None)
+            spec = spec() if spec is not None else None
+            if spec is not None:
+                # speculative serving: K, draft mode, lifetime windows/
+                # acceptance, adaptive per-slot disable + re-probe state
+                entry["speculation"] = spec
             if hasattr(server, "scheduler_snapshot"):
                 # token budget, chunk-size mix, SLO steering state, and
                 # per-priority ready-queue depth/age
@@ -345,8 +351,8 @@ class MLDatasource:
                 entry["resilience"] = server.resilience_snapshot()
             if getattr(server, "recorder", None) is not None:
                 # flight recorder: rolling per-dispatch phase breakdown
-                # (queue pop / decide / assemble / dispatch / device wait
-                # / emit / other) and the top host-side stall by share
+                # (queue pop / decide / assemble / launch / d2h issue /
+                # device wait / emit / other) and the top host-side stall
                 entry["stalls"] = server.recorder.snapshot()
             return entry
 

@@ -40,7 +40,7 @@ from .kv_offload import HostKVStore
 from .scheduler import TokenBudgetScheduler, maybe_enable_compilation_cache
 
 __all__ = ["Sampler", "sample_logits", "greedy", "Generator",
-           "PagePoolExhausted", "PrefixEvicted"]
+           "PagePoolExhausted", "PrefixEvicted", "spec_k_from_env"]
 
 _log = logging.getLogger("gofr_tpu.ml.generate")
 
@@ -55,6 +55,47 @@ def _chunk_ladder(chunk: int) -> tuple[int, ...]:
     if chunk > 1:
         ladder.append(chunk)
     return tuple(ladder)
+
+
+def _env_int(name: str, default: int, *, minimum: int = 0) -> int:
+    """Loudly-validated integer env knob (the PR-6 drain/replicas
+    pattern): malformed or out-of-range values fail at construction
+    instead of silently serving with a default."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name} must be an integer, got {raw!r}") from None
+    if value < minimum:
+        raise ValueError(f"{name} must be >= {minimum}, got {value}")
+    return value
+
+
+def _env_fraction(name: str, default: float) -> float:
+    """Loudly-validated [0, 1] float env knob — rejects malformed values,
+    negatives, values over 1, and NaN."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name} must be a number in [0, 1], got {raw!r}") from None
+    if not 0.0 <= value <= 1.0:  # NaN fails both compares
+        raise ValueError(f"{name} must be in [0, 1], got {raw!r}")
+    return value
+
+
+def spec_k_from_env(default: int = 0) -> int:
+    """``GOFR_ML_SPEC_K`` with loud validation — the ONE parse behind the
+    Generator's env default and the examples' LLM_SPEC_K fallback chain,
+    so a malformed value fails the boot with the knob's name instead of
+    a bare int() traceback."""
+    return _env_int("GOFR_ML_SPEC_K", default)
 
 
 class PagePoolExhausted(RuntimeError):
@@ -118,7 +159,8 @@ def sample_logits(logits: jnp.ndarray, key, sampler: Sampler) -> jnp.ndarray:
 class _Slot:
     __slots__ = ("live", "tokens", "max_new", "produced", "prompt_len",
                  "eos_hit", "evicted", "callback", "spec_windows",
-                 "spec_emitted")
+                 "spec_emitted", "spec_disabled", "spec_cooldown_left",
+                 "spec_recent_w", "spec_recent_e", "hist")
 
     def __init__(self) -> None:
         self.live = False
@@ -131,6 +173,18 @@ class _Slot:
         # emitted — the serving layer exports the acceptance rate
         self.spec_windows = 0
         self.spec_emitted = 0
+        # adaptive speculation (GOFR_ML_SPEC_MIN_ACCEPT): a slot whose
+        # rolling accept rate drops below the floor degrades to plain
+        # decode (1 token/window) and re-probes after a cooldown —
+        # adversarial streams stop wasting the verify budget, losslessly
+        self.spec_disabled = False
+        self.spec_cooldown_left = 0
+        self.spec_recent_w = 0   # windows in the current judging window
+        self.spec_recent_e = 0   # tokens emitted in it
+        # host mirror of the slot's FULL token history (prompt + emitted),
+        # kept only when the all-disabled plain-ladder fallback is armed:
+        # it re-seeds the device drafting row when speculation re-probes
+        self.hist: list[int] = []
         # a dry page pool truncated this slot: it finished with the tokens
         # it had, NOT at eos/max_new — serving layers must not report it
         # as a natural "stop" (ADVICE r4 #4)
@@ -153,8 +207,9 @@ class Generator:
                  max_seq: int = 2048, sampler: Sampler | None = None,
                  eos_id: int | None = None, prefill_buckets=(128, 512, 2048),
                  seed: int = 0, mesh=None, chunk: int = 1,
-                 shard_cache: bool = False, spec_k: int = 0,
-                 spec_ngram: int = 3, page_size: int = 0,
+                 shard_cache: bool = False, spec_k: int | None = None,
+                 spec_ngram: int = 3, spec_min_accept: float | None = None,
+                 spec_cooldown: int | None = None, page_size: int = 0,
                  n_pages: int | None = None, draft_params: Any = None,
                  draft_cfg: Any = None, prefill_chunk: int = 0,
                  token_budget: int | None = None,
@@ -178,7 +233,45 @@ class Generator:
             self._eos = frozenset(int(e) for e in eos_id)
         else:
             self._eos = frozenset((int(eos_id),))
+        # vector form for the batched burst apply (np.isin in _apply_burst)
+        self._eos_arr = (np.fromiter(self._eos, np.int64, len(self._eos))
+                         if self._eos else None)
         self.chunk = chunk
+        # -- speculation knobs (parsed EARLY: the auto token budget below
+        # charges verify windows at K+1 tokens per slot) -----------------
+        # spec_k: None -> env GOFR_ML_SPEC_K (0 = off); malformed or
+        # negative values fail loudly at construction (_env_int).
+        if spec_k is None:
+            spec_k = _env_int("GOFR_ML_SPEC_K", 0)
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {self.spec_k}")
+        # per-slot adaptive speculation: below this rolling accept rate a
+        # slot stops speculating (0 = never auto-disable) and re-probes
+        # after spec_cooldown windows
+        self.spec_min_accept = (
+            _env_fraction("GOFR_ML_SPEC_MIN_ACCEPT", 0.0)
+            if spec_min_accept is None else float(spec_min_accept))
+        if not 0.0 <= self.spec_min_accept <= 1.0:
+            raise ValueError(
+                f"spec_min_accept must be in [0, 1], got "
+                f"{self.spec_min_accept}")
+        self.spec_cooldown = (_env_int("GOFR_ML_SPEC_COOLDOWN", 32,
+                                       minimum=1)
+                              if spec_cooldown is None
+                              else int(spec_cooldown))
+        if self.spec_cooldown < 1:
+            raise ValueError(
+                f"spec_cooldown must be >= 1, got {self.spec_cooldown}")
+        self._spec_probe_min = 8  # windows judged before a disable verdict
+        self.spec_disables = 0    # slots auto-disabled (lifetime)
+        self.spec_reprobes = 0    # cooldown expiries re-arming a slot
+        self._plain_armed = False  # set in _init_spec (lookup mode only)
+        self._spec_rows_stale = False  # device history lags the mirror
+        if getattr(cfg, "kv_bits", 16) == 4 and not page_size:
+            raise ValueError(
+                "kv_bits=4 (int4 KV) requires the paged cache — set "
+                "page_size > 0")
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_seq
         ) or (max_seq,)
@@ -370,9 +463,15 @@ class Generator:
                 block = jnp.concatenate([tok_in[None], toks], axis=0)
                 return block, tok, cache
 
-            # donate the cache: in-place KV update on device, no copy per step
+            # donate the cache AND the input token row: in-place KV update
+            # on device, no copy per step, and the token-row buffer is
+            # reused across dispatches instead of reallocated (part of the
+            # dispatch-launch fusion — fewer allocator round-trips per
+            # program). The page table (last arg, paged mode) is NOT
+            # donated: it is a device-cached host upload reused until the
+            # table actually changes (_table_device).
             return jax.jit(paged_chunk_fn if self.page_size else chunk_fn,
-                           donate_argnums=(2,))
+                           donate_argnums=(1, 2))
 
         # Pre-jitted chunk ladder: one decode program per power-of-two size
         # up to `chunk`. The fixed path only ever uses `chunk` and the
@@ -380,6 +479,12 @@ class Generator:
         # ladder entry that fills the per-dispatch budget given live slots.
         self._chunk_ladder = _chunk_ladder(self.chunk)
         self._chunk_fns = {n: make_chunk_fn(n) for n in self._chunk_ladder}
+        # the PLAIN decode ladder survives _init_spec's spec-window ladder:
+        # when adaptive speculation has disabled every decodable slot
+        # (lookup mode), step() degrades the whole dispatch to these —
+        # full budget efficiency instead of paying K+1 verify positions
+        # per always-rejected draft
+        self._plain_fns = self._chunk_fns
         self._chunk_fn = self._chunk_fns[self.chunk]
         # TTFT path: a 1-step mini-chunk dispatched while first tokens are
         # pending, so a new request's first token reaches the host ~one full
@@ -395,10 +500,15 @@ class Generator:
         # in the remainder (budget >= decode cost + 2 * prefill_chunk) —
         # a budget equal to the decode cost alone would make the
         # scheduler strictly pay overhead without buying prefill progress.
+        # Under speculation one ladder step costs K+1 device positions per
+        # row (plan() charges unit_tokens=K+1), so the auto budget scales
+        # by the same factor — the steady-state window count matches the
+        # plain path's chunk count instead of collapsing the ladder.
+        per_step = (self.spec_k + 1) if self.spec_k else 1
         if token_budget is None:
             raw = os.environ.get("GOFR_ML_TOKEN_BUDGET", "auto")
-            token_budget = (max(2 * self.chunk * batch_slots,
-                                self.chunk * batch_slots
+            token_budget = (max(2 * self.chunk * batch_slots * per_step,
+                                self.chunk * batch_slots * per_step
                                 + 2 * self.prefill_chunk)
                             if raw.strip().lower() in ("", "auto")
                             else int(raw))
@@ -494,7 +604,7 @@ class Generator:
         self._admit_cap = 1 if self.page_size else min(8, batch_slots)
 
         # -- speculative decoding (device-resident prompt lookup) ----------
-        self.spec_k = int(spec_k)
+        # (self.spec_k was parsed and validated at the top of __init__)
         self.spec_ngram = int(spec_ngram)
         self._tokens_dev = None
         # draft-model speculation: a small shared-vocab model proposes the
@@ -502,7 +612,7 @@ class Generator:
         # dense fp cache rides the jitted window as donated state
         if (draft_params is None) != (draft_cfg is None):
             raise ValueError("draft_params and draft_cfg come together")
-        if draft_params is not None and not spec_k:
+        if draft_params is not None and not self.spec_k:
             raise ValueError("a draft model requires spec_k > 0")
         if draft_cfg is not None and draft_cfg.vocab_size != cfg.vocab_size:
             raise ValueError("draft and target must share the vocabulary")
@@ -602,15 +712,19 @@ class Generator:
 
         def make_spec_chunk_fn(n_windows: int):
             def spec_chunk_fn(params, tok, cache, tokens_dev, draft_cache,
-                              table=None):
+                              spec_on, table=None):
                 """``n_windows`` draft→verify→accept rounds. Returns
                 (input token row [B] — the firsts ride-along, as in the
                 plain chunk — emitted candidates [W, B, K+1], emit counts
                 [W, B], final carry tok, cache, tokens_dev, draft_cache).
                 Drafts come from the draft model when one is configured,
                 else prompt lookup; ``draft_cache`` is the empty pytree in
-                lookup mode. Paged mode routes window writes/reads through
-                the page table."""
+                lookup mode. ``spec_on`` [B] bool masks ADAPTIVE per-slot
+                disable: a masked row accepts nothing, so it emits exactly
+                its verified next token per window — plain greedy decode
+                at window cadence, bit-identical (the window's position-0
+                logits depend only on the prefix + input token). Paged
+                mode routes window writes/reads through the page table."""
                 tok_in = tok
                 ar = jnp.arange(K + 1)[None, :]
                 rows = jnp.arange(B)
@@ -634,6 +748,10 @@ class Generator:
                     greedy_t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                     match = (draft == greedy_t[:, :K]).astype(jnp.int32)
                     n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                    # adaptively-disabled rows accept nothing: their one
+                    # emitted token is the verifier's own argmax — plain
+                    # decode, losslessly
+                    n_acc = jnp.where(spec_on, n_acc, 0)
                     g_last = jnp.take_along_axis(greedy_t, n_acc[:, None], 1)
                     draft_pad = jnp.concatenate(
                         [draft, jnp.zeros((B, 1), jnp.int32)], axis=1)
@@ -666,15 +784,36 @@ class Generator:
                         host_visible(counts), host_visible(tok), cache,
                         tokens_dev, draft_cache)
 
-            return jax.jit(spec_chunk_fn, donate_argnums=(2, 3, 4))
+            # donate tok + cache + history + draft cache (the token row
+            # rides its buffer across dispatches, like the plain ladder)
+            return jax.jit(spec_chunk_fn, donate_argnums=(1, 2, 3, 4))
 
-        # spec mode replaces the whole ladder: entries are verify WINDOWS
-        # (each emits 1..K+1 tokens); the budget scheduler plans in window
-        # units, which keeps the decode/prefill split meaningful
+        # spec mode replaces the PRIMARY ladder (the plain one survives in
+        # self._plain_fns for the all-disabled fallback): entries are
+        # verify WINDOWS (each emits 1..K+1 tokens); the budget scheduler
+        # charges them at K+1 tokens per decodable row (plan(unit_tokens)),
+        # which keeps the decode/prefill split honest about device time
         self._chunk_fns = {n: make_spec_chunk_fn(n)
                            for n in self._chunk_ladder}
         self._chunk_fn = self._chunk_fns[self.chunk]
         self._mini_chunk_fn = self._chunk_fns[1]
+        # the all-disabled plain-ladder fallback needs two things a draft
+        # model can't give: drafting state that survives plain dispatches
+        # (prompt-lookup history does, via the host mirror + row re-seed;
+        # a draft model's own KV cache does not) and an auto-disable floor
+        # actually set. Draft mode still disables per slot via the mask.
+        self._plain_armed = (self.spec_min_accept > 0
+                             and draft_params is None)
+
+        def reseed_hist(rows):
+            """Replace the device drafting history wholesale from the
+            host mirror — the plain→spec transition repair. ONE upload
+            for the whole batch: per-slot row writes would pay one
+            program launch per live slot (~40 ms each through the remote
+            tunnel) at every re-probe transition."""
+            return host_visible(jnp.asarray(rows))
+
+        self._reseed_hist = jax.jit(reseed_hist)
 
         def spec_post_prefill(tok_dev, tokens_dev, logits, prompt, lens,
                               slot):
@@ -790,6 +929,7 @@ class Generator:
             pg = self._free_pages.pop()
             pages.append(pg)
             self._table[slot, len(pages) - 1] = pg
+            self._table_dirty = True
         return True
 
     def _pages_ever_free(self) -> int:
@@ -812,6 +952,7 @@ class Generator:
             self._slot_prefix[slot] = None
         self._slot_pages[slot] = []
         self._table[slot, :] = 0
+        self._table_dirty = True
 
     def _grow_pages(self) -> None:
         """Pre-allocate pages for the upcoming dispatch: host bookkeeping
@@ -837,6 +978,20 @@ class Generator:
                 s.evicted = True  # distinguishable from eos/length finishes
                 self.evictions += 1
 
+    def _table_device(self):
+        """The device-resident page table for the next chunk dispatch,
+        re-uploaded only when the host copy changed — before this, every
+        paged launch re-staged the [B, P_max] table H2D (part of the
+        PR-7-measured ~59% launch share). Under a mesh the host array is
+        passed through unchanged (a device_put here would COMMIT it to
+        one device and fight GSPMD's placement)."""
+        if self.mesh is not None:
+            return self._table
+        if self._table_dirty or self._table_dev is None:
+            self._table_dev = jax.device_put(self._table)
+            self._table_dirty = False
+        return self._table_dev
+
     @property
     def free_pages(self) -> int:
         return len(self._free_pages) if self.page_size else 0
@@ -855,10 +1010,22 @@ class Generator:
             "restarts": self.restarts,
         }
         if self.page_size:
+            cache = dict(self.cache)
+            # bytes ONE pool page costs across every cache plane (values +
+            # scale/zero), from array avals (valid even for donated
+            # buffers): the number the GOFR_ML_KV_BITS halving claim is
+            # audited against
+            page_bytes = sum(int(arr.nbytes) // self.n_pages
+                             for key, arr in cache.items() if key != "len")
+            value_bytes = sum(int(cache[key].nbytes) // self.n_pages
+                              for key in ("k", "v") if key in cache)
             out.update(
                 page_size=self.page_size,
                 n_pages=self.n_pages,
                 free_pages=self.free_pages,
+                kv_bits=getattr(self.cfg, "kv_bits", 16),
+                page_bytes=page_bytes,
+                page_value_bytes=value_bytes,
                 prefix_evictions=getattr(self, "prefix_evictions", 0),
                 registered_prefixes=len(getattr(self, "_prefixes", {})),
                 pinned_prefixes=sum(
@@ -1108,6 +1275,7 @@ class Generator:
             self._slot_prefix[slot] = pid
             info["refs"] += 1  # the except path's _free_slot_pages unrefs
             self._table[slot, :len(shared)] = shared
+            self._table_dirty = True
             upto = min(start + n_suf + 2 * self.chunk,
                        start + n_suf + max_new, self.max_seq)
             if not self._alloc_pages_to(slot, upto):
@@ -1162,6 +1330,9 @@ class Generator:
         s.produced = 1  # the pending first token counts as sampled
         s.prompt_len = start + n_suf
         s.callback = callback
+        if self._plain_armed:
+            s.hist = [int(t)
+                      for t in info["ids_full"][:info["len"]]] + suffix
         self.slots[slot] = s
         return slot
 
@@ -1225,6 +1396,11 @@ class Generator:
             self._slot_pages: list[list[int]] = [
                 [] for _ in range(self.batch_slots)]
             self._table = np.zeros((self.batch_slots, self._p_max), np.int32)
+            # device-cached copy of the host table: re-uploaded lazily,
+            # only when the host copy changes (dispatch-launch fusion —
+            # the per-dispatch table staging was pure launch overhead)
+            self._table_dev = None
+            self._table_dirty = True
             self._slot_shared = [0] * self.batch_slots
             self._slot_prefix: list[int | None] = [None] * self.batch_slots
             return
@@ -1319,6 +1495,7 @@ class Generator:
         # always rebuild rather than probing their liveness
         self._tok_dev = self._repl_zeros((self.batch_slots,))
         if self.spec_k:
+            self._spec_rows_stale = False  # fresh rows, no live slots
             self._tokens_dev = self._repl_zeros(
                 (self.batch_slots, self._hist_cap))
             if self.draft_params is not None:
@@ -1331,21 +1508,26 @@ class Generator:
         np.asarray(self._tok_dev)
         return invalidated
 
-    def _warm_dispatch(self, fn) -> None:
+    def _warm_dispatch(self, fn, spec: bool | None = None) -> None:
         """One dead-batch dispatch of a chunk program (all slots garbage):
         compiles it on first use (warmup) and proves a rebuilt decode
-        state executes (recover). Callers hold the mesh context."""
-        if self.spec_k and self.page_size:
+        state executes (recover). ``spec`` overrides the ladder family
+        (a spec generator warms its PLAIN fallback ladder too). Callers
+        hold the mesh context."""
+        spec = bool(self.spec_k) if spec is None else spec
+        if spec and self.page_size:
             (_row0, _e, _c, self._tok_dev, self.cache,
              self._tokens_dev, self._draft_cache) = fn(
                 self.params, self._tok_dev, self.cache,
                 self._tokens_dev, self._draft_cache,
+                np.zeros((self.batch_slots,), bool),
                 np.zeros_like(self._table))
-        elif self.spec_k:
+        elif spec:
             (_row0, _e, _c, self._tok_dev, self.cache,
              self._tokens_dev, self._draft_cache) = fn(
                 self.params, self._tok_dev, self.cache,
-                self._tokens_dev, self._draft_cache)
+                self._tokens_dev, self._draft_cache,
+                np.zeros((self.batch_slots,), bool))
         elif self.page_size:
             _toks, self._tok_dev, self.cache = fn(
                 self.params, self._tok_dev, self.cache,
@@ -1372,9 +1554,12 @@ class Generator:
         (now larger) ladder from disk instead of recompiling it.
         """
         maybe_enable_compilation_cache()
-        if self.scheduler is not None and (
-                self.prefill_chunk
-                or self.scheduler.budget < self.chunk * self.batch_slots):
+        per_step = (self.spec_k + 1) if self.spec_k else 1
+        full_ladder = self.scheduler is not None and (
+            self.prefill_chunk
+            or self.scheduler.budget
+            < self.chunk * self.batch_slots * per_step)
+        if full_ladder:
             # any ladder entry may be dispatched under load — compile them
             # all, largest first (the steady-state program is hot soonest)
             fns = [self._chunk_fns[n] for n in reversed(self._chunk_ladder)]
@@ -1388,6 +1573,19 @@ class Generator:
         with self._mesh_ctx():
             for fn in fns:
                 self._warm_dispatch(fn)
+            if self.spec_k and self._plain_armed:
+                # the all-disabled fallback dispatches the PLAIN ladder:
+                # compile it here too, or the first adversarial burst pays
+                # the compile exactly when it's already degraded
+                if full_ladder:
+                    plain = [self._plain_fns[n]
+                             for n in reversed(self._chunk_ladder)]
+                else:
+                    plain = [self._plain_fns[self.chunk]]
+                    if self.chunk != 1:
+                        plain.append(self._plain_fns[1])
+                for fn in plain:
+                    self._warm_dispatch(fn, spec=False)
             if self.prefill_chunk:
                 # segment program: startup pays the compile, not the first
                 # long prompt (len reset by the bucket prefills below)
@@ -1709,6 +1907,8 @@ class Generator:
                 self._n_requests += 1
                 self._pending_first.append(slot)
                 self.slots[slot].produced = 1  # the pending first token
+                if self._plain_armed:
+                    self.slots[slot].hist = [int(t) for t in st["ids"]]
                 if self.spec_k:
                     # seed the device history row with the FULL prompt
                     # (the segment-shaped _after_prefill would write a
@@ -1829,6 +2029,8 @@ class Generator:
                 s.prompt_len = n
                 s.eos_hit = False
                 s.callback = callback
+                if self._plain_armed:
+                    s.hist = [int(t) for t in _ids]
                 self.slots[slot] = s
             out.extend(slots)
         return out
@@ -1846,6 +2048,8 @@ class Generator:
             if not s.live:
                 continue
             s.tokens.append(t)
+            if self._plain_armed:
+                s.hist.append(t)
             if t in self._eos:
                 s.eos_hit = True
             if s.callback is not None:
@@ -1888,20 +2092,35 @@ class Generator:
             self.fault("step")
         rec = self.recorder
         sched = self.scheduler
+        # Adaptive speculation: which decodable slots still speculate this
+        # dispatch. With every one of them auto-disabled (and the plain
+        # fallback armed — lookup mode), the WHOLE dispatch degrades to
+        # the plain ladder: no K+1 verify positions for always-rejected
+        # drafts. The mask is snapshotted here and travels with the
+        # in-flight item so acceptance accounting matches what the device
+        # actually ran, one pipeline step later.
+        spec_mask = None
+        use_spec = False
+        if self.spec_k:
+            spec_mask = np.array(
+                [s.live and i not in self._chunked and not s.spec_disabled
+                 for i, s in enumerate(self.slots)], bool)
+            use_spec = bool(spec_mask.any()) or not self._plain_armed
+        unit = (self.spec_k + 1) if use_spec else 1
         n_steps = self.chunk
         if sched is not None:
             t0 = time.perf_counter() if rec is not None else 0.0
             n_steps, n_segments = sched.plan(self._n_decodable(),
-                                             bool(self._chunked))
+                                             bool(self._chunked), unit)
             if rec is not None:
                 rec.note("decide", time.perf_counter() - t0)
         if self._chunked:
             # segmented prefill rides the same device queue as the decode
-            # chunk — its launch cost is dispatch time of this pass
+            # chunk — its program-launch cost is launch time of this pass
             t0 = time.perf_counter() if rec is not None else 0.0
             self._advance_chunked(n_segments if sched is not None else 1)
             if rec is not None:
-                rec.note("dispatch", time.perf_counter() - t0)
+                rec.note("launch", time.perf_counter() - t0)
             if not self._decodable():
                 return  # everything live is still mid-prefill
         # Pending first tokens -> ONE 1-step mini-chunk so they surface a
@@ -1909,39 +2128,55 @@ class Generator:
         # All firsts pending at dispatch ride that chunk's input row, and
         # the mini path drains synchronously below, so pending_first is
         # empty again before the next step() call.
+        primary = not self.spec_k or use_spec
+        fns = self._chunk_fns if primary else self._plain_fns
         mini = bool(self._pending_first)
         if mini:
             n_steps = 1
-            fn = self._mini_chunk_fn
+            fn = self._mini_chunk_fn if primary else fns[1]
             if sched is not None:
                 # admission-driven, not a ladder pick: kept out of the
                 # dispatch-size mix so it can't read as 1-step collapse
                 sched.mini_dispatches += 1
         elif sched is not None:
-            fn = self._chunk_fns[n_steps]
+            fn = fns[n_steps]
             sched.note_dispatch(n_steps)
         else:
-            fn = self._chunk_fn
-        t_disp = time.perf_counter() if rec is not None else 0.0
+            fn = self._chunk_fn if primary else fns[self.chunk]
+        if self.spec_k and use_spec and self._spec_rows_stale:
+            # coming back from plain-ladder dispatches: settle host
+            # bookkeeping, then rewrite the device drafting rows from the
+            # host mirror so the re-probe drafts from real history
+            self.drain()
+            self._reseed_spec_rows()
+        t_asm = time.perf_counter() if rec is not None else 0.0
         with self._mesh_ctx():
-            if self.spec_k:
+            if self.page_size:
+                # page growth + the (cached) table upload are host-side
+                # batch ASSEMBLY, not program launch — split out so the
+                # launch number names only the dispatch machinery
+                self._grow_pages()
+                table = self._table_device()
+                if rec is not None:
+                    rec.note("assemble", time.perf_counter() - t_asm)
+            t_launch = time.perf_counter() if rec is not None else 0.0
+            if self.spec_k and use_spec:
                 if self.page_size:
-                    self._grow_pages()
                     (row0, emits, counts, self._tok_dev, self.cache,
                      self._tokens_dev, self._draft_cache) = fn(
                         self.params, self._tok_dev, self.cache,
-                        self._tokens_dev, self._draft_cache, self._table)
+                        self._tokens_dev, self._draft_cache, spec_mask,
+                        table)
                 else:
                     (row0, emits, counts, self._tok_dev, self.cache,
                      self._tokens_dev, self._draft_cache) = fn(
                         self.params, self._tok_dev, self.cache,
-                        self._tokens_dev, self._draft_cache)
+                        self._tokens_dev, self._draft_cache, spec_mask)
                 item: Any = (row0, emits, counts)
             elif self.page_size:
-                self._grow_pages()  # table must cover this whole chunk
                 toks, self._tok_dev, self.cache = fn(
                     self.params, self._tok_dev, self.cache,
-                    np.int32(self.steps), self._base_key, self._table,
+                    np.int32(self.steps), self._base_key, table,
                 )
                 item = toks
             else:
@@ -1951,6 +2186,13 @@ class Generator:
                 )
                 item = toks
         self.steps += n_steps
+        if self.spec_k and not use_spec:
+            # a plain dispatch leaves the device drafting rows behind the
+            # host mirror; repair before the next spec dispatch
+            self._spec_rows_stale = True
+        if rec is not None:
+            rec.note("launch", time.perf_counter() - t_launch)
+        t_d2h = time.perf_counter() if rec is not None else 0.0
         try:
             # best-effort prefetch; on transports where this is itself a
             # blocking transfer (the axon tunnel) the cost is the same as
@@ -1970,12 +2212,12 @@ class Generator:
                     "token prefetch (copy_to_host_async) failed; falling "
                     "back to blocking reads [%s: %s]",
                     type(exc).__name__, exc)
-        self._inflight.append(item)
+        self._inflight.append((item, spec_mask if use_spec else None))
         if rec is not None:
-            # program launch + arg staging + the async D2H prefetch issue:
-            # host cost of getting the chunk onto the device queue (the
-            # blocking read-back is device_wait, in _pop_process)
-            rec.note("dispatch", time.perf_counter() - t_disp)
+            # issuing the async D2H of the token block — the other half of
+            # what used to be one "dispatch" phase (the blocking read-back
+            # is device_wait, in _pop_process)
+            rec.note("d2h_issue", time.perf_counter() - t_d2h)
         if mini:
             # TTFT: the chunk carrying new requests' first tokens is read
             # back NOW instead of lagging one dispatch — one blocking
@@ -1992,51 +2234,169 @@ class Generator:
             self._pop_process()
 
     def _pop_process(self) -> None:
-        item = self._inflight.popleft()
+        item, mask = self._inflight.popleft()
         rec = self.recorder
         t0 = time.perf_counter() if rec is not None else 0.0
-        if self.spec_k:
+        if isinstance(item, tuple):  # a spec-window chunk
             row0, emits, counts = (np.asarray(x) for x in item)
             if rec is not None:
                 rec.note("device_wait", time.perf_counter() - t0)
-            self._process_spec(row0, emits, counts)
+            self._process_spec(row0, emits, counts, mask)
         else:
             toks = np.asarray(item)
             if rec is not None:
                 rec.note("device_wait", time.perf_counter() - t0)
             self._process(toks)
 
+    def _apply_burst(self, i: int, s: _Slot, col: np.ndarray,
+                     bursts: dict) -> int:
+        """Fold one slot's token COLUMN (decode-step order) into slot
+        state as a single batch: cap at the slot's remaining budget,
+        truncate at the first eos, extend the lists once. Replaces the
+        per-token Python loop (the dominant per-slot host assemble cost
+        at chunk 16 x 64 slots). Returns tokens applied."""
+        cap = min(len(col), s.max_new - s.produced,
+                  self.max_seq - s.prompt_len - s.produced)
+        if cap <= 0:
+            self._maybe_finish(i)
+            return 0
+        col = col[:cap]
+        if self._eos_arr is not None:
+            hits = np.nonzero(np.isin(col, self._eos_arr))[0]
+            if hits.size:
+                col = col[:int(hits[0]) + 1]
+                s.eos_hit = True
+        burst = col.tolist()
+        s.tokens.extend(burst)
+        s.produced += len(burst)
+        if self._plain_armed:
+            s.hist.extend(burst)
+        if s.callback is not None:
+            bursts.setdefault(i, []).extend(burst)
+        self._maybe_finish(i)
+        return len(burst)
+
     def _process_spec(self, row0: np.ndarray, emits: np.ndarray,
-                      counts: np.ndarray) -> None:
+                      counts: np.ndarray, mask) -> None:
         """Apply one speculative chunk — input row [B] (resolves pending
-        firsts), emitted candidates [W, B, K+1], counts [W, B] — to slot
-        state. Each window contributes 1..K+1 tokens per live slot."""
+        firsts), emitted candidates [W, B, K+1], counts [W, B], and the
+        per-slot enable mask the dispatch ran with — to slot state. Each
+        window contributes 1..K+1 tokens per live slot; windows of
+        mask-disabled slots emit exactly 1 (their plain-decode token)."""
         self._resolve_first(row0)
         bursts: dict[int, list[int]] = {}
-        for w in range(emits.shape[0]):
-            for i, s in enumerate(self.slots):
-                if not s.live or i in self._chunked:
-                    continue  # mid-prefill rows decode garbage; drop it
+        n_windows = emits.shape[0]
+        for i, s in enumerate(self.slots):
+            if not s.live or i in self._chunked:
+                continue  # mid-prefill rows decode garbage; drop it
+            enabled = mask is None or bool(mask[i])
+            seen = 0
+            for w in range(n_windows):
+                if not s.live:
+                    break
+                seen += 1
                 self.spec_windows += 1
                 s.spec_windows += 1
-                s.spec_emitted += int(counts[w, i])
-                for t in range(int(counts[w, i])):
-                    tok = int(emits[w, i, t])
-                    s.tokens.append(tok)
-                    s.produced += 1
-                    self.spec_emitted += 1
-                    if tok in self._eos:
-                        s.eos_hit = True
-                    if s.callback is not None:
-                        bursts.setdefault(i, []).append(tok)
-                    self._maybe_finish(i)
-                    if not s.live:
-                        break
+                n = int(counts[w, i])
+                s.spec_emitted += n
+                if enabled:
+                    s.spec_recent_w += 1
+                    s.spec_recent_e += n
+                self.spec_emitted += self._apply_burst(
+                    i, s, emits[w, i, :n], bursts)
+            self._eval_spec_slot(s, enabled, seen)
         self._fire_bursts(bursts)
+
+    def _eval_spec_slot(self, s: _Slot, enabled: bool,
+                        windows: int) -> None:
+        """Adaptive per-slot speculation control, run once per processed
+        dispatch: an ENABLED slot whose rolling accept rate over >=
+        ``_spec_probe_min`` windows falls below ``spec_min_accept`` is
+        disabled (it degrades to plain decode via the dispatch mask); a
+        DISABLED slot counts its cooldown down and re-probes — fresh
+        judging window — when it expires. Lossless either way: the mask
+        only moves tokens between the accept path and the verify-argmax
+        path, never changes them."""
+        if not windows:
+            return
+        if not enabled:
+            if not s.spec_disabled:
+                return  # flag flipped since that dispatch was planned
+            s.spec_cooldown_left -= windows
+            if s.spec_cooldown_left <= 0:
+                s.spec_disabled = False
+                s.spec_recent_w = s.spec_recent_e = 0
+                self.spec_reprobes += 1
+            return
+        if s.spec_disabled:
+            # the symmetric mirror race: an item dispatched enabled just
+            # before the disable verdict landed must not re-disable the
+            # slot (double-counting the alarm counter, restarting the
+            # cooldown clock)
+            return
+        if self.spec_min_accept <= 0 or not self.spec_k:
+            return
+        if s.spec_recent_w < self._spec_probe_min:
+            return
+        rate = max(0.0, (s.spec_recent_e - s.spec_recent_w)
+                   / (s.spec_recent_w * self.spec_k))
+        if rate < self.spec_min_accept:
+            s.spec_disabled = True
+            s.spec_cooldown_left = self.spec_cooldown
+            self.spec_disables += 1
+        s.spec_recent_w = s.spec_recent_e = 0
+
+    def _reseed_spec_rows(self) -> None:
+        """Rewrite the device drafting history from the host mirror —
+        the plain→spec transition repair (plain dispatches advance the
+        cache but not ``_tokens_dev``) — assembled host-side and
+        uploaded as ONE [B, hist_cap] transfer (the _table_device
+        pattern), so a re-probe transition costs one launch, not one per
+        live slot. Rows of dead or mid-chunked-prefill slots zero out:
+        dead rows are garbage either way, and a chunked slot's row is
+        (re)seeded whole at its final segment. Callers drain first so
+        the mirror is complete."""
+        rows = np.zeros((self.batch_slots, self._hist_cap), np.int32)
+        for i, s in enumerate(self.slots):
+            if not s.live or i in self._chunked:
+                continue
+            hist = s.hist[-self._hist_cap:]
+            rows[i, :len(hist)] = hist
+        with self._mesh_ctx():
+            self._tokens_dev = self._reseed_hist(rows)
+        self._spec_rows_stale = False
+
+    def spec_stats(self) -> dict | None:
+        """Speculation block for /debug/serving (None when spec is off):
+        config, lifetime window/acceptance totals, and the adaptive
+        disable/re-probe state."""
+        if not self.spec_k:
+            return None
+        accept = (max(0.0, (self.spec_emitted - self.spec_windows)
+                      / (self.spec_windows * self.spec_k))
+                  if self.spec_windows else None)
+        return {
+            "spec_k": self.spec_k,
+            "mode": "draft" if self.draft_params is not None else "lookup",
+            "min_accept": self.spec_min_accept,
+            "cooldown_windows": self.spec_cooldown,
+            "windows": self.spec_windows,
+            "emitted": self.spec_emitted,
+            "accept_rate": (round(accept, 4) if accept is not None
+                            else None),
+            "disabled_slots": sum(1 for s in self.slots
+                                  if s.live and s.spec_disabled),
+            "disables_total": self.spec_disables,
+            "reprobes_total": self.spec_reprobes,
+            "plain_fallback_armed": self._plain_armed,
+        }
 
     def _process(self, toks: np.ndarray) -> None:
         """Apply one [1 input + chunk sampled, B] token block to slot
-        state, in step order. The input row resolves pending firsts.
+        state. The input row resolves pending firsts; each slot's column
+        is folded in as ONE batch (_apply_burst) instead of a per-token
+        Python loop — token order within the chunk is preserved because a
+        slot only ever reads its own column in step order.
 
         Callbacks fire once per slot per chunk with the slot's BURST of
         tokens, not once per token: at 64 slots x chunk 16 a per-token
@@ -2044,20 +2404,17 @@ class Generator:
         serving stack each was a ``call_soon_threadsafe`` wakeup of the
         asyncio loop. One list per slot cuts that 16x."""
         self._resolve_first(toks[0])
-        toks = toks[1:]
+        body = toks[1:]
         bursts: dict[int, list[int]] = {}
-        for row in toks:
-            for i, s in enumerate(self.slots):
-                if not s.live or i in self._chunked:
-                    continue  # mid-prefill rows decode garbage; drop it
-                t = int(row[i])
-                s.tokens.append(t)
-                s.produced += 1
-                if t in self._eos:
-                    s.eos_hit = True
-                if s.callback is not None:
-                    bursts.setdefault(i, []).append(t)
-                self._maybe_finish(i)
+        for i, s in enumerate(self.slots):
+            if not s.live or i in self._chunked:
+                continue  # mid-prefill rows decode garbage; drop it
+            self._apply_burst(i, s, body[:, i], bursts)
+            if self.spec_k and s.spec_disabled:
+                # plain-fallback dispatches must still run the cooldown
+                # clock (one decode step ~ one window of cadence), or an
+                # all-disabled batch could never re-probe
+                self._eval_spec_slot(s, False, len(body))
         self._fire_bursts(bursts)
 
     def _fire_bursts(self, bursts: dict[int, list[int]]) -> None:

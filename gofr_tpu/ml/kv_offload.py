@@ -33,6 +33,14 @@ Keys are the prefix's full registered token tuple — the identity the
 radix cache already matches prompts by, so an offloaded prefix is found
 by the same longest-match that found it when it was device-resident.
 
+The tier is precision-agnostic: entries hold whatever page slabs the
+pool uses — fp, int8, or packed int4 (``GOFR_ML_KV_BITS=4``) values plus
+their scale/zero planes — and byte accounting follows the arrays, so
+int4 pages make the same host budget hold roughly twice the prefixes
+int8 did (exactly twice on the value planes). Spill→restore stays
+bit-identical at every precision because the raw stored bytes round-trip
+untouched.
+
 Thread-safety: all mutation happens on the serving thread that owns the
 Generator; a small lock makes ``stats()``/``meta()`` safe from the
 event-loop thread (the /debug/serving reader). Settling (the potentially

@@ -201,9 +201,11 @@ class LLMServer:
         self._steered_dispatches = -1  # ladder dispatches recorded so far
         # offload-counter watermarks: the generator counts spills/restores
         # monotonically; the gauge pass publishes the deltas as Prometheus
-        # counters so the generator itself stays metrics-free
+        # counters so the generator itself stays metrics-free (same
+        # pattern for the adaptive-speculation disable counter)
         self._kv_spills_seen = 0
         self._kv_restores_seen = 0
+        self._spec_disables_seen = 0
         self._active: dict[int, _Request] = {}
         self._closed = False
         self.served = 0
@@ -983,7 +985,11 @@ class LLMServer:
                 and req.last_burst_at is not None):
             # live cadence per burst: waiting for stream FINISH would leave
             # the controller TPOT-blind (and decode unprotected) for the
-            # whole lifetime of a long stream
+            # whole lifetime of a long stream. Under speculation the burst
+            # carries every VERIFIED token of the window (accepted drafts
+            # + the bonus token), so verify tokens steer the controller
+            # exactly like plain decode tokens — the SLO loop sees spec
+            # speedups as lower TPOT, not as a blind spot
             self._controller.observe_tpot(
                 (now - req.last_burst_at) / len(tokens))
         req.last_burst_at = now
@@ -1082,6 +1088,15 @@ class LLMServer:
                 self._metrics.set_gauge("app_llm_prefill_share",
                                         float(sched.prefill_share),
                                         model=self.name)
+            disables = int(getattr(self.gen, "spec_disables", 0))
+            if disables > self._spec_disables_seen:
+                # adaptive speculation turned a slot OFF (accept rate
+                # below GOFR_ML_SPEC_MIN_ACCEPT) — the alarm-able pair to
+                # the app_llm_spec_accept histogram
+                self._metrics.add_counter(
+                    "app_llm_spec_disabled_total",
+                    disables - self._spec_disables_seen, model=self.name)
+                self._spec_disables_seen = disables
         except Exception:
             pass
 

@@ -34,6 +34,12 @@ of vLLM's PagedAttention block reuse and SGLang's RadixAttention:
   loses the race to pool pressure falls back to the full prompt, exactly
   like the ``PrefixEvicted`` race.
 
+Registration is precision-agnostic: the pages a prefix pins may be fp,
+int8, or packed int4 (``GOFR_ML_KV_BITS=4``) — at int4 the same pool
+holds roughly twice the registered prefixes per HBM byte, so promotion
+pressure (and the eviction churn this cache manages) halves for the
+same traffic.
+
 All mutation happens on the LLMServer serving thread (the one thread
 allowed to touch the Generator); a small lock makes ``snapshot()`` and
 ``peek()`` safe from the event-loop thread. Device work (the prefix

@@ -137,13 +137,18 @@ class TokenBudgetScheduler:
     """Per-dispatch planner: one token budget split between decode and
     chunked prefill.
 
-    ``plan(n_decodable, prefill_pending)`` returns ``(chunk_size,
-    n_segments)``: the ladder entry to dispatch and how many prefill
-    segments may run before it. Invariants:
+    ``plan(n_decodable, prefill_pending, unit_tokens=1)`` returns
+    ``(chunk_size, n_segments)``: the ladder entry to dispatch and how
+    many prefill segments may run before it. ``unit_tokens`` is the
+    device cost of ONE ladder step per decodable row — 1 for plain
+    decode, ``K+1`` for a speculative verify window (draft + verify
+    positions all sweep the weights), so spec windows are charged
+    honestly against the same budget. Invariants:
 
     - chunk_size is the LARGEST ladder entry whose total decode tokens
-      (``size * n_decodable``) fit the decode share of the budget — i.e.
-      the smallest program count for the work, never beyond ``chunk``.
+      (``size * n_decodable * unit_tokens``) fit the decode share of the
+      budget — i.e. the smallest program count for the work, never
+      beyond ``chunk``.
     - with prefill pending, ``max(prefill_chunk, share * budget)`` tokens
       are reserved for prefill first; the decode chunk shrinks down the
       ladder instead of delaying prefill a full chunk.
@@ -175,6 +180,9 @@ class TokenBudgetScheduler:
         self.mini_dispatches = 0
         self.last_chunk = self.ladder[-1]
         self.last_segments = 0
+        # device cost of one ladder step per row in the LAST plan: 1 for
+        # plain decode, K+1 when the dispatch was a spec verify window
+        self.last_unit = 1
         # KV-restore charging (generate.Generator.restore_prefix): a
         # host->device prefix restore rides the device queue like prefill
         # work; its token count lands here as DEBT that upcoming plans pay
@@ -197,7 +205,10 @@ class TokenBudgetScheduler:
                                  max(self.min_share, float(share)))
         return self.prefill_share
 
-    def plan(self, n_decodable: int, prefill_pending: bool) -> tuple[int, int]:
+    def plan(self, n_decodable: int, prefill_pending: bool,
+             unit_tokens: int = 1) -> tuple[int, int]:
+        unit = max(1, int(unit_tokens))
+        self.last_unit = unit
         budget = self.budget
         if self.restore_debt:
             # pay down restore debt first — at most half a budget per
@@ -213,7 +224,7 @@ class TokenBudgetScheduler:
             # leans toward prefill, live streams keep at least half their
             # fixed-path cadence, so a misdirected share ratchet can
             # never collapse decode to 1-step dispatches
-            floor = (self.ladder[-1] // 2) * max(1, n_decodable)
+            floor = (self.ladder[-1] // 2) * max(1, n_decodable) * unit
             decode_budget = max(budget - int(budget * self.prefill_share),
                                 min(floor, budget))
         else:
@@ -221,7 +232,7 @@ class TokenBudgetScheduler:
         rows = max(1, n_decodable)
         size = self.ladder[0]
         for c in self.ladder:
-            if c * rows <= decode_budget:
+            if c * rows * unit <= decode_budget:
                 size = c
         if not (prefill_pending and self.prefill_chunk):
             self.last_segments = 0
@@ -233,7 +244,7 @@ class TokenBudgetScheduler:
         light = (self.slots is None
                  or n_decodable <= max(1, self.slots // 4)
                  or self.prefill_share > 0.6)
-        spare = budget - size * n_decodable
+        spare = budget - size * n_decodable * unit
         segments = max(1, spare // self.prefill_chunk if light else 1)
         self.last_segments = segments
         return size, segments
@@ -256,6 +267,7 @@ class TokenBudgetScheduler:
                            for k, v in sorted(dispatches.items())},
             "mini_dispatches": self.mini_dispatches,
             "last_segments": self.last_segments,
+            "last_unit": self.last_unit,
             "restore_debt": self.restore_debt,
             "restores_charged": self.restores_charged,
         }

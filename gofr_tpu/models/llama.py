@@ -59,6 +59,7 @@ class LlamaConfig:
         remat: bool = False,
         attn_impl: str = "auto",
         kv_quant: bool = False,
+        kv_bits: int | None = None,
         w8: bool = False,
         rope_scaling: dict | None = None,
     ) -> None:
@@ -89,7 +90,23 @@ class LlamaConfig:
         # the serving roofline at large slot counts. Composes with
         # sequence-parallel decode: each sp shard dequantizes its own
         # int8 slice before the pmax/psum combine (parallel/ring.py).
-        self.kv_quant = kv_quant
+        # ``kv_bits`` selects the precision below fp: 8 (default when
+        # kv_quant, symmetric per-vector int8) or 4 (asymmetric per-vector
+        # int4, two codes packed per byte — ops.quantize_kv4). 16 means
+        # the fp cache; setting 4 or 8 implies kv_quant. int4 is a
+        # paged-cache precision (the Generator enforces page_size > 0).
+        if kv_bits is None:
+            kv_bits = 8 if kv_quant else 16
+        kv_bits = int(kv_bits)
+        if kv_bits not in (4, 8, 16):
+            raise ValueError(f"kv_bits must be 4, 8 or 16, got {kv_bits}")
+        if kv_bits == 16 and kv_quant:
+            raise ValueError("kv_quant=True contradicts kv_bits=16")
+        if kv_bits == 4 and self.head_dim % 2:
+            raise ValueError(
+                f"int4 packing needs an even head_dim, got {self.head_dim}")
+        self.kv_bits = kv_bits
+        self.kv_quant = kv_bits < 16
         # int8 weights (quantize_weights): halves the OTHER half of
         # decode's HBM traffic — the per-step weight sweep
         self.w8 = w8
@@ -158,27 +175,69 @@ def params_from_config(cfg: "LlamaConfig", seed: int = 0,
     return params
 
 
+def kv_bits_from_env() -> int | None:
+    """``GOFR_ML_KV_BITS`` → 4 | 8 | 16, or None when unset. Malformed
+    values fail loudly at construction (the PR-6 drain/replicas pattern)
+    instead of silently serving at the wrong precision."""
+    import os
+
+    raw = os.environ.get("GOFR_ML_KV_BITS", "").strip()
+    if not raw:
+        return None
+    try:
+        bits = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"GOFR_ML_KV_BITS must be 4, 8 or 16, got {raw!r}") from None
+    if bits not in (4, 8, 16):
+        raise ValueError(f"GOFR_ML_KV_BITS must be 4, 8 or 16, got {bits}")
+    return bits
+
+
 def config_from_env(tiny_vocab_size: int | None = None) -> LlamaConfig:
     """The examples' shared boot path: LLAMA_PRESET=tiny|1b|8b selects the
     config (tiny disables the flash kernel and can adopt a tokenizer's
     vocab so decoded text is always valid), LLAMA_KV_QUANT=1 turns on the
-    int8 cache, LLAMA_W8=1 turns on int8 weights (pair with
-    params_from_config, which applies the quantization). Centralized so
-    the llama/openai servers can't drift."""
+    int8 cache, GOFR_ML_KV_BITS=4|8|16 selects the KV precision directly
+    (4 = packed int4 pages, overrides LLAMA_KV_QUANT), LLAMA_W8=1 turns
+    on int8 weights (pair with params_from_config, which applies the
+    quantization). Centralized so the llama/openai servers can't drift."""
     import os
 
     preset = os.environ.get("LLAMA_PRESET", "tiny")
     kv_quant = os.environ.get("LLAMA_KV_QUANT") == "1"
+    kv_bits = kv_bits_from_env()  # validated loudly; None = unset
+    if kv_bits is not None:
+        kv_quant = kv_bits < 16
+    elif kv_quant:
+        kv_bits = 8
     w8 = os.environ.get("LLAMA_W8") == "1"
     ckpt = os.environ.get("LLAMA_CKPT")
+    # LLAMA_DTYPE=bf16|f32: activation/weight dtype override. f32 is the
+    # bit-identity dtype — bf16 rounding can flip a near-tie argmax
+    # between two program SHAPES computing the same math (e.g. a spec
+    # verify window vs a plain decode step), which is numeric noise, not
+    # a serving bug; benches assert cross-arm token identity under f32
+    raw_dtype = os.environ.get("LLAMA_DTYPE", "").strip().lower()
+    dtype_kw: dict = {}
+    if raw_dtype:
+        names = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                 "f32": jnp.float32, "float32": jnp.float32}
+        if raw_dtype not in names:
+            raise ValueError(
+                f"LLAMA_DTYPE must be one of {sorted(names)}, "
+                f"got {raw_dtype!r}")
+        dtype_kw["dtype"] = names[raw_dtype]
     from ..ml.hf_import import hf_config, is_hf_dir
 
     if ckpt and is_hf_dir(ckpt):
         # a HF checkpoint defines its own architecture: the preset only
         # contributes serving knobs
-        return hf_config(ckpt, kv_quant=kv_quant, w8=w8)
+        return hf_config(ckpt, kv_quant=kv_quant, kv_bits=kv_bits, w8=w8,
+                         **dtype_kw)
     if preset == "tiny":
-        kw = {"use_flash": False, "kv_quant": kv_quant, "w8": w8}
+        kw = {"use_flash": False, "kv_quant": kv_quant, "kv_bits": kv_bits,
+              "w8": w8, **dtype_kw}
         if tiny_vocab_size is not None:
             kw["vocab_size"] = tiny_vocab_size
         return tiny_llama(**kw)
@@ -186,10 +245,11 @@ def config_from_env(tiny_vocab_size: int | None = None) -> LlamaConfig:
         return LlamaConfig(
             vocab_size=32_128, dim=2048, n_layers=16, n_heads=16,
             n_kv_heads=8, ffn_dim=8192, max_seq_len=2048, kv_quant=kv_quant,
-            w8=w8,
+            kv_bits=kv_bits, w8=w8, **dtype_kw,
         )
     if preset == "8b":
-        return llama3_8b(kv_quant=kv_quant, w8=w8)
+        return llama3_8b(kv_quant=kv_quant, kv_bits=kv_bits, w8=w8,
+                         **dtype_kw)
     raise ValueError(f"unknown LLAMA_PRESET {preset!r}")
 
 
@@ -520,24 +580,69 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
 
 # -- KV-cache serving path ----------------------------------------------------
 
+def kv_plane_names(cfg: LlamaConfig) -> tuple[str, ...]:
+    """Per-vector side planes riding next to the quantized values —
+    ``scale`` for symmetric int8, ``scale`` + ``zero`` for asymmetric
+    int4. Cache keys are ``k_<plane>`` / ``v_<plane>``, always shaped
+    sequence-minor ([..., KV, S] / [..., KV, page_s])."""
+    return ("scale", "zero") if cfg.kv_bits == 4 else ("scale",)
+
+
+def kv_store_width(cfg: LlamaConfig) -> int:
+    """Stored bytes-axis width of ONE kv vector: ``head_dim`` int8 codes,
+    or ``head_dim / 2`` packed int4 bytes."""
+    return cfg.head_dim // 2 if cfg.kv_bits == 4 else cfg.head_dim
+
+
+def kv_encode(cfg: LlamaConfig, x: jnp.ndarray):
+    """Quantize [..., KV, hd] at the config's precision. Returns
+    (values [..., KV, kv_store_width], {plane: [..., KV]})."""
+    from ..ops import quantize_kv, quantize_kv4
+
+    if cfg.kv_bits == 4:
+        q, sc, zp = quantize_kv4(x)
+        return q, {"scale": sc, "zero": zp}
+    q, sc = quantize_kv(x)
+    return q, {"scale": sc}
+
+
+def kv_decode(cfg: LlamaConfig, q: jnp.ndarray, planes: dict,
+              dtype=None) -> jnp.ndarray:
+    """Dequantize values [..., KV, kv_store_width] with their planes back
+    to [..., KV, hd] — the inverse of ``kv_encode``."""
+    from ..ops import dequantize_kv, dequantize_kv4
+
+    dtype = dtype or cfg.dtype
+    if cfg.kv_bits == 4:
+        return dequantize_kv4(q, planes["scale"], planes["zero"], dtype)
+    return dequantize_kv(q, planes["scale"], dtype)
+
+
+def _kv_value_dtype(cfg: LlamaConfig):
+    return jnp.uint8 if cfg.kv_bits == 4 else jnp.int8
+
+
 def init_cache(cfg: LlamaConfig, batch: int, max_seq: int | None = None) -> dict:
     S = max_seq or cfg.max_seq_len
     shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_dim)
     if cfg.kv_quant:
-        # int8 values are stored FLAT, [L, B, S, KV*D]: int8's VMEM tile is
-        # (32, 128), so a [block_s, KV, D] slab with KV=8 sublanes pads 4x
-        # (which made int8 SLOWER than bf16); the flat [block_s, KV*D] slab
-        # tiles perfectly. Scales are [L, B, KV, S] (seq minor) so their
-        # [KV, block_s] DMA slices stay 128-aligned too.
-        flat = (cfg.n_layers, batch, S, cfg.n_kv_heads * cfg.head_dim)
+        # quantized values are stored FLAT, [L, B, S, KV*W]: int8's VMEM
+        # tile is (32, 128), so a [block_s, KV, D] slab with KV=8 sublanes
+        # pads 4x (which made int8 SLOWER than bf16); the flat
+        # [block_s, KV*W] slab tiles perfectly (W = head_dim, halved for
+        # packed int4). Scale/zero planes are [L, B, KV, S] (seq minor) so
+        # their [KV, block_s] DMA slices stay 128-aligned too.
+        flat = (cfg.n_layers, batch, S, cfg.n_kv_heads * kv_store_width(cfg))
         scale_shape = (cfg.n_layers, batch, cfg.n_kv_heads, S)
-        return {
-            "k": jnp.zeros(flat, jnp.int8),
-            "v": jnp.zeros(flat, jnp.int8),
-            "k_scale": jnp.zeros(scale_shape, jnp.bfloat16),
-            "v_scale": jnp.zeros(scale_shape, jnp.bfloat16),
-            "len": jnp.zeros((batch,), jnp.int32),
+        cache = {
+            "k": jnp.zeros(flat, _kv_value_dtype(cfg)),
+            "v": jnp.zeros(flat, _kv_value_dtype(cfg)),
         }
+        for pl in kv_plane_names(cfg):
+            cache[f"k_{pl}"] = jnp.zeros(scale_shape, jnp.bfloat16)
+            cache[f"v_{pl}"] = jnp.zeros(scale_shape, jnp.bfloat16)
+        cache["len"] = jnp.zeros((batch,), jnp.int32)
+        return cache
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -578,20 +683,21 @@ def prefill(params: dict, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
         raise ValueError(f"prompt bucket {s} exceeds cache length {S_max}")
     widen = lambda a: jnp.pad(a, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
     if cfg.kv_quant:
-        from ..ops import quantize_kv
-
-        # int8 values flatten [L, B, S, KV, D] -> [L, B, S, KV*D]; scales
-        # go [L, B, S, KV] -> [L, B, KV, S] (layouts: see init_cache)
+        # quantized values flatten [L, B, S, KV, W] -> [L, B, S, KV*W];
+        # scale/zero planes go [L, B, S, KV] -> [L, B, KV, S] (layouts:
+        # see init_cache)
         L, B = ks.shape[0], ks.shape[1]
         widen_q = lambda a: jnp.pad(a.reshape(L, B, s, -1),
                                     ((0, 0), (0, 0), (0, pad), (0, 0)))
         widen_s = lambda a: jnp.pad(a.transpose(0, 1, 3, 2),
                                     ((0, 0), (0, 0), (0, 0), (0, pad)))
-        kq, k_sc = quantize_kv(ks)
-        vq, v_sc = quantize_kv(vs)
+        kq, k_pl = kv_encode(cfg, ks)
+        vq, v_pl = kv_encode(cfg, vs)
         cache = {"k": widen_q(kq), "v": widen_q(vq),
-                 "k_scale": widen_s(k_sc), "v_scale": widen_s(v_sc),
                  "len": seq_lens.astype(jnp.int32)}
+        for pl in kv_plane_names(cfg):
+            cache[f"k_{pl}"] = widen_s(k_pl[pl])
+            cache[f"v_{pl}"] = widen_s(v_pl[pl])
     else:
         cache = {"k": widen(ks), "v": widen(vs),
                  "len": seq_lens.astype(jnp.int32)}
@@ -671,6 +777,9 @@ def prefill_segment_into(params: dict, tokens: jnp.ndarray,
     from ..ops import (apply_rope, attention, dequantize_kv, quantize_kv,
                        repeat_kv, rms_norm, rope_table)
 
+    if cfg.kv_bits == 4:
+        raise ValueError("int4 KV is a paged-cache precision — use "
+                         "page_size > 0 (paged_suffix_prefill)")
     _, c = tokens.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     positions = start + jnp.arange(c)[None, :]            # [1, C]
@@ -744,6 +853,9 @@ def decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
     Rows may sit at different positions (continuous batching); each row
     writes its cache slot at its own ``len`` and attends to len+1 keys.
     """
+    if cfg.kv_bits == 4:
+        raise ValueError("int4 KV is a paged-cache precision — use "
+                         "page_size > 0 (paged_decode_step)")
     b = tokens.shape[0]
     pos = cache["len"]  # [B]
     x = params["embed"][tokens][:, None, :].astype(cfg.dtype)
@@ -786,22 +898,26 @@ def init_paged_cache(cfg: LlamaConfig, batch: int, n_pages: int,
     scratch: unallocated table entries point at it, over-capacity writes
     land there harmlessly, and kv_len masking keeps reads out.
 
-    kv_quant composes: int8 page values stay FLAT [L, N, ps, KV*D] and
-    scales ride page-shaped [L, N, KV, ps] (the same tiling rationale as
-    the dense int8 layout) — the two memory levers multiply: half the
-    bytes per token AND pages shared across slots.
+    kv_quant composes: quantized page values stay FLAT [L, N, ps, KV*W]
+    (W = head_dim for int8, head_dim/2 for packed int4) and the per-page
+    scale — plus zero, at int4 — planes ride page-shaped [L, N, KV, ps]
+    (the same tiling rationale as the dense int8 layout) — the memory
+    levers multiply: half (int8) or a quarter (int4) of the value bytes
+    per token AND pages shared across slots.
     """
     if cfg.kv_quant:
         flat = (cfg.n_layers, n_pages, page_s,
-                cfg.n_kv_heads * cfg.head_dim)
+                cfg.n_kv_heads * kv_store_width(cfg))
         scale_shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page_s)
-        return {
-            "k": jnp.zeros(flat, jnp.int8),
-            "v": jnp.zeros(flat, jnp.int8),
-            "k_scale": jnp.zeros(scale_shape, jnp.bfloat16),
-            "v_scale": jnp.zeros(scale_shape, jnp.bfloat16),
-            "len": jnp.zeros((batch,), jnp.int32),
+        cache = {
+            "k": jnp.zeros(flat, _kv_value_dtype(cfg)),
+            "v": jnp.zeros(flat, _kv_value_dtype(cfg)),
         }
+        for pl in kv_plane_names(cfg):
+            cache[f"k_{pl}"] = jnp.zeros(scale_shape, jnp.bfloat16)
+            cache[f"v_{pl}"] = jnp.zeros(scale_shape, jnp.bfloat16)
+        cache["len"] = jnp.zeros((batch,), jnp.int32)
+        return cache
     shape = (cfg.n_layers, n_pages, page_s, cfg.n_kv_heads, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
@@ -823,7 +939,7 @@ def paged_prefill_into(params: dict, tokens: jnp.ndarray,
     n_pg = tokens.shape[1] // page_s
     for j in range(n_pg):  # static unroll: one page-sized slab per write
         for key in arrays:
-            if key.endswith("_scale"):  # int8 scales: [L, B, KV, S]
+            if key.endswith(("_scale", "_zero")):  # planes: [L, B, KV, S]
                 slab = filled[key][:, 0, :, j * page_s:(j + 1) * page_s]
             else:                       # values: [L, B, S, ...]
                 slab = filled[key][:, 0, j * page_s:(j + 1) * page_s]
@@ -875,30 +991,32 @@ def paged_suffix_prefill(params: dict, tokens: jnp.ndarray,
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if cfg.kv_quant:
-            kq, k_sc = quantize_kv(k[0])     # [S, KV, hd] -> sc [S, KV]
-            vq, v_sc = quantize_kv(v[0])
+            kq, k_pl = kv_encode(cfg, k[0])  # [S, KV, W] + planes [S, KV]
+            vq, v_pl = kv_encode(cfg, v[0])
+            w_kv = kq.shape[-1]
             kv_i = jnp.arange(KV)[None, :]
-            arrays = {
-                "k": arrays["k"].at[layer, page, off].set(
-                    kq.reshape(s, KV * hd)),
-                "v": arrays["v"].at[layer, page, off].set(
-                    vq.reshape(s, KV * hd)),
-                "k_scale": arrays["k_scale"].at[
-                    layer, page[:, None], kv_i, off[:, None]].set(k_sc),
-                "v_scale": arrays["v_scale"].at[
-                    layer, page[:, None], kv_i, off[:, None]].set(v_sc),
-            }
+            arrays = dict(arrays)
+            arrays["k"] = arrays["k"].at[layer, page, off].set(
+                kq.reshape(s, KV * w_kv))
+            arrays["v"] = arrays["v"].at[layer, page, off].set(
+                vq.reshape(s, KV * w_kv))
+            for base, planes in (("k", k_pl), ("v", v_pl)):
+                for pl, val in planes.items():
+                    key = f"{base}_{pl}"
+                    arrays[key] = arrays[key].at[
+                        layer, page[:, None], kv_i, off[:, None]].set(val)
 
             def virt(name):
                 q8 = jnp.take(jax.lax.dynamic_index_in_dim(
                     arrays[name], layer, 0, keepdims=False),
-                    table_row, axis=0)
-                sc = jnp.take(jax.lax.dynamic_index_in_dim(
-                    arrays[name + "_scale"], layer, 0, keepdims=False),
-                    table_row, axis=0)              # [P, KV, ps]
-                q8 = q8.reshape(1, -1, KV, hd)
-                sc = jnp.swapaxes(sc, -1, -2).reshape(1, -1, KV)
-                return dequantize_kv(q8, sc, cfg.dtype)
+                    table_row, axis=0).reshape(1, -1, KV, w_kv)
+                planes = {}
+                for pl in kv_plane_names(cfg):
+                    p = jnp.take(jax.lax.dynamic_index_in_dim(
+                        arrays[f"{name}_{pl}"], layer, 0, keepdims=False),
+                        table_row, axis=0)          # [P, KV, ps]
+                    planes[pl] = jnp.swapaxes(p, -1, -2).reshape(1, -1, KV)
+                return kv_decode(cfg, q8, planes, cfg.dtype)
 
             k_virt, v_virt = virt("k"), virt("v")
         else:
@@ -971,28 +1089,31 @@ def paged_decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if cfg.kv_quant:
-            kq, k_sc = quantize_kv(k[:, 0])          # [B, KV, hd], [B, KV]
-            vq, v_sc = quantize_kv(v[:, 0])
-            arrays = {
-                "k": arrays["k"].at[layer, page, off].set(
-                    kq.reshape(b, KV * hd)),
-                "v": arrays["v"].at[layer, page, off].set(
-                    vq.reshape(b, KV * hd)),
-                "k_scale": arrays["k_scale"].at[
-                    layer, page[:, None], kv_idx, off[:, None]].set(k_sc),
-                "v_scale": arrays["v_scale"].at[
-                    layer, page[:, None], kv_idx, off[:, None]].set(v_sc),
-            }
+            kq, k_pl = kv_encode(cfg, k[:, 0])  # [B, KV, W] + [B, KV]
+            vq, v_pl = kv_encode(cfg, v[:, 0])
+            w_kv = kq.shape[-1]
+            arrays = dict(arrays)
+            arrays["k"] = arrays["k"].at[layer, page, off].set(
+                kq.reshape(b, KV * w_kv))
+            arrays["v"] = arrays["v"].at[layer, page, off].set(
+                vq.reshape(b, KV * w_kv))
+            for base, planes in (("k", k_pl), ("v", v_pl)):
+                for pl, val in planes.items():
+                    key = f"{base}_{pl}"
+                    arrays[key] = arrays[key].at[
+                        layer, page[:, None], kv_idx, off[:, None]].set(val)
 
             def virt(name):
                 q8 = jnp.take(jax.lax.dynamic_index_in_dim(
                     arrays[name], layer, 0, keepdims=False), table, axis=0)
-                sc = jnp.take(jax.lax.dynamic_index_in_dim(
-                    arrays[name + "_scale"], layer, 0, keepdims=False),
-                    table, axis=0)                  # [B, P, KV, ps]
-                q8 = q8.reshape(b, -1, KV, hd)      # [B, P*ps, KV, hd]
-                sc = jnp.swapaxes(sc, -1, -2).reshape(b, -1, KV)
-                return dequantize_kv(q8, sc, cfg.dtype)
+                q8 = q8.reshape(b, -1, KV, w_kv)    # [B, P*ps, KV, W]
+                planes = {}
+                for pl in kv_plane_names(cfg):
+                    p = jnp.take(jax.lax.dynamic_index_in_dim(
+                        arrays[f"{name}_{pl}"], layer, 0, keepdims=False),
+                        table, axis=0)              # [B, P, KV, ps]
+                    planes[pl] = jnp.swapaxes(p, -1, -2).reshape(b, -1, KV)
+                return kv_decode(cfg, q8, planes, cfg.dtype)
 
             k_virt, v_virt = virt("k"), virt("v")
         else:
@@ -1069,32 +1190,34 @@ def paged_decode_window(params: dict, toks: jnp.ndarray, cache: dict,
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if cfg.kv_quant:
-            # int8 page layouts (init_paged_cache): values flat
-            # [L, N, ps, KV*D], scales [L, N, KV, ps]
-            kq, k_sc = quantize_kv(k)        # [B, W, KV, hd] -> [B, W, KV]
-            vq, v_sc = quantize_kv(v)
-            arrays = {
-                "k": arrays["k"].at[layer, page, off].set(
-                    kq.reshape(b, w, KV * hd)),
-                "v": arrays["v"].at[layer, page, off].set(
-                    vq.reshape(b, w, KV * hd)),
-                "k_scale": arrays["k_scale"].at[
-                    layer, page[:, :, None], kv_idx3,
-                    off[:, :, None]].set(k_sc),
-                "v_scale": arrays["v_scale"].at[
-                    layer, page[:, :, None], kv_idx3,
-                    off[:, :, None]].set(v_sc),
-            }
+            # quantized page layouts (init_paged_cache): values flat
+            # [L, N, ps, KV*W], scale/zero planes [L, N, KV, ps]
+            kq, k_pl = kv_encode(cfg, k)  # [B, W, KV, Wd] + [B, W, KV]
+            vq, v_pl = kv_encode(cfg, v)
+            w_kv = kq.shape[-1]
+            arrays = dict(arrays)
+            arrays["k"] = arrays["k"].at[layer, page, off].set(
+                kq.reshape(b, w, KV * w_kv))
+            arrays["v"] = arrays["v"].at[layer, page, off].set(
+                vq.reshape(b, w, KV * w_kv))
+            for base, planes in (("k", k_pl), ("v", v_pl)):
+                for pl, val in planes.items():
+                    key = f"{base}_{pl}"
+                    arrays[key] = arrays[key].at[
+                        layer, page[:, :, None], kv_idx3,
+                        off[:, :, None]].set(val)
 
             def virt(name):
                 q8 = jnp.take(jax.lax.dynamic_index_in_dim(
                     arrays[name], layer, 0, keepdims=False), table, axis=0)
-                sc = jnp.take(jax.lax.dynamic_index_in_dim(
-                    arrays[name + "_scale"], layer, 0, keepdims=False),
-                    table, axis=0)                  # [B, P, KV, ps]
-                q8 = q8.reshape(b, -1, KV, hd)      # [B, P*ps, KV, hd]
-                sc = jnp.swapaxes(sc, -1, -2).reshape(b, -1, KV)
-                return dequantize_kv(q8, sc, cfg.dtype)
+                q8 = q8.reshape(b, -1, KV, w_kv)    # [B, P*ps, KV, W]
+                planes = {}
+                for pl in kv_plane_names(cfg):
+                    p = jnp.take(jax.lax.dynamic_index_in_dim(
+                        arrays[f"{name}_{pl}"], layer, 0, keepdims=False),
+                        table, axis=0)              # [B, P, KV, ps]
+                    planes[pl] = jnp.swapaxes(p, -1, -2).reshape(b, -1, KV)
+                return kv_decode(cfg, q8, planes, cfg.dtype)
 
             k_virt, v_virt = virt("k"), virt("v")
         else:
@@ -1146,6 +1269,9 @@ def decode_window(params: dict, toks: jnp.ndarray, cache: dict,
                        repeat_kv, rms_norm, rope_table)
     from ..parallel import constrain
 
+    if cfg.kv_bits == 4:
+        raise ValueError("int4 KV is a paged-cache precision — use "
+                         "page_size > 0 (paged_decode_window)")
     b, w = toks.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     pos0 = cache["len"]                                   # [B]

@@ -138,7 +138,7 @@ def test_dispatch_recorder_record_math_and_top_stall():
     assert rec.snapshot()["dispatches"] == 1
     # the ring is bounded: 4 more commits roll the first record off
     for _ in range(4):
-        rec.note("dispatch", 0.001)
+        rec.note("launch", 0.001)
         rec.commit()
     snap = rec.snapshot()
     assert snap["dispatches"] == 5
@@ -175,14 +175,18 @@ def test_server_phase_breakdown_covers_step_wall(model, run):
     assert snap["attributed_share"] is not None
     assert snap["attributed_share"] >= 0.95
     assert snap["top_stall"] in ("queue_pop", "decide", "assemble",
-                                 "dispatch", "emit", "other")
+                                 "launch", "d2h_issue", "emit", "other")
     phases = snap["window"]["phases"]
-    assert phases["dispatch"]["s"] > 0  # a device dispatch really ran
+    # the old single "dispatch" phase is split: program launch and the
+    # async-D2H issue are separately attributable (the fusion A/B reads
+    # launch directly)
+    assert phases["launch"]["s"] > 0  # a device dispatch really ran
+    assert "d2h_issue" in phases
     assert sum(p["share"] for p in phases.values()) == pytest.approx(
         1.0, abs=0.01)
     text = metrics.expose_text()
     assert ('app_llm_dispatch_phase_seconds_count'
-            '{model="fr-phases",phase="dispatch"}') in text
+            '{model="fr-phases",phase="launch"}') in text
     # the generator shares the server's recorder instance
     assert server.gen.recorder is rec
 

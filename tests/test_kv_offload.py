@@ -160,6 +160,55 @@ def test_spill_restore_int8_pages(model):
     assert run(gen.restore_prefix(PFX)) == ref
 
 
+def test_spill_restore_int4_pages_and_byte_halving(model):
+    """GOFR_ML_KV_BITS=4 pages (packed values + scale/zero planes) ride
+    the same spill→restore path bit-identically, and the byte accounting
+    delivers the point of int4: page VALUE bytes exactly halve vs int8
+    (total page bytes well under int8's, the scale+zero planes being the
+    only overhead), both in the pool (pool_stats) and in the host tier
+    (the bytes the app_ml_kv_offload_bytes gauge publishes)."""
+    cfg4 = llama.tiny_llama(use_flash=False, kv_bits=4)
+    params4 = llama.init_params(cfg4, jax.random.PRNGKey(0))
+    store = _store()
+    gen = Generator(params4, cfg4, batch_slots=2, max_seq=64,
+                    prefill_buckets=(8, 16), page_size=4, n_pages=16,
+                    host_kv=store)
+    # byte halving: compare against an int8 pool of identical shape
+    # (construction only — pool_stats reads array avals, no dispatch)
+    cfg8 = llama.tiny_llama(use_flash=False, kv_quant=True)
+    gen8 = Generator(llama.init_params(cfg8, jax.random.PRNGKey(0)), cfg8,
+                     batch_slots=2, max_seq=64, prefill_buckets=(8, 16),
+                     page_size=4, n_pages=16)
+    s4, s8 = gen.pool_stats(), gen8.pool_stats()
+    assert s4["kv_bits"] == 4 and s8["kv_bits"] == 8
+    assert s4["page_value_bytes"] * 2 == s8["page_value_bytes"]
+    # total page bytes: the bf16 scale(+zero) planes are the only
+    # overhead — one plane entry per 16-wide vector here (tiny head_dim
+    # = 16 inflates their share ~4x vs a real head_dim of 64-128, where
+    # the total lands at ~0.52x int8)
+    assert s4["page_bytes"] < 0.70 * s8["page_bytes"]
+
+    pid = gen.register_prefix(PFX)
+
+    def run(prefix):
+        slot = gen.add_request([6, 2], 6, prefix=prefix)
+        while gen.slots[slot].live:
+            gen.step()
+        gen.drain()
+        toks = list(gen.slots[slot].tokens)
+        gen.release(slot)
+        return toks
+
+    ref = run(pid)
+    assert gen._reclaim_prefix_pages(len(gen._free_pages) + 2)
+    assert gen.has_offloaded(PFX)
+    # the spilled entry's host bytes = its 2 whole pages at int4 rates
+    assert store.bytes_used == 2 * s4["page_bytes"]
+    assert store.bytes_used < 0.70 * 2 * s8["page_bytes"]
+    assert run(gen.restore_prefix(PFX)) == ref  # bit-identical round trip
+    assert gen.kv_spills == 1 and gen.kv_restores == 1
+
+
 def test_borrowed_prefix_never_spilled(model):
     """refs > 0 prefixes are never eviction candidates, so their pages
     can never be mid-copy to the host while a slot still reads them."""

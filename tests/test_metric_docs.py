@@ -1,4 +1,5 @@
-"""Doc-drift guard for the serving metric inventory (tier-1, no jax).
+"""Doc-drift guard for the serving metric AND env-knob inventories
+(tier-1, no jax).
 
 Every ``app_ml_*`` / ``app_llm_*`` metric name that appears in
 ``gofr_tpu/`` must have a row in ``docs/tpu/observability.md`` — and
@@ -7,6 +8,12 @@ operator cannot look up is invisible; a documented metric that no longer
 exists sends an incident responder grepping for a ghost. The guard greps
 both sides, so adding a metric without its doc row (or deleting one
 without its row) fails tier-1 instead of rotting silently.
+
+The same contract covers the ``GOFR_ML_*`` env knobs: every knob the
+code reads must appear somewhere under ``docs/`` (operators discover
+knobs by reading docs, not source), and every knob the docs mention must
+still be read by the code (a documented knob that silently does nothing
+is worse than none).
 
 ``app_tpu_*`` gauges are device-runtime metrics with compound doc rows
 (e.g. ``app_tpu_hbm_bytes_in_use / ..._limit``) — out of scope here.
@@ -17,9 +24,11 @@ import re
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 DOC = REPO / "docs" / "tpu" / "observability.md"
+DOCS_DIR = REPO / "docs"
 # full metric names only: the char class excludes "*"/"…", so prose like
 # "registered app_ml_* metrics" can never register a phantom name
 NAME_RE = re.compile(r"app_(?:ml|llm)_[a-z0-9_]+")
+KNOB_RE = re.compile(r"GOFR_ML_[A-Z0-9_]+")
 # exposition suffixes are series of their base histogram, not metrics
 SUFFIXES = ("_bucket", "_sum", "_count")
 
@@ -55,3 +64,41 @@ def test_every_documented_metric_still_exists():
     assert not ghosts, (
         f"metrics documented in {DOC.relative_to(REPO)} but absent from "
         f"gofr_tpu/: {sorted(ghosts)} — delete the stale rows")
+
+
+# ------------------------------------------------- GOFR_ML_* env knobs
+def _knobs(text: str) -> set[str]:
+    # a trailing "_" is a line-wrap artifact (a name split across a
+    # docstring line), never a real knob — drop it rather than demand a
+    # phantom doc row
+    return {m for m in KNOB_RE.findall(text) if not m.endswith("_")}
+
+
+def _code_knobs() -> set[str]:
+    knobs: set[str] = set()
+    for path in (REPO / "gofr_tpu").rglob("*.py"):
+        knobs.update(_knobs(path.read_text()))
+    return knobs
+
+
+def _doc_knobs() -> set[str]:
+    knobs: set[str] = set()
+    for path in DOCS_DIR.rglob("*.md"):
+        knobs.update(_knobs(path.read_text()))
+    return knobs
+
+
+def test_every_env_knob_is_documented():
+    undocumented = _code_knobs() - _doc_knobs()
+    assert not undocumented, (
+        f"GOFR_ML_* knobs read by gofr_tpu/ but absent from docs/: "
+        f"{sorted(undocumented)} — operators discover knobs in the docs; "
+        f"add them (docs/tpu/llm-serving.md is the usual home)")
+
+
+def test_every_documented_env_knob_still_exists():
+    ghosts = _doc_knobs() - _code_knobs()
+    assert not ghosts, (
+        f"GOFR_ML_* knobs documented under docs/ but never read by "
+        f"gofr_tpu/: {sorted(ghosts)} — delete the stale mentions or "
+        f"wire the knob back up")

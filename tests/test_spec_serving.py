@@ -1,0 +1,212 @@
+"""Scheduler-integrated adaptive speculation (ISSUE 8 tentpole): loud
+env-knob validation, honest budget charging of verify windows, greedy
+bit-identity with speculation on vs off end-to-end through LLMServer
+(f32), per-slot auto-disable + re-probe with the plain-ladder fallback,
+and the speculation observability surface (`app_llm_spec_disabled_total`
++ the `/debug/serving` ``llms.<name>.speculation`` block)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gofr_tpu.ml.generate import Generator
+from gofr_tpu.ml.llm import LLMServer
+from gofr_tpu.ml.scheduler import TokenBudgetScheduler
+from gofr_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def model():
+    # f32: spec windows and plain steps compute logits through different
+    # program shapes; bit-identity of the argmax chain is exact in f32
+    # (bf16 rounding could flip near-ties between the two shapes)
+    cfg = llama.tiny_llama(use_flash=False, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+PROMPT = [5, 9, 2, 7, 1]
+
+
+def _run_gen(gen, prompt, n):
+    slot = gen.add_request(prompt, n)
+    while gen.slots[slot].live:
+        gen.step()
+    gen.drain()
+    out = list(gen.slots[slot].tokens)
+    gen.release(slot)
+    return out
+
+
+# -------------------------------------------------------- knob validation
+def test_env_knob_validation(model, monkeypatch):
+    """GOFR_ML_SPEC_K / GOFR_ML_SPEC_MIN_ACCEPT / GOFR_ML_SPEC_COOLDOWN /
+    GOFR_ML_KV_BITS fail LOUDLY at construction on malformed, negative,
+    nan or out-of-range values — the PR-6 drain/replicas pattern."""
+    cfg, params = model
+
+    def build(**kw):
+        return Generator(params, cfg, batch_slots=1, max_seq=32,
+                         prefill_buckets=(8,), **kw)
+
+    for bad in ("nope", "-1", "1.5"):
+        monkeypatch.setenv("GOFR_ML_SPEC_K", bad)
+        with pytest.raises(ValueError, match="GOFR_ML_SPEC_K"):
+            build()
+    monkeypatch.setenv("GOFR_ML_SPEC_K", "2")
+    assert build().spec_k == 2
+    monkeypatch.delenv("GOFR_ML_SPEC_K")
+
+    for bad in ("x", "-0.1", "1.5", "nan"):
+        monkeypatch.setenv("GOFR_ML_SPEC_MIN_ACCEPT", bad)
+        with pytest.raises(ValueError, match="GOFR_ML_SPEC_MIN_ACCEPT"):
+            build()
+    monkeypatch.setenv("GOFR_ML_SPEC_MIN_ACCEPT", "0.25")
+    assert build().spec_min_accept == 0.25
+    monkeypatch.delenv("GOFR_ML_SPEC_MIN_ACCEPT")
+
+    for bad in ("0", "-3", "soon"):
+        monkeypatch.setenv("GOFR_ML_SPEC_COOLDOWN", bad)
+        with pytest.raises(ValueError, match="GOFR_ML_SPEC_COOLDOWN"):
+            build()
+    monkeypatch.delenv("GOFR_ML_SPEC_COOLDOWN")
+
+    # KV precision: validated in the shared config boot path
+    for bad in ("3", "banana", "4.5"):
+        monkeypatch.setenv("GOFR_ML_KV_BITS", bad)
+        with pytest.raises(ValueError, match="GOFR_ML_KV_BITS"):
+            llama.config_from_env()
+    monkeypatch.setenv("GOFR_ML_KV_BITS", "4")
+    cfg4 = llama.config_from_env()
+    assert cfg4.kv_bits == 4 and cfg4.kv_quant
+    monkeypatch.setenv("GOFR_ML_KV_BITS", "16")
+    assert not llama.config_from_env().kv_quant
+    monkeypatch.delenv("GOFR_ML_KV_BITS")
+
+    # int4 is a paged precision: a dense generator rejects it at
+    # construction instead of mis-shaping the first dispatch
+    params4 = llama.init_params(
+        llama.tiny_llama(use_flash=False, kv_bits=4), jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="paged"):
+        Generator(params4, llama.tiny_llama(use_flash=False, kv_bits=4),
+                  batch_slots=1, max_seq=32, prefill_buckets=(8,))
+    with pytest.raises(ValueError):
+        llama.tiny_llama(use_flash=False, kv_bits=3)
+
+
+# ------------------------------------------------------- budget charging
+def test_plan_charges_spec_windows_as_k_plus_1():
+    """A verify window costs K+1 device positions per decodable row; the
+    scheduler's plan must shrink the window count accordingly instead of
+    pretending a window is one token."""
+    sched = TokenBudgetScheduler(64, (1, 2, 4, 8, 16), 0, slots=8)
+    assert sched.plan(8, False) == (8, 0)           # plain: 64/8 -> 8
+    size, _ = sched.plan(8, False, unit_tokens=4)   # spec K=3: 8*4=32/step
+    assert size == 2                                # 2*8*4 = 64 fits
+    assert sched.last_unit == 4
+    assert sched.snapshot()["last_unit"] == 4
+    # the floor under prefill pressure scales with the unit too
+    sched2 = TokenBudgetScheduler(256, (1, 2, 4, 8, 16), 16, slots=8)
+    size_plain, _ = sched2.plan(8, True)
+    size_spec, _ = sched2.plan(8, True, unit_tokens=4)
+    assert size_spec <= size_plain
+
+    # generator wiring: the auto budget scales by K+1 so spec steady
+    # state plans the same window count as the plain path's chunk count
+    # (constructor-only — no device programs run here)
+
+
+def test_auto_budget_scales_with_spec_k(model):
+    cfg, params = model
+    plain = Generator(params, cfg, batch_slots=2, max_seq=32,
+                      prefill_buckets=(8,), chunk=4)
+    spec = Generator(params, cfg, batch_slots=2, max_seq=32,
+                     prefill_buckets=(8,), chunk=4, spec_k=3)
+    assert spec.scheduler.budget == plain.scheduler.budget * 4
+    assert spec._plain_fns is not spec._chunk_fns  # both ladders kept
+
+
+# ------------------------------------------- end-to-end server identity
+def test_spec_on_off_identity_through_server(model, run):
+    """THE lossless contract, through the full async serving path: greedy
+    outputs with speculation on are token-identical to speculation off,
+    while the spec server demonstrably ran verify windows."""
+    cfg, params = model
+    prompts = [PROMPT, [3, 3, 4], [8, 1, 1, 2]]
+
+    async def scenario(spec_k):
+        gen = Generator(params, cfg, batch_slots=2, max_seq=64,
+                        prefill_buckets=(8,), chunk=2, spec_k=spec_k)
+        server = LLMServer(gen, name=f"spec-{spec_k}")
+        try:
+            import asyncio
+
+            outs = await asyncio.gather(
+                *[server.generate(p, 10) for p in prompts])
+            return outs, gen
+        finally:
+            server.close()
+
+    plain_out, _ = run(scenario(0))
+    spec_out, spec_gen = run(scenario(3))
+    assert plain_out == spec_out
+    assert spec_gen.spec_windows > 0
+    stats = spec_gen.spec_stats()
+    assert stats["spec_k"] == 3 and stats["mode"] == "lookup"
+    assert stats["windows"] == spec_gen.spec_windows
+
+
+# ---------------------------------- adaptive disable / re-probe + surface
+def test_auto_disable_reprobe_and_observability(model, run):
+    """A slot whose acceptance stays under GOFR_ML_SPEC_MIN_ACCEPT is
+    auto-disabled (degrading to plain decode, still bit-identical),
+    re-probes after the cooldown, the disable counter reaches the
+    metrics manager, and /debug/serving grows the speculation block."""
+    cfg, params = model
+    counts: dict = {}
+
+    class _Metrics:
+        def add_counter(self, name, delta, **labels):
+            counts[name] = counts.get(name, 0) + delta
+
+        def set_gauge(self, name, value, **labels):
+            pass
+
+        def record_histogram(self, name, value, **labels):
+            counts.setdefault("hist:" + name, 0)
+            counts["hist:" + name] += 1
+
+    from gofr_tpu.ml import MLDatasource
+
+    async def scenario():
+        ml = MLDatasource(metrics=_Metrics())
+        # min_accept=1.0 is unreachable for a random-weight draft source:
+        # every judging window disables; a short cooldown then re-probes
+        gen = Generator(params, cfg, batch_slots=2, max_seq=160,
+                        prefill_buckets=(8,), chunk=2, spec_k=3,
+                        spec_min_accept=1.0, spec_cooldown=4)
+        server = ml.register_llm("adapt", None, None, generator=gen)
+        try:
+            out = await server.generate(PROMPT, 120)
+            snap = ml.serving_snapshot()["llms"]["adapt"]
+            return out, gen, snap
+        finally:
+            server.close()
+
+    out, gen, snap = run(scenario())
+    assert gen.spec_disables >= 1, "the floor never tripped"
+    assert gen.spec_reprobes >= 1, "cooldown expiry never re-armed"
+    assert counts.get("app_llm_spec_disabled_total", 0) == gen.spec_disables
+    spec = snap["speculation"]
+    assert spec["min_accept"] == 1.0
+    assert spec["disables_total"] == gen.spec_disables
+    assert spec["reprobes_total"] == gen.spec_reprobes
+    assert spec["plain_fallback_armed"] is True
+    assert {"spec_k", "mode", "windows", "emitted", "accept_rate",
+            "disabled_slots", "cooldown_windows"} <= set(spec)
+
+    # lossless even through disable->plain-fallback->re-probe cycles:
+    # compare against a plain boot of the same shape
+    plain = Generator(params, cfg, batch_slots=2, max_seq=160,
+                      prefill_buckets=(8,), chunk=2)
+    assert out == _run_gen(plain, PROMPT, 120)
