@@ -1157,6 +1157,166 @@ async def main() -> None:
             "grid": grid,
         }
 
+    # ---- phase J: disaggregated prefill/decode A/B ----------------------
+    # 2-replica pool, mixed load: STEADY short-prompt decode streams (the
+    # TPOT side) + an open-loop burst of heavy prompts (the TTFT side).
+    # Disagg ON routes the heavy prompts to the prefill-biased replica,
+    # ships their prefix KV through the transport, and decodes them
+    # suffix-only on the decode replica — prompt bursts stop competing
+    # with steady decode for one token budget. Reports burst TTFT
+    # p50/p99, steady TPOT p99 + tok/s, the ships/lands ledger from
+    # /debug/serving, and greedy token identity across the two boots.
+    # Skipped under the headline watchdog budget unless
+    # BENCH_DISAGG_ARM=1 (bench/run_all.py sets it).
+    disagg_arm = None
+    if os.environ.get("BENCH_DISAGG_ARM",
+                      "0" if skip_jitter else "1") == "1":
+        window_j = float(os.environ.get("BENCH_DISAGG_WINDOW_S", "1.6"))
+        reps_j = int(os.environ.get("BENCH_DISAGG_REPS", "2"))
+        page_j = os.environ.get("BENCH_DISAGG_PAGE",
+                                "16" if on_tpu else "8")
+        steady_new_j = int(os.environ.get("BENCH_DISAGG_STEADY_NEW",
+                                          "128" if on_tpu else "24"))
+        long_j = int(os.environ.get("BENCH_DISAGG_LONG",
+                                    str(long_len) if on_tpu else "32"))
+        streams_j = int(os.environ.get("BENCH_DISAGG_STREAMS",
+                                       "8" if on_tpu else "2"))
+        ident_prompt_j = rng.integers(1, vocab_hi, (long_j,)).tolist()
+
+        async def disagg_window(gen_fn) -> dict:
+            """One time-bounded mixed-load window: steady decode streams
+            measured for tok/s AND per-token cadence (TPOT), while heavy
+            prompts arrive open-loop and their first-token latency is
+            probed."""
+            stop = asyncio.Event()
+            steady_tokens = [0]
+            tpot_gaps: list[float] = []
+            burst_ttfts: list[float] = []
+            long_done = [0]
+
+            async def steady_loop():
+                while not stop.is_set():
+                    last = None
+                    async for msg in gen_fn(req(steady_new_j)):
+                        now = time.perf_counter()
+                        n = n_toks(msg)
+                        if last is not None and n:
+                            tpot_gaps.append((now - last) / n)
+                        last = now
+                        steady_tokens[0] += n
+                        if stop.is_set():
+                            break
+
+            async def one_long():
+                body = {"prompt_ids": rng.integers(
+                            1, vocab_hi, (long_j,)).tolist(),
+                        "max_new_tokens": 8}
+                t1 = time.perf_counter()
+                async for _ in gen_fn(body):
+                    burst_ttfts.append(time.perf_counter() - t1)
+                    break
+                long_done[0] += 1
+
+            async def long_loop():
+                pending = []
+                while not stop.is_set():
+                    pending.append(asyncio.create_task(one_long()))
+                    await asyncio.sleep(0.08)
+                for t in pending:
+                    t.cancel()
+                await asyncio.gather(*pending, return_exceptions=True)
+
+            steady = [asyncio.create_task(steady_loop())
+                      for _ in range(streams_j)]
+            longs = [asyncio.create_task(long_loop())]
+            t0 = time.perf_counter()
+            try:
+                await asyncio.sleep(window_j)
+            finally:
+                window = time.perf_counter() - t0
+                stop.set()
+                for t in steady + longs:
+                    t.cancel()
+                await asyncio.gather(*steady, *longs,
+                                     return_exceptions=True)
+            return {
+                "burst_p50_ttft_ms": round(
+                    percentile(burst_ttfts, 50) * 1e3, 1),
+                "burst_p99_ttft_ms": round(
+                    percentile(burst_ttfts, 99) * 1e3, 1),
+                "steady_tpot_p99_ms": round(
+                    percentile(tpot_gaps, 99) * 1e3, 2),
+                "steady_tok_s": round(steady_tokens[0] / window, 1),
+                "bursts_served": long_done[0],
+            }
+
+        armsJ: dict = {}
+        ident_j: dict = {}
+        for mode in ("off", "on"):
+            os.environ["GOFR_ML_REPLICAS"] = "2"
+            os.environ["LLM_PAGE_SIZE"] = page_j
+            os.environ["LLM_PREFILL_CHUNK"] = str(seg)
+            if mode == "on":
+                os.environ["GOFR_ML_DISAGG"] = "1"
+            appJ = chJ = None
+            try:
+                appJ = build_app()
+                await boot(appJ)
+                chJ = grpc.aio.insecure_channel(
+                    f"127.0.0.1:{ports['GRPC_PORT']}")
+                genJ = chJ.unary_stream(
+                    "/llm.Chat/Generate",
+                    request_serializer=lambda o: json.dumps(o).encode(),
+                    response_deserializer=lambda raw: (json.loads(raw)
+                                                       if raw else {}),
+                )
+                async for _ in genJ(req(4)):        # warm compiles
+                    pass
+                warm_long = {"prompt_ids": rng.integers(
+                                 1, vocab_hi, (long_j,)).tolist(),
+                             "max_new_tokens": 4}
+                async for _ in genJ(warm_long):     # warm heavy shapes
+                    pass
+                toks_j: list = []
+                async for msg in genJ({"prompt_ids": ident_prompt_j,
+                                       "max_new_tokens": 16}):
+                    toks_j.extend(msg.get("tokens", ()))
+                ident_j[mode] = toks_j
+                runs_j = [await disagg_window(genJ)
+                          for _ in range(reps_j)]
+                cell = max(runs_j, key=lambda r: r["steady_tok_s"])
+                entry = await _debug_llm(ports)
+                routing = entry.get("routing", {})
+                dis = routing.get("disagg") or {}
+                cell["ships"] = dis.get("ships")
+                cell["lands"] = dis.get("lands")
+                cell["transport_failures"] = dis.get("failures")
+                cell["prefill_replicas"] = dis.get("prefill_replicas")
+                cell["routed"] = routing.get("routed")
+                armsJ[mode] = cell
+            except Exception as exc:    # optional arm: record, don't abort
+                armsJ[mode] = {"error": str(exc)}
+            finally:
+                os.environ.pop("GOFR_ML_REPLICAS", None)
+                os.environ.pop("GOFR_ML_DISAGG", None)
+                os.environ.pop("LLM_PAGE_SIZE", None)
+                os.environ.pop("LLM_PREFILL_CHUNK", None)
+                if chJ is not None:
+                    await chJ.close()
+                if appJ is not None:
+                    await appJ.shutdown()
+        disagg_arm = {
+            "replicas": 2,
+            "page_size": int(page_j),
+            "burst_prompt_len": long_j,
+            "off": armsJ.get("off"),
+            "on": armsJ.get("on"),
+            # greedy probe across the two boots: the transport moves KV,
+            # never changes tokens
+            "tokens_identical": (ident_j.get("off") == ident_j.get("on")
+                                 if len(ident_j) == 2 else None),
+        }
+
     agg_tok_s = sum(token_counts) / elapsed
     emit(
         "llama_served_tok_per_s", agg_tok_s, "tok/s", 2000.0,
@@ -1214,6 +1374,11 @@ async def main() -> None:
             # (steady tok/s, step_ms, phases, accept rate, token identity)
             "speculation": (spec_arm if spec_arm is not None
                             else "skipped (headline budget)"),
+            # phase J: disaggregated prefill/decode — 2-replica disagg
+            # on/off under prompt-burst + steady-decode mixed load (burst
+            # TTFT, steady TPOT p99, ships/lands ledger, token identity)
+            "disagg": (disagg_arm if disagg_arm is not None
+                       else "skipped (headline budget)"),
             "preset": os.environ.get("LLAMA_PRESET", "tiny"),
             "backend": jax.default_backend(),
             "config": 4,

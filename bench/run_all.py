@@ -20,14 +20,14 @@ import time
 # phase F: the tiered-KV-cache offload-on-vs-off A/B; phase G: the
 # resilience fault-vs-clean A/B; phase H: the flight-recorder stall
 # breakdown + recorder-overhead A/B; phase I: the speculation x
-# KV-precision grid)
+# KV-precision grid; phase J: the disaggregated prefill/decode A/B)
 CONFIGS = [
     ("config1_echo.py", {}),
     ("config2_mnist.py", {}),
     ("config3_bert.py", {}),
     ("config4_llama.py", {"BENCH_SCHED_ARM": "1", "BENCH_OFFLOAD_ARM": "1",
                           "BENCH_FAULT_ARM": "1", "BENCH_STALL_ARM": "1",
-                          "BENCH_SPEC_ARM": "1"}),
+                          "BENCH_SPEC_ARM": "1", "BENCH_DISAGG_ARM": "1"}),
     ("config5_sdxl.py", {}),
     ("config6_compute.py", {}),
     ("config7_longcontext.py", {}),

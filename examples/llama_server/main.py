@@ -124,6 +124,11 @@ def main() -> gofr_tpu.App:
     spec_k = int(raw_spec) if raw_spec else spec_k_from_env()
     draft_params, draft_cfg = (llama.draft_from_env(cfg, params)
                                if spec_k else (None, None))
+    # LLM_DISAGG validates LOUDLY like GOFR_ML_DISAGG would: a typo'd
+    # value must not silently boot aggregated (and override the env)
+    raw_disagg = os.environ.get("LLM_DISAGG", "").strip()
+    if raw_disagg and raw_disagg not in ("0", "1"):
+        raise ValueError(f"LLM_DISAGG must be 0 or 1, got {raw_disagg!r}")
     app.register_llm(
         "chat", params, cfg,
         batch_slots=int(os.environ.get("LLM_SLOTS", "4")),
@@ -145,6 +150,12 @@ def main() -> gofr_tpu.App:
         prefill_chunk=int(os.environ.get("LLM_PREFILL_CHUNK", "0")),
         page_size=int(os.environ.get("LLM_PAGE_SIZE", "0")),
         n_pages=int(os.environ.get("LLM_PAGES", "0")) or None,
+        # LLM_DISAGG=1 (fallback: the framework-wide GOFR_ML_DISAGG knob,
+        # which the replica pool reads itself) with GOFR_ML_REPLICAS>=2:
+        # disaggregated prefill/decode over the KV transport — prompts
+        # prefill on prefill-biased replicas, pages ship, decode replicas
+        # admit suffix-only (paged generators only)
+        **({"disagg": raw_disagg == "1"} if raw_disagg else {}),
     )
 
     app.post("/generate", generate)

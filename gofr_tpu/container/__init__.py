@@ -147,6 +147,15 @@ class Container:
                       "on a cache hit")
         m.new_gauge("app_ml_kv_offload_bytes",
                     "bytes held by the host-RAM KV offload tier")
+        m.new_counter("app_ml_kv_transport_ships_total",
+                      "prefix KV page sets exported off a prefill replica "
+                      "by the disaggregated-serving KV transport")
+        m.new_counter("app_ml_kv_transport_lands_total",
+                      "transported prefix KV page sets landed in a decode "
+                      "replica's host tier")
+        m.new_counter("app_ml_kv_transport_bytes",
+                      "payload bytes moved by the KV transport "
+                      "(successful ships)")
         m.new_gauge("app_ml_host_rss_bytes",
                     "current process resident set size (the offload "
                     "tier's footprint lives here)")
@@ -188,8 +197,8 @@ class Container:
         m.new_histogram(
             "app_llm_dispatch_phase_seconds",
             "serving dispatch wall time per phase (flight recorder: "
-            "queue_pop / decide / assemble / dispatch / device_wait / "
-            "emit / route / other)",
+            "queue_pop / decide / assemble / launch / d2h_issue / "
+            "device_wait / emit / route / ship / land / other)",
             # phases run from microseconds (a scheduler plan) to a whole
             # device step — the default buckets' 1 ms floor would flatten
             # every host-side phase into one bucket

@@ -56,11 +56,15 @@ __all__ = ["PHASES", "DispatchRecorder", "EventLog", "CrashVault",
 # segments) and ``d2h_issue`` (issuing the async token prefetch) split
 # what used to be one ``dispatch`` phase, so the PR-7 "launch is ~59% of
 # step time" finding is directly attributable before/after the fusion
-# work. ``other`` is the honest remainder: wall time of a dispatch pass
-# no instrumented site claimed (host bookkeeping loops, GC, OS
-# scheduling).
+# work. ``ship`` (computing + spilling a prefix's KV pages out of a
+# prefill replica) and ``land`` (adopting transported pages into a
+# decode replica's host tier) are the disaggregated-serving KV-transport
+# phases (ml/kv_transport.py), stamped by the serving thread of the
+# replica doing that side of the handoff. ``other`` is the honest
+# remainder: wall time of a dispatch pass no instrumented site claimed
+# (host bookkeeping loops, GC, OS scheduling).
 PHASES = ("queue_pop", "decide", "assemble", "launch", "d2h_issue",
-          "device_wait", "emit", "route", "other")
+          "device_wait", "emit", "route", "ship", "land", "other")
 # phases that burn HOST time; ``device_wait`` is the one phase where the
 # host is merely blocked on device compute, so it never names a stall
 _HOST_PHASES = tuple(p for p in PHASES if p != "device_wait")
@@ -113,7 +117,8 @@ class DispatchRecorder:
         the serve loop's tail-flush commit, so idle passes that merely
         glanced at an empty queue never pollute the dispatch ring."""
         return any(k in self._pending
-                   for k in ("launch", "d2h_issue", "device_wait", "emit"))
+                   for k in ("launch", "d2h_issue", "device_wait", "emit",
+                             "ship", "land"))
 
     def note(self, phase: str, seconds: float) -> None:
         """Attribute ``seconds`` of the current pass to ``phase``.

@@ -143,7 +143,7 @@ class MLDatasource:
         # they ride separately instead of crashing Generator/LLMServer
         pool_kwargs = {
             k: gen_kwargs.pop(k)
-            for k in ("depth_per_replica", "affinity_min_tokens")
+            for k in ("depth_per_replica", "affinity_min_tokens", "disagg")
             if k in gen_kwargs
         }
         explicit = (replicas is not None
@@ -190,6 +190,19 @@ class MLDatasource:
                 if warm:
                     # startup pays every compile, not a request
                     gens[0].warmup()
+        if len(gens) == 1:
+            from .replica import disagg_from_env
+
+            disagg_req = pool_kwargs.get("disagg")
+            if disagg_req is None:
+                disagg_req = disagg_from_env()
+            if disagg_req:
+                # disagg with one replica cannot separate anything: fail
+                # loudly at startup, not silently single-server during
+                # the prompt burst the operator configured it to survive
+                raise ValueError(
+                    f"llm {name}: disaggregated prefill/decode "
+                    f"(GOFR_ML_DISAGG/disagg=) requires replicas >= 2")
         if len(gens) > 1:
             server = ReplicaPool(gens, name=name, logger=self._logger,
                                  metrics=self._metrics, tracer=self._tracer,

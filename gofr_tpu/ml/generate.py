@@ -1071,21 +1071,33 @@ class Generator:
         if shared_len:
             bucket = next((b for b in self.prefill_buckets
                            if shared_len <= b), None)
-            if bucket is None:
+            if bucket is None and not self.prefill_chunk:
                 for pg in pages:
                     self._free_pages.append(pg)
                 raise ValueError(
                     f"prefix length {shared_len} exceeds the largest "
-                    f"prefill bucket {self.prefill_buckets[-1]}")
+                    f"prefill bucket {self.prefill_buckets[-1]} (set "
+                    f"prefill_chunk to register long prefixes in segments)")
             row = np.zeros((self._p_max,), np.int32)
             row[:n_need] = pages
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :shared_len] = ids[:shared_len]
+            # bucket None (prefix longer than every bucket, chunked
+            # prefill armed): the prefix KV builds in LARGEST-BUCKET
+            # segments through the same suffix-prefill program — the
+            # chunked-prefill ladder applied to registration, so a
+            # disaggregated prefill replica can compute KV for prompts
+            # no single prefill program covers
+            seg_cap = bucket if bucket is not None \
+                else self.prefill_buckets[-1]
             with self._mesh_ctx():
-                _logits, self.cache = self._prefix_prefill(
-                    self.params, toks, np.array([shared_len], np.int32),
-                    self.cache, row, np.int32(0), np.int32(0),
-                )
+                for off in range(0, shared_len, seg_cap):
+                    seg = ids[off:min(off + seg_cap, shared_len)]
+                    toks = np.zeros((1, seg_cap), np.int32)
+                    toks[0, :len(seg)] = seg
+                    _logits, self.cache = self._prefix_prefill(
+                        self.params, toks,
+                        np.array([len(seg)], np.int32),
+                        self.cache, row, np.int32(off), np.int32(0),
+                    )
             # the compute a restore avoids: re-registrations after a
             # discard land here, restores land in kv_restores instead
             self.prefix_prefills += 1

@@ -174,14 +174,18 @@ class HostKVStore:
             self._settle(e)
         return True
 
-    def put_back(self, key: tuple, arrays: dict, meta: dict) -> None:
+    def put_back(self, key: tuple, arrays: dict, meta: dict) -> bool:
         """Reinsert a popped (already settled) entry after a failed
         restore — as most-recently-used, so the very restore attempt that
-        failed doesn't make it the next LRU victim."""
+        failed doesn't make it the next LRU victim. False when the entry
+        alone exceeds the budget (dropped honestly, never evicted for)."""
         nbytes = _entry_nbytes(arrays)
         with self._lock:
             if nbytes > self.budget_bytes:
-                return  # oversize: drop it honestly, never evict for it
+                return False  # oversize: drop it, never evict for it
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.bytes_used -= old.nbytes
             while self._entries and self.bytes_used + nbytes > self.budget_bytes:
                 _, victim = self._entries.popitem(last=False)
                 self.bytes_used -= victim.nbytes
@@ -189,6 +193,28 @@ class HostKVStore:
             self._entries[key] = _Entry(arrays, dict(meta), nbytes,
                                         settled=True)
             self.bytes_used += nbytes
+        return True
+
+    # -- KV-transport handoff (ml/kv_transport.py) --------------------------
+    def take(self, key: tuple) -> tuple[dict, dict] | None:
+        """Remove and return ``(arrays, meta)`` for a TRANSPORT handoff —
+        settled numpy, like ``pop``, but without the restore accounting
+        (no ``restore`` event, no store hit): the pages are leaving this
+        replica, not coming back device-ward."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                return None
+            self.bytes_used -= entry.nbytes
+        self._settle(entry)
+        return entry.arrays, entry.meta
+
+    def receive(self, key: tuple, arrays: dict, meta: dict) -> bool:
+        """Land a TRANSPORTED entry (settled numpy slabs shipped from a
+        peer replica) as most-recently-used. Same budget contract as
+        ``put``: LRU entries make room, an entry larger than the whole
+        budget is rejected (the shipper falls back to full prefill)."""
+        return self.put_back(key, arrays, meta)
 
     @staticmethod
     def _settle(entry: _Entry) -> None:

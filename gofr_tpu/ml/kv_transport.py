@@ -1,0 +1,247 @@
+"""KV transport: move whole-prefix KV pages between serving replicas.
+
+The missing piece between PR 4's tiered KV cache and PR 6's replica pool.
+DistServe showed that putting prefill and decode on the SAME worker makes
+them fight for one token budget — a heavy prompt burst degrades every
+stream's TPOT no matter how a scheduler splits the budget — and Mooncake
+showed the practical cure: make the KV cache itself the thing that moves,
+so dedicated prefill workers compute KV and ship the pages to decode
+workers that only ever spend their budget on tokens.
+
+This stack already had both halves of the primitive:
+
+- a prefill replica can compute a prefix's KV pages once
+  (``Generator.register_prefix`` — now in chunked-ladder segments for
+  prefixes longer than any single prefill program) and spill them
+  device→host as settled numpy slabs (``drop_prefix(spill=True)`` through
+  ``kv_offload.HostKVStore``), bit-identically at fp/int8/int4;
+- a decode replica can restore exactly such slabs with one batched
+  ``device_put`` + donated scatter (``Generator.restore_prefix``), charge
+  the restore to its token-budget scheduler as repayable debt, and admit
+  the request suffix-only — with ``PrefixEvicted``-style full-prefill
+  fallback when anything goes missing.
+
+``KVTransport`` is the connection:
+
+- **In-process** (replicas in one process — the replica pool's layout):
+  ``ship`` takes the spilled entry out of the source replica's host store
+  (``HostKVStore.take`` — no restore accounting; the pages are leaving)
+  and lands it in the destination replica's store
+  (``LLMServer.import_prefix_kv`` → ``HostKVStore.receive`` + a radix-trie
+  adoption so the next matching prompt restores it at admission). The
+  numpy slabs move **by reference** — a zero-copy handoff through the
+  shared host tier.
+- **Cross-host**: ``encode_entry``/``decode_entry`` pack the slabs into
+  one raw-bytes blob (JSON header + contiguous array payloads) that rides
+  ``ml/multihost.py``'s new binary frame (``send_bytes``) — raw bytes on
+  the wire instead of +33% base64 inside a JSON frame. ``ship_bytes`` /
+  ``land_bytes`` are the socket-facing halves of ``ship``.
+
+Failure semantics are inherited, not invented: any export/land failure —
+an armed ``ship``/``land`` fault, a dead replica, an over-budget entry, a
+pool too tight to register — makes ``ship`` return ``None`` and the
+caller (the replica pool's disaggregated router) simply routes the
+request for a FULL prefill on a decode replica. Bit-identity holds
+end-to-end because every hop (prefix prefill, spill, wire round-trip,
+restore, suffix prefill) is bit-exact at every KV precision.
+
+Observability: counters ``app_ml_kv_transport_ships_total`` /
+``app_ml_kv_transport_lands_total`` / ``app_ml_kv_transport_bytes``,
+typed ``kv_ship``/``kv_land`` events in the fleet event log, and
+``ship``/``land`` phases in the dispatch flight recorder (stamped by the
+serving thread of the replica doing that side of the handoff).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+from typing import Any
+
+import numpy as np
+
+from ..flight_recorder import event_log
+
+__all__ = ["KVTransport", "encode_entry", "decode_entry"]
+
+
+# -- wire codec (cross-host: rides multihost.send_bytes) ----------------------
+
+def encode_entry(key, arrays: dict, meta: dict) -> bytes:
+    """Pack one host-tier entry — ``(key, {name: ndarray}, meta)`` — into
+    a single raw-bytes blob: a length-prefixed JSON header (key, meta,
+    array names/dtypes/shapes) followed by each array's contiguous bytes
+    in header order. The values round-trip bit-exactly at any KV
+    precision (fp, int8, packed int4 + scale/zero planes): raw buffer
+    bytes, no re-quantization, no base64."""
+    names = list(arrays)
+    header = {
+        "key": [int(t) for t in key],
+        "meta": meta,
+        # dtype by NAME, not descriptor: ml_dtypes values (bf16 KV
+        # caches, fp8) stringify to an opaque void descriptor ("|V2")
+        # that cannot rebuild a dtype; their .name round-trips
+        "arrays": [{"name": n, "dtype": arrays[n].dtype.name,
+                    "shape": list(arrays[n].shape)} for n in names],
+    }
+    hraw = json.dumps(header).encode()
+    parts = [struct.pack(">I", len(hraw)), hraw]
+    parts.extend(np.ascontiguousarray(arrays[n]).tobytes() for n in names)
+    return b"".join(parts)
+
+
+def _dtype_by_name(name: str) -> np.dtype:
+    """``np.dtype`` from a dtype NAME, reaching into ``ml_dtypes`` for
+    the accelerator types plain numpy doesn't know (bfloat16, fp8)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def decode_entry(raw: bytes) -> tuple[tuple, dict, dict]:
+    """Inverse of ``encode_entry``: ``(key, arrays, meta)`` with each
+    array rebuilt zero-copy over the blob's buffer."""
+    (hlen,) = struct.unpack(">I", raw[:4])
+    header = json.loads(raw[4:4 + hlen])
+    arrays: dict = {}
+    off = 4 + hlen
+    for spec in header["arrays"]:
+        dtype = _dtype_by_name(spec["dtype"])
+        shape = tuple(spec["shape"])
+        nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+        arrays[spec["name"]] = np.frombuffer(
+            raw, dtype=dtype, count=nbytes // dtype.itemsize,
+            offset=off).reshape(shape)
+        off += nbytes
+    return tuple(header["key"]), arrays, header["meta"]
+
+
+class KVTransport:
+    """Whole-prefix KV page movement between replicas.
+
+    One instance per replica pool (constructed ONLY when disaggregated
+    mode is on — ``GOFR_ML_DISAGG`` unset never builds one). Thread-safe:
+    ``ship`` is called from per-request worker threads; counters are
+    lock-guarded and the heavy lifting runs on the source/destination
+    replicas' own serving threads (``export_prefix_kv`` /
+    ``import_prefix_kv``)."""
+
+    def __init__(self, *, name: str = "llm", metrics=None) -> None:
+        self.name = name
+        self._metrics = metrics
+        self._events = event_log()
+        self._lock = threading.Lock()
+        self.ships = 0          # entries successfully exported (pages left
+        self.lands = 0          # the prefill replica) / landed decode-side
+        self.failures = 0       # handoffs that fell back to full prefill
+        self.bytes_moved = 0    # payload bytes of successful ships
+
+    # -- in-process handoff (the replica pool's path) ------------------------
+    def ship(self, src: Any, dst: Any, prefix_ids,
+             timeout_s: float = 120.0) -> tuple | None:
+        """Compute ``prefix_ids``'s KV on the ``src`` serving core
+        (prefill replica), spill it through the host tier, and land the
+        settled pages in ``dst``'s host tier + radix trie (decode
+        replica). Returns the landed key, or ``None`` on ANY failure —
+        the caller falls back to a full prefill; nothing is ever left
+        half-landed (a lost entry just re-prefills)."""
+        try:
+            entry = src.export_prefix_kv(prefix_ids, timeout_s)
+        except Exception:
+            entry = None
+        if entry is None:
+            with self._lock:
+                self.failures += 1
+            return None
+        key, arrays, meta = entry
+        nbytes = sum(int(a.nbytes) for a in arrays.values())
+        with self._lock:
+            self.ships += 1
+            self.bytes_moved += nbytes
+        self._count("app_ml_kv_transport_ships_total", 1)
+        self._count("app_ml_kv_transport_bytes", nbytes)
+        self._events.emit("kv_ship", model=self.name, tokens=len(key),
+                          bytes=nbytes)
+        return self._land(dst, key, arrays, meta, timeout_s)
+
+    def _land(self, dst: Any, key: tuple, arrays: dict, meta: dict,
+              timeout_s: float) -> tuple | None:
+        try:
+            ok = dst.import_prefix_kv(key, arrays, meta, timeout_s)
+        except Exception:
+            ok = False
+        if not ok:
+            with self._lock:
+                self.failures += 1
+            return None
+        with self._lock:
+            self.lands += 1
+        self._count("app_ml_kv_transport_lands_total", 1)
+        self._events.emit("kv_land", model=self.name, tokens=len(key),
+                          bytes=sum(int(a.nbytes) for a in arrays.values()))
+        return key
+
+    # -- cross-host halves (ride multihost.send_bytes) -----------------------
+    def ship_bytes(self, src: Any, prefix_ids,
+                   timeout_s: float = 120.0) -> bytes | None:
+        """Export from ``src`` and encode for the wire (the sender half of
+        a cross-host ship; pair with ``multihost.send_bytes``)."""
+        try:
+            entry = src.export_prefix_kv(prefix_ids, timeout_s)
+        except Exception:
+            entry = None
+        if entry is None:
+            with self._lock:
+                self.failures += 1
+            return None
+        key, arrays, meta = entry
+        raw = encode_entry(key, arrays, meta)
+        with self._lock:
+            self.ships += 1
+            self.bytes_moved += len(raw)
+        self._count("app_ml_kv_transport_ships_total", 1)
+        self._count("app_ml_kv_transport_bytes", len(raw))
+        self._events.emit("kv_ship", model=self.name, tokens=len(key),
+                          bytes=len(raw))
+        return raw
+
+    def land_bytes(self, dst: Any, raw: bytes,
+                   timeout_s: float = 30.0) -> tuple | None:
+        """Decode a received binary frame and land it in ``dst`` (the
+        receiver half of a cross-host ship). A corrupt or truncated
+        frame counts as a failure and returns ``None`` — the receiver
+        falls back like every other lost handoff, it never crashes."""
+        try:
+            key, arrays, meta = decode_entry(raw)
+            # frombuffer views are read-only over the frame; the store
+            # hands these straight to device_put at restore time, which
+            # copies — but receive may outlive the frame, so own the
+            # bytes
+            arrays = {n: np.array(a) for n, a in arrays.items()}
+        except Exception:
+            with self._lock:
+                self.failures += 1
+            return None
+        return self._land(dst, key, arrays, meta, timeout_s)
+
+    # -- introspection -------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "ships": self.ships,
+                "lands": self.lands,
+                "failures": self.failures,
+                "bytes_moved": self.bytes_moved,
+            }
+
+    def _count(self, name: str, value: int) -> None:
+        if self._metrics is None:
+            return
+        try:
+            self._metrics.add_counter(name, value, model=self.name)
+        except Exception:
+            pass

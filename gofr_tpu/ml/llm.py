@@ -402,6 +402,38 @@ class LLMServer:
                 return
             work()
 
+    def _run_on_serving(self, work, timeout_s: float, what: str):
+        """Run ``work`` on the SERVING thread (the one thread allowed to
+        dispatch device programs) and relay its result/exception here.
+        The one mechanism behind register_prefix/drop_prefix and the KV
+        transport's export/import — it may wait one idle-poll interval
+        (<= 50 ms) plus whatever device work (and first-use compiles)
+        ``work`` itself dispatches."""
+        done = threading.Event()
+        box: dict = {}
+
+        def wrapped() -> None:
+            try:
+                box["out"] = work()
+            except Exception as exc:  # relayed to the caller below
+                box["err"] = exc
+            finally:
+                done.set()
+
+        if self._closed:
+            raise self._closed_error()
+        self._setup_q.put(wrapped)
+        deadline = time.monotonic() + timeout_s
+        while not done.wait(0.1):
+            if self._closed:  # serving thread gone: fail fast, not 120 s
+                raise self._closed_error()
+            if time.monotonic() > deadline:
+                raise DeadlineExceeded(
+                    f"{what} timed out after {timeout_s:g}s")
+        if "err" in box:
+            raise box["err"]
+        return box.get("out")
+
     def register_prefix(self, prefix_ids, timeout_s: float = 120.0) -> int:
         """PIN a shared prefix (system prompt): registered through the
         framework prefix cache when one is active, so the registration is
@@ -412,63 +444,97 @@ class LLMServer:
         id is only needed to guarantee residency. Thread-safe: the prefill
         runs on the serving thread (it may wait one idle-poll interval,
         <= 50 ms, plus the prefix compile on first use)."""
-        done = threading.Event()
-        box: dict = {}
+        def work() -> int:
+            if self.prefix_cache is not None:
+                return self.prefix_cache.pin(prefix_ids)
+            return self.gen.register_prefix(prefix_ids)
 
-        def work() -> None:
-            try:
-                if self.prefix_cache is not None:
-                    box["pid"] = self.prefix_cache.pin(prefix_ids)
-                else:
-                    box["pid"] = self.gen.register_prefix(prefix_ids)
-            except Exception as exc:  # relayed to the caller below
-                box["err"] = exc
-            finally:
-                done.set()
-
-        if self._closed:
-            raise self._closed_error()
-        self._setup_q.put(work)
-        deadline = time.monotonic() + timeout_s
-        while not done.wait(0.1):
-            if self._closed:  # serving thread gone: fail fast, not 120 s
-                raise self._closed_error()
-            if time.monotonic() > deadline:
-                raise DeadlineExceeded(
-                    f"register_prefix timed out after {timeout_s:g}s")
-        if "err" in box:
-            raise box["err"]
-        return box["pid"]
+        return self._run_on_serving(work, timeout_s, "register_prefix")
 
     def drop_prefix(self, pid: int, timeout_s: float = 30.0) -> None:
         """Release a registered prefix's pages (raises if slots still
         borrow them). Runs on the serving thread like register_prefix."""
-        done = threading.Event()
-        box: dict = {}
-
         def work() -> None:
-            try:
-                if self.prefix_cache is not None:
-                    self.prefix_cache.drop(pid)
-                else:
-                    self.gen.drop_prefix(pid)
-            except Exception as exc:
-                box["err"] = exc
-            finally:
-                done.set()
+            if self.prefix_cache is not None:
+                self.prefix_cache.drop(pid)
+            else:
+                self.gen.drop_prefix(pid)
 
-        if self._closed:
-            raise self._closed_error()
-        self._setup_q.put(work)
-        deadline = time.monotonic() + timeout_s
-        while not done.wait(0.1):
-            if self._closed:
-                raise self._closed_error()
-            if time.monotonic() > deadline:
-                raise DeadlineExceeded(
-                    f"drop_prefix timed out after {timeout_s:g}s")
-        if "err" in box:
-            raise box["err"]
+        self._run_on_serving(work, timeout_s, "drop_prefix")
+
+    # -- KV transport (ml/kv_transport.py): disaggregated prefill/decode -----
+    def export_prefix_kv(self, prefix_ids,
+                         timeout_s: float = 120.0) -> tuple | None:
+        """PREFILL-replica half of a KV-transport ship: compute the
+        prefix's KV pages (``register_prefix`` — chunked-ladder segments
+        for prefixes longer than any prefill bucket), spill them through
+        the host tier (``drop_prefix(spill=True)``), and take the settled
+        numpy slabs out of the store for the transport. Returns ``(key,
+        arrays, meta)`` or ``None`` when this core cannot ship (dense
+        cache, host tier off, nothing page-whole to share, pool too
+        tight, entry over the host budget) — the transport then falls
+        back to a full prefill on the decode replica. Runs on the serving
+        thread; the ``ship`` fault point and flight-recorder phase fire
+        there."""
+        def work() -> tuple | None:
+            gen = self.gen
+            if not getattr(gen, "page_size", 0) \
+                    or getattr(gen, "host_kv", None) is None:
+                return None
+            ids = tuple(int(t) for t in prefix_ids)
+            t0 = time.perf_counter()
+            try:
+                pid = gen.register_prefix(ids)
+            except (PagePoolExhausted, ValueError):
+                return None  # pool too tight / shape-impossible: fall back
+            try:
+                spilled = gen.drop_prefix(pid, spill=True)
+            except Exception:
+                # the spill path failed mid-handoff (e.g. an armed
+                # ``spill`` fault): the registration is still idle
+                # device-side — discard it so its pages return to the
+                # pool instead of parking until a reclaim pass
+                if gen.has_prefix(pid):
+                    gen.drop_prefix(pid)
+                raise
+            entry = gen.host_kv.take(ids) if spilled else None
+            if self._fault is not None:
+                self._fault("ship")  # chaos: pages lost mid-handoff
+            if self.recorder is not None:
+                self.recorder.note("ship", time.perf_counter() - t0)
+            if entry is None:
+                return None
+            return ids, entry[0], entry[1]
+
+        return self._run_on_serving(work, timeout_s, "export_prefix_kv")
+
+    def import_prefix_kv(self, key, arrays: dict, meta: dict,
+                         timeout_s: float = 30.0) -> bool:
+        """DECODE-replica half of a KV-transport ship: land the settled
+        slabs in this core's host tier and seed the radix trie with the
+        OFFLOADED node, so the next prompt longest-matching ``key``
+        restores the shipped pages at admission — suffix-only prefill,
+        restore debt charged to this core's token-budget scheduler
+        exactly like a local offload hit. False when the entry cannot
+        land (host tier off or the entry exceeds its budget). Runs on the
+        serving thread; the ``land`` fault point and flight-recorder
+        phase fire there."""
+        def work() -> bool:
+            gen = self.gen
+            if getattr(gen, "host_kv", None) is None:
+                return False
+            ids = tuple(int(t) for t in key)
+            t0 = time.perf_counter()
+            if self._fault is not None:
+                self._fault("land")  # chaos: arrival dropped on the floor
+            ok = gen.host_kv.receive(ids, arrays, dict(meta))
+            if ok and self.prefix_cache is not None:
+                self.prefix_cache.adopt_offloaded(ids)
+            if self.recorder is not None:
+                self.recorder.note("land", time.perf_counter() - t0)
+            return ok
+
+        return self._run_on_serving(work, timeout_s, "import_prefix_kv")
 
     def has_prefix(self, pid: int) -> bool:
         """False once the prefix was dropped or LRU-evicted under pool
@@ -575,9 +641,10 @@ class LLMServer:
         crash_id = self._capture_crash(exc)
         crash = GeneratorCrashed(
             f"generator dispatch failed ({type(exc).__name__}: {exc})")
-        for slot, req in list(self._active.items()):
-            self._reject(req, crash)
-            del self._active[slot]
+        # STATE TRANSITION BEFORE THE REJECTS: a rejected consumer wakes
+        # immediately (call_soon_threadsafe) and routinely reads
+        # ``health()`` — or /debug/serving — right away; flipping the
+        # state first means what it reads is never a stale ``serving``
         now = time.monotonic()
         with self._restart_lock:
             while (self._restart_times
@@ -590,6 +657,9 @@ class LLMServer:
             self._events.emit("dead", model=self.name, crash_id=crash_id,
                               restarts=self._restarts_total,
                               budget=self._max_restarts)
+            for slot, req in list(self._active.items()):
+                self._reject(req, crash)
+                del self._active[slot]
             if self._logger is not None:
                 try:
                     self._logger.error(
@@ -605,6 +675,9 @@ class LLMServer:
         # visible to routers for the whole rebuild: a replica pool skips a
         # ``recovering`` replica instead of queueing behind its re-warmup
         self._state = "recovering"
+        for slot, req in list(self._active.items()):
+            self._reject(req, crash)
+            del self._active[slot]
         t0 = time.perf_counter()
         try:
             invalidated = self.gen.recover()

@@ -51,7 +51,8 @@ from typing import Any, AsyncIterator, Iterable
 
 from .errors import GeneratorCrashed, ServerClosed
 
-__all__ = ["MultiHostWorker", "MultiHostLLMClient", "send_frame", "recv_frame"]
+__all__ = ["MultiHostWorker", "MultiHostLLMClient", "send_frame",
+           "recv_frame", "send_bytes"]
 
 _OP_STOP = 0
 
@@ -68,22 +69,50 @@ _OP_CANCEL = 3
 _OP_NOOP = 4  # heartbeat: keeps followers' broadcast wait from timing out
 
 
-# -- framed JSON over a socket (sync side: worker rank 0) ---------------------
+# -- framed JSON / raw bytes over a socket (sync side: worker rank 0) ---------
+#
+# Two frame types share one wire, distinguished by the top bit of the
+# 4-byte length prefix:
+#
+# - JSON frames (bit clear): exactly the original format, byte-for-byte —
+#   every existing peer keeps working unchanged.
+# - BINARY frames (bit set, ``send_bytes``): the payload is raw bytes.
+#   KV page slabs ride these (ml/kv_transport.py) — inside a JSON frame
+#   they would have to travel base64 at +33% wire cost plus an
+#   encode/decode copy on each side.
+#
+# The flag bit caps a single frame at 2 GiB, far past any KV page set
+# (and the old unflagged format could never legitimately produce a
+# length with the top bit set, so the formats cannot be confused).
+
+_BIN_FLAG = 0x8000_0000
+
 
 def send_frame(sock: socket.socket, obj: Any) -> None:
     raw = json.dumps(obj).encode()
     sock.sendall(struct.pack(">I", len(raw)) + raw)
 
 
+def send_bytes(sock: socket.socket, payload: bytes) -> None:
+    """Send one raw-bytes frame (received as ``bytes`` by ``recv_frame``)."""
+    if len(payload) >= _BIN_FLAG:
+        raise ValueError(
+            f"binary frame too large ({len(payload)} bytes; max 2 GiB)")
+    sock.sendall(struct.pack(">I", _BIN_FLAG | len(payload)) + payload)
+
+
 def recv_frame(sock: socket.socket) -> Any | None:
-    """None on EOF."""
+    """One frame: parsed JSON for JSON frames, ``bytes`` for binary
+    frames, ``None`` on EOF."""
     header = _recv_exact(sock, 4)
     if header is None:
         return None
     (size,) = struct.unpack(">I", header)
-    body = _recv_exact(sock, size)
+    body = _recv_exact(sock, size & ~_BIN_FLAG)
     if body is None:
         return None
+    if size & _BIN_FLAG:
+        return body
     return json.loads(body)
 
 

@@ -128,8 +128,13 @@ class RadixPrefixCache:
         # prefill needs ≥1 token beyond the shared pages)
         self._min_tokens = max(self.cfg.min_tokens, gen.page_size + 1)
         # prompts longer than the largest prefill bucket can never
-        # register whole — tracking beyond it only burns trie memory
-        self._track_cap = int(gen.prefill_buckets[-1])
+        # register whole — tracking beyond it only burns trie memory.
+        # With chunked prefill armed the generator registers long
+        # prefixes in segments (register_prefix), so the trie tracks to
+        # capacity and long-prompt prefixes stay promotable/adoptable.
+        self._track_cap = (int(gen.max_seq) - 1
+                           if getattr(gen, "prefill_chunk", 0)
+                           else int(gen.prefill_buckets[-1]))
         self._root = _Node((), None, 0)
         self._by_pid: dict[int, _Node] = {}
         self._n_nodes = 0
@@ -519,6 +524,34 @@ class RadixPrefixCache:
                 self._by_pid.pop(pid, None)
                 node.pid = None
                 node.reg_len = 0  # an explicit drop is not an eviction
+
+    def adopt_offloaded(self, key_ids) -> bool:
+        """A KV transport landed this prefix's pages in the generator's
+        HOST tier (ml/kv_transport.py): seed the trie with an OFFLOADED
+        node for the key, so the next prompt longest-matching it restores
+        the shipped pages at admission instead of re-prefilling — the
+        decode-side half of disaggregated prefill/decode. Runs on the
+        serving thread (the import path), same locking discipline as
+        ``observe``. False when the key cannot be tracked (too long for
+        the trie) or a device-resident registration already supersedes
+        it."""
+        ids = tuple(int(t) for t in key_ids)
+        if not ids or len(ids) > self._track_cap:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            path = self._insert(ids, now)
+            node = path[-1] if path and path[-1].depth == len(ids) else None
+            if node is None:
+                return False
+            if node.pid is not None:
+                if self.gen.has_prefix(node.pid):
+                    return False  # live device copy beats the host entry
+                self._by_pid.pop(node.pid, None)  # stale: supersede it
+                node.pid = None
+            node.offload_key = ids
+            node.reg_len = len(ids)
+        return True
 
     def invalidate(self, pid: int) -> None:
         """The generator evicted this pid under pool pressure (a

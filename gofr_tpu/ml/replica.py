@@ -61,15 +61,94 @@ from .errors import (DeadlineExceeded, GeneratorCrashed, Overloaded,
                      ServerClosed)
 from ..flight_recorder import event_log
 from .generate import PrefixEvicted
+from .kv_offload import HostKVStore, OffloadConfig
 from .llm import LLMServer, drain_s_from_env
-from .scheduler import (PRIORITIES, AgingPriorityQueue, normalize_priority,
-                        retry_after_s)
+from .scheduler import (PRIORITIES, AgingPriorityQueue, SLOController,
+                        normalize_priority, retry_after_s)
 
 __all__ = ["ReplicaPool", "split_devices", "build_replica_generators",
-           "replicas_from_env"]
+           "replicas_from_env", "disagg_from_env"]
 
 # health-state ordinal for the app_llm_replica_state gauge (alert on >= 2)
 _STATE_VALUE = {"serving": 0, "degraded": 1, "recovering": 2, "dead": 3}
+
+# _route's verdict for a prefill-stage request when NO live prefill-role
+# replica exists: stage 1 is skipped outright (the request full-prefills
+# on a decode replica) instead of parking behind replicas that will
+# never come back
+_SKIP_PREFILL = object()
+
+# host-tier budget armed per replica when disaggregated mode is on but
+# the operator left GOFR_ML_KV_HOST_BUDGET_MB unset: the transport moves
+# pages THROUGH the host tier, so a store must exist
+_DISAGG_DEFAULT_HOST_MB = 256.0
+
+
+def disagg_from_env() -> bool:
+    """``GOFR_ML_DISAGG`` as the disaggregated prefill/decode switch.
+    Unset/0 = off (the pool code path is byte-identical to the
+    non-disaggregated behavior); malformed values fail loudly at
+    startup, like ``GOFR_ML_REPLICAS``."""
+    raw = os.environ.get("GOFR_ML_DISAGG", "").strip()
+    if not raw or raw == "0":
+        return False
+    if raw == "1":
+        return True
+    raise ValueError(f"GOFR_ML_DISAGG must be 0 or 1, got {raw!r}")
+
+
+def _disagg_prefill_from_env(default: int) -> int:
+    """``GOFR_ML_DISAGG_PREFILL``: the INITIAL prefill-biased replica
+    count (the SLO controller steers it live from there). Defaults to
+    half the fleet, floor 1."""
+    raw = os.environ.get("GOFR_ML_DISAGG_PREFILL", "").strip()
+    if not raw:
+        return default
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"GOFR_ML_DISAGG_PREFILL must be an integer, got {raw!r}"
+        ) from None
+    if n < 1:
+        raise ValueError(f"GOFR_ML_DISAGG_PREFILL must be >= 1, got {n}")
+    return n
+
+
+class _RoleSteer:
+    """Prefill/decode role assignment, steered by the PR-3 SLO controller.
+
+    Duck-types the ``TokenBudgetScheduler`` share contract
+    (``prefill_share`` / ``set_share``) so ``scheduler.SLOController``
+    drives the fleet ROLE RATIO with the exact AIMD policy it applies to
+    a single core's budget split: fleet TPOT over target sheds a prefill
+    replica (multiplicative backoff — decode capacity recovers first),
+    fleet TTFT over target adds one (additive increase), both-in-target
+    drifts back toward the configured split. Roles are positional —
+    replicas ``[0, n_prefill)`` are prefill-biased — so a ratio change
+    re-roles one replica at a time, and in-flight ships still land (a
+    destination's host tier and radix trie don't care about its role).
+    Bounds: always >= 1 prefill and >= 1 decode replica."""
+
+    def __init__(self, n: int, n_prefill: int) -> None:
+        self.n = int(n)
+        self.n_prefill = min(max(1, int(n_prefill)), self.n - 1)
+        self.initial = self.n_prefill
+        self.changes = 0  # realized role-ratio transitions
+
+    def role(self, idx: int) -> str:
+        return "prefill" if idx < self.n_prefill else "decode"
+
+    @property
+    def prefill_share(self) -> float:
+        return self.n_prefill / self.n
+
+    def set_share(self, share: float) -> float:
+        want = min(self.n - 1, max(1, round(float(share) * self.n)))
+        if want != self.n_prefill:
+            self.n_prefill = want
+            self.changes += 1
+        return self.prefill_share
 
 
 def replicas_from_env(default: int = 1) -> int:
@@ -142,7 +221,7 @@ class _FrontRequest:
     __slots__ = ("prompt", "max_new", "priority", "enqueued_at",
                  "deadline_at", "n_tokens", "future", "loop", "prefix",
                  "attempts", "cancelled", "streamed", "routed_idx",
-                 "last_replica")
+                 "last_replica", "want_role", "kv_holder")
 
     def __init__(self, prompt, max_new: int, priority: int,
                  deadline_s: float, prefix: int | None) -> None:
@@ -164,6 +243,12 @@ class _FrontRequest:
         self.streamed = False         # a token reached the consumer
         self.routed_idx: int | None = None  # replica slot reserved for us
         self.last_replica: int | None = None  # avoid on reroute
+        # disaggregated mode (GOFR_ML_DISAGG): which routing stage this
+        # request is in ("prefill" while its KV computes on a prefill
+        # replica; None/"decode" otherwise) and which decode replica the
+        # transport landed its prefix pages on (route-affinity target)
+        self.want_role: str | None = None
+        self.kv_holder: int | None = None
 
 
 class ReplicaPool:
@@ -183,11 +268,40 @@ class ReplicaPool:
                  default_deadline_s: float | None = None,
                  depth_per_replica: int | None = None,
                  affinity_min_tokens: int | None = None,
-                 fault: Any = None, **server_kwargs) -> None:
+                 fault: Any = None, disagg: Any = None,
+                 **server_kwargs) -> None:
         generators = list(generators)
         if not generators:
             raise ValueError("a replica pool needs at least one generator")
         self.name = name
+        # -- disaggregated prefill/decode (ml/kv_transport.py) ---------------
+        # GOFR_ML_DISAGG=1 (or disagg=True) splits the fleet into
+        # prefill-biased and decode replicas over a KV transport; OFF is
+        # the default and constructs NOTHING — the pool code path stays
+        # byte-identical to the non-disaggregated behavior.
+        self._disagg = disagg_from_env() if disagg is None else bool(disagg)
+        self._transport = None
+        self._roles = None
+        self._role_ctl = None
+        self._ship_min = 0
+        if self._disagg:
+            if len(generators) < 2:
+                raise ValueError(
+                    "disaggregated prefill/decode needs >= 2 replicas "
+                    "(one prefill-biased + one decode)")
+            for idx, gen in enumerate(generators):
+                if not getattr(gen, "page_size", 0):
+                    raise ValueError(
+                        "disaggregated prefill/decode requires paged "
+                        f"generators (page_size > 0); replica {idx} is "
+                        "dense")
+                if getattr(gen, "host_kv", None) is None:
+                    # the transport moves pages THROUGH the host tier, so
+                    # every replica needs a store even when the operator
+                    # left plain offload off (GOFR_ML_KV_HOST_BUDGET_MB
+                    # unset/0) — armed at a serviceable default budget
+                    gen.host_kv = HostKVStore.from_env() or HostKVStore(
+                        OffloadConfig(budget_mb=_DISAGG_DEFAULT_HOST_MB))
         self._logger = logger
         self._metrics = metrics
         self._tracer = tracer   # ml.route spans (one per routing attempt)
@@ -246,6 +360,33 @@ class ReplicaPool:
                 default_deadline_s=0.0, **ck))
         self._capacity = [max(1, g.batch_slots) * depth for g in generators]
         self._outstanding = [0] * len(generators)
+        if self._disagg:
+            from .kv_transport import KVTransport
+
+            self._transport = KVTransport(name=name, metrics=metrics)
+            self._roles = _RoleSteer(
+                len(generators),
+                _disagg_prefill_from_env(max(1, len(generators) // 2)))
+            # the PR-3 SLO controller, LIFTED to the pool front: the same
+            # AIMD loop that steers a single core's prefill share now
+            # steers the fleet's prefill/decode ROLE RATIO from observed
+            # fleet TTFT/TPOT (same GOFR_ML_TTFT_TARGET_MS /
+            # GOFR_ML_TPOT_TARGET_MS targets)
+            self._role_ctl = SLOController(
+                self._roles,
+                ttft_target_s=float(
+                    os.environ.get("GOFR_ML_TTFT_TARGET_MS", "200")) / 1e3,
+                tpot_target_s=float(
+                    os.environ.get("GOFR_ML_TPOT_TARGET_MS", "50")) / 1e3,
+                neutral_share=self._roles.initial / len(generators))
+            # shortest prompt worth a prefill-stage ship: one whole page
+            # plus a non-empty decode-side suffix
+            self._ship_min = generators[0].page_size + 1
+            # the controller's sample windows are written by consumer
+            # coroutines on ANY loop/thread (the pool contract) and
+            # read/cleared by the dispatcher's maybe_update — serialize
+            # them (SLOController itself is single-thread by design)
+            self._role_obs_lock = threading.Lock()
         # fleet ready queue — priority classes + aging, exactly once
         self._queue = AgingPriorityQueue(
             aging_s=float(os.environ.get("GOFR_ML_PRIORITY_AGING_S", "2.0")))
@@ -367,6 +508,11 @@ class ReplicaPool:
                 return
             self._reap_queued()
             self._refresh_replicas()
+            if self._role_ctl is not None:
+                # disagg: re-steer the prefill/decode role ratio from the
+                # fleet TTFT/TPOT windows (interval-gated internally)
+                with self._role_obs_lock:
+                    self._role_ctl.maybe_update()
             self._pump()
 
     def _reap_queued(self) -> None:
@@ -490,6 +636,12 @@ class ReplicaPool:
                 # applies while it waits)
                 parked.append(fr)
                 continue
+            if picked is _SKIP_PREFILL:
+                # disagg stage 1 with no live prefill replica: tell the
+                # consumer to skip the stage (full prefill on a decode
+                # replica) — no slot reserved, no route accounting
+                self._resolve(fr, result=(None, "no_prefill"))
+                continue
             idx, reason = picked
             with self._lock:
                 if (fr.cancelled or fr.future is None or fr.future.done()):
@@ -553,6 +705,46 @@ class ReplicaPool:
             # PrefixEvicted contract at admission — the caller owns
             # re-registration
             return min(candidates, key=self._load), "least_loaded"
+        if self._disagg:
+            want = fr.want_role or "decode"
+            rolewise = [i for i in candidates
+                        if self._roles.role(i) == want]
+            if want == "prefill":
+                # stage 1: the prompt's KV computes on a prefill-biased
+                # replica. Busy prefill replicas park the request (their
+                # capacity frees within a prefill); a fleet with NO live
+                # prefill replica skips the stage outright.
+                if rolewise:
+                    return min(rolewise, key=self._load), "prefill"
+                if any(self._routable(i)
+                       and self._roles.role(i) == "prefill"
+                       for i in range(len(self.replicas))):
+                    return None
+                return _SKIP_PREFILL
+            if (fr.kv_holder is not None
+                    and fr.kv_holder != fr.last_replica):
+                # stage 2 with shipped pages: the decode replica holding
+                # them wins (restore beats re-prefill); if it is merely
+                # at capacity, wait for its slot — any other replica
+                # could only full-prefill
+                if fr.kv_holder in candidates:
+                    return fr.kv_holder, "affinity"
+                if self._routable(fr.kv_holder):
+                    return None
+                fr.kv_holder = None  # holder died: the pages died with it
+            if rolewise:
+                candidates = rolewise
+            elif any(self._routable(i)
+                     and self._roles.role(i) == "decode"
+                     for i in range(len(self.replicas))):
+                # decode replicas merely at capacity: wait for one
+                # instead of re-mixing decode work onto a prefill
+                # replica — which would reintroduce exactly the
+                # prefill/decode interference disagg exists to remove
+                return None
+            # else: no decode replica alive — roles are a bias, not a
+            # cage, so any routable replica serves (a degraded fleet
+            # keeps completing requests)
         best, best_len = None, 0
         for i in candidates:
             cache = self.replicas[i].prefix_cache
@@ -567,6 +759,83 @@ class ReplicaPool:
         pool = [i for i in candidates if i != fr.last_replica] or candidates
         return (min(pool, key=self._load),
                 "failover" if fr.attempts else "least_loaded")
+
+    # -- disaggregated prefill stage (GOFR_ML_DISAGG) -------------------------
+    def _ship_ids(self, prompt: list) -> list:
+        """The prefix actually shipped: the whole prompt, shaved one
+        token when page-aligned — the decode-side admission always needs
+        a non-empty suffix to prefill (mirrors the radix cache's
+        ``_reg_len_for`` rule)."""
+        ps = self.replicas[0].gen.page_size
+        return prompt[:-1] if ps > 1 and len(prompt) % ps == 0 else prompt
+
+    def _already_resident(self, prompt: list) -> bool:
+        """True when some live replica's radix trie already covers the
+        prefix a ship would compute — re-prefilling and re-shipping it
+        would pay two serving threads and a handoff to overwrite the
+        same key; stage 2's affinity routing finds the holder anyway.
+        (A just-shipped-but-not-yet-restored prefix is invisible to
+        ``peek`` and may re-ship once in that window — wasteful, never
+        wrong.)"""
+        want = len(self._ship_ids(prompt))
+        for i, core in enumerate(self.replicas):
+            cache = core.prefix_cache
+            if (cache is not None and self._routable(i)
+                    and cache.peek(prompt)[1] >= want):
+                return True
+        return False
+
+    def _pick_decode_dst(self, src_idx: int) -> int | None:
+        """The decode replica a ship targets: least-loaded live
+        decode-role replica (any live replica when none is decode-role —
+        a degraded fleet still lands pages somewhere useful)."""
+        with self._lock:
+            live = [i for i in range(len(self.replicas))
+                    if i != src_idx and self._routable(i)
+                    and self._roles.role(i) == "decode"]
+            if not live:
+                live = [i for i in range(len(self.replicas))
+                        if i != src_idx and self._routable(i)]
+            return min(live, key=self._load) if live else None
+
+    async def _disagg_prefill(self, fr: _FrontRequest) -> None:
+        """Disaggregated stage 1: route the request to a prefill-biased
+        replica, compute its prompt's whole-page prefix KV there, and
+        ship the pages to the decode replica stage 2 will admit on
+        (``fr.kv_holder``). EVERY failure — no live prefill replica, a
+        ``ship``/``land`` fault, a replica dying under the export, an
+        over-budget entry — leaves ``kv_holder`` unset and the request
+        simply full-prefills on a decode replica: the transport may lose
+        pages, never requests. Deadline/cancel semantics while queued for
+        this stage are the fleet queue's own (reaped typed, never
+        dispatched)."""
+        fr.want_role = "prefill"
+        try:
+            fr.future = fr.loop.create_future()
+            with self._lock:
+                if self._closed:
+                    raise self._closed_error()
+                fr.routed_idx = None
+                self._queue.push(fr)
+            self._kick()
+            idx, _reason = await self._await_routing(fr)
+            if idx is None:
+                return  # no live prefill replica: skip the stage
+            try:
+                dst = self._pick_decode_dst(idx)
+                if dst is not None:
+                    key = await asyncio.to_thread(
+                        self._transport.ship, self.replicas[idx],
+                        self.replicas[dst], self._ship_ids(fr.prompt))
+                    if key is not None:
+                        fr.kv_holder = dst
+            finally:
+                with self._lock:
+                    self._outstanding[idx] -= 1
+                    fr.routed_idx = None
+                self._kick()
+        finally:
+            fr.want_role = "decode"
 
     # -- fleet admission bounds / shedding ------------------------------------
     def _admit(self, fr: _FrontRequest) -> None:
@@ -666,6 +935,17 @@ class ReplicaPool:
         # trace end-to-end, with the failover visible as a span event
         ctx = current_context()
         try:
+            if (self._transport is not None and fr.prefix is None
+                    and fr.n_tokens >= self._ship_min
+                    and not self._already_resident(fr.prompt)):
+                # disagg stage 1: compute the prompt's prefix KV on a
+                # prefill replica and ship it to the decode replica the
+                # loop below will route to (full-prefill fallback on any
+                # transport failure). Explicitly-pinned prefixes and
+                # prompts whose prefix a live trie already holds skip
+                # the stage: their pages exist — affinity routes there.
+                await self._disagg_prefill(fr)
+            last_burst = None
             while True:
                 fr.future = fr.loop.create_future()
                 route_span = None
@@ -709,6 +989,20 @@ class ReplicaPool:
                             info=info, priority=fr.priority,
                             deadline_s=self._remaining(fr))
                         async for burst in agen:
+                            if self._role_ctl is not None and burst:
+                                # fleet latency samples for the role
+                                # controller: TTFT on the first burst,
+                                # per-token cadence after it
+                                now = time.perf_counter()
+                                with self._role_obs_lock:
+                                    if not fr.streamed:
+                                        self._role_ctl.observe_ttft(
+                                            now - fr.enqueued_at)
+                                    elif last_burst is not None:
+                                        self._role_ctl.observe_tpot(
+                                            (now - last_burst)
+                                            / len(burst))
+                                last_burst = now
                             fr.streamed = True
                             yield burst
                         with self._lock:
@@ -1008,6 +1302,18 @@ class ReplicaPool:
                 "default_deadline_s": self._default_deadline or None,
                 "fault": fault_snap,
                 "fault_replica": FaultInjector.armed_replica(),
+                # disaggregated prefill/decode: roles + the transport
+                # ledger (ships/lands/failures/bytes) + the lifted SLO
+                # controller's state; None whenever GOFR_ML_DISAGG is off
+                "disagg": (None if self._transport is None else {
+                    "prefill_replicas": self._roles.n_prefill,
+                    "roles": {str(i): self._roles.role(i)
+                              for i in range(len(self.replicas))},
+                    "role_changes": self._roles.changes,
+                    "ship_min_tokens": self._ship_min,
+                    "controller": self._role_ctl.snapshot(),
+                    **self._transport.snapshot(),
+                }),
             }
 
     def export_gauges(self, metrics) -> None:

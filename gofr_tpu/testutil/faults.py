@@ -18,6 +18,10 @@ Points (where the serving stack calls ``fire``):
 - ``restore``  — a host→device KV restore (Generator.restore_prefix)
 - ``emit``     — the token-burst callback into the serving layer
 - ``route``    — a ReplicaPool routing decision (ml/replica.py)
+- ``ship``     — a KV transport handoff out of a prefill replica
+  (ml/kv_transport.py; the pages are already off the source)
+- ``land``     — a KV transport arrival into a decode replica's host
+  tier (fired on the receiving serving thread, before the store insert)
 
 The injector only exists when the env var is set (``from_env`` returns
 ``None`` otherwise) and the instrumented call sites guard with an
@@ -42,7 +46,8 @@ import random
 __all__ = ["FAULT_POINTS", "FaultInjector", "InjectedFault",
            "fault_snapshot"]
 
-FAULT_POINTS = ("step", "prefill", "spill", "restore", "emit", "route")
+FAULT_POINTS = ("step", "prefill", "spill", "restore", "emit", "route",
+                "ship", "land")
 
 
 class InjectedFault(RuntimeError):

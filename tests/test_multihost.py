@@ -670,3 +670,58 @@ def test_four_rank_serving_and_rank_kill(tmp_path, run):
         run(scenario())
     finally:
         _teardown_workers(procs, logs, expect_ok=False)
+
+
+# -------------------------------------------------- wire framing (no mesh)
+def test_binary_frames_interleave_with_json():
+    """The model-port wire carries BOTH frame types on one socket: JSON
+    frames (unchanged format) parse to objects, binary frames
+    (``send_bytes`` — raw KV page slabs ride these, not +33% base64)
+    come back as the exact payload bytes, in order, however the two
+    interleave."""
+    import socket
+
+    from gofr_tpu.ml.multihost import recv_frame, send_bytes, send_frame
+
+    a, b = socket.socketpair()
+    try:
+        payload1 = bytes(range(256)) * 17     # not valid UTF-8/JSON
+        send_frame(a, {"op": "hello", "n": 1})
+        send_bytes(a, payload1)
+        send_frame(a, {"op": "mid", "xs": [1, 2, 3]})
+        send_bytes(a, b"")                    # empty binary frame is legal
+        send_frame(a, {"op": "bye"})
+        assert recv_frame(b) == {"op": "hello", "n": 1}
+        got = recv_frame(b)
+        assert isinstance(got, bytes) and got == payload1
+        assert recv_frame(b) == {"op": "mid", "xs": [1, 2, 3]}
+        got2 = recv_frame(b)
+        assert isinstance(got2, bytes) and got2 == b""
+        assert recv_frame(b) == {"op": "bye"}
+        a.close()
+        assert recv_frame(b) is None          # EOF contract unchanged
+    finally:
+        b.close()
+
+
+def test_json_frame_wire_format_unchanged():
+    """Wire compatibility: a JSON frame's bytes are EXACTLY the original
+    length-prefixed format — an old peer on the other end keeps working
+    — and the binary flag bit can never be confused with a JSON length."""
+    import socket
+
+    from gofr_tpu.ml.multihost import _BIN_FLAG, send_bytes, send_frame
+
+    a, b = socket.socketpair()
+    try:
+        obj = {"id": 7, "tokens": [1, 2, 3]}
+        send_frame(a, obj)
+        raw = json.dumps(obj).encode()
+        assert b.recv(4 + len(raw)) == struct.pack(">I", len(raw)) + raw
+        send_bytes(a, b"\x01\x02")
+        wire = b.recv(6)
+        (size,) = struct.unpack(">I", wire[:4])
+        assert size & _BIN_FLAG and size & ~_BIN_FLAG == 2
+    finally:
+        a.close()
+        b.close()
