@@ -162,6 +162,21 @@ async def _debug_stalls(ports, llm: str = "chat") -> dict:
         return {}
 
 
+async def _debug_requests(ports) -> dict:
+    """The /debug/requests journey summary (per-mark percentiles +
+    finish-reason mix) for the journey bench arm."""
+    import aiohttp
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.get(
+                f"http://127.0.0.1:{ports['HTTP_PORT']}/debug/requests")
+            body = await r.json()
+        return body["data"]
+    except Exception:
+        return {}
+
+
 async def _debug_llm(ports, llm: str = "chat") -> dict:
     """The whole per-LLM block of /debug/serving (speculation block,
     pool stats — the phase-I grid reads both)."""
@@ -905,13 +920,23 @@ async def main() -> None:
             return {"steady_tok_s": round(steady_tokens[0] / window, 1)}
 
         arms_h: dict = {}
-        # pin the knob explicitly PER ARM (an ambient operator-set
-        # GOFR_ML_FLIGHT_RECORDER=0 would otherwise turn the A/B into
-        # off-vs-off) and restore the operator's value afterwards
+        # pin BOTH observability knobs explicitly PER ARM (an ambient
+        # operator-set GOFR_ML_FLIGHT_RECORDER=0 / GOFR_ML_JOURNEY=0
+        # would otherwise turn the A/B into off-vs-off) and restore the
+        # operator's values afterwards. Three arms price the layers
+        # separately: recorder+journeys on (the shipped default),
+        # journeys off (the journey tracer's own cost), everything off
+        # (the PR-10-baseline floor the acceptance bound compares to).
         prior_rec_env = os.environ.get("GOFR_ML_FLIGHT_RECORDER")
-        for mode in ("recorder", "off"):
-            os.environ["GOFR_ML_FLIGHT_RECORDER"] = (
-                "1" if mode == "recorder" else "0")
+        prior_jrn_env = os.environ.get("GOFR_ML_JOURNEY")
+        for mode, rec_knob, jrn_knob in (("recorder", "1", None),
+                                         ("journeys_off", "1", "0"),
+                                         ("off", "0", "0")):
+            os.environ["GOFR_ML_FLIGHT_RECORDER"] = rec_knob
+            if jrn_knob is None:
+                os.environ.pop("GOFR_ML_JOURNEY", None)
+            else:
+                os.environ["GOFR_ML_JOURNEY"] = jrn_knob
             appH = chH = None
             try:
                 appH = build_app()
@@ -948,6 +973,20 @@ async def main() -> None:
                         "top_stall": stalls.get("top_stall"),
                         "attributed_share": stalls.get("attributed_share"),
                     })
+                    journeys = await _debug_requests(ports)
+                    if journeys.get("enabled"):
+                        # per-request attribution next to the per-dispatch
+                        # one: where the requests' wall actually went
+                        arm["journeys"] = {
+                            "finished": journeys.get("finished"),
+                            "wall": journeys.get("wall"),
+                            "marks": {
+                                name: p.get("p50_ms")
+                                for name, p in
+                                journeys.get("marks", {}).items()},
+                            "finish_reasons":
+                                journeys.get("finish_reasons"),
+                        }
                 arms_h[mode] = arm
             except Exception as exc:    # optional arm: record, don't abort
                 arms_h[mode] = {"error": str(exc)}
@@ -960,19 +999,31 @@ async def main() -> None:
             os.environ.pop("GOFR_ML_FLIGHT_RECORDER", None)
         else:
             os.environ["GOFR_ML_FLIGHT_RECORDER"] = prior_rec_env
+        if prior_jrn_env is None:
+            os.environ.pop("GOFR_ML_JOURNEY", None)
+        else:
+            os.environ["GOFR_ML_JOURNEY"] = prior_jrn_env
         rec_h, off_h = arms_h.get("recorder", {}), arms_h.get("off", {})
-        overhead = None
+        joff_h = arms_h.get("journeys_off", {})
+        overhead = journey_overhead = None
         if rec_h.get("steady_tok_s") and off_h.get("steady_tok_s"):
             overhead = round(
                 100.0 * (1 - rec_h["steady_tok_s"] / off_h["steady_tok_s"]),
                 2)
+        if rec_h.get("steady_tok_s") and joff_h.get("steady_tok_s"):
+            # the journey tracer's OWN cost: both-on vs recorder-only
+            journey_overhead = round(
+                100.0 * (1 - rec_h["steady_tok_s"]
+                         / joff_h["steady_tok_s"]), 2)
         stall_arm = {
             "long_prompt_len": long_h,
             "recorder": rec_h,
+            "journeys_off": joff_h,
             "recorder_off": off_h,
             # recorder-on vs recorder-off steady decode: the acceptance
             # bound is <= 2% (negative = measurement noise in our favor)
             "recorder_overhead_pct": overhead,
+            "journey_overhead_pct": journey_overhead,
         }
 
     # ---- phase I: speculative serving — spec x KV-precision grid --------

@@ -156,6 +156,14 @@ class Container:
         m.new_counter("app_ml_kv_transport_bytes",
                       "payload bytes moved by the KV transport "
                       "(successful ships)")
+        m.new_counter("app_ml_events_dropped_total",
+                      "fleet-event-log ring overwrites: events consumers "
+                      "polling /debug/events can no longer read (their "
+                      "cursor gapped)")
+        m.new_counter("app_ml_journeys_total",
+                      "request journeys sealed, by finish reason "
+                      "(stop / length / eviction / deadline / shed / "
+                      "crashed / cancelled / error)")
         m.new_gauge("app_ml_host_rss_bytes",
                     "current process resident set size (the offload "
                     "tier's footprint lives here)")

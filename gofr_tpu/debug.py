@@ -24,6 +24,12 @@ the server doing right now?". The TPU-native equivalents here:
   dies: the triggering event, the preceding fleet events, the scheduler
   and pool state, and the in-flight slot table — the postmortem without a
   live repro.
+- ``GET /debug/requests`` / ``GET /debug/requests/<rid>`` — the request
+  journey tracer (ml/journey.py): per-request lifecycle timelines whose
+  marks (route, ship/land, admit, prefill, decode, finish) sum to the
+  request wall. The index answers with per-mark duration percentiles
+  over the retained ring plus the failed/p99-slow exemplars; the rid
+  route returns one request's waterfall.
 """
 
 from __future__ import annotations
@@ -218,9 +224,41 @@ def register_debug_routes(app, aio_app: web.Application) -> None:
         if limit < 1:
             return web.json_response(
                 {"error": {"message": "limit must be >= 1"}}, status=400)
+        # kind= is multi-value: repeatable (?kind=a&kind=b) and/or
+        # comma-separated (?kind=a,b) — one incident query can follow a
+        # request across admit/route/shed without N polls
+        kinds = [k for raw in request.query.getall("kind", [])
+                 for k in raw.split(",") if k]
         return web.json_response({"data": event_log().query(
             since=since, model=request.query.get("model") or None,
-            kind=request.query.get("kind") or None, limit=limit)})
+            kind=tuple(kinds) or None,
+            rid=request.query.get("rid") or None, limit=limit)})
+
+    async def requests_handler(_: web.Request) -> web.Response:
+        from .ml.journey import journey_log
+
+        log = journey_log()
+        if log is None:
+            return web.json_response(
+                {"data": {"enabled": False,
+                          "reason": "GOFR_ML_JOURNEY=0"}})
+        data = log.snapshot()
+        data["enabled"] = True
+        return web.json_response({"data": data})
+
+    async def request_handler(request: web.Request) -> web.Response:
+        from .ml.journey import journey_log
+
+        log = journey_log()
+        rid = request.match_info["rid"]
+        journey = log.get(rid) if log is not None else None
+        if journey is None:
+            return web.json_response(
+                {"error": {"message": f"unknown request id {rid!r}"
+                           + (" (journeys disabled: GOFR_ML_JOURNEY=0)"
+                              if log is None else "")}},
+                status=404)
+        return web.json_response({"data": journey.snapshot()})
 
     async def crash_list_handler(_: web.Request) -> web.Response:
         from .flight_recorder import crash_vault
@@ -244,3 +282,5 @@ def register_debug_routes(app, aio_app: web.Application) -> None:
     aio_app.router.add_get("/debug/events", events_handler)
     aio_app.router.add_get("/debug/crash", crash_list_handler)
     aio_app.router.add_get("/debug/crash/{crash_id}", crash_handler)
+    aio_app.router.add_get("/debug/requests", requests_handler)
+    aio_app.router.add_get("/debug/requests/{rid}", request_handler)

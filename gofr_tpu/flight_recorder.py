@@ -35,6 +35,11 @@ before the crash?":
   postmortem survives the recovery, so reading it never needs a live
   repro.
 
+The per-REQUEST axis of the same story — "where did this request's
+TTFT/TPOT budget go, across the fleet?" — lives in the sibling journey
+tracer (``ml/journey.py``): dispatch records carry the rids they served
+and journey marks carry the dispatch seq, so forensics pivot both ways.
+
 Everything here is host-side stdlib — no jax imports, safe to import from
 the debug endpoints without paying the ml package's startup cost.
 """
@@ -94,6 +99,7 @@ class DispatchRecorder:
         # serving-thread-private and takes no lock at all
         self._lock = threading.Lock()
         self._pending: dict[str, float] = {}
+        self._pending_rids: list[str] = []  # rids served this pass
         self._anchor: float | None = None  # pass start (perf_counter)
         self.dispatches = 0
         self.totals = dict.fromkeys(PHASES, 0.0)  # lifetime seconds
@@ -125,10 +131,18 @@ class DispatchRecorder:
         Serving-thread only; one dict update, no lock."""
         self._pending[phase] = self._pending.get(phase, 0.0) + seconds
 
+    def note_rid(self, rid: str) -> None:
+        """Tag the current pass with a request id it served (burst
+        delivery): the committed record carries the rid set, so forensics
+        can pivot dispatch→requests (journeys carry the other direction).
+        Serving-thread only, like ``note``."""
+        self._pending_rids.append(rid)
+
     def reset(self) -> None:
         """Drop the current pass unrecorded (idle poll: no dispatch to
         attribute the wait to) and re-anchor the wall clock."""
         self._pending.clear()
+        self._pending_rids.clear()
         self._anchor = time.perf_counter()
 
     def commit(self) -> None:
@@ -142,9 +156,15 @@ class DispatchRecorder:
         phases = dict(self._pending)
         phases["other"] = max(0.0, wall - attributed)
         rec = {"wall_s": wall, "phases": phases}
+        if self._pending_rids:
+            # stable de-dup (a slot may burst twice in one pass): the
+            # record names every request this dispatch served
+            rec["rids"] = list(dict.fromkeys(self._pending_rids))
+            self._pending_rids.clear()
         with self._lock:
-            self._ring.append(rec)
             self.dispatches += 1
+            rec["seq"] = self.dispatches  # the journey marks' pivot key
+            self._ring.append(rec)
             for name, v in phases.items():
                 self.totals[name] = self.totals.get(name, 0.0) + v
         self._pending.clear()
@@ -158,6 +178,18 @@ class DispatchRecorder:
                                            v, model=self.model, phase=name)
             except Exception:
                 pass  # bare managers in tests: recording stays optional
+
+    def tail(self, n: int = 16) -> list[dict]:
+        """The newest ``n`` raw dispatch records (seq, wall, phases, and
+        the rids served) — crash bundles carry these so a postmortem can
+        pivot the victims' journeys onto the exact dispatches that ran
+        them. Safe from any thread."""
+        with self._lock:
+            records = list(self._ring)[-max(0, n):]
+        return [{**r, "wall_s": round(r["wall_s"], 6),
+                 "phases": {k: round(v, 6)
+                            for k, v in r["phases"].items()}}
+                for r in records]
 
     def snapshot(self) -> dict:
         """The ``stalls`` block of ``/debug/serving``: rolling per-phase
@@ -214,6 +246,10 @@ class EventLog:
             maxlen=max(16, capacity))
         self._lock = threading.Lock()
         self._seq = 0
+        # events silently overwritten by ring churn: consumers polling
+        # with since= need to know their cursor gapped (the ``dropped``
+        # field of /debug/events + app_ml_events_dropped_total)
+        self.dropped = 0
 
     @property
     def cursor(self) -> int:
@@ -228,6 +264,8 @@ class EventLog:
             self._seq += 1
             rec = {"seq": self._seq, "ts": round(time.time(), 6),
                    "kind": kind, "model": model, **data}
+            if len(self._buf) == self._buf.maxlen:
+                self.dropped += 1  # the append below overwrites the oldest
             self._buf.append(rec)
             return rec
 
@@ -238,25 +276,36 @@ class EventLog:
                 or (ev_model is not None and ev_model.startswith(want + "/")))
 
     def query(self, since: int = 0, *, model: str | None = None,
-              kind: str | None = None, limit: int = 256) -> dict:
+              kind=None, rid: str | None = None,
+              limit: int = 256) -> dict:
         """Events with ``seq > since`` (oldest first), optionally filtered
-        by model (a pool name matches its replica cores too) and kind.
+        by model (a pool name matches its replica cores too), kind (one
+        name or any collection of names — the multi-value ``kind=`` of
+        /debug/events), and rid (the request-journey id stamped on
+        admit/shed/deadline/route/failover/kv_ship/kv_land events).
         ``cursor`` is what the next poll passes as ``since=``: past the
         whole ring normally, or the last returned event when ``limit``
-        truncated the page (so pagination never skips events)."""
+        truncated the page (so pagination never skips events).
+        ``dropped`` counts events the ring has overwritten since boot —
+        a consumer whose poll cadence lost to churn sees it move."""
         with self._lock:
             events = [e for e in self._buf if e["seq"] > since]
             cursor = self._seq
+            dropped = self.dropped
         if model is not None:
             events = [e for e in events
                       if self._model_match(e.get("model"), model)]
         if kind is not None:
-            events = [e for e in events if e["kind"] == kind]
+            kinds = {kind} if isinstance(kind, str) else set(kind)
+            events = [e for e in events if e["kind"] in kinds]
+        if rid is not None:
+            events = [e for e in events if e.get("rid") == rid]
         truncated = len(events) > max(1, limit)
         if truncated:
             events = events[:max(1, limit)]
             cursor = events[-1]["seq"]
-        return {"cursor": cursor, "truncated": truncated, "events": events}
+        return {"cursor": cursor, "truncated": truncated,
+                "dropped": dropped, "events": events}
 
     def tail(self, n: int = 128) -> list[dict]:
         """Newest ``n`` events, oldest first (crash-bundle context)."""

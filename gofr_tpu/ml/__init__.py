@@ -46,6 +46,10 @@ class MLDatasource:
         self._batchers: dict[str, Any] = {}
         self._llms: dict[str, Any] = {}
         self._sampler_registered = False
+        # event-ring overwrite watermark: the ring drops oldest events
+        # silently under churn; the sampler pass publishes the delta as
+        # app_ml_events_dropped_total so poller cursor gaps are visible
+        self._events_dropped_seen = 0
         self._maybe_register_sampler()
 
     def _maybe_register_sampler(self) -> None:
@@ -300,6 +304,16 @@ class MLDatasource:
             if depth is not None:
                 m.set_gauge("app_ml_queue_depth", depth(),
                             component="batcher", model=name)
+        from ..flight_recorder import event_log
+
+        dropped = event_log().dropped
+        if dropped > self._events_dropped_seen:
+            try:
+                m.add_counter("app_ml_events_dropped_total",
+                              dropped - self._events_dropped_seen)
+                self._events_dropped_seen = dropped
+            except Exception:
+                pass
         for name, server in self._llms.items():
             m.set_gauge("app_ml_queue_depth", server.queue_depth(),
                         component="llm", model=name)

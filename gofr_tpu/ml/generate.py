@@ -1455,6 +1455,27 @@ class Generator:
             return
         self.cache = llama.init_cache(cfg, self.batch_slots, self.max_seq)
 
+    def quarantine_borrowed(self) -> list[int]:
+        """Invalidate the prefix registrations BORROWED by live slots and
+        return their pids — the cheap, device-free slice of ``recover``
+        the watchdog runs *before* failing the crashed slots' consumers.
+        A woken consumer's first act is often ``has_prefix``/re-register;
+        the borrowed registrations are suspect (a crashed slot was
+        attending their pages) and must already read as gone, or the
+        consumer races ``recover`` and can observe a stale True.
+        Idempotent with ``recover``: it re-discovers nothing (the pops
+        happened here) and ``_free_slot_pages`` tolerates the missing
+        pids."""
+        if not self.page_size:
+            return []
+        invalidated: list[int] = []
+        for pid in [p for p, info in self._prefixes.items()
+                    if info["refs"] > 0]:
+            info = self._prefixes.pop(pid)
+            self._free_pages.extend(info["pages"])
+            invalidated.append(pid)
+        return invalidated
+
     def recover(self) -> list[int]:
         """Crash recovery for the serving watchdog (llm.py): discard
         everything the crashed dispatch may have corrupted and rebuild

@@ -47,9 +47,19 @@ restore, suffix prefill) is bit-exact at every KV precision.
 
 Observability: counters ``app_ml_kv_transport_ships_total`` /
 ``app_ml_kv_transport_lands_total`` / ``app_ml_kv_transport_bytes``,
-typed ``kv_ship``/``kv_land`` events in the fleet event log, and
+typed ``kv_ship``/``kv_land`` events in the fleet event log (stamped with
+the request's rid and trace id when the handoff serves one), and
 ``ship``/``land`` phases in the dispatch flight recorder (stamped by the
 serving thread of the replica doing that side of the handoff).
+
+Tracing: a handoff is ONE trace across hosts. With a tracer configured,
+``ship``/``ship_bytes`` open an ``ml.kv_ship`` span (child of the
+request context) and the wire codec carries its W3C ``traceparent`` in
+the entry's JSON header — so ``land_bytes`` on the RECEIVING host parents
+its ``ml.kv_land`` span to the sender's span and the disaggregated
+stage-1/stage-2 request reads as a single trace id on both ends of the
+socket. Request journeys (journey.py) get ``ship``/``land`` marks with
+byte counts through the same calls.
 """
 
 from __future__ import annotations
@@ -62,8 +72,14 @@ from typing import Any
 import numpy as np
 
 from ..flight_recorder import event_log
+from ..tracing import format_traceparent, parse_traceparent
 
 __all__ = ["KVTransport", "encode_entry", "decode_entry"]
+
+# reserved meta key carrying the W3C traceparent across the wire: it
+# rides the entry's JSON header (the one structured field both hosts
+# parse) and is popped back out before the meta reaches the host store
+_TRACE_KEY = "_traceparent"
 
 
 # -- wire codec (cross-host: rides multihost.send_bytes) ----------------------
@@ -130,9 +146,11 @@ class KVTransport:
     replicas' own serving threads (``export_prefix_kv`` /
     ``import_prefix_kv``)."""
 
-    def __init__(self, *, name: str = "llm", metrics=None) -> None:
+    def __init__(self, *, name: str = "llm", metrics=None,
+                 tracer=None) -> None:
         self.name = name
         self._metrics = metrics
+        self._tracer = tracer   # ml.kv_ship / ml.kv_land spans
         self._events = event_log()
         self._lock = threading.Lock()
         self.ships = 0          # entries successfully exported (pages left
@@ -140,15 +158,50 @@ class KVTransport:
         self.failures = 0       # handoffs that fell back to full prefill
         self.bytes_moved = 0    # payload bytes of successful ships
 
+    def _span(self, name: str, parent, **attrs):
+        """One transport-hop span (None without a tracer). ``activate``
+        stays off: ship/land run on worker and serving threads whose
+        next work item must not inherit this span."""
+        if self._tracer is None:
+            return None
+        return self._tracer.start_span(
+            name, parent=parent, activate=False,
+            kind="PRODUCER" if name == "ml.kv_ship" else "CONSUMER",
+            attributes={"ml.model": self.name, **attrs})
+
+    @staticmethod
+    def _end(span, error: str | None = None) -> None:
+        if span is None:
+            return
+        if error is not None:
+            span.set_status("ERROR", error)
+        span.end()
+
+    def _rid_extra(self, rid, span, parent) -> dict:
+        """Event fields linking a handoff to its request and trace."""
+        extra: dict = {}
+        if rid is not None:
+            extra["rid"] = rid
+        ctx = span.context if span is not None else parent
+        if ctx is not None:
+            extra["trace"] = ctx.trace_id
+        return extra
+
     # -- in-process handoff (the replica pool's path) ------------------------
     def ship(self, src: Any, dst: Any, prefix_ids,
-             timeout_s: float = 120.0) -> tuple | None:
+             timeout_s: float = 120.0, *, journey=None, rid=None,
+             parent=None) -> tuple | None:
         """Compute ``prefix_ids``'s KV on the ``src`` serving core
         (prefill replica), spill it through the host tier, and land the
         settled pages in ``dst``'s host tier + radix trie (decode
         replica). Returns the landed key, or ``None`` on ANY failure —
         the caller falls back to a full prefill; nothing is ever left
-        half-landed (a lost entry just re-prefills)."""
+        half-landed (a lost entry just re-prefills). ``journey``/``rid``
+        stamp the request's timeline and the fleet events; ``parent`` is
+        the request's span context, so the ship/land spans ride its
+        trace."""
+        span = self._span("ml.kv_ship", parent, **(
+            {"ml.rid": rid} if rid is not None else {}))
         try:
             entry = src.export_prefix_kv(prefix_ids, timeout_s)
         except Exception:
@@ -156,6 +209,7 @@ class KVTransport:
         if entry is None:
             with self._lock:
                 self.failures += 1
+            self._end(span, "export failed")
             return None
         key, arrays, meta = entry
         nbytes = sum(int(a.nbytes) for a in arrays.values())
@@ -165,11 +219,22 @@ class KVTransport:
         self._count("app_ml_kv_transport_ships_total", 1)
         self._count("app_ml_kv_transport_bytes", nbytes)
         self._events.emit("kv_ship", model=self.name, tokens=len(key),
-                          bytes=nbytes)
-        return self._land(dst, key, arrays, meta, timeout_s)
+                          bytes=nbytes, **self._rid_extra(rid, span, parent))
+        if journey is not None:
+            journey.mark("ship", bytes=nbytes, tokens=len(key))
+        if span is not None:
+            span.set_attributes({"ml.bytes": nbytes, "ml.tokens": len(key)})
+        self._end(span)
+        return self._land(dst, key, arrays, meta, timeout_s,
+                          journey=journey, rid=rid,
+                          parent=span.context if span is not None else parent)
 
     def _land(self, dst: Any, key: tuple, arrays: dict, meta: dict,
-              timeout_s: float) -> tuple | None:
+              timeout_s: float, *, journey=None, rid=None,
+              parent=None) -> tuple | None:
+        nbytes = sum(int(a.nbytes) for a in arrays.values())
+        span = self._span("ml.kv_land", parent, **(
+            {"ml.rid": rid} if rid is not None else {}))
         try:
             ok = dst.import_prefix_kv(key, arrays, meta, timeout_s)
         except Exception:
@@ -177,19 +242,31 @@ class KVTransport:
         if not ok:
             with self._lock:
                 self.failures += 1
+            self._end(span, "land failed")
             return None
         with self._lock:
             self.lands += 1
         self._count("app_ml_kv_transport_lands_total", 1)
         self._events.emit("kv_land", model=self.name, tokens=len(key),
-                          bytes=sum(int(a.nbytes) for a in arrays.values()))
+                          bytes=nbytes,
+                          **self._rid_extra(rid, span, parent))
+        if journey is not None:
+            journey.mark("land", bytes=nbytes, tokens=len(key))
+        if span is not None:
+            span.set_attributes({"ml.bytes": nbytes, "ml.tokens": len(key)})
+        self._end(span)
         return key
 
     # -- cross-host halves (ride multihost.send_bytes) -----------------------
     def ship_bytes(self, src: Any, prefix_ids,
-                   timeout_s: float = 120.0) -> bytes | None:
+                   timeout_s: float = 120.0, *, journey=None, rid=None,
+                   parent=None) -> bytes | None:
         """Export from ``src`` and encode for the wire (the sender half of
-        a cross-host ship; pair with ``multihost.send_bytes``)."""
+        a cross-host ship; pair with ``multihost.send_bytes``). The
+        encoded header carries the ship span's W3C ``traceparent``, so
+        the receiving host's ``land_bytes`` continues the SAME trace."""
+        span = self._span("ml.kv_ship", parent, **(
+            {"ml.rid": rid} if rid is not None else {}))
         try:
             entry = src.export_prefix_kv(prefix_ids, timeout_s)
         except Exception:
@@ -197,8 +274,15 @@ class KVTransport:
         if entry is None:
             with self._lock:
                 self.failures += 1
+            self._end(span, "export failed")
             return None
         key, arrays, meta = entry
+        ctx = span.context if span is not None else parent
+        if ctx is not None:
+            # the wire carries the trace context INSIDE the entry header:
+            # binary frames have no side channel, and this is exactly the
+            # gap that made cross-host handoffs fall out of their traces
+            meta = {**meta, _TRACE_KEY: format_traceparent(ctx)}
         raw = encode_entry(key, arrays, meta)
         with self._lock:
             self.ships += 1
@@ -206,15 +290,26 @@ class KVTransport:
         self._count("app_ml_kv_transport_ships_total", 1)
         self._count("app_ml_kv_transport_bytes", len(raw))
         self._events.emit("kv_ship", model=self.name, tokens=len(key),
-                          bytes=len(raw))
+                          bytes=len(raw),
+                          **self._rid_extra(rid, span, parent))
+        if journey is not None:
+            journey.mark("ship", bytes=len(raw), tokens=len(key))
+        if span is not None:
+            span.set_attributes({"ml.bytes": len(raw),
+                                 "ml.tokens": len(key)})
+        self._end(span)
         return raw
 
     def land_bytes(self, dst: Any, raw: bytes,
-                   timeout_s: float = 30.0) -> tuple | None:
+                   timeout_s: float = 30.0, *, journey=None,
+                   rid=None) -> tuple | None:
         """Decode a received binary frame and land it in ``dst`` (the
         receiver half of a cross-host ship). A corrupt or truncated
         frame counts as a failure and returns ``None`` — the receiver
-        falls back like every other lost handoff, it never crashes."""
+        falls back like every other lost handoff, it never crashes. The
+        frame header's ``traceparent`` (stamped by ``ship_bytes`` on the
+        sending host) parents this side's ``ml.kv_land`` span, so both
+        halves of the handoff share one trace id."""
         try:
             key, arrays, meta = decode_entry(raw)
             # frombuffer views are read-only over the frame; the store
@@ -226,7 +321,9 @@ class KVTransport:
             with self._lock:
                 self.failures += 1
             return None
-        return self._land(dst, key, arrays, meta, timeout_s)
+        parent = parse_traceparent(meta.pop(_TRACE_KEY, None))
+        return self._land(dst, key, arrays, meta, timeout_s,
+                          journey=journey, rid=rid, parent=parent)
 
     # -- introspection -------------------------------------------------------
     def snapshot(self) -> dict:

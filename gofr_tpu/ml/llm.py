@@ -46,6 +46,8 @@ from .errors import (DeadlineExceeded, GeneratorCrashed, Overloaded,
 from ..flight_recorder import (DispatchRecorder, crash_vault, event_log,
                               recorder_enabled)
 from .generate import PagePoolExhausted, PrefixEvicted
+from .journey import Journey, journey_log, next_rid
+from .journey import seal as seal_journey
 from .prefix_cache import PrefixCacheConfig, RadixPrefixCache
 from .scheduler import (PRIORITIES, AgingPriorityQueue, SLOController,
                         normalize_priority, retry_after_s)
@@ -108,11 +110,12 @@ class _Request:
                  "first_token_at", "cancelled", "prefix", "trace_ctx",
                  "queue_span", "decode_span", "full_prompt", "cache_seen",
                  "priority", "last_burst_at", "deadline_at", "deadline_hit",
-                 "n_tokens")
+                 "n_tokens", "rid", "journey", "journey_owned")
 
     def __init__(self, prompt, max_new, out_q, loop, prefix=None,
                  trace_ctx=None, queue_span=None, priority: int = 1,
-                 deadline_s: float = 0.0) -> None:
+                 deadline_s: float = 0.0, rid: str | None = None,
+                 journey=None, journey_owned: bool = False) -> None:
         self.prompt = prompt
         self.max_new = max_new
         self.out_q = out_q
@@ -138,6 +141,10 @@ class _Request:
         self.decode_span = None       # ml.decode, admission -> finish
         self.full_prompt = None  # original ids when the framework prefix
         self.cache_seen = False  # cache split the prompt (eviction fallback)
+        self.rid = rid           # process-unique request id (journey key)
+        self.journey = journey   # request-journey timeline (None = off)
+        self.journey_owned = journey_owned  # this server seals it; a pool
+        # -owned journey survives core rejects so failover keeps ONE record
 
     def finish_spans(self, status: str = "OK", message: str = "") -> None:
         """End whichever phase spans are still open (admission rejects and
@@ -259,6 +266,10 @@ class LLMServer:
         self.recorder = (DispatchRecorder(model=name, metrics=metrics)
                          if recorder_enabled() else None)
         generator.recorder = self.recorder
+        # request journeys (journey.py): per-request lifecycle timelines,
+        # tail-sampled at /debug/requests. GOFR_ML_JOURNEY=0 disables —
+        # every instrumented site guards on is-not-None like the recorder
+        self._journeys = journey_log()
         self._events = event_log()
         self._crashes = crash_vault()
         if getattr(generator, "host_kv", None) is not None:
@@ -594,6 +605,14 @@ class LLMServer:
                 f"{self._restart_window:g}s)")
         return ServerClosed()
 
+    def _finish_journey(self, req: _Request, reason: str,
+                        error: str | None = None) -> None:
+        """Seal a request's journey into retention (journey.seal — the
+        shared idempotent sequence; the pool and its core may both get
+        here, first caller wins)."""
+        seal_journey(req.journey, reason, error,
+                     log=self._journeys, metrics=self._metrics)
+
     def _reject(self, req: _Request, exc: Exception) -> None:
         """Terminate a request that will never (or no longer) decode: end
         its spans — stamped with the typed outcome as ``ml.finish_reason``
@@ -606,6 +625,14 @@ class LLMServer:
                 if span is not None and span.end_time is None:
                     span.set_attribute("ml.finish_reason", reason)
         req.finish_spans("ERROR", str(exc))
+        if req.journey is not None:
+            if req.journey_owned:
+                self._finish_journey(req, reason or "error", str(exc))
+            else:
+                # a pool-owned journey is NOT sealed here: the front may
+                # reroute this request to a survivor, and the journey
+                # must keep recording — the reject is just one mark
+                req.journey.mark("reject", reason=reason or "error")
         try:
             req.loop.call_soon_threadsafe(req.out_q.put_nowait, exc)
             req.loop.call_soon_threadsafe(req.out_q.put_nowait, _DONE)
@@ -675,6 +702,15 @@ class LLMServer:
         # visible to routers for the whole rebuild: a replica pool skips a
         # ``recovering`` replica instead of queueing behind its re-warmup
         self._state = "recovering"
+        # quarantine the borrowed prefix registrations BEFORE waking the
+        # crashed slots' consumers: a woken consumer's first read is often
+        # has_prefix()/re-register, and it must never observe a suspect
+        # registration as still live while recover() races toward the
+        # invalidation (pure host bookkeeping; recover stays idempotent)
+        try:
+            quarantined = self.gen.quarantine_borrowed()
+        except Exception:
+            quarantined = []
         for slot, req in list(self._active.items()):
             self._reject(req, crash)
             del self._active[slot]
@@ -696,7 +732,7 @@ class LLMServer:
                     pass
             return False
         if self.prefix_cache is not None:
-            for pid in invalidated:
+            for pid in (*quarantined, *invalidated):
                 try:
                     self.prefix_cache.invalidate(pid)
                 except Exception:
@@ -749,6 +785,7 @@ class LLMServer:
             now = time.perf_counter()
             slot_table = [{
                 "slot": slot,
+                "rid": req.rid,
                 "prompt_tokens": req.n_tokens,
                 "produced": getattr(self.gen.slots[slot], "produced", 0),
                 "priority": PRIORITIES[req.priority],
@@ -765,6 +802,17 @@ class LLMServer:
                 "slots": slot_table,
                 "scheduler": self.scheduler_snapshot(),
             }
+            # each victim's FULL path, not just its final state: the
+            # journey timelines of the in-flight slots, plus the newest
+            # dispatch records (with the rids they served) so a
+            # postmortem pivots request↔dispatch without a live repro
+            journeys = [req.journey.snapshot()
+                        for _, req in sorted(self._active.items())
+                        if req.journey is not None]
+            if journeys:
+                state["journeys"] = journeys
+            if self.recorder is not None:
+                state["dispatches"] = self.recorder.tail(16)
             try:  # the pool counters may be mid-wreck; best effort
                 state["pool"] = self.gen.pool_stats()
             except Exception:
@@ -810,6 +858,7 @@ class LLMServer:
         prio = PRIORITIES[req.priority]
         self._shed_counts[prio] += 1
         self._events.emit("shed", model=self.name, priority=prio,
+                          rid=req.rid,
                           queued=len(self._waiting),
                           queued_tokens=self._waiting.tokens,
                           retry_after_s=round(retry_after, 3))
@@ -979,11 +1028,29 @@ class LLMServer:
                 req.slot = slot
                 self._active[slot] = req
                 self._admit_times.append(now)
+                trace = (req.trace_ctx.trace_id
+                         if req.trace_ctx is not None else None)
                 self._events.emit(
                     "admit", model=self.name, slot=slot,
+                    rid=req.rid,
                     priority=PRIORITIES[req.priority],
                     prompt_tokens=req.n_tokens,
-                    queued_ms=round((now - req.enqueued_at) * 1e3, 2))
+                    queued_ms=round((now - req.enqueued_at) * 1e3, 2),
+                    **({"trace": trace} if trace is not None else {}))
+                if req.journey is not None:
+                    # the admit mark closes the queue-wait segment; the
+                    # radix split and any restore debt the admission
+                    # charged ride along so the waterfall explains what
+                    # the decode replica actually prefilled
+                    extra: dict = {"slot": slot,
+                                   "priority": PRIORITIES[req.priority]}
+                    if req.full_prompt is not None:
+                        extra["prefix_tokens"] = (len(req.full_prompt)
+                                                  - len(req.prompt))
+                    sched = getattr(self.gen, "scheduler", None)
+                    if sched is not None and sched.restore_debt:
+                        extra["restore_debt"] = sched.restore_debt
+                    req.journey.mark("admit", **extra)
                 if req.full_prompt is not None and self.prefix_cache is not None:
                     # the hit is real only now: the slot borrowed the
                     # prefix pages and the suffix-only prefill happened
@@ -1053,6 +1120,20 @@ class LLMServer:
         ``call_soon_threadsafe`` wakeups/s on the event loop thread."""
         if self._fault is not None:
             self._fault("emit")  # chaos point: a poisoned token callback
+        if req.journey is not None:
+            # one mark per BURST, never per token: the first burst closes
+            # the prefill segment (the TTFT boundary), later ones are
+            # decode windows. The dispatch seq (this pass commits as
+            # dispatches+1) and the rid tag on the dispatch record are
+            # the two halves of the request↔dispatch pivot.
+            name = "prefill" if req.first_token_at is None else "decode"
+            rec = self.recorder
+            if rec is not None:
+                rec.note_rid(req.rid)
+                req.journey.mark(name, tokens=len(tokens),
+                                 dispatch=rec.dispatches + 1)
+            else:
+                req.journey.mark(name, tokens=len(tokens))
         now = time.perf_counter()
         if (self._controller is not None and tokens
                 and req.last_burst_at is not None):
@@ -1096,6 +1177,7 @@ class LLMServer:
         the counter the operator alarms on."""
         self._deadline_expired += 1
         self._events.emit("deadline", model=self.name, where=where,
+                          rid=req.rid,
                           priority=PRIORITIES[req.priority])
         if self._metrics is not None:
             try:
@@ -1256,6 +1338,14 @@ class LLMServer:
                         "ml.tokens": produced,
                         "ml.finish_reason": reason,
                     })
+                if req.journey is not None:
+                    # natural completion seals the journey here even for
+                    # pool-owned ones — there is no reroute after a finish
+                    req.journey.note(tokens=produced)
+                    if getattr(self.gen, "spec_k", 0) and s.spec_windows:
+                        req.journey.note(spec_windows=s.spec_windows,
+                                         spec_emitted=s.spec_emitted)
+                    self._finish_journey(req, reason)
                 req.finish_spans()
                 # all of the slot's tokens were streamed via the callback
                 self.gen.release(slot)
@@ -1335,7 +1425,8 @@ class LLMServer:
                             info: dict | None = None,
                             priority: int | str | None = None,
                             deadline_s: float | None = None,
-                            ) -> AsyncIterator[list[int]]:
+                            rid: str | None = None,
+                            journey=None) -> AsyncIterator[list[int]]:
         """Yield BURSTS of tokens — each list is the slot's share of one
         processed decode chunk (the first is ``[first_token]`` from the
         TTFT mini-chunk). The low-overhead surface for transports that can
@@ -1357,6 +1448,12 @@ class LLMServer:
         ``"stop"`` (eos), ``"length"`` (budget), or ``"eviction"`` (page
         pool dry — the answer was truncated mid-thought and must not be
         presented as a natural stop).
+
+        ``rid``/``journey`` are the request-journey plumbing (a
+        ``ReplicaPool`` front passes its own so the fleet hop and the
+        core hop share ONE timeline); standalone callers leave them unset
+        and the server records a journey itself when ``GOFR_ML_JOURNEY``
+        enables them.
         """
         if self._closed or self._draining:
             raise self._closed_error()
@@ -1375,9 +1472,18 @@ class LLMServer:
                 "ml.queue", parent=ctx, activate=False,
                 attributes={"ml.model": self.name},
             )
+        if rid is None:
+            rid = next_rid()
+        owned = False
+        if journey is None and self._journeys is not None:
+            journey = self._journeys.start(Journey(
+                rid, model=self.name,
+                trace_id=ctx.trace_id if ctx is not None else None))
+            owned = True
         req = _Request(prompt_ids, max_new_tokens, out_q, loop,
                        prefix=prefix, trace_ctx=ctx, queue_span=queue_span,
-                       priority=prio, deadline_s=ttl)
+                       priority=prio, deadline_s=ttl, rid=rid,
+                       journey=journey, journey_owned=owned)
         self._requests.put(req)
         if self._closed:
             # close() may have drained the queue before our put landed —
@@ -1386,6 +1492,8 @@ class LLMServer:
             # into out_q, which we're abandoning; mark cancelled so the
             # serving thread reaps it if it was somehow admitted.
             req.cancelled = True
+            if owned:
+                self._finish_journey(req, "error", "server closed")
             raise self._closed_error()
         try:
             while True:
@@ -1404,6 +1512,10 @@ class LLMServer:
             # flag it so the serving thread frees the slot instead of
             # decoding to max_new_tokens for nobody
             req.cancelled = True
+            if owned and journey is not None and not journey.done:
+                # abandonment, not a serving failure (errors and natural
+                # completions sealed the journey before we got here)
+                self._finish_journey(req, "cancelled")
 
     async def stream(self, prompt_ids, max_new_tokens: int = 64,
                      prefix: int | None = None,

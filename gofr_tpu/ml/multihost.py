@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Any, AsyncIterator, Iterable
 
+from ..tracing import current_traceparent, parse_traceparent
 from .errors import GeneratorCrashed, ServerClosed
 
 __all__ = ["MultiHostWorker", "MultiHostLLMClient", "send_frame",
@@ -223,7 +224,7 @@ class MultiHostWorker:
                  chunk: int = 4, sampler=None, eos_id: int | None = None,
                  spec_k: int = 0, prefill_chunk: int = 0,
                  heartbeat_s: float = 5.0,
-                 logger=None) -> None:
+                 logger=None, tracer=None) -> None:
         self.process_id = process_id
         self.num_processes = num_processes
         self.coordinator = coordinator
@@ -245,6 +246,10 @@ class MultiHostWorker:
             (prompt_bucket,) if prompt_bucket else (32, 128))
         self._cfg = cfg
         self._logger = logger
+        # optional rank-0 tracer: generate frames carry the front-end's
+        # W3C traceparent, so a mesh request can be one span in the SAME
+        # trace the front-end's handler opened — across the model port
+        self._tracer = tracer
 
     # -- mesh + model setup ----------------------------------------------------
     def _setup(self):
@@ -460,7 +465,13 @@ class MultiHostWorker:
                     conn.send({"id": rid, "error":
                                f"token ids must be 0..{vocab - 1}"})
                     continue
-                self._inbox.put(("gen", conn, (rid, tokens, max_new)))
+                # optional W3C trace context from the front-end: the mesh
+                # side of the request joins the SAME trace (parsed only
+                # when rank 0 has a tracer to spend it on)
+                tp = req.get("traceparent")
+                self._inbox.put(("gen", conn,
+                                 (rid, tokens, max_new,
+                                  tp if isinstance(tp, str) else None)))
         except Exception:
             # one bad connection (malformed frame, reset socket) must never
             # take rank 0 down — but loud, not silent: a protocol bug on
@@ -483,13 +494,22 @@ class MultiHostWorker:
         it locally, stream results. EVERY device-touching operation happens
         broadcast-first so followers replay the identical sequence."""
         gen = self.gen
-        pending: list[tuple[_Conn, Any, list[int], int]] = []
-        active: dict[int, tuple[_Conn, Any]] = {}  # slot -> (conn, rid)
+        # pending: (conn, rid, tokens, max_new, traceparent)
+        pending: list[tuple] = []
+        active: dict[int, tuple] = {}  # slot -> (conn, rid, span)
+
+        def end_span(span, status: str | None = None) -> None:
+            if span is None:
+                return
+            if status is not None:
+                span.set_status("ERROR", status)
+            span.end()
 
         def finish_dead() -> None:
-            for slot, (conn, rid) in list(active.items()):
+            for slot, (conn, rid, span) in list(active.items()):
                 if not gen.slots[slot].live:
                     conn.send({"id": rid, "done": True})
+                    end_span(span)
                     gen.release(slot)
                     del active[slot]
 
@@ -514,30 +534,32 @@ class MultiHostWorker:
             for kind, conn, payload in items:
                 if kind == "stop":
                     self._broadcast(self._zero_cmd())  # STOP
-                    for c, rid in active.values():
+                    for c, rid, span in active.values():
                         c.send({"id": rid, "error": _ERR_STOPPED})
-                    for c, rid, _, _ in pending:
+                        end_span(span, _ERR_STOPPED)
+                    for c, rid, *_ in pending:
                         c.send({"id": rid, "error": _ERR_STOPPED})
                     conn.send({"stopped": True})
                     for c in list(self._conns):  # deliver final frames
                         c.flush()                # before teardown close()s
                     return
                 if kind == "gen":
-                    rid, tokens, max_new = payload
-                    pending.append((conn, rid, tokens, max_new))
+                    rid, tokens, max_new, tp = payload
+                    pending.append((conn, rid, tokens, max_new, tp))
                 elif kind == "cancel":
                     pending = [p for p in pending
                                if not (p[0] is conn and p[1] == payload)]
-                    for slot, (c, rid) in list(active.items()):
+                    for slot, (c, rid, _span) in list(active.items()):
                         if c is conn and rid == payload:
                             cancels.append(slot)
                 elif kind == "bye":
                     pending = [p for p in pending if p[0] is not conn]
-                    cancels.extend(s for s, (c, _) in active.items()
+                    cancels.extend(s for s, (c, *_) in active.items()
                                    if c is conn)
             # drop requests whose connection died since queueing
             pending = [p for p in pending if p[0].alive]
-            cancels.extend(s for s, (c, _) in active.items() if not c.alive)
+            cancels.extend(s for s, (c, *_) in active.items()
+                           if not c.alive)
 
             # -- one broadcast + local apply per iteration -----------------
             if cancels:
@@ -545,7 +567,9 @@ class MultiHostWorker:
                 self._broadcast(self._encode_cancel(cancels))
                 self._apply_cancel(cancels)
                 for slot in cancels:
-                    active.pop(slot, None)
+                    entry = active.pop(slot, None)
+                    if entry is not None:
+                        end_span(entry[2], "cancelled")
                     gen.release(slot)
                 continue
             free = 0
@@ -561,15 +585,25 @@ class MultiHostWorker:
                 wave = pending[:free]
                 pending = pending[free:]
                 self._broadcast(self._encode_admit(
-                    [(toks, max_new) for _, _, toks, max_new in wave]))
+                    [(toks, max_new) for _, _, toks, max_new, _ in wave]))
                 slots = gen.add_requests([
                     (toks, max_new,
                      (lambda i, burst, c=conn, r=rid: c.send(
                          {"id": r, "tokens": burst})))
-                    for conn, rid, toks, max_new in wave
+                    for conn, rid, toks, max_new, _ in wave
                 ])
-                for (conn, rid, _, _), slot in zip(wave, slots, strict=True):
-                    active[slot] = (conn, rid)
+                for (conn, rid, _, _, tp), slot in zip(wave, slots,
+                                                       strict=True):
+                    span = None
+                    if self._tracer is not None:
+                        # the mesh half of the request, in the SAME trace
+                        # the front-end opened (traceparent off the frame)
+                        span = self._tracer.start_span(
+                            "ml.mesh.generate",
+                            parent=parse_traceparent(tp),
+                            kind="SERVER", activate=False,
+                            attributes={"ml.slot": slot})
+                    active[slot] = (conn, rid, span)
                 finish_dead()
             elif gen.n_live:
                 self._broadcast(self._zero_step())
@@ -690,6 +724,11 @@ class MultiHostLLMClient:
         token gets ONE transparent reconnect-and-resend first — a
         front-end riding out a worker restart never surfaces the blip."""
         prompt = list(prompt_ids)
+        # the caller's trace context rides the generate frame as a W3C
+        # traceparent, so the mesh side of the request (and anything it
+        # ships over binary frames) stays in the SAME trace the
+        # front-end's handler opened; absent a live span, no field
+        traceparent = current_traceparent()
         retried = False
         while True:
             try:
@@ -706,9 +745,11 @@ class MultiHostLLMClient:
             retrying = False
             try:
                 try:
-                    await self._send({"op": "generate", "id": rid,
-                                      "tokens": prompt,
-                                      "max_new": max_new})
+                    frame = {"op": "generate", "id": rid,
+                             "tokens": prompt, "max_new": max_new}
+                    if traceparent is not None:
+                        frame["traceparent"] = traceparent
+                    await self._send(frame)
                 except (ConnectionError, OSError) as exc:
                     finished = True  # never reached the mesh: no cancel
                     if not retried:

@@ -61,8 +61,10 @@ from .errors import (DeadlineExceeded, GeneratorCrashed, Overloaded,
                      ServerClosed)
 from ..flight_recorder import event_log
 from .generate import PrefixEvicted
+from .journey import Journey, journey_log, next_rid
+from .journey import seal as seal_journey
 from .kv_offload import HostKVStore, OffloadConfig
-from .llm import LLMServer, drain_s_from_env
+from .llm import LLMServer, _abort_reason, drain_s_from_env
 from .scheduler import (PRIORITIES, AgingPriorityQueue, SLOController,
                         normalize_priority, retry_after_s)
 
@@ -221,7 +223,7 @@ class _FrontRequest:
     __slots__ = ("prompt", "max_new", "priority", "enqueued_at",
                  "deadline_at", "n_tokens", "future", "loop", "prefix",
                  "attempts", "cancelled", "streamed", "routed_idx",
-                 "last_replica", "want_role", "kv_holder")
+                 "last_replica", "want_role", "kv_holder", "rid", "journey")
 
     def __init__(self, prompt, max_new: int, priority: int,
                  deadline_s: float, prefix: int | None) -> None:
@@ -249,6 +251,8 @@ class _FrontRequest:
         # transport landed its prefix pages on (route-affinity target)
         self.want_role: str | None = None
         self.kv_holder: int | None = None
+        self.rid: str | None = None   # process-unique journey key
+        self.journey = None           # the ONE fleet-spanning timeline
 
 
 class ReplicaPool:
@@ -306,6 +310,10 @@ class ReplicaPool:
         self._metrics = metrics
         self._tracer = tracer   # ml.route spans (one per routing attempt)
         self._events = event_log()  # fleet event log (flight_recorder.py)
+        # request journeys (journey.py): the FRONT owns one timeline per
+        # request; replica cores mark into it, so a rerouted or disagg
+        # two-stage request stays ONE record. GOFR_ML_JOURNEY=0 disables.
+        self._journeys = journey_log()
         # routing-decision wall time: the pool's contribution to the
         # dispatch-phase breakdown (phase="route" of
         # app_llm_dispatch_phase_seconds) and the routing debug block
@@ -363,7 +371,8 @@ class ReplicaPool:
         if self._disagg:
             from .kv_transport import KVTransport
 
-            self._transport = KVTransport(name=name, metrics=metrics)
+            self._transport = KVTransport(name=name, metrics=metrics,
+                                          tracer=tracer)
             self._roles = _RoleSteer(
                 len(generators),
                 _disagg_prefill_from_env(max(1, len(generators) // 2)))
@@ -530,7 +539,7 @@ class ReplicaPool:
                 self._resolve(fr, cancel=True)
                 continue
             self._events.emit("deadline", model=self.name,
-                              where="while queued (fleet)",
+                              where="while queued (fleet)", rid=fr.rid,
                               priority=PRIORITIES[fr.priority])
             self._count("app_llm_deadline_exceeded_total", 1,
                         model=self.name)
@@ -652,12 +661,16 @@ class ReplicaPool:
                 self._admit_times.append(time.perf_counter())
                 if fr.attempts:
                     self._failovers += 1
+            trace = (fr.journey.trace_id
+                     if fr.journey is not None else None)
+            extra = {"trace": trace} if trace is not None else {}
             self._events.emit("route", model=self.name, replica=idx,
-                              reason=reason, attempt=fr.attempts)
+                              reason=reason, attempt=fr.attempts,
+                              rid=fr.rid, **extra)
             if fr.attempts:
                 self._events.emit("failover", model=self.name, replica=idx,
                                   from_replica=fr.last_replica,
-                                  attempt=fr.attempts)
+                                  attempt=fr.attempts, rid=fr.rid, **extra)
                 self._count("app_llm_replica_failovers_total", 1,
                             model=self.name)
             self._count("app_llm_replica_routed_total", 1, model=self.name,
@@ -798,7 +811,8 @@ class ReplicaPool:
                         if i != src_idx and self._routable(i)]
             return min(live, key=self._load) if live else None
 
-    async def _disagg_prefill(self, fr: _FrontRequest) -> None:
+    async def _disagg_prefill(self, fr: _FrontRequest,
+                              parent=None) -> None:
         """Disaggregated stage 1: route the request to a prefill-biased
         replica, compute its prompt's whole-page prefix KV there, and
         ship the pages to the decode replica stage 2 will admit on
@@ -821,12 +835,16 @@ class ReplicaPool:
             idx, _reason = await self._await_routing(fr)
             if idx is None:
                 return  # no live prefill replica: skip the stage
+            if fr.journey is not None:
+                fr.journey.mark("route", replica=idx, reason="prefill",
+                                attempt=fr.attempts)
             try:
                 dst = self._pick_decode_dst(idx)
                 if dst is not None:
                     key = await asyncio.to_thread(
                         self._transport.ship, self.replicas[idx],
-                        self.replicas[dst], self._ship_ids(fr.prompt))
+                        self.replicas[dst], self._ship_ids(fr.prompt),
+                        journey=fr.journey, rid=fr.rid, parent=parent)
                     if key is not None:
                         fr.kv_holder = dst
             finally:
@@ -865,9 +883,18 @@ class ReplicaPool:
         prio = PRIORITIES[fr.priority]
         self._shed_counts[prio] += 1
         self._events.emit("shed", model=self.name, priority=prio,
+                          rid=fr.rid,
                           queued=len(self._queue),
                           queued_tokens=self._queue.tokens)
         self._count("app_llm_shed_total", 1, model=self.name, priority=prio)
+
+    def _finish_journey(self, fr: _FrontRequest, reason: str,
+                        error: str | None = None) -> None:
+        """Seal a front request's journey into retention (journey.seal —
+        idempotent; a core may have sealed it first on natural
+        completion)."""
+        seal_journey(fr.journey, reason, error,
+                     log=self._journeys, metrics=self._metrics)
 
     def _overloaded(self) -> Overloaded:
         retry_after = self._retry_after_s()
@@ -928,13 +955,18 @@ class ReplicaPool:
         self._ensure_dispatcher()
         fr = _FrontRequest(prompt_ids, max_new_tokens, prio, ttl, prefix)
         fr.loop = asyncio.get_running_loop()
-        self._admit(fr)  # fleet shedding; may raise Overloaded
+        fr.rid = next_rid()
         # the caller's request span, captured BEFORE any executor hop: the
         # per-attempt ml.route spans (and, through the core, ml.queue/
         # ml.decode) all parent here — so a rerouted request stays ONE
         # trace end-to-end, with the failover visible as a span event
         ctx = current_context()
+        if self._journeys is not None:
+            fr.journey = self._journeys.start(Journey(
+                fr.rid, model=self.name,
+                trace_id=ctx.trace_id if ctx is not None else None))
         try:
+            self._admit(fr)  # fleet shedding; may raise Overloaded
             if (self._transport is not None and fr.prefix is None
                     and fr.n_tokens >= self._ship_min
                     and not self._already_resident(fr.prompt)):
@@ -944,7 +976,7 @@ class ReplicaPool:
                 # transport failure). Explicitly-pinned prefixes and
                 # prompts whose prefix a live trie already holds skip
                 # the stage: their pages exist — affinity routes there.
-                await self._disagg_prefill(fr)
+                await self._disagg_prefill(fr, ctx)
             last_burst = None
             while True:
                 fr.future = fr.loop.create_future()
@@ -980,6 +1012,12 @@ class ReplicaPool:
                     if route_span is not None:
                         route_span.set_attributes({
                             "ml.replica": idx, "ml.route_reason": reason})
+                    if fr.journey is not None:
+                        # closes the fleet-queue-wait segment; the core's
+                        # own marks (admit/prefill/decode) follow in the
+                        # SAME timeline
+                        fr.journey.mark("route", replica=idx,
+                                        reason=reason, attempt=fr.attempts)
                     core = self.replicas[idx]
                     agen = None
                     try:
@@ -987,7 +1025,8 @@ class ReplicaPool:
                             fr.prompt, fr.max_new,
                             prefix=self._core_pid(fr.prefix, idx),
                             info=info, priority=fr.priority,
-                            deadline_s=self._remaining(fr))
+                            deadline_s=self._remaining(fr),
+                            rid=fr.rid, journey=fr.journey)
                         async for burst in agen:
                             if self._role_ctl is not None and burst:
                                 # fleet latency samples for the role
@@ -1055,6 +1094,14 @@ class ReplicaPool:
                 finally:
                     if route_span is not None and route_span.end_time is None:
                         route_span.end()
+        except Exception as exc:
+            # the typed outcome seals the fleet journey (shed/deadline/
+            # crashed/error) — natural completions were sealed by the
+            # core at slot finish, so this never double-stamps
+            if fr.journey is not None and not fr.journey.done:
+                self._finish_journey(fr, _abort_reason(exc) or "error",
+                                     str(exc))
+            raise
         finally:
             with self._lock:
                 fr.cancelled = True
@@ -1064,6 +1111,10 @@ class ReplicaPool:
                     self._outstanding[fr.routed_idx] -= 1
                     fr.routed_idx = None
             self._kick()
+            if fr.journey is not None and not fr.journey.done:
+                # consumer walked away mid-flight (GeneratorExit/aclose):
+                # an abandonment, not a serving failure
+                self._finish_journey(fr, "cancelled")
 
     async def _await_routing(self, fr: _FrontRequest) -> tuple[int, str]:
         """Wait for the router's verdict — ``(replica index, route

@@ -32,6 +32,7 @@ __all__ = [
     "new_tracer",
     "current_span",
     "current_context",
+    "current_traceparent",
     "parse_traceparent",
     "format_traceparent",
 ]
@@ -156,6 +157,19 @@ def current_context() -> SpanContext | None:
     """
     span = _current_span.get()
     return span.context if span is not None else None
+
+
+def current_traceparent() -> str | None:
+    """The active span's W3C ``traceparent`` header value, or None.
+
+    The one-liner wire producers use to put the current trace ON the
+    wire (multihost model-port frames, the KV transport's binary entry
+    headers) — the receiving side rebuilds the context with
+    ``parse_traceparent`` and parents its spans there, so a request that
+    crosses processes or hosts stays a single trace.
+    """
+    ctx = current_context()
+    return format_traceparent(ctx) if ctx is not None else None
 
 
 class SpanExporter:

@@ -1,0 +1,378 @@
+"""Request journey tracer: per-request timelines, tail-sampled retention,
+cross-component marks, and the /debug/requests endpoints (tier-1, CPU).
+
+The headline contracts under test: a request's journey marks TILE its
+wall time (sum-to-wall with no negative segments — the DispatchRecorder
+honesty contract applied to the request axis), under chunked prefill and
+speculation too; a replica-pool request is ONE timeline across
+route/admit/decode (and ship/land under disagg — test_kv_transport.py
+covers that end); failed requests are retained as exemplars past ring
+churn; ``GOFR_ML_JOURNEY=0`` leaves the serving hot path untouched
+(no journey objects anywhere, byte-identical output); and the
+dispatch↔request crosslink lets forensics pivot both ways.
+"""
+
+import asyncio
+
+import jax
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gofr_tpu.app import App
+from gofr_tpu.config import MapConfig
+from gofr_tpu.flight_recorder import event_log
+from gofr_tpu.ml.errors import DeadlineExceeded
+from gofr_tpu.ml.generate import Generator
+from gofr_tpu.ml.journey import (FAILURE_REASONS, MAX_MARKS, Journey,
+                                 JourneyLog, journey_log, journeys_enabled)
+from gofr_tpu.ml.llm import LLMServer
+from gofr_tpu.ml.replica import ReplicaPool
+from gofr_tpu.models import llama
+from gofr_tpu.testutil import RecordingTracer
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.tiny_llama(use_flash=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _gen(model, **kw):
+    cfg, params = model
+    kw.setdefault("batch_slots", 2)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("prefill_buckets", (8, 16))
+    return Generator(params, cfg, **kw)
+
+
+def _assert_tiles(waterfall: dict) -> None:
+    """The honesty contract: marks sum to the request wall, no segment
+    is negative, and the record is sealed with a finish reason."""
+    marks = waterfall["marks"]
+    assert waterfall["done"] and waterfall["finish_reason"] is not None
+    assert all(m["dur_s"] >= 0.0 for m in marks)
+    total = sum(m["dur_s"] for m in marks)
+    assert total == pytest.approx(waterfall["wall_s"], abs=1e-5)
+    assert marks[-1]["mark"] in ("finish", "other")
+
+
+# ---------------------------------------------------------------- unit level
+def test_journey_marks_tile_wall_and_bound():
+    j = Journey("r-unit", model="m")
+    for i in range(3 * MAX_MARKS):
+        j.mark("decode", tokens=2, dispatch=i + 1)
+    assert j.finish("stop")
+    assert not j.finish("length")  # idempotent: first seal wins
+    snap = j.snapshot()
+    _assert_tiles(snap)
+    assert snap["finish_reason"] == "stop"
+    # bounded record: repeats past the cap FOLD into the newest mark
+    # (durations and token counts summed) instead of growing the list
+    assert len(snap["marks"]) <= MAX_MARKS + 1
+    decoded = sum(m.get("tokens", 0) for m in snap["marks"]
+                  if m["mark"] == "decode")
+    assert decoded == 6 * MAX_MARKS
+    folded = [m for m in snap["marks"] if m.get("folded")]
+    assert folded, "the overflow must be visible as folded counts"
+    # identity fields survive the fold as the NEWEST value, never a sum:
+    # the dispatch seq is the request↔dispatch pivot key
+    assert folded[-1]["dispatch"] == 3 * MAX_MARKS
+    # a straggler mark after the seal must not corrupt the record
+    j.mark("decode", tokens=9)
+    assert j.snapshot()["marks"] == snap["marks"]
+
+
+def test_journey_log_tail_sampling_keeps_failures_and_slow():
+    log = JourneyLog(capacity=16)
+
+    def ok(rid: str, wall: float) -> None:
+        j = Journey(rid, model="m")
+        log.start(j)
+        j.finish("stop")
+        j.wall_s = wall
+        log.finish(j)
+
+    # an early FAILURE pins unconditionally (no warm-up needed) …
+    failed = Journey("r-fail", model="m")
+    log.start(failed)
+    failed.finish("deadline")
+    log.finish(failed)
+    # … the slow detector needs a warm rolling window first
+    for i in range(40):
+        ok(f"r-ok-{i}", 0.001)
+    slow = Journey("r-slow", model="m")
+    log.start(slow)
+    slow.finish("stop")
+    slow.wall_s = 99.0  # way past the fast cohort's p99
+    log.finish(slow)
+    for i in range(40, 60):  # churn r-slow out of the recent ring
+        ok(f"r-ok-{i}", 0.001)
+    assert log.get("r-ok-0") is None          # churned out of the ring
+    assert log.get("r-fail") is not None      # failures are pinned
+    assert log.get("r-slow") is not None      # p99-slow is pinned
+    snap = log.snapshot()
+    assert snap["retained"] == 16
+    ex = {e["rid"]: e for e in snap["exemplars"]}
+    assert ex["r-fail"]["failed"] and ex["r-fail"]["finish_reason"] in \
+        FAILURE_REASONS
+    assert not ex["r-slow"]["failed"]
+
+
+def test_journeys_enabled_knob(monkeypatch):
+    monkeypatch.delenv("GOFR_ML_JOURNEY", raising=False)
+    assert journeys_enabled() and journey_log() is not None
+    monkeypatch.setenv("GOFR_ML_JOURNEY", "0")
+    assert not journeys_enabled() and journey_log() is None
+
+
+# ------------------------------------------------------------ serving (live)
+def test_sum_to_wall_under_chunked_prefill_and_speculation(model, run):
+    """THE property acceptance: a prompt long enough to chunk its prefill,
+    decoded with speculation on, still yields a waterfall whose marks sum
+    to the request wall — no negative gaps, spec accept counts attached."""
+    server = LLMServer(_gen(model, batch_slots=1, page_size=4, chunk=2,
+                            prefill_chunk=8, spec_k=2, n_pages=32),
+                       name="jr-prop")
+
+    async def scenario():
+        prompt = list(range(1, 21))  # > largest bucket: chunked prefill
+        out = await server.generate(prompt, 8)
+        assert len(out) == 8
+
+    try:
+        run(scenario())
+    finally:
+        server.close()
+    log = journey_log()
+    snap = log.snapshot()
+    rid = snap["recent_rids"][-1]
+    waterfall = log.get(rid).snapshot()
+    assert waterfall["model"] == "jr-prop"
+    _assert_tiles(waterfall)
+    names = [m["mark"] for m in waterfall["marks"]]
+    assert "admit" in names and "prefill" in names and "decode" in names
+    req = waterfall["request"]
+    assert req["tokens"] == 8
+    assert req.get("spec_windows", 0) >= 1  # spec ran and was accounted
+
+
+def test_failed_request_retained_with_reason(model, run):
+    """A deadline-reaped request's journey seals with the typed reason and
+    pins into the exemplar store; the deadline event carries its rid."""
+    cursor = event_log().cursor
+    server = LLMServer(_gen(model, batch_slots=1), name="jr-dead")
+
+    async def scenario():
+        hog = asyncio.create_task(server.generate([9, 9], 30))
+        await asyncio.sleep(0.05)  # the hog owns the only... both slots?
+        with pytest.raises(DeadlineExceeded):
+            await server.generate([1, 2, 3], 4, deadline_s=0.001)
+        await hog
+
+    try:
+        run(scenario())
+    finally:
+        server.close()
+    ev = [e for e in event_log().query(
+        since=cursor, model="jr-dead", kind="deadline")["events"]]
+    assert ev and ev[-1]["rid"]
+    waterfall = journey_log().get(ev[-1]["rid"]).snapshot()
+    assert waterfall["finish_reason"] == "deadline"
+    _assert_tiles(waterfall)
+    ex = {e["rid"] for e in journey_log().snapshot()["exemplars"]}
+    assert ev[-1]["rid"] in ex
+
+
+def test_pool_request_is_one_timeline(model, run):
+    """A replica-pool request keeps ONE journey across the fleet hop and
+    the core hop: route/admit/prefill/decode/finish in a single record,
+    rid stamped on the route AND admit events, trace id attached — and
+    app_ml_journeys_total labels with the POOL name even though a core
+    seals the natural completion (one label value per fleet)."""
+    counts: dict = {}
+
+    class _Metrics:
+        def add_counter(self, name, delta, **labels):
+            counts[(name, labels.get("model"), labels.get("reason"))] = \
+                counts.get((name, labels.get("model"),
+                            labels.get("reason")), 0) + delta
+
+        def set_gauge(self, name, value, **labels):
+            pass
+
+        def record_histogram(self, name, value, **labels):
+            pass
+
+    tracer = RecordingTracer()
+    cursor = event_log().cursor
+    pool = ReplicaPool([_gen(model), _gen(model)], name="jr-pool",
+                       tracer=tracer, metrics=_Metrics())
+
+    async def scenario():
+        with tracer.start_span("req") as root:
+            out = await pool.generate([3, 1, 4, 1, 5], 5)
+        assert len(out) == 5
+        return root
+
+    try:
+        root = run(scenario())
+    finally:
+        pool.close()
+    routes = [e for e in event_log().query(
+        since=cursor, kind="route")["events"] if e["model"] == "jr-pool"]
+    assert routes and routes[-1]["rid"]
+    rid = routes[-1]["rid"]
+    assert routes[-1]["trace"] == root.trace_id
+    admits = [e for e in event_log().query(
+        since=cursor, kind="admit")["events"]
+        if e.get("rid") == rid]
+    assert admits and admits[0]["model"].startswith("jr-pool/")
+    waterfall = journey_log().get(rid).snapshot()
+    assert waterfall["trace_id"] == root.trace_id
+    _assert_tiles(waterfall)
+    names = [m["mark"] for m in waterfall["marks"]]
+    assert names[0] == "route" and "admit" in names
+    route = waterfall["marks"][0]
+    assert route["reason"] in ("affinity", "least_loaded")
+    assert route["replica"] in (0, 1)
+    assert counts.get(("app_ml_journeys_total", "jr-pool", "length")) == 1
+    assert not any(name == "app_ml_journeys_total" and model != "jr-pool"
+                   for name, model, _ in counts)
+
+
+def test_dispatch_request_crosslink(model, run):
+    """Forensics pivots both ways: decode marks carry the dispatch seq,
+    and the dispatch ring records carry the rids they served."""
+    server = LLMServer(_gen(model), name="jr-xlink")
+
+    async def scenario():
+        await server.generate([3, 1, 4], 6)
+
+    try:
+        run(scenario())
+    finally:
+        server.close()
+    rid = journey_log().snapshot()["recent_rids"][-1]
+    waterfall = journey_log().get(rid).snapshot()
+    seqs = {m["dispatch"] for m in waterfall["marks"] if "dispatch" in m}
+    assert seqs, "prefill/decode marks must carry dispatch seqs"
+    records = server.recorder.tail(64)
+    by_seq = {r["seq"]: r for r in records}
+    linked = [by_seq[s] for s in seqs if s in by_seq]
+    assert linked, "journey seqs must resolve to ring records"
+    assert any(rid in r.get("rids", ()) for r in linked)
+
+
+def test_journeys_disabled_leaves_hot_path_untouched(model, run,
+                                                     monkeypatch):
+    """GOFR_ML_JOURNEY=0: no journey objects anywhere (the instrumented
+    sites see None, same pattern as the recorder knob) and greedy output
+    is byte-identical to the journeys-on run above."""
+    exp = _gen(model).generate([3, 1, 4], 6)
+    monkeypatch.setenv("GOFR_ML_JOURNEY", "0")
+    server = LLMServer(_gen(model), name="jr-off")
+
+    async def scenario():
+        assert server._journeys is None
+        out = await server.generate([3, 1, 4], 6)
+        assert out == exp
+
+    try:
+        run(scenario())
+    finally:
+        server.close()
+    # no dispatch record carries rids when journeys are off: the
+    # crosslink tagging is part of the journey feature, not a fixed tax
+    assert all("rids" not in r for r in server.recorder.tail(64))
+
+
+def test_crash_bundle_carries_victim_journeys(model, run):
+    """CrashVault satellite: the in-flight slots' journey timelines (and
+    the newest dispatch records) ride the crash bundle, so forensics
+    show each victim's full path, not just its final state."""
+    from gofr_tpu.flight_recorder import crash_vault
+    from gofr_tpu.ml.errors import GeneratorCrashed
+
+    server = LLMServer(_gen(model), name="jr-crash", max_restarts=0)
+    fired = {"n": 0}
+
+    def hook(point):
+        if point == "step":
+            fired["n"] += 1
+            if fired["n"] > 1:
+                raise RuntimeError("injected mid-decode")
+
+    server.gen.fault = hook
+
+    async def scenario():
+        with pytest.raises(GeneratorCrashed):
+            await server.generate([3, 1, 4], 12)
+
+    try:
+        run(scenario())
+    finally:
+        server.close()
+    mine = [c for c in crash_vault().list() if c["model"] == "jr-crash"]
+    assert mine
+    bundle = crash_vault().get(mine[-1]["id"])
+    journeys = bundle["state"]["journeys"]
+    assert len(journeys) == 1
+    assert journeys[0]["rid"] == bundle["state"]["slots"][0]["rid"]
+    assert any(m["mark"] == "admit" for m in journeys[0]["marks"])
+    assert bundle["state"]["dispatches"], "dispatch tail rides the bundle"
+
+
+# -------------------------------------------------------- debug endpoints
+def test_debug_requests_endpoints(model, run):
+    """GET /debug/requests (summary + percentiles per mark) and
+    GET /debug/requests/<rid> (waterfall); unknown rids answer 404; the
+    events endpoint takes multi-value kind= and rid= filters and reports
+    the ring's dropped count."""
+
+    async def scenario():
+        app = App(config=MapConfig({"APP_NAME": "jr-app"}))
+        ml = app._ensure_ml()
+        server = LLMServer(_gen(model), name="jr-http")
+        ml._llms["jr-http"] = server
+        http_server = TestServer(app._build_http_app())
+        client = TestClient(http_server)
+        await client.start_server()
+        try:
+            cursor = event_log().cursor
+            await server.generate([3, 1, 4], 5)
+
+            r = await client.get("/debug/requests")
+            body = (await r.json())["data"]
+            assert body["enabled"] and body["finished"] >= 1
+            assert "admit" in body["marks"] and "wall" in body
+            rid = body["recent_rids"][-1]
+
+            r = await client.get(f"/debug/requests/{rid}")
+            assert r.status == 200
+            waterfall = (await r.json())["data"]
+            assert waterfall["rid"] == rid
+            _assert_tiles(waterfall)
+
+            r = await client.get("/debug/requests/no-such-rid")
+            assert r.status == 404
+
+            # multi-value kind filter + rid filter + dropped field
+            r = await client.get(
+                "/debug/events",
+                params=[("kind", "admit,deadline"), ("kind", "shed"),
+                        ("since", str(cursor))])
+            body = (await r.json())["data"]
+            assert "dropped" in body
+            assert {e["kind"] for e in body["events"]} <= {
+                "admit", "deadline", "shed"}
+            r = await client.get("/debug/events",
+                                 params={"rid": rid,
+                                         "since": str(cursor)})
+            evs = (await r.json())["data"]["events"]
+            assert evs and all(e["rid"] == rid for e in evs)
+        finally:
+            await client.close()
+            server.close()
+
+    run(scenario())

@@ -406,6 +406,54 @@ def test_cross_host_ship_over_binary_frame(model, run):
         dst.close()
 
 
+def test_cross_host_ship_single_trace_id(model, run):
+    """THE trace-propagation acceptance: a cross-host ship carries its
+    W3C traceparent INSIDE the binary entry header, so the sender's
+    ``ml.kv_ship`` span and the receiver's ``ml.kv_land`` span (opened
+    by a DIFFERENT tracer, as on a different host) share one trace id —
+    with land parented under ship — and the landed meta never leaks the
+    reserved header key into the host store."""
+    from gofr_tpu.testutil import RecordingTracer
+
+    src = LLMServer(_gen(model, host_kv=HostKVStore(
+        OffloadConfig(budget_mb=64))), name="tr-src")
+    dst = LLMServer(_gen(model, host_kv=HostKVStore(
+        OffloadConfig(budget_mb=64))), name="tr-dst")
+    sender_tr, receiver_tr = RecordingTracer(), RecordingTracer()
+    sender = KVTransport(name="tr-a", tracer=sender_tr)
+    receiver = KVTransport(name="tr-b", tracer=receiver_tr)
+    a, b = socket.socketpair()
+    try:
+        cursor = event_log().cursor
+        with sender_tr.start_span("request") as root:
+            raw = sender.ship_bytes(src, PROMPT, rid="r-xhost")
+        assert raw is not None
+        send_bytes(a, raw)
+        got = recv_frame(b)
+        assert receiver.land_bytes(dst, got, rid="r-xhost") == tuple(PROMPT)
+        ship = sender_tr.by_name("ml.kv_ship")[0]
+        land = receiver_tr.by_name("ml.kv_land")[0]
+        # ONE trace across the socket: the land span continues the
+        # sender's trace and hangs under the ship span
+        assert ship.trace_id == land.trace_id == root.trace_id
+        assert land.parent_span_id == ship.span_id
+        assert land.attributes["ml.rid"] == "r-xhost"
+        # the fleet events carry rid + trace on both ends
+        evs = {e["kind"]: e for e in event_log().query(
+            since=cursor, kind=("kv_ship", "kv_land"))["events"]}
+        assert evs["kv_ship"]["rid"] == evs["kv_land"]["rid"] == "r-xhost"
+        assert evs["kv_ship"]["trace"] == root.trace_id
+        assert evs["kv_land"]["trace"] == root.trace_id
+        # the reserved traceparent key is wire-only — never store meta
+        entry = dst.gen.host_kv._entries[tuple(PROMPT)]
+        assert "_traceparent" not in entry.meta
+    finally:
+        a.close()
+        b.close()
+        src.close()
+        dst.close()
+
+
 def test_land_bytes_corrupt_frame_counts_failure(model):
     """A truncated/garbage binary frame never crashes the receiver: it
     counts as a transport failure and returns None (the full-prefill

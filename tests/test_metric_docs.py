@@ -15,6 +15,17 @@ knobs by reading docs, not source), and every knob the docs mention must
 still be read by the code (a documented knob that silently does nothing
 is worse than none).
 
+Two more inventories ride the same guard:
+
+- **Fleet event kinds**: every ``EventLog.emit("<kind>", …)`` call site
+  in ``gofr_tpu/`` must have a row in the observability doc's event-kind
+  table, and every row must still be emitted — an operator filtering
+  ``/debug/events?kind=…`` discovers the vocabulary there.
+- **``/debug/*`` endpoints**: every route registered in code must be
+  documented, and every documented route must still be mounted — a
+  debug endpoint nobody can find might as well not exist, and a
+  documented 404 burns incident time.
+
 ``app_tpu_*`` gauges are device-runtime metrics with compound doc rows
 (e.g. ``app_tpu_hbm_bytes_in_use / ..._limit``) — out of scope here.
 """
@@ -102,3 +113,91 @@ def test_every_documented_env_knob_still_exists():
         f"GOFR_ML_* knobs documented under docs/ but never read by "
         f"gofr_tpu/: {sorted(ghosts)} — delete the stale mentions or "
         f"wire the knob back up")
+
+
+# --------------------------------------------------- fleet event kinds
+# every emit site in gofr_tpu/ writes through the shared EventLog, so
+# the kind vocabulary is exactly the set of `.emit("<kind>", …)` string
+# literals (\s* spans the line-wrapped calls)
+EMIT_RE = re.compile(r'\.emit\(\s*"([a-z_]+)"')
+
+
+def _code_event_kinds() -> set[str]:
+    kinds: set[str] = set()
+    for path in (REPO / "gofr_tpu").rglob("*.py"):
+        kinds.update(EMIT_RE.findall(path.read_text()))
+    return kinds
+
+
+def _doc_event_kinds() -> set[str]:
+    """Rows of the observability doc's event-kind table: lines of the
+    form ``| `kind` | …`` after the ``| kind |`` table header."""
+    kinds: set[str] = set()
+    in_table = False
+    for raw in DOC.read_text().splitlines():
+        line = raw.strip()  # the table may sit indented inside a bullet
+        if re.match(r"\|\s*kind\s*\|", line):
+            in_table = True
+            continue
+        if in_table:
+            m = re.match(r"\|\s*`([a-z_]+)`\s*\|", line)
+            if m:
+                kinds.add(m.group(1))
+            elif not line.startswith("|"):
+                in_table = False
+    return kinds
+
+
+def test_every_emitted_event_kind_has_a_doc_row():
+    undocumented = _code_event_kinds() - _doc_event_kinds()
+    assert not undocumented, (
+        f"event kinds emitted in gofr_tpu/ missing from the "
+        f"{DOC.relative_to(REPO)} event-kind table: {sorted(undocumented)}"
+        f" — operators discover the /debug/events?kind= vocabulary there")
+
+
+def test_every_documented_event_kind_is_still_emitted():
+    ghosts = _doc_event_kinds() - _code_event_kinds()
+    assert not ghosts, (
+        f"event kinds documented in {DOC.relative_to(REPO)} but never "
+        f"emitted by gofr_tpu/: {sorted(ghosts)} — delete the stale rows")
+
+
+# --------------------------------------------------- /debug/* endpoints
+ROUTE_RE = re.compile(r'add_(?:get|post)\(\s*"(/debug/[^"]+)"')
+DOC_ROUTE_RE = re.compile(r"/debug/[a-zA-Z_/{}<>]+")
+
+
+def _normalize_route(path: str) -> str:
+    """``/debug/crash/{crash_id}`` and ``/debug/crash/<id>`` are the same
+    endpoint: path parameters normalize to one placeholder."""
+    return re.sub(r"(\{[^}]*\}|<[^>]*>)", "<p>", path).rstrip("/")
+
+
+def _code_routes() -> set[str]:
+    routes: set[str] = set()
+    for path in (REPO / "gofr_tpu").rglob("*.py"):
+        routes.update(_normalize_route(m)
+                      for m in ROUTE_RE.findall(path.read_text()))
+    return routes
+
+
+def _doc_routes() -> set[str]:
+    return {_normalize_route(m)
+            for m in DOC_ROUTE_RE.findall(DOC.read_text())}
+
+
+def test_every_debug_route_is_documented():
+    undocumented = _code_routes() - _doc_routes()
+    assert not undocumented, (
+        f"/debug routes registered in gofr_tpu/ but absent from "
+        f"{DOC.relative_to(REPO)}: {sorted(undocumented)} — add them to "
+        f"the Debug endpoints section")
+
+
+def test_every_documented_debug_route_still_exists():
+    ghosts = _doc_routes() - _code_routes()
+    assert not ghosts, (
+        f"/debug routes documented in {DOC.relative_to(REPO)} but not "
+        f"registered by gofr_tpu/: {sorted(ghosts)} — delete the stale "
+        f"mentions or re-mount the route")
