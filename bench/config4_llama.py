@@ -76,6 +76,7 @@ from __future__ import annotations
 
 import json
 import os
+import tempfile
 import time
 
 import numpy as np
@@ -1368,6 +1369,226 @@ async def main() -> None:
                                  if len(ident_j) == 2 else None),
         }
 
+    # ---- phase K: elastic fleet A/B -------------------------------------
+    # Diurnal ramp over an elastic (1 -> 2 -> 1 autoscaled) vs a static
+    # 2-replica fleet, plus a FORCED scale-down of the radix-cache
+    # holder: warm-TTFT across the scale event (migrated cache restored
+    # on the survivor) vs a cold-start prompt of the same length, the
+    # fleet-size trace, the migration ledger (ships == adoptions +
+    # failures), and greedy token identity across arms. Skipped under
+    # the headline watchdog budget unless BENCH_ELASTIC_ARM=1
+    # (bench/run_all.py sets it).
+    elastic_arm = None
+    if os.environ.get("BENCH_ELASTIC_ARM",
+                      "0" if skip_jitter else "1") == "1":
+        page_k = os.environ.get("BENCH_ELASTIC_PAGE",
+                                "16" if on_tpu else "8")
+        hot_len = int(os.environ.get("BENCH_ELASTIC_HOT",
+                                     str(long_len) if on_tpu else "96"))
+        ramp_s = float(os.environ.get("BENCH_ELASTIC_RAMP_S", "1.2"))
+        hot_prompt_k = rng.integers(1, vocab_hi, (hot_len,)).tolist()
+        ident_prompt_k = rng.integers(1, vocab_hi, (12,)).tolist()
+
+        async def hot_ttft(gen_fn, prompt) -> float:
+            t1 = time.perf_counter()
+            async for _ in gen_fn({"prompt_ids": list(prompt),
+                                   "max_new_tokens": 4}):
+                return time.perf_counter() - t1
+            return float("nan")
+
+        armsK: dict = {}
+        ident_k: dict = {}
+        # one persistent XLA cache dir shared by both boots: scale-ups
+        # replay compiles from disk (the production story), and the
+        # TTFT probes time serving work, not first-use compilation
+        cache_dir_k = tempfile.mkdtemp(prefix="bench-elastic-xla-")
+        for mode in ("static", "elastic"):
+            os.environ["LLM_PAGE_SIZE"] = page_k
+            os.environ["LLM_PREFILL_CHUNK"] = str(seg)
+            os.environ["GOFR_ML_KV_HOST_BUDGET_MB"] = "64"
+            os.environ["GOFR_ML_COMPILATION_CACHE_DIR"] = cache_dir_k
+            if mode == "static":
+                os.environ["GOFR_ML_REPLICAS"] = "2"
+            else:
+                os.environ["GOFR_ML_REPLICAS"] = "2"
+                os.environ["GOFR_ML_ELASTIC"] = "1"
+                os.environ["GOFR_ML_REPLICAS_MAX"] = "3"
+                os.environ["GOFR_ML_ELASTIC_INTERVAL_S"] = "0.2"
+            appK = chK = None
+            try:
+                appK = build_app()
+                await boot(appK)
+                chK = grpc.aio.insecure_channel(
+                    f"127.0.0.1:{ports['GRPC_PORT']}")
+                genK = chK.unary_stream(
+                    "/llm.Chat/Generate",
+                    request_serializer=lambda o: json.dumps(o).encode(),
+                    response_deserializer=lambda raw: (json.loads(raw)
+                                                       if raw else {}),
+                )
+                async for _ in genK(req(4)):        # warm compiles
+                    pass
+                toks_k: list = []
+                async for msg in genK({"prompt_ids": ident_prompt_k,
+                                       "max_new_tokens": 16}):
+                    toks_k.extend(msg.get("tokens", ()))
+                ident_k[mode] = toks_k
+                pool = appK.container.ml.llm("chat")
+                if mode == "elastic" and pool._steer is not None:
+                    # CPU-preset cadence: the default hysteresis is
+                    # sized for production diurnals (seconds of
+                    # sustained pressure), not a 1.2 s bench ramp
+                    pool._steer.interval_s = 0.15
+                    pool._steer.up_after = 1
+                    pool._steer.down_after = 3
+                # warm every core's register/spill/migrate/restore
+                # machinery (each core owns its jitted gather/scatter):
+                # the probes below must time serving work, not XLA
+                warm_ids = rng.integers(1, vocab_hi,
+                                        (hot_len - 1,)).tolist()
+
+                async def warm_cores() -> None:
+                    if not hasattr(pool, "replicas"):
+                        return
+                    for i in range(len(pool.replicas)):
+                        if i in pool._retired:
+                            continue
+                        core = pool.replicas[i]
+                        try:
+                            pid = await asyncio.to_thread(
+                                core.register_prefix, warm_ids)
+                            entry = await asyncio.to_thread(
+                                core.export_resident_prefix, warm_ids,
+                                pid)
+                            if entry:
+                                await asyncio.to_thread(
+                                    core.import_prefix_kv, entry[0],
+                                    entry[1], entry[2])
+                                await core.generate(
+                                    list(warm_ids) + [5], 2)
+                        except Exception:
+                            pass
+
+                await warm_cores()
+                # hot prompt: cold first use, promoted + registered on
+                # the repeats, warm once affinity routes to the holder
+                cold_ttft = await hot_ttft(genK, hot_prompt_k)
+                for _ in range(3):
+                    await hot_ttft(genK, hot_prompt_k)
+                warm_ttft = await hot_ttft(genK, hot_prompt_k)
+                # diurnal ramp: an open-loop burst (the up-slope), then
+                # quiet (the down-slope); the fleet-size trace is polled
+                # from /debug/serving's routing.elastic block
+                trace: list[int] = []
+
+                async def poll_fleet(stop_ev):
+                    while not stop_ev.is_set():
+                        entry = await _debug_llm(ports)
+                        el = (entry.get("routing") or {}).get(
+                            "elastic") or {}
+                        if el.get("size"):
+                            trace.append(el["size"])
+                        await asyncio.sleep(0.1)
+
+                stopK = asyncio.Event()
+                poller = asyncio.create_task(poll_fleet(stopK))
+                t0 = time.perf_counter()
+                burst: list = []
+
+                async def slow_req():
+                    t1 = time.perf_counter()
+                    first = None
+                    async for _ in genK(req(24)):
+                        if first is None:
+                            first = time.perf_counter() - t1
+                    return first if first is not None else float("nan")
+
+                # up-slope: a front-loaded wave plus a trickle keeps the
+                # fleet queue pressured for the whole ramp window
+                burst.extend(asyncio.create_task(slow_req())
+                             for _ in range(24))
+                while time.perf_counter() - t0 < ramp_s:
+                    burst.append(asyncio.create_task(slow_req()))
+                    await asyncio.sleep(0.03)
+                ramp_ttfts = [t for t in await asyncio.gather(*burst)
+                              if t == t]
+                await asyncio.sleep(1.5)            # the quiet slope
+                stopK.set()
+                await poller
+                # forced scale-down of the HOT HOLDER (in-process: the
+                # bench owns the app): migration ships the hot subtree
+                # to the survivor, and the next hot probe restores
+                # instead of re-prefilling
+                post_warm = post_cold = None
+                led = None
+                if hasattr(pool, "remove_replica"):
+                    if pool._steer is not None:
+                        # park the autoscaler's floor at 2 so it cannot
+                        # race the forced probe below (retiring the peer
+                        # we just ensured)
+                        pool._steer.n_min = 2
+                    if pool.fleet_size() < 2:
+                        # the autoscaler's quiet slope may have shrunk
+                        # the fleet already: restore a peer so the
+                        # forced scale-down has a survivor to migrate to
+                        await asyncio.to_thread(pool.add_replica)
+                    await warm_cores()  # autoscale-built cores too
+                    holder = max(
+                        (i for i in range(len(pool.replicas))
+                         if i not in pool._retired),
+                        key=lambda i: (
+                            pool.replicas[i].prefix_cache.peek(
+                                hot_prompt_k)[1]
+                            if pool.replicas[i].prefix_cache else 0))
+                    await asyncio.to_thread(pool.remove_replica, holder,
+                                            drain_s=30.0)
+                    post_warm = await hot_ttft(genK, hot_prompt_k)
+                    post_cold = await hot_ttft(genK, rng.integers(
+                        1, vocab_hi, (hot_len,)).tolist())
+                    led = pool.routing_snapshot()["elastic"]["migrations"]
+                armsK[mode] = {
+                    "cold_ttft_ms": round(cold_ttft * 1e3, 1),
+                    "warm_ttft_ms": round(warm_ttft * 1e3, 1),
+                    "ramp_p50_ttft_ms": round(
+                        percentile(ramp_ttfts, 50) * 1e3, 1),
+                    "ramp_p99_ttft_ms": round(
+                        percentile(ramp_ttfts, 99) * 1e3, 1),
+                    "ramp_requests": len(ramp_ttfts),
+                    "fleet_trace": trace[:64],
+                    "post_scale_warm_ttft_ms": (
+                        round(post_warm * 1e3, 1)
+                        if post_warm is not None else None),
+                    "post_scale_cold_ttft_ms": (
+                        round(post_cold * 1e3, 1)
+                        if post_cold is not None else None),
+                    "migrations": led,
+                }
+            except Exception as exc:    # optional arm: record, don't abort
+                armsK[mode] = {"error": str(exc)}
+            finally:
+                for k in ("GOFR_ML_REPLICAS", "GOFR_ML_ELASTIC",
+                          "GOFR_ML_REPLICAS_MAX",
+                          "GOFR_ML_ELASTIC_INTERVAL_S",
+                          "GOFR_ML_KV_HOST_BUDGET_MB", "LLM_PAGE_SIZE",
+                          "LLM_PREFILL_CHUNK",
+                          "GOFR_ML_COMPILATION_CACHE_DIR"):
+                    os.environ.pop(k, None)
+                if chK is not None:
+                    await chK.close()
+                if appK is not None:
+                    await appK.shutdown()
+        elastic_arm = {
+            "page_size": int(page_k),
+            "hot_prompt_len": hot_len,
+            "static": armsK.get("static"),
+            "elastic": armsK.get("elastic"),
+            # greedy probe across the two boots: scale events move KV,
+            # never change tokens
+            "tokens_identical": (
+                ident_k.get("static") == ident_k.get("elastic")
+                if len(ident_k) == 2 else None),
+        }
+
     agg_tok_s = sum(token_counts) / elapsed
     emit(
         "llama_served_tok_per_s", agg_tok_s, "tok/s", 2000.0,
@@ -1430,6 +1651,12 @@ async def main() -> None:
             # TTFT, steady TPOT p99, ships/lands ledger, token identity)
             "disagg": (disagg_arm if disagg_arm is not None
                        else "skipped (headline budget)"),
+            # phase K: elastic fleet — diurnal ramp over autoscaled vs
+            # static replicas + a forced holder scale-down (migrated
+            # warm TTFT vs cold start, fleet-size trace, migration
+            # ledger, token identity)
+            "elastic": (elastic_arm if elastic_arm is not None
+                        else "skipped (headline budget)"),
             "preset": os.environ.get("LLAMA_PRESET", "tiny"),
             "backend": jax.default_backend(),
             "config": 4,

@@ -156,6 +156,11 @@ class Container:
         m.new_counter("app_ml_kv_transport_bytes",
                       "payload bytes moved by the KV transport "
                       "(successful ships)")
+        m.new_counter("app_ml_kv_migrations_total",
+                      "live-KV-migration attempts during elastic scale "
+                      "events, by outcome (adopted / failed / skipped)")
+        m.new_gauge("app_llm_fleet_size",
+                    "live (non-retired) replicas in an elastic pool")
         m.new_counter("app_ml_events_dropped_total",
                       "fleet-event-log ring overwrites: events consumers "
                       "polling /debug/events can no longer read (their "

@@ -127,7 +127,12 @@ class MLDatasource:
         (``depth_per_replica``, ``affinity_min_tokens``) reach the pool;
         with a single replica there is no router and they do not apply.
         With the default of 1, behavior is exactly the single-server
-        path."""
+        path — except under ``GOFR_ML_ELASTIC=1`` (or ``elastic=True``),
+        which mounts the pool front even at size 1 so the elastic fleet
+        can scale at runtime (``scale_to``/``add_replica``/
+        ``remove_replica`` + the autoscale loop); when the fleet is
+        built from ``(params, cfg)`` a default ``spawn=`` factory is
+        wired so scale-ups can build new replica cores."""
         from .generate import Generator
         from .llm import LLMServer
         from .replica import (ReplicaPool, build_replica_generators,
@@ -147,7 +152,8 @@ class MLDatasource:
         # they ride separately instead of crashing Generator/LLMServer
         pool_kwargs = {
             k: gen_kwargs.pop(k)
-            for k in ("depth_per_replica", "affinity_min_tokens", "disagg")
+            for k in ("depth_per_replica", "affinity_min_tokens", "disagg",
+                      "spawn", "elastic", "replicas_min", "replicas_max")
             if k in gen_kwargs
         }
         explicit = (replicas is not None
@@ -186,6 +192,25 @@ class MLDatasource:
             gens = [generator]
         else:
             warm = gen_kwargs.pop("warmup", True)
+            if "spawn" not in pool_kwargs:
+                # an elastic pool built from (params, cfg) can grow at
+                # runtime: the default spawn factory builds one warmed
+                # replica generator on the new index's device slice
+                # (spares first, round-robin past the device count —
+                # exactly split_devices' CPU-test degradation)
+                def _default_spawn(idx, _p=params, _c=cfg, _n0=n,
+                                   _kw=dict(gen_kwargs)):
+                    import jax
+
+                    devs = list(jax.devices())
+                    per = max(1, len(devs) // max(1, _n0))
+                    lo = idx * per
+                    subset = (devs[lo:lo + per] if lo + per <= len(devs)
+                              else [devs[idx % len(devs)]])
+                    return build_replica_generators(
+                        _p, _c, 1, warmup=True, devices=subset, **_kw)[0]
+
+                pool_kwargs["spawn"] = _default_spawn
             if n > 1:
                 gens = build_replica_generators(params, cfg, n,
                                                 warmup=warm, **gen_kwargs)
@@ -194,9 +219,12 @@ class MLDatasource:
                 if warm:
                     # startup pays every compile, not a request
                     gens[0].warmup()
-        if len(gens) == 1:
-            from .replica import disagg_from_env
+        from .replica import disagg_from_env, elastic_from_env
 
+        elastic_req = pool_kwargs.get("elastic")
+        if elastic_req is None:
+            elastic_req = elastic_from_env()
+        if len(gens) == 1:
             disagg_req = pool_kwargs.get("disagg")
             if disagg_req is None:
                 disagg_req = disagg_from_env()
@@ -207,7 +235,7 @@ class MLDatasource:
                 raise ValueError(
                     f"llm {name}: disaggregated prefill/decode "
                     f"(GOFR_ML_DISAGG/disagg=) requires replicas >= 2")
-        if len(gens) > 1:
+        if len(gens) > 1 or elastic_req:
             server = ReplicaPool(gens, name=name, logger=self._logger,
                                  metrics=self._metrics, tracer=self._tracer,
                                  **pool_kwargs, **server_kwargs)

@@ -37,6 +37,13 @@ This stack already had both halves of the primitive:
   the wire instead of +33% base64 inside a JSON frame. ``ship_bytes`` /
   ``land_bytes`` are the socket-facing halves of ``ship``.
 
+The same machinery carries **live KV migration** for elastic scale
+events (``migrate``): a draining replica's already-computed hot radix
+subtrees leave through ``export_resident_prefix`` (spill + take, no
+recompute) and land on survivors exactly like a disagg ship — the scale
+event moves the cache instead of discarding it, with a balanced ledger
+(ships == adoptions + failures) as the acceptance contract.
+
 Failure semantics are inherited, not invented: any export/land failure —
 an armed ``ship``/``land`` fault, a dead replica, an over-budget entry, a
 pool too tight to register — makes ``ship`` return ``None`` and the
@@ -80,6 +87,10 @@ __all__ = ["KVTransport", "encode_entry", "decode_entry"]
 # rides the entry's JSON header (the one structured field both hosts
 # parse) and is popped back out before the meta reaches the host store
 _TRACE_KEY = "_traceparent"
+# reserved meta key flagging a cross-host MIGRATION (elastic scale
+# event) so the receiving host's land_bytes closes the migration ledger
+# there: sender ships == receiver adoptions + failures, fleet-wide
+_MIGRATE_KEY = "_migration"
 
 
 # -- wire codec (cross-host: rides multihost.send_bytes) ----------------------
@@ -157,6 +168,14 @@ class KVTransport:
         self.lands = 0          # the prefill replica) / landed decode-side
         self.failures = 0       # handoffs that fell back to full prefill
         self.bytes_moved = 0    # payload bytes of successful ships
+        # live-KV-migration ledger (elastic scale events, ml/replica.py):
+        # every entry that left a draining replica ("ships") either
+        # landed on a survivor ("adoptions") or is an accounted failure
+        # ("failures") — ships == adoptions + failures, always. Exports
+        # that never left (nothing migratable, spill rejected) are
+        # "skipped": the survivor cold-starts that prefix, honestly.
+        self.migrations = {"ships": 0, "adoptions": 0, "failures": 0,
+                           "skipped": 0, "bytes": 0}
 
     def _span(self, name: str, parent, **attrs):
         """One transport-hop span (None without a tracer). ``activate``
@@ -257,6 +276,113 @@ class KVTransport:
         self._end(span)
         return key
 
+    # -- live KV migration (elastic scale events, ml/replica.py) -------------
+    def migrate(self, src: Any, dst: Any, prefix_ids,
+                pid: int | None = None, timeout_s: float = 30.0, *,
+                src_idx: int | None = None,
+                dst_idx: int | None = None) -> str:
+        """Move KV a draining replica ALREADY HOLDS to a survivor: take
+        the registered (or already-offloaded) entry out of ``src``
+        without recomputing it (``LLMServer.export_resident_prefix``) and
+        land it in ``dst``'s host tier + radix trie exactly like a disagg
+        ship. Returns the outcome — ``"adopted"`` (the survivor holds the
+        pages), ``"failed"`` (they left the source and were lost on the
+        way), or ``"skipped"`` (nothing migratable left the source). The
+        ledger
+        is the acceptance contract of a scale event: every export that
+        left the source is a ship, and ships == adoptions + failures —
+        a lost migration is ACCOUNTED (the prefix cold-starts on the
+        survivor, bit-identically), never silent. Outcomes also publish
+        as ``app_ml_kv_migrations_total{outcome=adopted|failed|skipped}``
+        and one ``migrate`` fleet event per attempt that left the
+        source."""
+        span = self._span("ml.kv_ship", None, **(
+            {"ml.migration": True}))
+        try:
+            entry = src.export_resident_prefix(prefix_ids, pid, timeout_s)
+        except Exception:
+            entry = None
+        if entry is None:
+            with self._lock:
+                self.migrations["skipped"] += 1
+            self._count_outcome("skipped")
+            self._end(span, "nothing migratable")
+            return "skipped"
+        key, arrays, meta = entry
+        nbytes = sum(int(a.nbytes) for a in arrays.values())
+        with self._lock:
+            self.migrations["ships"] += 1
+            self.migrations["bytes"] += nbytes
+        try:
+            ok = dst.import_prefix_kv(key, arrays, meta, timeout_s)
+        except Exception:
+            ok = False
+        outcome = "adopted" if ok else "failed"
+        with self._lock:
+            self.migrations["adoptions" if ok else "failures"] += 1
+        self._count_outcome(outcome)
+        self._events.emit("migrate", model=self.name, tokens=len(key),
+                          bytes=nbytes, outcome=outcome,
+                          **({"from_replica": src_idx}
+                             if src_idx is not None else {}),
+                          **({"to_replica": dst_idx}
+                             if dst_idx is not None else {}))
+        if span is not None:
+            span.set_attributes({"ml.bytes": nbytes,
+                                 "ml.tokens": len(key)})
+        self._end(span, None if ok else "land failed")
+        return outcome
+
+    def migrate_bytes(self, src: Any, prefix_ids,
+                      pid: int | None = None,
+                      timeout_s: float = 30.0) -> bytes | None:
+        """Cross-host sender half of a migration: export resident KV off
+        a draining replica and encode it for the wire (pair with
+        ``multihost.send_bytes``; the receiving host lands it with the
+        ordinary ``land_bytes``, whose success/failure closes the ledger
+        there: sender ships == receiver adoptions + failures,
+        fleet-wide). ``None`` when nothing migratable left the source
+        (counted ``skipped``)."""
+        try:
+            entry = src.export_resident_prefix(prefix_ids, pid, timeout_s)
+        except Exception:
+            entry = None
+        if entry is None:
+            with self._lock:
+                self.migrations["skipped"] += 1
+            self._count_outcome("skipped")
+            return None
+        key, arrays, meta = entry
+        raw = encode_entry(key, arrays, {**meta, _MIGRATE_KEY: True})
+        with self._lock:
+            self.migrations["ships"] += 1
+            self.migrations["bytes"] += len(raw)
+        self._events.emit("migrate", model=self.name, tokens=len(key),
+                          bytes=len(raw), outcome="shipped_bytes")
+        return raw
+
+    @staticmethod
+    def _header_says_migration(raw: bytes) -> bool:
+        """Best-effort peek at a frame's JSON header for the migration
+        marker — used when the full decode failed, so every parse step
+        may itself fail (then the frame is unattributable and only the
+        generic failure counter moves)."""
+        try:
+            (hlen,) = struct.unpack(">I", raw[:4])
+            header = json.loads(raw[4:4 + hlen])
+            return bool(header.get("meta", {}).get(_MIGRATE_KEY))
+        except Exception:
+            return False
+
+    def _count_outcome(self, outcome: str) -> None:
+        if self._metrics is None:
+            return
+        try:
+            self._metrics.add_counter("app_ml_kv_migrations_total", 1,
+                                      model=self.name, outcome=outcome)
+        except Exception:
+            pass
+
     # -- cross-host halves (ride multihost.send_bytes) -----------------------
     def ship_bytes(self, src: Any, prefix_ids,
                    timeout_s: float = 120.0, *, journey=None, rid=None,
@@ -320,10 +446,32 @@ class KVTransport:
         except Exception:
             with self._lock:
                 self.failures += 1
+            if self._header_says_migration(raw):
+                # the payload was truncated/corrupt but the header still
+                # names this frame a migration: account the failure so
+                # the fleet-wide ledger (sender ships == receiver
+                # adoptions + failures) holds for the common
+                # lost-payload case
+                with self._lock:
+                    self.migrations["failures"] += 1
+                self._count_outcome("failed")
             return None
         parent = parse_traceparent(meta.pop(_TRACE_KEY, None))
-        return self._land(dst, key, arrays, meta, timeout_s,
-                          journey=journey, rid=rid, parent=parent)
+        migration = bool(meta.pop(_MIGRATE_KEY, False))
+        landed = self._land(dst, key, arrays, meta, timeout_s,
+                            journey=journey, rid=rid, parent=parent)
+        if migration:
+            # this frame was a cross-host MIGRATION (elastic scale
+            # event): close the migration ledger on THIS side — the
+            # sender counted the ship, adoption/failure lands here
+            ok = landed is not None
+            with self._lock:
+                self.migrations["adoptions" if ok else "failures"] += 1
+            self._count_outcome("adopted" if ok else "failed")
+            self._events.emit("migrate", model=self.name,
+                              tokens=len(key),
+                              outcome="adopted" if ok else "failed")
+        return landed
 
     # -- introspection -------------------------------------------------------
     def snapshot(self) -> dict:
@@ -333,6 +481,7 @@ class KVTransport:
                 "lands": self.lands,
                 "failures": self.failures,
                 "bytes_moved": self.bytes_moved,
+                "migrations": dict(self.migrations),
             }
 
     def _count(self, name: str, value: int) -> None:

@@ -272,6 +272,10 @@ class LLMServer:
         self._journeys = journey_log()
         self._events = event_log()
         self._crashes = crash_vault()
+        # a ReplicaPool front installs a fleet-shape provider here so a
+        # core's crash bundle snapshots the CURRENT membership (elastic
+        # fleets change shape at runtime); standalone servers leave None
+        self.fleet_info = None
         if getattr(generator, "host_kv", None) is not None:
             # label the host tier's spill/restore events with this model
             generator.host_kv.model = name
@@ -518,6 +522,54 @@ class LLMServer:
             return ids, entry[0], entry[1]
 
         return self._run_on_serving(work, timeout_s, "export_prefix_kv")
+
+    def export_resident_prefix(self, prefix_ids, pid: int | None = None,
+                               timeout_s: float = 30.0) -> tuple | None:
+        """MIGRATION-side export (elastic scale-down, ml/replica.py):
+        hand over KV this core ALREADY HOLDS — a registered radix-cache
+        prefix (spilled device→host with ``drop_prefix(spill=True)``,
+        then taken out of the store) or an already-offloaded host-tier
+        entry — WITHOUT recomputing anything, unlike ``export_prefix_kv``
+        (whose job is to compute fresh KV on a prefill replica). Returns
+        ``(key, arrays, meta)`` or ``None`` when there is nothing
+        migratable under this key (borrowed registration, spill rejected
+        by the host budget, entry already gone) — the caller counts it
+        and moves on; the worst case is a cold cache on the survivor,
+        never a wrong token. Runs on the serving thread; the ``migrate``
+        fault point fires there, so ``GOFR_ML_FAULT_REPLICA`` narrows
+        chaos to one replica's exports."""
+        def work() -> tuple | None:
+            gen = self.gen
+            if not getattr(gen, "page_size", 0) \
+                    or getattr(gen, "host_kv", None) is None:
+                return None
+            ids = tuple(int(t) for t in prefix_ids)
+            t0 = time.perf_counter()
+            if self._fault is not None:
+                self._fault("migrate")  # chaos: export lost mid-handoff
+            if pid is not None and gen.has_prefix(pid):
+                info = gen._prefixes[pid]
+                if info["refs"] > 0:
+                    return None  # borrowed: drains with its slots
+                key = tuple(int(t) for t in info["ids_full"])
+                spilled = gen.drop_prefix(pid, spill=True)
+                if self.prefix_cache is not None:
+                    # registered → offloaded in the trie bookkeeping
+                    # (cleared again below once the entry leaves)
+                    self.prefix_cache.invalidate(pid)
+                if not spilled:
+                    return None  # host budget rejected it: discarded
+                ids = key
+            entry = gen.host_kv.take(ids)
+            if self.prefix_cache is not None:
+                self.prefix_cache.forget_offloaded(ids)
+            if self.recorder is not None:
+                self.recorder.note("ship", time.perf_counter() - t0)
+            if entry is None:
+                return None
+            return ids, entry[0], entry[1]
+
+        return self._run_on_serving(work, timeout_s, "export_resident_prefix")
 
     def import_prefix_kv(self, key, arrays: dict, meta: dict,
                          timeout_s: float = 30.0) -> bool:
@@ -813,6 +865,13 @@ class LLMServer:
                 state["journeys"] = journeys
             if self.recorder is not None:
                 state["dispatches"] = self.recorder.tail(16)
+            if self.fleet_info is not None:
+                try:  # the fleet shape at crash time (elastic pools
+                    # scale at runtime, so "2 replicas" is a timestamped
+                    # fact, not a config constant)
+                    state["fleet"] = self.fleet_info()
+                except Exception:
+                    pass
             try:  # the pool counters may be mid-wreck; best effort
                 state["pool"] = self.gen.pool_stats()
             except Exception:

@@ -553,6 +553,60 @@ class RadixPrefixCache:
             node.reg_len = len(ids)
         return True
 
+    def hot_prefixes(self, limit: int | None = None) -> list[dict]:
+        """The cache's hot radix subtrees, hit-count-descending — the
+        migration worklist of an elastic scale-down (ml/replica.py): a
+        draining replica ships exactly these to survivors so the scale
+        event moves the cache instead of discarding it. Each row is
+        ``{"ids": <registered token run>, "hits": n, "state":
+        "registered"|"offloaded", "pid": id|None}``. Borrowed (refs > 0)
+        registrations are skipped — they drain with their slots and the
+        core's close() waits for them — and PINNED ones too: a pool-level
+        pin already lives on every replica, so migrating it would only
+        duplicate pages the survivors hold. Read-only under the lock,
+        safe from any thread."""
+        rows: list[dict] = []
+        with self._lock:
+            for pid, node in self._by_pid.items():
+                info = self.gen._prefixes.get(pid)
+                if info is None or info["refs"] > 0 or info.get("pinned"):
+                    continue
+                ids = self._node_tokens(node)[:node.reg_len]
+                if ids:
+                    rows.append({"ids": ids, "hits": node.hits,
+                                 "state": "registered", "pid": pid})
+            offloaded = []
+            stack = [self._root]
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                if n.offload_key is not None and n.pid is None:
+                    offloaded.append(n)
+            for node in offloaded:
+                rows.append({"ids": node.offload_key, "hits": node.hits,
+                             "state": "offloaded", "pid": None})
+        rows.sort(key=lambda r: -r["hits"])
+        return rows if limit is None else rows[:limit]
+
+    def forget_offloaded(self, key_ids) -> None:
+        """The host-tier entry for this exact key LEFT the replica (a KV
+        migration took it): clear the node's offloaded state so admission
+        never chases a restore that can only miss."""
+        ids = tuple(int(t) for t in key_ids)
+        with self._lock:
+            node = self._root
+            pos = 0
+            while pos < len(ids):
+                child = node.children.get(ids[pos])
+                if (child is None
+                        or ids[pos:pos + len(child.edge)] != child.edge):
+                    return
+                pos += len(child.edge)
+                node = child
+            if node.offload_key == ids and node.pid is None:
+                node.offload_key = None
+                node.reg_len = 0
+
     def invalidate(self, pid: int) -> None:
         """The generator evicted this pid under pool pressure (a
         ``PrefixEvicted`` admission race): clear the stale registration

@@ -37,7 +37,25 @@ The front owns the request plane once, fleet-wide:
 
 ``GOFR_ML_REPLICAS=1`` (the default) never constructs a pool —
 ``register_llm`` returns a plain ``LLMServer``, byte-identical to the
-single-replica behavior.
+single-replica behavior (``GOFR_ML_ELASTIC=1`` is the one exception: an
+elastic fleet needs the pool front even at size 1 so it can grow).
+
+**Elastic fleet** (this module's scale plane): membership is dynamic.
+``scale_to(n)`` / ``add_replica()`` / ``remove_replica(idx)`` change the
+fleet at runtime — scale-up builds a new core (from the ``spawn=``
+factory, warmed through the persistent XLA cache), backfills every
+pool-pinned prefix registration, and only then marks it routable;
+scale-down retires a replica from routing, **migrates its hot radix
+subtrees to survivors through the KV transport** (the scale event moves
+the cache instead of discarding it), then reuses the PR 6 drain path —
+in-flight decode finishes, staged work re-admits front-of-class.
+``GOFR_ML_ELASTIC=1`` arms an autoscale control loop (``_FleetSteer`` —
+PR 9's ``_RoleSteer`` generalized from "role ratio" to "fleet size"),
+steered by fleet queue depth and the observed Retry-After drain rate
+(plus the disagg SLO controller's state when one runs), with hysteresis
+and ``GOFR_ML_REPLICAS_MIN``/``GOFR_ML_REPLICAS_MAX`` bounds. Every
+migration failure degrades to the PR 9 contract: full prefill on a
+survivor, bit-identical output, no hangs.
 
 In-process replicas place their generators on distinct device subsets
 (``split_devices`` + ``parallel``'s mesh machinery); the cross-host seam
@@ -50,6 +68,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import concurrent.futures
+import inspect
 import os
 import threading
 import time
@@ -69,7 +88,7 @@ from .scheduler import (PRIORITIES, AgingPriorityQueue, SLOController,
                         normalize_priority, retry_after_s)
 
 __all__ = ["ReplicaPool", "split_devices", "build_replica_generators",
-           "replicas_from_env", "disagg_from_env"]
+           "replicas_from_env", "disagg_from_env", "elastic_from_env"]
 
 # health-state ordinal for the app_llm_replica_state gauge (alert on >= 2)
 _STATE_VALUE = {"serving": 0, "degraded": 1, "recovering": 2, "dead": 3}
@@ -84,6 +103,16 @@ _SKIP_PREFILL = object()
 # the operator left GOFR_ML_KV_HOST_BUDGET_MB unset: the transport moves
 # pages THROUGH the host tier, so a store must exist
 _DISAGG_DEFAULT_HOST_MB = 256.0
+
+
+def _ensure_host_store(gen) -> None:
+    """Arm a generator's host KV tier at the serviceable default when
+    the operator left ``GOFR_ML_KV_HOST_BUDGET_MB`` unset — the ONE
+    arming expression behind disagg construction, runtime scale-up, and
+    migration (the transports move pages THROUGH the host tier)."""
+    if getattr(gen, "host_kv", None) is None:
+        gen.host_kv = HostKVStore.from_env() or HostKVStore(
+            OffloadConfig(budget_mb=_DISAGG_DEFAULT_HOST_MB))
 
 
 def disagg_from_env() -> bool:
@@ -115,6 +144,134 @@ def _disagg_prefill_from_env(default: int) -> int:
     if n < 1:
         raise ValueError(f"GOFR_ML_DISAGG_PREFILL must be >= 1, got {n}")
     return n
+
+
+def elastic_from_env() -> bool:
+    """``GOFR_ML_ELASTIC`` as the autoscale switch. Unset/0 = off (the
+    pool path is byte-identical to the static-fleet behavior); malformed
+    values fail loudly at startup, like ``GOFR_ML_REPLICAS``."""
+    raw = os.environ.get("GOFR_ML_ELASTIC", "").strip()
+    if not raw or raw == "0":
+        return False
+    if raw == "1":
+        return True
+    raise ValueError(f"GOFR_ML_ELASTIC must be 0 or 1, got {raw!r}")
+
+
+def _fleet_bound_from_env(name: str, default: int, floor: int) -> int:
+    """``GOFR_ML_REPLICAS_MIN``/``GOFR_ML_REPLICAS_MAX`` parsed loudly
+    (0 on MAX = unbounded)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name} must be an integer, got {raw!r}") from None
+    if n < floor:
+        raise ValueError(f"{name} must be >= {floor}, got {n}")
+    return n
+
+
+class _FleetSteer:
+    """Fleet-SIZE controller: ``_RoleSteer`` generalized from "what ratio
+    of a fixed fleet prefills" to "how many replicas the fleet has".
+
+    One ``decide()`` pass per controller interval
+    (``GOFR_ML_ELASTIC_INTERVAL_S``), fed signals the stack already
+    produces: fleet queue depth vs free capacity, the observed
+    Retry-After drain-rate estimate, and — under disaggregation — the
+    lifted SLO controller's last TTFT window. **Pressure** (backlog past
+    what the fleet can stage, a drain estimate that says waiters will
+    sit multiple intervals, or TTFT over target) votes up; **idle**
+    (empty queue AND the in-flight load fitting comfortably in one fewer
+    replica) votes down. Hysteresis: ``up_after`` consecutive pressure
+    votes grow the fleet by ONE, ``down_after`` consecutive idle votes
+    shrink it by one — scale-down is deliberately the slower direction
+    (a wrongly-shed replica costs a rebuild; a wrongly-kept one only
+    costs idle devices) — and any mixed signal resets both counters.
+    Bounds: the verdict never leaves [n_min, n_max]."""
+
+    def __init__(self, n_min: int, n_max: int, *,
+                 interval_s: float | None = None, up_after: int = 2,
+                 down_after: int = 6) -> None:
+        self.n_min = max(1, int(n_min))
+        self.n_max = max(self.n_min, int(n_max))
+        if interval_s is None:
+            raw = os.environ.get("GOFR_ML_ELASTIC_INTERVAL_S", "").strip()
+            try:
+                interval_s = float(raw) if raw else 2.0
+            except ValueError:
+                raise ValueError(
+                    f"GOFR_ML_ELASTIC_INTERVAL_S must be seconds, "
+                    f"got {raw!r}") from None
+        if not 0.0 < float(interval_s) < float("inf"):
+            raise ValueError(
+                f"elastic interval must be finite and > 0, got {interval_s}")
+        self.interval_s = float(interval_s)
+        self.up_after = max(1, int(up_after))
+        self.down_after = max(1, int(down_after))
+        self._last = 0.0
+        self._up_ticks = 0
+        self._down_ticks = 0
+        self.decisions = 0
+        self.verdicts = {"up": 0, "down": 0}
+        self.last_signal: dict = {}
+
+    def decide(self, *, queued: int, free: int, outstanding: int,
+               capacity: int, n_live: int, retry_after_s: float,
+               slo_over: bool = False,
+               now: float | None = None) -> int | None:
+        """A target fleet size, or ``None`` (stay put). Interval-gated
+        internally, like ``SLOController.maybe_update``."""
+        now = time.monotonic() if now is None else now
+        if now - self._last < self.interval_s:
+            return None
+        self._last = now
+        self.decisions += 1
+        pressure = (queued > max(0, free)
+                    and (retry_after_s > self.interval_s or slo_over
+                         or queued >= n_live))
+        per_replica = capacity // max(1, n_live)
+        idle = (queued == 0 and n_live > 1
+                and outstanding * 2 <= max(0, capacity - per_replica))
+        self.last_signal = {"queued": queued, "free": free,
+                            "outstanding": outstanding,
+                            "retry_after_s": round(retry_after_s, 3),
+                            "slo_over": slo_over,
+                            "pressure": pressure, "idle": idle}
+        if pressure and n_live < self.n_max:
+            self._down_ticks = 0
+            self._up_ticks += 1
+            if self._up_ticks >= self.up_after:
+                self._up_ticks = 0
+                self.verdicts["up"] += 1
+                return min(self.n_max, n_live + 1)
+        elif idle and n_live > self.n_min:
+            self._up_ticks = 0
+            self._down_ticks += 1
+            if self._down_ticks >= self.down_after:
+                self._down_ticks = 0
+                self.verdicts["down"] += 1
+                return max(self.n_min, n_live - 1)
+        else:
+            self._up_ticks = 0
+            self._down_ticks = 0
+        return None
+
+    def snapshot(self) -> dict:
+        return {
+            "interval_s": self.interval_s,
+            "bounds": {"min": self.n_min, "max": self.n_max},
+            "hysteresis": {"up_after": self.up_after,
+                           "down_after": self.down_after,
+                           "up_ticks": self._up_ticks,
+                           "down_ticks": self._down_ticks},
+            "decisions": self.decisions,
+            "verdicts": dict(self.verdicts),
+            "last_signal": dict(self.last_signal),
+        }
 
 
 class _RoleSteer:
@@ -273,6 +430,9 @@ class ReplicaPool:
                  depth_per_replica: int | None = None,
                  affinity_min_tokens: int | None = None,
                  fault: Any = None, disagg: Any = None,
+                 spawn: Any = None, elastic: Any = None,
+                 replicas_min: int | None = None,
+                 replicas_max: int | None = None,
                  **server_kwargs) -> None:
         generators = list(generators)
         if not generators:
@@ -299,13 +459,10 @@ class ReplicaPool:
                         "disaggregated prefill/decode requires paged "
                         f"generators (page_size > 0); replica {idx} is "
                         "dense")
-                if getattr(gen, "host_kv", None) is None:
-                    # the transport moves pages THROUGH the host tier, so
-                    # every replica needs a store even when the operator
-                    # left plain offload off (GOFR_ML_KV_HOST_BUDGET_MB
-                    # unset/0) — armed at a serviceable default budget
-                    gen.host_kv = HostKVStore.from_env() or HostKVStore(
-                        OffloadConfig(budget_mb=_DISAGG_DEFAULT_HOST_MB))
+                # every replica needs a store even when the operator
+                # left plain offload off (GOFR_ML_KV_HOST_BUDGET_MB
+                # unset/0) — armed at a serviceable default budget
+                _ensure_host_store(gen)
         self._logger = logger
         self._metrics = metrics
         self._tracer = tracer   # ml.route spans (one per routing attempt)
@@ -340,32 +497,58 @@ class ReplicaPool:
         self._affinity_min = (
             int(os.environ.get("GOFR_ML_AFFINITY_MIN_TOKENS", "1"))
             if affinity_min_tokens is None else int(affinity_min_tokens))
-        # the front's own chaos point ("route"); replica-independent
+        # the front's own chaos point ("route" + the elastic
+        # scale_up/scale_down points); replica-independent
         self._fault = (FaultInjector.from_env() if fault is None
                        else (fault or None))
+        # -- elastic fleet (runtime scale-up/down) ---------------------------
+        # ``scale_to``/``add_replica``/``remove_replica`` work on ANY pool;
+        # GOFR_ML_ELASTIC=1 (or elastic=True) additionally arms the
+        # autoscale control loop. OFF plus no scale calls keeps the pool
+        # path byte-identical to the static-fleet behavior: the only new
+        # work on the hot path is one empty-set membership test.
+        self._spawn = spawn          # builds a Generator for a new replica
+        self._elastic = (elastic_from_env() if elastic is None
+                         else bool(elastic))
+        self._n_min = (_fleet_bound_from_env("GOFR_ML_REPLICAS_MIN", 1, 1)
+                       if replicas_min is None else max(1, int(replicas_min)))
+        self._n_max = (_fleet_bound_from_env("GOFR_ML_REPLICAS_MAX", 0, 0)
+                       if replicas_max is None else max(0, int(replicas_max)))
+        if self._n_max and self._n_max < self._n_min:
+            raise ValueError(
+                f"GOFR_ML_REPLICAS_MAX ({self._n_max}) < "
+                f"GOFR_ML_REPLICAS_MIN ({self._n_min})")
+        if self._disagg:
+            # a disaggregated fleet can never drop below 2 (one prefill-
+            # biased + one decode): floor the scale plane there so the
+            # autoscaler can't loop on down-verdicts remove_replica must
+            # reject, and scale_to(1) clamps instead of raising
+            self._n_min = max(self._n_min, 2)
+        # retired membership slots: indices are STABLE for the pool's
+        # lifetime (every accounting list is positional), so a removed
+        # replica keeps its index and joins this set instead of shifting
+        # everyone behind it
+        self._retired: set[int] = set()
+        # serializes scale events; close() acquires it to SETTLE an
+        # in-flight event before touching the membership list
+        self._scale_lock = threading.Lock()
+        self._scale_history: collections.deque[dict] = collections.deque(
+            maxlen=32)
+        self._scale_thread: threading.Thread | None = None
+        self._steer = (_FleetSteer(self._n_min, self._n_max or 1_000_000)
+                       if self._elastic else None)
+        self._depth = depth
+        self._server_kwargs = dict(server_kwargs)
+        self._fault_arg = fault
         # per-replica cores: bounds/deadline/shedding DISABLED — the front
         # is the one place those policies run. The fault spec — env OR the
         # programmatic ``fault=`` injector — arms each core through the
         # same per-replica derivation (GOFR_ML_FAULT_REPLICA narrowing,
-        # independent seed per replica).
+        # independent seed per replica) whether the replica exists from
+        # construction or joins at runtime (seed offset = POOL index).
         self.replicas: list[LLMServer] = []
         for idx, gen in enumerate(generators):
-            ck = dict(server_kwargs)
-            if fault is None:
-                core_fault = FaultInjector.from_env_for_replica(idx)
-            elif self._fault is None:
-                core_fault = None
-            elif hasattr(self._fault, "for_replica"):
-                core_fault = self._fault.for_replica(idx)
-            else:
-                # a bare callable hook (the LLMServer fault= contract):
-                # no per-replica derivation to do — arm every core with it
-                core_fault = self._fault
-            ck.setdefault("fault", core_fault or False)
-            self.replicas.append(LLMServer(
-                gen, name=f"{name}/{idx}", logger=logger, metrics=metrics,
-                tracer=tracer, max_queue=0, max_queued_tokens=0,
-                default_deadline_s=0.0, **ck))
+            self.replicas.append(self._build_core(gen, idx))
         self._capacity = [max(1, g.batch_slots) * depth for g in generators]
         self._outstanding = [0] * len(generators)
         if self._disagg:
@@ -430,6 +613,59 @@ class ReplicaPool:
         self._loop: asyncio.AbstractEventLoop | None = None
         self._wake: asyncio.Event | None = None
         self._dispatcher: asyncio.Task | None = None
+
+    # -- membership -----------------------------------------------------------
+    def _build_core(self, gen, idx: int) -> LLMServer:
+        """One serving core at pool index ``idx`` — the ONE construction
+        path for replicas present at startup and replicas added at
+        runtime, so the per-replica fault derivation (seed offset = pool
+        index) and the disabled per-core bounds can never diverge."""
+        ck = dict(self._server_kwargs)
+        if self._fault_arg is None:
+            core_fault = FaultInjector.from_env_for_replica(idx)
+        elif self._fault is None:
+            core_fault = None
+        elif hasattr(self._fault, "for_replica"):
+            core_fault = self._fault.for_replica(idx)
+        else:
+            # a bare callable hook (the LLMServer fault= contract):
+            # no per-replica derivation to do — arm every core with it
+            core_fault = self._fault
+        ck.setdefault("fault", core_fault or False)
+        core = LLMServer(
+            gen, name=f"{self.name}/{idx}", logger=self._logger,
+            metrics=self._metrics, tracer=self._tracer, max_queue=0,
+            max_queued_tokens=0, default_deadline_s=0.0, **ck)
+        # crash bundles on this core snapshot the CURRENT fleet shape —
+        # in an elastic fleet "how many replicas" is a timestamped fact
+        core.fleet_info = self._fleet_shape
+        return core
+
+    def _live_indices(self) -> list[int]:
+        """Fleet membership: every index that has not been retired by a
+        scale-down. (Set reads are GIL-atomic; callers that also need
+        the accounting lists consistent hold ``self._lock``.)"""
+        return [i for i in range(len(self.replicas))
+                if i not in self._retired]
+
+    def fleet_size(self) -> int:
+        """Live (non-retired) replica count — the
+        ``app_llm_fleet_size`` gauge."""
+        return len(self._live_indices())
+
+    def _fleet_shape(self) -> dict:
+        """The membership snapshot crash bundles and scale events carry.
+        Lock-free simple reads — this runs on core serving threads
+        mid-crash and must never deadlock against the request plane."""
+        retired = sorted(self._retired)
+        return {
+            "replicas": len(self.replicas) - len(retired),
+            "states": {str(i): ("retired" if i in self._retired
+                                else c.health())
+                       for i, c in enumerate(self.replicas)},
+            "retired": retired,
+            "scale_events": len(self._scale_history),
+        }
 
     # -- dispatcher -----------------------------------------------------------
     def _ensure_dispatcher(self) -> None:
@@ -502,6 +738,24 @@ class ReplicaPool:
         request-plane state is touched only under ``self._lock`` (consumers
         may live on other loops); futures resolve via ``_resolve``."""
         wake = self._wake
+        if self._steer is not None:
+            # elastic: an IDLE fleet keeps a slow heartbeat at the
+            # controller interval — the down-scale half of the autoscaler
+            # is precisely about fleets with no traffic, which would
+            # otherwise never wake to shed a replica. A call_later chain
+            # (not a task, not a wait_for) so loop teardown semantics
+            # stay exactly the non-elastic ones: the dispatcher parks in
+            # a plainly-cancellable wake.wait(), and the chain dies with
+            # the pool (or a dispatcher re-home: the wake identity check).
+            hb_loop = asyncio.get_running_loop()
+
+            def _heartbeat() -> None:
+                if self._closed or self._wake is not wake:
+                    return
+                wake.set()
+                hb_loop.call_later(self._steer.interval_s, _heartbeat)
+
+            hb_loop.call_later(self._steer.interval_s, _heartbeat)
         while not self._closed:
             if len(self._queue):
                 # saturated: poll at 50 Hz so deadlines, recoveries, and
@@ -522,6 +776,11 @@ class ReplicaPool:
                 # fleet TTFT/TPOT windows (interval-gated internally)
                 with self._role_obs_lock:
                     self._role_ctl.maybe_update()
+            if self._steer is not None:
+                # elastic: one fleet-size controller pass (interval-gated
+                # internally); realized scale events run on a worker
+                # thread, never on this loop
+                self._maybe_autoscale()
             self._pump()
 
     def _reap_queued(self) -> None:
@@ -554,6 +813,8 @@ class ReplicaPool:
         the state gauge is only written on a TRANSITION — the sampler
         pass (export_gauges) keeps it fresh between transitions."""
         for idx, core in enumerate(self.replicas):
+            if idx in self._retired:
+                continue  # scale-down already accounted for it
             state = core.health()
             if state == self._last_states[idx]:
                 continue
@@ -580,6 +841,10 @@ class ReplicaPool:
                     pass
 
     def _routable(self, idx: int) -> bool:
+        if idx in self._retired or idx >= len(self.replicas):
+            # retired by a scale-down, or a scale-up whose backfill has
+            # touched the pin maps but whose core is not yet a member
+            return False
         core = self.replicas[idx]
         return (not core._closed and not core._draining
                 and core.health() in ("serving", "degraded"))
@@ -614,7 +879,9 @@ class ReplicaPool:
                               if self._routable(i)
                               and self._outstanding[i] < self._capacity[i]]
                 if not candidates:
-                    if all(c.health() == "dead" for c in self.replicas):
+                    live = self._live_indices()
+                    if live and all(self.replicas[i].health() == "dead"
+                                    for i in live):
                         # total fleet loss: nothing will ever route — flush
                         # the queue typed instead of parking consumers
                         flushed = self._queue.drain()
@@ -721,7 +988,7 @@ class ReplicaPool:
         if self._disagg:
             want = fr.want_role or "decode"
             rolewise = [i for i in candidates
-                        if self._roles.role(i) == want]
+                        if self._role_of(i) == want]
             if want == "prefill":
                 # stage 1: the prompt's KV computes on a prefill-biased
                 # replica. Busy prefill replicas park the request (their
@@ -730,7 +997,7 @@ class ReplicaPool:
                 if rolewise:
                     return min(rolewise, key=self._load), "prefill"
                 if any(self._routable(i)
-                       and self._roles.role(i) == "prefill"
+                       and self._role_of(i) == "prefill"
                        for i in range(len(self.replicas))):
                     return None
                 return _SKIP_PREFILL
@@ -748,7 +1015,7 @@ class ReplicaPool:
             if rolewise:
                 candidates = rolewise
             elif any(self._routable(i)
-                     and self._roles.role(i) == "decode"
+                     and self._role_of(i) == "decode"
                      for i in range(len(self.replicas))):
                 # decode replicas merely at capacity: wait for one
                 # instead of re-mixing decode work onto a prefill
@@ -772,6 +1039,29 @@ class ReplicaPool:
         pool = [i for i in candidates if i != fr.last_replica] or candidates
         return (min(pool, key=self._load),
                 "failover" if fr.attempts else "least_loaded")
+
+    def _sync_roles(self) -> None:
+        """Re-fit the disagg role steer to the CURRENT live membership
+        after a scale event (roles are positional over live ranks)."""
+        if self._roles is None:
+            return
+        n = max(2, self.fleet_size())
+        self._roles.n = n
+        self._roles.n_prefill = min(max(1, self._roles.n_prefill), n - 1)
+
+    def _role_of(self, idx: int) -> str:
+        """A replica's disagg role, computed over its LIVE rank — roles
+        are positional over the non-retired membership, so a scale event
+        re-roles deterministically instead of leaving a hole in the
+        prefill range."""
+        if self._roles is None:
+            return "decode"
+        live = self._live_indices()
+        try:
+            rank = live.index(idx)
+        except ValueError:
+            return "decode"  # retired: never prefill-biased
+        return self._roles.role(rank)
 
     # -- disaggregated prefill stage (GOFR_ML_DISAGG) -------------------------
     def _ship_ids(self, prompt: list) -> list:
@@ -805,7 +1095,7 @@ class ReplicaPool:
         with self._lock:
             live = [i for i in range(len(self.replicas))
                     if i != src_idx and self._routable(i)
-                    and self._roles.role(i) == "decode"]
+                    and self._role_of(i) == "decode"]
             if not live:
                 live = [i for i in range(len(self.replicas))
                         if i != src_idx and self._routable(i)]
@@ -922,12 +1212,13 @@ class ReplicaPool:
     # -- errors ---------------------------------------------------------------
     def _dead_error(self) -> GeneratorCrashed:
         return GeneratorCrashed(
-            f"replica pool is dead: all {len(self.replicas)} replicas "
-            f"exhausted their restart budgets")
+            f"replica pool is dead: all {len(self._live_indices())} live "
+            f"replicas exhausted their restart budgets")
 
     def _closed_error(self) -> Exception:
-        if not self._closed and all(
-                c.health() == "dead" for c in self.replicas):
+        live = self._live_indices()
+        if not self._closed and live and all(
+                self.replicas[i].health() == "dead" for i in live):
             return self._dead_error()
         return ServerClosed()
 
@@ -967,7 +1258,7 @@ class ReplicaPool:
                 trace_id=ctx.trace_id if ctx is not None else None))
         try:
             self._admit(fr)  # fleet shedding; may raise Overloaded
-            if (self._transport is not None and fr.prefix is None
+            if (self._disagg and fr.prefix is None
                     and fr.n_tokens >= self._ship_min
                     and not self._already_resident(fr.prompt)):
                 # disagg stage 1: compute the prompt's prefix KV on a
@@ -1050,12 +1341,19 @@ class ReplicaPool:
                     except (GeneratorCrashed, ServerClosed) as exc:
                         if fr.streamed or self._closed:
                             raise
-                        others = [i for i, c in enumerate(self.replicas)
-                                  if i != idx and c.health() != "dead"]
+                        # survivors = live (non-retired) peers: a replica
+                        # retired by scale-down rejects exactly like a
+                        # dead one, and its flushed work re-admits here —
+                        # same path, same ONE journey record
+                        live = self._live_indices()
+                        others = [i for i in live
+                                  if i != idx
+                                  and self.replicas[i].health() != "dead"]
                         if (not others
                                 or fr.attempts >= 2 * len(self.replicas)):
-                            if all(c.health() == "dead"
-                                   for c in self.replicas):
+                            if live and all(
+                                    self.replicas[i].health() == "dead"
+                                    for i in live):
                                 raise self._dead_error() from exc
                             raise
                         fr.attempts += 1
@@ -1197,8 +1495,8 @@ class ReplicaPool:
         if self._closed:
             raise self._closed_error()
         ids = tuple(int(t) for t in prefix_ids)
-        live = [(idx, core) for idx, core in enumerate(self.replicas)
-                if core.health() != "dead"]
+        live = [(idx, self.replicas[idx]) for idx in self._live_indices()
+                if self.replicas[idx].health() != "dead"]
         by_replica: dict[int, int] = {}
         last_exc: Exception | None = None
         if live:
@@ -1232,6 +1530,8 @@ class ReplicaPool:
             raise KeyError(f"unknown prefix id {pid}")
         first_exc: Exception | None = None
         for idx, core_pid in info["by_replica"].items():
+            if idx >= len(self.replicas):
+                continue  # backfilling scale-up not yet a member
             core = self.replicas[idx]
             if core.health() == "dead" or not core.has_prefix(core_pid):
                 continue
@@ -1250,7 +1550,8 @@ class ReplicaPool:
             if info is None:
                 return False
             by_replica = dict(info["by_replica"])
-        return any(self.replicas[idx].health() != "dead"
+        return any(idx < len(self.replicas)
+                   and self.replicas[idx].health() != "dead"
                    and self.replicas[idx].has_prefix(core_pid)
                    for idx, core_pid in by_replica.items())
 
@@ -1261,7 +1562,9 @@ class ReplicaPool:
         replica able to answer is itself an admission failure — a dead
         fleet or a pin with no surviving holder must reject HERE, not
         deep inside the stream."""
-        for idx, core in enumerate(self.replicas):
+        live = self._live_indices()
+        for idx in live:
+            core = self.replicas[idx]
             if core.health() == "dead":
                 continue
             core_pid = None
@@ -1274,11 +1577,373 @@ class ReplicaPool:
             core.check_admissible(prompt_ids, max_new_tokens,
                                   prefix=core_pid)
             return
-        if all(c.health() == "dead" for c in self.replicas):
+        if live and all(self.replicas[i].health() == "dead" for i in live):
             raise self._dead_error()
         raise PrefixEvicted(
             f"prefix {prefix} has no live registration on any replica "
             f"(its holders died); re-register and retry")
+
+    # -- elastic fleet: runtime scale-up/down + live KV migration -------------
+    def _ensure_transport(self):
+        """The KV transport, constructed on first need: disagg pools have
+        one from construction; a plain elastic pool only builds it when a
+        scale-down actually migrates. (The disagg request path gates on
+        ``self._disagg``, never on transport existence, so arming the
+        transport here cannot flip the pool into disaggregated
+        routing.)"""
+        if self._transport is None:
+            from .kv_transport import KVTransport
+
+            self._transport = KVTransport(name=self.name,
+                                          metrics=self._metrics,
+                                          tracer=self._tracer)
+        return self._transport
+
+    @staticmethod
+    def _arm_host_tier(core: LLMServer) -> bool:
+        """Migration moves pages THROUGH the host tier; arm a default
+        store on a core whose operator left plain offload off (the disagg
+        constructor's contract, applied lazily). False when the core
+        cannot take one (dense cache)."""
+        gen = core.gen
+        if not getattr(gen, "page_size", 0):
+            return False
+        if getattr(gen, "host_kv", None) is None:
+            _ensure_host_store(gen)
+            gen.host_kv.model = core.name  # post-construction arming:
+            # the LLMServer constructor's own stamp already ran
+        return True
+
+    def _call_spawn(self, idx: int):
+        """Build a Generator for pool index ``idx`` via the ``spawn=``
+        factory (called with the index when its signature takes one, so
+        a factory can place the replica on spare devices)."""
+        try:
+            takes_idx = bool(inspect.signature(self._spawn).parameters)
+        except (TypeError, ValueError):
+            takes_idx = True
+        return self._spawn(idx) if takes_idx else self._spawn()
+
+    def _note_scale(self, kind: str, **data) -> None:
+        """One realized scale event: history row, typed fleet event, and
+        the ``app_llm_fleet_size`` gauge."""
+        size = self.fleet_size()
+        rec = {"kind": kind, "at": round(time.time(), 3),
+               "fleet_size": size, **data}
+        with self._lock:
+            self._scale_history.append(rec)
+        # literal kinds: the event vocabulary is greppable (the doc-drift
+        # guard reconciles .emit("…") literals against the doc table)
+        if kind == "scale_up":
+            self._events.emit("scale_up", model=self.name,
+                              fleet_size=size, **data)
+        else:
+            self._events.emit("scale_down", model=self.name,
+                              fleet_size=size, **data)
+        if self._metrics is not None:
+            try:
+                self._metrics.set_gauge("app_llm_fleet_size", float(size),
+                                        model=self.name)
+            except Exception:
+                pass
+        if self._logger is not None:
+            try:
+                self._logger.infof("llm %s: %s -> fleet size %d",
+                                   self.name, kind, size)
+            except Exception:
+                pass
+
+    def add_replica(self, generator=None) -> int:
+        """Grow the fleet by ONE replica and return its pool index. The
+        new core is built from ``generator`` (or the ``spawn=`` factory —
+        warmed there, so the persistent XLA cache makes it cheap), every
+        pool-pinned prefix is backfilled onto it, and only then does it
+        become routable — a request can never route to a half-built
+        replica. Serialized with other scale events and with close()
+        (which aborts a half-built scale-up cleanly). Thread-safe, sync;
+        call via ``asyncio.to_thread`` from async code."""
+        with self._scale_lock:
+            return self._add_replica_locked(generator)
+
+    def _add_replica_locked(self, generator=None) -> int:
+        if self._closed:
+            raise self._closed_error()
+        if self._n_max and self.fleet_size() >= self._n_max:
+            raise ValueError(
+                f"llm {self.name}: fleet already at its maximum of "
+                f"{self._n_max} replicas (GOFR_ML_REPLICAS_MAX)")
+        if self._fault is not None:
+            self._fault("scale_up")  # chaos point: a poisoned scale-up
+        t0 = time.perf_counter()
+        idx = len(self.replicas)
+        gen = generator
+        if gen is None:
+            if self._spawn is None:
+                raise ValueError(
+                    f"llm {self.name}: scale-up needs a generator — pass "
+                    f"one to add_replica() or construct the pool with a "
+                    f"spawn= factory")
+            gen = self._call_spawn(idx)
+        if self._disagg:
+            if not getattr(gen, "page_size", 0):
+                raise ValueError(
+                    "disaggregated prefill/decode requires paged "
+                    f"generators (page_size > 0); replica {idx} is dense")
+            # armed BEFORE _build_core so the LLMServer constructor
+            # stamps the store's model label, like a boot-time replica
+            _ensure_host_store(gen)
+        core = self._build_core(gen, idx)
+        # backfill every pool-pinned prefix BEFORE the replica becomes
+        # routable: affinity routing may hand it a prefix= request the
+        # moment it joins, and _core_pid must find a live registration.
+        # A failed backfill skips THAT pin (existing holders still serve
+        # it; this replica answers those requests with PrefixEvicted
+        # avoidance — the router only picks holders).
+        with self._prefix_lock:
+            pins = [(pid, info["ids"]) for pid, info in
+                    self._prefixes.items()]
+        backfilled = 0
+        for pid, ids in pins:
+            if self._closed:
+                break
+            try:
+                core_pid = core.register_prefix(ids)
+            except Exception:
+                continue
+            with self._prefix_lock:
+                info = self._prefixes.get(pid)
+                if info is not None:
+                    info["by_replica"][idx] = core_pid
+                    backfilled += 1
+                    continue
+            try:  # pin dropped while we backfilled: release the orphan
+                core.drop_prefix(core_pid)
+            except Exception:
+                pass
+        if self._closed:
+            # close() raced the build and is waiting on the scale lock:
+            # abort cleanly — the half-built core never becomes routable,
+            # and its backfilled registrations leave the pin maps (they
+            # die with the core)
+            with self._prefix_lock:
+                for info in self._prefixes.values():
+                    info["by_replica"].pop(idx, None)
+            core.close(0.0)
+            raise self._closed_error()
+        with self._lock:
+            # accounting rows FIRST, the membership list LAST: any reader
+            # that can see index ``idx`` finds its rows present
+            self._capacity.append(
+                max(1, gen.batch_slots) * self._depth)
+            self._outstanding.append(0)
+            self._routed.append(collections.Counter())
+            self._dead_seen.append(False)
+            self._last_states.append("serving")
+            self.replicas.append(core)
+        self._sync_roles()
+        self._note_scale(
+            "scale_up", replica=idx, backfilled_pins=backfilled,
+            build_ms=round((time.perf_counter() - t0) * 1e3, 1))
+        self._kick()
+        return idx
+
+    def remove_replica(self, idx: int, *, migrate: bool = True,
+                       drain_s: float | None = None) -> dict:
+        """Shrink the fleet by retiring replica ``idx``: it leaves the
+        routable set immediately, its hot radix subtrees MIGRATE to
+        survivors through the KV transport (``migrate=False`` skips — the
+        survivors cold-start those prefixes), and then the core drains
+        exactly like PR 6's graceful close — in-flight decode finishes
+        within ``drain_s``, staged work re-admits front-of-class on
+        survivors with priority/deadline preserved and ONE journey record.
+        Returns the migration tally. Refuses to remove the last live
+        replica (and the second-to-last of a disaggregated fleet).
+        Thread-safe, sync; call via ``asyncio.to_thread`` from async
+        code."""
+        with self._scale_lock:
+            return self._remove_replica_locked(int(idx), migrate=migrate,
+                                               drain_s=drain_s)
+
+    def _remove_replica_locked(self, idx: int, *, migrate: bool = True,
+                               drain_s: float | None = None) -> dict:
+        if self._closed:
+            raise self._closed_error()
+        if not 0 <= idx < len(self.replicas) or idx in self._retired:
+            raise ValueError(
+                f"llm {self.name}: replica {idx} is not a live fleet "
+                f"member")
+        live = self._live_indices()
+        if len(live) <= 1:
+            raise ValueError(
+                f"llm {self.name}: refusing to retire the last live "
+                f"replica")
+        if self._disagg and len(live) <= 2:
+            raise ValueError(
+                f"llm {self.name}: a disaggregated fleet needs >= 2 "
+                f"replicas (one prefill-biased + one decode)")
+        if self._fault is not None:
+            self._fault("scale_down")  # chaos point: a poisoned scale-down
+        t0 = time.perf_counter()
+        core = self.replicas[idx]
+        # 1) leave the routable set NOW: the router stops picking it, and
+        # anything staged inside re-admits to survivors through the PR 6
+        # failover path once the drain flushes it
+        with self._lock:
+            self._retired.add(idx)
+            self._dead_seen[idx] = True   # a retire is not an incident:
+            self._last_states[idx] = "retired"  # no dead-replica alarm
+        self._sync_roles()
+        self._kick()
+        # 2) live KV migration: the scale event moves the cache instead
+        # of discarding it. Every failure is ACCOUNTED (ledger) and
+        # degrades to a cold start on the survivor — bit-identical, just
+        # slower; a close() racing us cuts the loop short.
+        tally = {"adopted": 0, "failed": 0, "skipped": 0}
+        if migrate:
+            tally = self._migrate_out(idx)
+        # 3) the PR 6 drain: admission is already stopped pool-side;
+        # in-flight decode finishes (bounded), queued work flushes typed
+        # and re-routes
+        if drain_s is None:
+            drain_s = self._drain_default
+        core.close(drain_s)
+        with self._lock:
+            self._capacity[idx] = 0
+        with self._prefix_lock:
+            # per-replica pin registrations died with the core
+            for info in self._prefixes.values():
+                info["by_replica"].pop(idx, None)
+        self._note_scale(
+            "scale_down", replica=idx, migrated=tally,
+            drain_s=drain_s,
+            wall_ms=round((time.perf_counter() - t0) * 1e3, 1))
+        self._kick()
+        return tally
+
+    def _migrate_out(self, idx: int) -> dict:
+        """Ship replica ``idx``'s hot radix subtrees (hit-count order) to
+        the least-loaded survivors. Returns the per-outcome tally; the
+        transport's ledger keeps the fleet-lifetime totals."""
+        tally = {"adopted": 0, "failed": 0, "skipped": 0}
+        src = self.replicas[idx]
+        cache = src.prefix_cache
+        if cache is None or not self._arm_host_tier(src):
+            return tally  # nothing enumerable / no tier to move through
+        transport = self._ensure_transport()
+        for row in cache.hot_prefixes(limit=32):
+            if self._closed:
+                break  # close() is settling us: fall back, don't stall
+            dst_idx = self._pick_migrate_dst(idx)
+            if dst_idx is None:
+                break  # no survivor can take pages: cold starts for all
+            dst = self.replicas[dst_idx]
+            if not self._arm_host_tier(dst):
+                continue
+            outcome = transport.migrate(src, dst, row["ids"], row["pid"],
+                                        src_idx=idx, dst_idx=dst_idx)
+            tally[outcome] += 1
+        return tally
+
+    def _pick_migrate_dst(self, src_idx: int) -> int | None:
+        """Least-loaded routable survivor with a paged cache (decode-role
+        preferred under disagg — migrated pages serve decode-side
+        restores)."""
+        with self._lock:
+            cands = [i for i in range(len(self.replicas))
+                     if i != src_idx and self._routable(i)
+                     and getattr(self.replicas[i].gen, "page_size", 0)]
+            if self._disagg:
+                decode = [i for i in cands if self._role_of(i) == "decode"]
+                cands = decode or cands
+            return min(cands, key=self._load) if cands else None
+
+    def scale_to(self, n: int, *, migrate: bool = True,
+                 drain_s: float | None = None) -> int:
+        """Scale the fleet to ``n`` live replicas (clamped to the
+        min/max bounds): repeated ``add_replica`` (needs ``spawn=``) or
+        ``remove_replica`` of the least-loaded member, one at a time
+        under the scale lock. Returns the realized size. Sync, like the
+        other scale calls."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"llm {self.name}: cannot scale to {n}")
+        n = max(n, self._n_min)
+        if self._n_max:
+            n = min(n, self._n_max)
+        with self._scale_lock:
+            while not self._closed and self.fleet_size() < n:
+                self._add_replica_locked(None)
+            while not self._closed and self.fleet_size() > n:
+                idx = self._pick_retire_idx()
+                if idx is None:
+                    break
+                self._remove_replica_locked(idx, migrate=migrate,
+                                            drain_s=drain_s)
+            return self.fleet_size()
+
+    def _pick_retire_idx(self) -> int | None:
+        """The scale-down victim: the least-loaded live replica, highest
+        index on ties (LIFO — runtime-added replicas go first, keeping
+        the construction-time fleet, and its device placement, stable)."""
+        live = self._live_indices()
+        if len(live) <= 1:
+            return None
+        with self._lock:
+            return min(live,
+                       key=lambda i: (self._outstanding[i]
+                                      + self.replicas[i].queue_depth(),
+                                      -i))
+
+    def _maybe_autoscale(self) -> None:
+        """One autoscale controller pass (dispatcher loop, elastic armed):
+        read the fleet signals under the lock, ask the steer for a
+        verdict, and realize it on a worker thread — scale events build
+        cores and drain replicas, which must never block routing."""
+        if self._scale_thread is not None and self._scale_thread.is_alive():
+            return  # one scale event at a time; the next pass re-reads
+        with self._lock:
+            routable = [i for i in self._live_indices()
+                        if self._routable(i)]
+            n_live = len(routable) or self.fleet_size()
+            free = sum(max(0, self._capacity[i] - self._outstanding[i])
+                       for i in routable)
+            outstanding = sum(self._outstanding[i] for i in routable)
+            capacity = sum(self._capacity[i] for i in routable)
+            queued = len(self._queue)
+            retry = retry_after_s(self._admit_times, queued)
+        slo_over = False
+        if self._role_ctl is not None:
+            # the lifted SLO controller's last verdict doubles as a
+            # scale signal: TTFT persistently over target means role
+            # re-balancing alone is not keeping up
+            p95, target = (self._role_ctl.last_ttft_p95,
+                           self._role_ctl.ttft_target_s)
+            slo_over = p95 == p95 and p95 > target
+        target_n = self._steer.decide(
+            queued=queued, free=free, outstanding=outstanding,
+            capacity=capacity, n_live=n_live, retry_after_s=retry,
+            slo_over=slo_over)
+        if target_n is None or target_n == n_live:
+            return
+        if target_n > n_live and self._spawn is None:
+            return  # cannot build cores: scale-up needs the factory
+        t = threading.Thread(target=self._autoscale_to, args=(target_n,),
+                             daemon=True,
+                             name=f"gofr-elastic-{self.name}")
+        self._scale_thread = t
+        t.start()
+
+    def _autoscale_to(self, n: int) -> None:
+        try:
+            self.scale_to(n)
+        except Exception as exc:
+            if self._logger is not None:
+                try:
+                    self._logger.warnf(
+                        "llm %s: autoscale to %d failed (%s: %s)",
+                        self.name, n, type(exc).__name__, exc)
+                except Exception:
+                    pass
 
     # -- observability / datasource contract ----------------------------------
     def queue_depth(self) -> int:
@@ -1287,12 +1952,14 @@ class ReplicaPool:
         return fleet + sum(c.queue_depth() for c in self.replicas)
 
     def health(self) -> str:
-        """``serving`` — every replica healthy; ``degraded`` — ANY replica
-        dead, recovering, or degraded (capacity is reduced but requests
-        still complete); ``dead`` — every replica dead (or the pool is
-        closed): nothing will complete."""
-        states = [c.health() for c in self.replicas]
-        if self._closed or all(s == "dead" for s in states):
+        """``serving`` — every live replica healthy; ``degraded`` — ANY
+        live replica dead, recovering, or degraded (capacity is reduced
+        but requests still complete); ``dead`` — every live replica dead
+        (or the pool is closed): nothing will complete. Replicas RETIRED
+        by a scale-down are not fleet members and never count — a scaled-
+        down fleet is healthy, not degraded."""
+        states = [self.replicas[i].health() for i in self._live_indices()]
+        if self._closed or not states or all(s == "dead" for s in states):
             return "dead"
         if any(s != "serving" for s in states):
             return "degraded"
@@ -1307,8 +1974,10 @@ class ReplicaPool:
             "details": {
                 "model": self.name,
                 "state": state,
-                "replicas": {str(i): c.health()
+                "replicas": {str(i): ("retired" if i in self._retired
+                                      else c.health())
                              for i, c in enumerate(self.replicas)},
+                "fleet_size": self.fleet_size(),
                 "queued": self.queue_depth(),
                 "served": self.served,
                 "failovers": self._failovers,
@@ -1326,7 +1995,8 @@ class ReplicaPool:
         with self._lock:
             return {
                 "replicas": len(self.replicas),
-                "states": {str(i): c.health()
+                "states": {str(i): ("retired" if i in self._retired
+                                    else c.health())
                            for i, c in enumerate(self.replicas)},
                 "capacity": list(self._capacity),
                 "outstanding": list(self._outstanding),
@@ -1356,15 +2026,33 @@ class ReplicaPool:
                 # disaggregated prefill/decode: roles + the transport
                 # ledger (ships/lands/failures/bytes) + the lifted SLO
                 # controller's state; None whenever GOFR_ML_DISAGG is off
-                "disagg": (None if self._transport is None else {
+                "disagg": (None if not self._disagg else {
                     "prefill_replicas": self._roles.n_prefill,
-                    "roles": {str(i): self._roles.role(i)
+                    "roles": {str(i): self._role_of(i)
                               for i in range(len(self.replicas))},
                     "role_changes": self._roles.changes,
                     "ship_min_tokens": self._ship_min,
                     "controller": self._role_ctl.snapshot(),
                     **self._transport.snapshot(),
                 }),
+                # elastic fleet: membership bounds + autoscale controller
+                # + the realized scale events and the migration ledger
+                # (ships == adoptions + failures, the scale-event
+                # acceptance contract)
+                "elastic": {
+                    "armed": self._elastic,
+                    "size": len(self.replicas) - len(self._retired),
+                    "min": self._n_min,
+                    "max": self._n_max or None,
+                    "retired": sorted(self._retired),
+                    "spawn": self._spawn is not None,
+                    "controller": (self._steer.snapshot()
+                                   if self._steer is not None else None),
+                    "events": list(self._scale_history),
+                    "migrations": (
+                        self._transport.snapshot()["migrations"]
+                        if self._transport is not None else None),
+                },
             }
 
     def export_gauges(self, metrics) -> None:
@@ -1376,6 +2064,8 @@ class ReplicaPool:
         series."""
         total_live = 0
         for idx, core in enumerate(self.replicas):
+            if idx in self._retired:
+                continue  # not a fleet member: no state/occupancy series
             try:
                 total_live += core.gen.n_live
                 metrics.set_gauge(
@@ -1391,6 +2081,8 @@ class ReplicaPool:
         try:
             metrics.set_gauge("app_llm_active_slots", float(total_live),
                               model=self.name)
+            metrics.set_gauge("app_llm_fleet_size",
+                              float(self.fleet_size()), model=self.name)
         except Exception:
             pass
 
@@ -1416,6 +2108,17 @@ class ReplicaPool:
             if self._closed:
                 return
             self._closed = True
+        # SETTLE any in-flight scale event before touching membership:
+        # scale workers see the closed flag — a half-built scale-up
+        # aborts cleanly (its core never becomes routable), a migrating
+        # scale-down cuts its migration loop short and finishes its
+        # drain — and only then does teardown proceed, so close() and a
+        # scale event can never race the membership list. (Lock order is
+        # consistent: _lock above was released before this acquire;
+        # scale workers take _scale_lock first, _lock only briefly
+        # inside.)
+        self._scale_lock.acquire()
+        self._scale_lock.release()
         if drain_s is None:
             drain_s = self._drain_default
         if drain_s > 0:

@@ -22,6 +22,13 @@ Points (where the serving stack calls ``fire``):
   (ml/kv_transport.py; the pages are already off the source)
 - ``land``     — a KV transport arrival into a decode replica's host
   tier (fired on the receiving serving thread, before the store insert)
+- ``scale_up`` — an elastic scale-up event (ml/replica.py: fired by the
+  pool front before the new core is built)
+- ``scale_down`` — an elastic scale-down event (fired before the
+  retiring replica stops routing)
+- ``migrate``  — one live-KV-migration attempt off a draining replica
+  (fired on the SOURCE replica's serving thread, so
+  ``GOFR_ML_FAULT_REPLICA`` narrows it to one replica's exports)
 
 The injector only exists when the env var is set (``from_env`` returns
 ``None`` otherwise) and the instrumented call sites guard with an
@@ -47,7 +54,7 @@ __all__ = ["FAULT_POINTS", "FaultInjector", "InjectedFault",
            "fault_snapshot"]
 
 FAULT_POINTS = ("step", "prefill", "spill", "restore", "emit", "route",
-                "ship", "land")
+                "ship", "land", "scale_up", "scale_down", "migrate")
 
 
 class InjectedFault(RuntimeError):

@@ -26,6 +26,12 @@ import asyncio  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long soak tests excluded from tier-1 (-m 'not slow')")
+
+
 @pytest.fixture
 def run():
     """Run an async scenario to completion: ``run(scenario())``."""
