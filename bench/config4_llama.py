@@ -1589,6 +1589,178 @@ async def main() -> None:
                 if len(ident_k) == 2 else None),
         }
 
+    # ---- phase L: serving economics — goodput ledger + auto-profiler ----
+    # Two boots sharing one traffic shape: a CLEAN run and a GOFR_ML_FAULT
+    # chaos run (probabilistic step crashes + watchdog recoveries + a slice
+    # of deadline-bound requests + speculation), each reporting the goodput
+    # fraction, the wasted-token ledger by reason, and the auto-profiler
+    # trigger count — and asserting the ledger BALANCES (delivered +
+    # wasted == device tokens). The ledger is process-global, so each arm
+    # reads per-model DELTAS around its own window.
+    # Skipped under the headline watchdog budget unless BENCH_GOODPUT_ARM=1
+    # (bench/run_all.py sets it).
+    goodput_arm = None
+    if os.environ.get("BENCH_GOODPUT_ARM",
+                      "0" if skip_jitter else "1") == "1":
+        from gofr_tpu.flight_recorder import event_log as _event_log
+        from gofr_tpu.ml.goodput import goodput_ledger as _goodput_ledger
+
+        n_req_l = int(os.environ.get("BENCH_GOODPUT_REQUESTS",
+                                     "48" if on_tpu else "16"))
+        new_l = max(8, max_new // 8) if on_tpu else 8
+        spec_l = os.environ.get("BENCH_GOODPUT_FAULT",
+                                "step:0.04:RuntimeError")
+        deadline_every = 4  # every 4th request carries a tight TTL
+        typed_codes_l = {grpc.StatusCode.UNAVAILABLE,
+                         grpc.StatusCode.RESOURCE_EXHAUSTED,
+                         grpc.StatusCode.DEADLINE_EXCEEDED}
+
+        def _ledger_chat() -> dict:
+            led = _goodput_ledger()
+            return led.snapshot_model("chat") if led is not None else {}
+
+        def _ledger_delta(before: dict, after: dict) -> dict:
+            wasted = {
+                r: after.get("wasted", {}).get(r, 0)
+                - before.get("wasted", {}).get(r, 0)
+                for r in set(after.get("wasted", {}))
+                | set(before.get("wasted", {}))
+            }
+            wasted = {r: n for r, n in wasted.items() if n}
+            delivered = (after.get("delivered", 0)
+                         - before.get("delivered", 0))
+            total = (after.get("device_tokens", 0)
+                     - before.get("device_tokens", 0))
+            return {
+                "device_tokens": total,
+                "delivered": delivered,
+                "wasted": wasted,
+                "goodput": (round(delivered / total, 4) if total else None),
+                # the acceptance invariant, checked on the window's delta
+                "balanced": delivered + sum(wasted.values()) == total,
+            }
+
+        async def goodput_window(gen_fn) -> dict:
+            outcome = {"ok": 0, "typed_errors": 0, "other_errors": 0}
+            # client-side delivered count: tokens received by requests
+            # that COMPLETED — the independent observation the ledger's
+            # delivered side must match (the in-ledger balance holds by
+            # construction; this cross-check is the falsifiable one)
+            client_delivered = [0]
+            before = _ledger_chat()
+            ev_cursor = _event_log().cursor
+
+            async def one(i: int) -> None:
+                body = {"prompt_ids": rng.integers(
+                            1, vocab_hi, (prompt_len,)).tolist(),
+                        "max_new_tokens": new_l}
+                if i % deadline_every == 0:
+                    body["deadline_s"] = 0.15  # some answers WILL miss
+                try:
+                    got = 0
+                    async for msg in gen_fn(body):
+                        got += n_toks(msg)
+                    outcome["ok"] += 1
+                    client_delivered[0] += got
+                except grpc.aio.AioRpcError as exc:
+                    key = ("typed_errors" if exc.code() in typed_codes_l
+                           else "other_errors")
+                    outcome[key] += 1
+
+            # half-concurrent waves keep slots contended without hangs
+            for lo in range(0, n_req_l, 8):
+                await asyncio.gather(*(one(i)
+                                       for i in range(lo,
+                                                      min(lo + 8,
+                                                          n_req_l))))
+            after = _ledger_chat()
+            profile_events = _event_log().query(
+                since=ev_cursor, kind="profile")["events"]
+            # the endpoint answers the same ledger the deltas came from
+            import aiohttp
+
+            endpoint_ok = False
+            try:
+                async with aiohttp.ClientSession() as s:
+                    r = await s.get(f"http://127.0.0.1:"
+                                    f"{ports['HTTP_PORT']}/debug/goodput")
+                    endpoint_ok = (r.status == 200
+                                   and (await r.json())["data"]["enabled"])
+            except Exception:
+                pass
+            res = await _debug_resilience(ports)
+            ledger = _ledger_delta(before, after)
+            return {
+                **outcome,
+                "requests": n_req_l,
+                "ledger": ledger,
+                "client_delivered": client_delivered[0],
+                # the falsifiable invariant: the ledger's delivered side
+                # equals what completed clients actually received
+                "delivered_matches_client": (
+                    ledger["delivered"] == client_delivered[0]),
+                "autoprof_captures": len(profile_events),
+                "generator_restarts": (res.get("restarts") or {}
+                                       ).get("total", 0),
+                "endpoint_ok": bool(endpoint_ok),
+            }
+
+        arms_l: dict = {}
+        for mode in ("clean", "chaos"):
+            if mode == "chaos":
+                os.environ["GOFR_ML_FAULT"] = spec_l
+                os.environ["GOFR_ML_MAX_RESTARTS"] = os.environ.get(
+                    "BENCH_GOODPUT_MAX_RESTARTS", "1000")
+                # a regression under crash churn should auto-profile
+                os.environ.setdefault("GOFR_ML_AUTOPROF_MULT", "1.5")
+            os.environ["LLM_SPEC_K"] = os.environ.get(
+                "BENCH_GOODPUT_SPEC_K", "2")  # spec_rejected in both arms
+            appL = chL = None
+            try:
+                appL = build_app()
+                await boot(appL)
+                chL = grpc.aio.insecure_channel(
+                    f"127.0.0.1:{ports['GRPC_PORT']}")
+                genL = chL.unary_stream(
+                    "/llm.Chat/Generate",
+                    request_serializer=lambda o: json.dumps(o).encode(),
+                    response_deserializer=lambda raw: (json.loads(raw)
+                                                       if raw else {}),
+                )
+                try:
+                    async for _ in genL(req(4)):    # warm compiles
+                        pass
+                except grpc.aio.AioRpcError:
+                    if mode != "chaos":
+                        raise  # chaos may crash the first dispatch
+                arms_l[mode] = await goodput_window(genL)
+            except Exception as exc:    # optional arm: record, don't abort
+                arms_l[mode] = {"error": str(exc)}
+            finally:
+                for k in ("GOFR_ML_FAULT", "GOFR_ML_MAX_RESTARTS",
+                          "GOFR_ML_AUTOPROF_MULT", "LLM_SPEC_K"):
+                    os.environ.pop(k, None)
+                if chL is not None:
+                    await chL.close()
+                if appL is not None:
+                    await appL.shutdown()
+        clean_l = arms_l.get("clean", {})
+        chaos_l = arms_l.get("chaos", {})
+        goodput_arm = {
+            "fault_spec": spec_l,
+            "clean": clean_l,
+            "chaos": chaos_l,
+            # the acceptance invariant, both windows: the ledger balances
+            # AND its delivered side matches the tokens completed clients
+            # actually received (the half that can actually fail)
+            "ledger_balanced": (
+                (clean_l.get("ledger") or {}).get("balanced") is True
+                and (chaos_l.get("ledger") or {}).get("balanced") is True
+                and clean_l.get("delivered_matches_client") is True
+                and chaos_l.get("delivered_matches_client") is True
+                if "ledger" in clean_l and "ledger" in chaos_l else None),
+        }
+
     agg_tok_s = sum(token_counts) / elapsed
     emit(
         "llama_served_tok_per_s", agg_tok_s, "tok/s", 2000.0,
@@ -1656,6 +1828,11 @@ async def main() -> None:
             # warm TTFT vs cold start, fleet-size trace, migration
             # ledger, token identity)
             "elastic": (elastic_arm if elastic_arm is not None
+                        else "skipped (headline budget)"),
+            # phase L: serving economics — goodput ledger balance under a
+            # clean vs chaos window (wasted-token ledger by reason,
+            # goodput fraction, auto-profiler trigger count)
+            "goodput": (goodput_arm if goodput_arm is not None
                         else "skipped (headline budget)"),
             "preset": os.environ.get("LLAMA_PRESET", "tiny"),
             "backend": jax.default_backend(),

@@ -28,7 +28,8 @@ CONFIGS = [
     ("config4_llama.py", {"BENCH_SCHED_ARM": "1", "BENCH_OFFLOAD_ARM": "1",
                           "BENCH_FAULT_ARM": "1", "BENCH_STALL_ARM": "1",
                           "BENCH_SPEC_ARM": "1", "BENCH_DISAGG_ARM": "1",
-                          "BENCH_ELASTIC_ARM": "1"}),
+                          "BENCH_ELASTIC_ARM": "1",
+                          "BENCH_GOODPUT_ARM": "1"}),
     ("config5_sdxl.py", {}),
     ("config6_compute.py", {}),
     ("config7_longcontext.py", {}),

@@ -218,6 +218,25 @@ class Container:
             buckets=(5e-5, 2e-4, 5e-4, 1e-3, 3e-3, 5e-3, 0.01, 0.02,
                      0.03, 0.05, 0.1, 0.2, 0.5, 1.0),
         )
+        m.new_counter("app_llm_tokens_wasted_total",
+                      "device-computed tokens that never delivered, by "
+                      "reason (spec_rejected / deadline_cancelled / "
+                      "crashed / disconnected / failover_recompute / "
+                      "restore_fallback / migration_cold) — the goodput "
+                      "ledger's waste side")
+        m.new_gauge("app_llm_goodput_fraction",
+                    "delivered / device-computed tokens per model (the "
+                    "goodput ledger's headline ratio)")
+        m.new_counter("app_ml_compile_seconds_total",
+                      "wall seconds spent compiling jitted programs "
+                      "(warmup ladder, prefill buckets, paged ops, "
+                      "engine batch buckets, native pjrt executables)")
+        m.new_counter("app_ml_compile_cache_hits_total",
+                      "program compiles served by the persistent XLA "
+                      "compilation cache (GOFR_ML_COMPILATION_CACHE_DIR)")
+        m.new_gauge("app_ml_programs",
+                    "jitted/native programs in a model's compiled "
+                    "inventory (the /debug/programs row count)")
         m.new_gauge("app_llm_evictions",
                     "streams truncated because the KV page pool ran dry")
         m.new_gauge("app_llm_prefix_evictions",

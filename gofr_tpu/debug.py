@@ -30,19 +30,29 @@ the server doing right now?". The TPU-native equivalents here:
   request wall. The index answers with per-mark duration percentiles
   over the retained ring plus the failed/p99-slow exemplars; the rid
   route returns one request's waterfall.
+- ``GET /debug/goodput`` — the serving-economics ledger (ml/goodput.py):
+  every device-computed token classified as delivered or one of the
+  wasted reasons (spec rejects, deadline/crash/disconnect losses,
+  failover/restore/migration recomputes), per model and fleet-wide,
+  with the goodput fraction and delivered tokens/s.
+- ``GET /debug/programs`` — the jitted-program inventory
+  (ml/programs.py): per-model rows for every compiled program (shapes,
+  compile wall, persistent-XLA-cache provenance, lazy ``cost_analysis``
+  flops/bytes; ``?cost=0`` skips the analysis) plus live per-device HBM.
+- ``GET /debug/profile/auto`` / ``GET /debug/profile/auto/<id>`` — the
+  anomaly-triggered auto-profiler's vault (flight_recorder.py): trace
+  zips captured when a serving core's step time or phase shares
+  regressed past their rolling baseline; the index lists triggers, the
+  id route streams the zip.
 """
 
 from __future__ import annotations
 
 import asyncio
-import io
 import math
-import os
 import shutil
 import tempfile
-import threading
 import time
-import zipfile
 
 from aiohttp import web
 
@@ -63,8 +73,11 @@ _LATENCY_HISTOGRAMS = (
 _PRIORITY_HISTOGRAM = "app_llm_priority_queue_seconds"
 _QUANTILES = (0.5, 0.95, 0.99)
 
-# the jax profiler is process-global state: one capture at a time, ever
-_profile_lock = threading.Lock()
+# the jax profiler is process-global state: one capture at a time, ever —
+# the lock lives in flight_recorder so the auto-profiler and this manual
+# endpoint can never corrupt each other's trace
+from .flight_recorder import PROFILE_LOCK as _profile_lock  # noqa: E402
+
 MAX_PROFILE_SECONDS = 60.0
 
 
@@ -129,25 +142,20 @@ def serving_snapshot(container) -> dict:
 
 
 def _run_profile_capture(trace_dir: str, seconds: float) -> None:
-    """Blocking capture, run off the event loop. Split out so tests can
-    monkeypatch it where ``jax.profiler`` has no backend to trace."""
-    import jax
+    """Blocking capture, run off the event loop. Kept as a module-level
+    seam so tests can monkeypatch it where ``jax.profiler`` has no
+    backend to trace; the body is the auto-profiler's capture (ONE
+    start/sleep/stop implementation for both profiler paths)."""
+    from .flight_recorder import _capture_profile_trace
 
-    jax.profiler.start_trace(trace_dir)
-    try:
-        time.sleep(seconds)
-    finally:
-        jax.profiler.stop_trace()
+    _capture_profile_trace(trace_dir, seconds)
 
 
 def _zip_dir(root: str) -> bytes:
-    buf = io.BytesIO()
-    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
-        for base, _, files in os.walk(root):
-            for fname in files:
-                full = os.path.join(base, fname)
-                zf.write(full, os.path.relpath(full, root))
-    return buf.getvalue()
+    from .flight_recorder import zip_dir_bytes
+
+    data, _truncated = zip_dir_bytes(root)  # manual capture: uncapped
+    return data
 
 
 def register_debug_routes(app, aio_app: web.Application) -> None:
@@ -260,6 +268,56 @@ def register_debug_routes(app, aio_app: web.Application) -> None:
                 status=404)
         return web.json_response({"data": journey.snapshot()})
 
+    async def goodput_handler(_: web.Request) -> web.Response:
+        from .ml.goodput import goodput_ledger
+
+        ledger = goodput_ledger()
+        if ledger is None:
+            return web.json_response(
+                {"data": {"enabled": False,
+                          "reason": "GOFR_ML_GOODPUT=0"}})
+        data = ledger.snapshot()
+        data["enabled"] = True
+        return web.json_response({"data": data})
+
+    async def programs_handler(request: web.Request) -> web.Response:
+        ml = getattr(app.container, "ml", None)
+        if ml is None or not hasattr(ml, "programs_snapshot"):
+            return web.json_response(
+                {"data": {"models": {}}})
+        cost = request.query.get("cost", "1") != "0"
+        # cost analysis re-lowers each program once (cached after) —
+        # debug-endpoint work; keep it off the event loop
+        loop = asyncio.get_running_loop()
+        data = await loop.run_in_executor(
+            None, lambda: ml.programs_snapshot(cost=cost))
+        return web.json_response({"data": data})
+
+    async def autoprofile_list_handler(_: web.Request) -> web.Response:
+        from .flight_recorder import autoprof_enabled, profile_vault
+
+        return web.json_response({"data": {
+            "enabled": autoprof_enabled(),
+            "captures": profile_vault().list(),
+        }})
+
+    async def autoprofile_handler(request: web.Request) -> web.Response:
+        from .flight_recorder import profile_vault
+
+        profile_id = request.match_info["profile_id"]
+        bundle = profile_vault().get(profile_id)
+        if bundle is None:
+            return web.json_response(
+                {"error": {"message":
+                           f"unknown profile id {profile_id!r}"}},
+                status=404)
+        return web.Response(
+            body=bundle["data"],
+            content_type="application/zip",
+            headers={"Content-Disposition":
+                     f'attachment; filename="{profile_id}.zip"'},
+        )
+
     async def crash_list_handler(_: web.Request) -> web.Response:
         from .flight_recorder import crash_vault
 
@@ -279,6 +337,14 @@ def register_debug_routes(app, aio_app: web.Application) -> None:
 
     aio_app.router.add_get("/debug/serving", serving_handler)
     aio_app.router.add_get("/debug/profile", profile_handler)
+    # /profile/auto must register BEFORE aiohttp ever sees a bare
+    # /debug/profile/{...}; these are literal paths, so order is only
+    # cosmetic — kept adjacent for readability
+    aio_app.router.add_get("/debug/profile/auto", autoprofile_list_handler)
+    aio_app.router.add_get("/debug/profile/auto/{profile_id}",
+                           autoprofile_handler)
+    aio_app.router.add_get("/debug/goodput", goodput_handler)
+    aio_app.router.add_get("/debug/programs", programs_handler)
     aio_app.router.add_get("/debug/events", events_handler)
     aio_app.router.add_get("/debug/crash", crash_list_handler)
     aio_app.router.add_get("/debug/crash/{crash_id}", crash_handler)

@@ -34,6 +34,17 @@ before the crash?":
   into an in-memory bundle served at ``GET /debug/crash/<id>`` — the
   postmortem survives the recovery, so reading it never needs a live
   repro.
+- ``AutoProfiler`` + ``ProfileVault`` — the **anomaly-triggered
+  auto-profiler**: a rolling baseline over the DispatchRecorder's
+  step wall and phase shares; when a regression trips (step p50 past
+  ``GOFR_ML_AUTOPROF_MULT`` × baseline, or a host phase's share jumping
+  by more than 25 points) it captures a bounded ``jax.profiler`` trace
+  on a background thread into an 8-deep vault served at
+  ``GET /debug/profile/auto`` — the trace of the slowdown exists by the
+  time a human reads the alert, instead of asking them to reproduce it.
+  Cooldown (``GOFR_ML_AUTOPROF_COOLDOWN_S``) bounds capture frequency;
+  ``GOFR_ML_AUTOPROF=0`` disables under the same is-not-None
+  zero-overhead contract as the recorder itself.
 
 The per-REQUEST axis of the same story — "where did this request's
 TTFT/TPOT budget go, across the fleet?" — lives in the sibling journey
@@ -47,12 +58,23 @@ the debug endpoints without paying the ml package's startup cost.
 from __future__ import annotations
 
 import collections
+import io
 import os
+import shutil
+import tempfile
 import threading
 import time
+import zipfile
 
 __all__ = ["PHASES", "DispatchRecorder", "EventLog", "CrashVault",
-           "event_log", "crash_vault", "recorder_enabled"]
+           "AutoProfiler", "ProfileVault", "PROFILE_LOCK",
+           "event_log", "crash_vault", "profile_vault",
+           "recorder_enabled", "autoprof_enabled", "zip_dir_bytes"]
+
+# the jax profiler is process-global state: ONE capture at a time, ever —
+# shared by the manual /debug/profile endpoint and the auto-profiler, so
+# the two can never corrupt each other's trace
+PROFILE_LOCK = threading.Lock()
 
 # the dispatch-phase taxonomy (the label set of
 # app_llm_dispatch_phase_seconds). ``route`` is recorded by the replica
@@ -103,6 +125,10 @@ class DispatchRecorder:
         self._anchor: float | None = None  # pass start (perf_counter)
         self.dispatches = 0
         self.totals = dict.fromkeys(PHASES, 0.0)  # lifetime seconds
+        # optional per-commit observer (the auto-profiler's feed): called
+        # with (wall_s, phases) after each committed record. None costs
+        # one attribute test — the GOFR_ML_AUTOPROF=0 contract.
+        self.observer = None
 
     @property
     def pending(self) -> bool:
@@ -169,6 +195,12 @@ class DispatchRecorder:
                 self.totals[name] = self.totals.get(name, 0.0) + v
         self._pending.clear()
         self._anchor = now
+        obs = self.observer
+        if obs is not None:
+            try:
+                obs(wall, phases)
+            except Exception:
+                pass  # a broken observer must never fail a dispatch
         m = self._metrics
         if m is not None:
             try:
@@ -357,10 +389,298 @@ class CrashVault:
                     for b in self._bundles.values()]
 
 
+def autoprof_enabled() -> bool:
+    """``GOFR_ML_AUTOPROF`` (default on): 0 disables the auto-profiler —
+    the recorder's ``observer`` stays ``None`` and commits do zero extra
+    work (same contract as ``GOFR_ML_FLIGHT_RECORDER``)."""
+    return os.environ.get("GOFR_ML_AUTOPROF", "").strip() != "0"
+
+
+def _env_float(name: str, default: float, *, minimum: float,
+               maximum: float = float("inf")) -> float:
+    """Loudly-validated float env knob (the PR-6 drain pattern): a
+    malformed threshold must fail the boot, not silently profile never
+    (or constantly)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}") from None
+    if not minimum <= value <= maximum:  # NaN fails both compares
+        raise ValueError(
+            f"{name} must be in [{minimum:g}, {maximum:g}], got {raw!r}")
+    return value
+
+
+def zip_dir_bytes(root: str, max_bytes: int | None = None) -> tuple[bytes, bool]:
+    """Zip a directory tree into memory, stopping once the archive would
+    exceed ``max_bytes`` (profiler traces can be large; a bounded vault
+    must never eat the heap). Returns ``(data, truncated)``."""
+    buf = io.BytesIO()
+    truncated = False
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for base, _, files in os.walk(root):
+            for fname in sorted(files):
+                full = os.path.join(base, fname)
+                if max_bytes is not None:
+                    try:
+                        size = os.path.getsize(full)
+                    except OSError:
+                        continue
+                    # guard BEFORE deflating: one giant .xplane.pb must
+                    # not blow the heap the cap exists to bound (deflate
+                    # compresses, so raw size is a conservative bound)
+                    if buf.tell() + size > max_bytes:
+                        truncated = True
+                        continue
+                zf.write(full, os.path.relpath(full, root))
+    return buf.getvalue(), truncated
+
+
+def _capture_profile_trace(trace_dir: str, seconds: float) -> None:
+    """Blocking jax.profiler capture (device + host timelines), run on
+    the auto-profiler's background thread. Module-level so tests can
+    monkeypatch it where jax has no backend worth tracing — the same
+    seam as ``debug._run_profile_capture``."""
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        time.sleep(seconds)
+    finally:
+        jax.profiler.stop_trace()
+
+
+class ProfileVault:
+    """Bounded in-memory store of auto-captured profile bundles, keyed
+    by id — the CrashVault pattern applied to ``jax.profiler`` zips."""
+
+    def __init__(self, capacity: int = 8) -> None:
+        self._bundles: collections.OrderedDict[str, dict] = \
+            collections.OrderedDict()
+        self._capacity = max(1, capacity)
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def capture(self, *, model: str, trigger: dict, data: bytes,
+                truncated: bool = False) -> str:
+        with self._lock:
+            self._n += 1
+            profile_id = f"{model.replace('/', '-')}-{self._n}"
+            self._bundles[profile_id] = {
+                "id": profile_id,
+                "at": round(time.time(), 6),
+                "model": model,
+                "trigger": trigger,
+                "bytes": len(data),
+                "truncated": truncated,
+                "data": data,
+            }
+            while len(self._bundles) > self._capacity:
+                self._bundles.popitem(last=False)
+            return profile_id
+
+    def get(self, profile_id: str) -> dict | None:
+        with self._lock:
+            return self._bundles.get(profile_id)
+
+    def list(self) -> list[dict]:
+        """Summaries (no trace bytes), oldest first."""
+        with self._lock:
+            return [{k: v for k, v in b.items() if k != "data"}
+                    for b in self._bundles.values()]
+
+
+class AutoProfiler:
+    """Anomaly-triggered profiling over one serving core's dispatches.
+
+    Installed as the core's ``DispatchRecorder.observer``: every commit
+    feeds ``observe(wall_s, phases)``. Dispatches accumulate in a short
+    window; when it fills, its step-wall p50 and host-phase shares are
+    compared against a rolling baseline of earlier windows. A regression
+    — p50 ≥ ``multiplier`` × baseline p50, or a host phase's share of
+    wall jumping by more than ``share_jump`` — spawns ONE background
+    capture (``jax.profiler``, ``capture_s`` seconds, zipped and
+    size-capped into the process-global :class:`ProfileVault`), emits a
+    ``profile`` fleet event, and starts the cooldown. Everything on the
+    serving thread is deque appends and, once per window, two small
+    sorts — the capture itself never runs there.
+    """
+
+    def __init__(self, *, model: str = "llm", vault: "ProfileVault | None"
+                 = None, events: "EventLog | None" = None,
+                 multiplier: float | None = None,
+                 cooldown_s: float | None = None,
+                 capture_s: float | None = None,
+                 share_jump: float = 0.25,
+                 window: int = 16, baseline: int = 128,
+                 min_baseline: int = 64,
+                 max_bytes: int = 32 * 1024 * 1024,
+                 capture_fn=None) -> None:
+        self.model = model
+        self._vault = vault if vault is not None else profile_vault()
+        self._events = events if events is not None else event_log()
+        self.multiplier = (_env_float("GOFR_ML_AUTOPROF_MULT", 2.0,
+                                      minimum=1.01)
+                           if multiplier is None else float(multiplier))
+        self.cooldown_s = (_env_float("GOFR_ML_AUTOPROF_COOLDOWN_S", 120.0,
+                                      minimum=0.0)
+                           if cooldown_s is None else float(cooldown_s))
+        self.capture_s = (_env_float("GOFR_ML_AUTOPROF_SECONDS", 1.0,
+                                     minimum=0.05, maximum=30.0)
+                          if capture_s is None else float(capture_s))
+        self.share_jump = float(share_jump)
+        self._win: list[tuple[float, dict]] = []
+        self._win_n = max(4, int(window))
+        # baseline of (wall, phases) records from PAST windows only — the
+        # window under judgment never pollutes its own reference. The
+        # serving thread extends it; /debug/serving snapshots read it —
+        # the lock keeps a concurrent extend from crashing the iteration
+        # (the PR-9 role-controller deque lesson)
+        self._base_lock = threading.Lock()
+        self._base: collections.deque[tuple[float, dict]] = \
+            collections.deque(maxlen=max(self._win_n * 2, int(baseline)))
+        self._min_baseline = max(self._win_n, int(min_baseline))
+        self._max_bytes = int(max_bytes)
+        self._capture_fn = (capture_fn if capture_fn is not None
+                            else _capture_profile_trace)
+        self._cooldown_until = 0.0
+        self.dispatches = 0
+        self.captures = 0
+        self.failures = 0
+        self.skipped_busy = 0  # trigger lost the profiler lock (manual
+        # capture in flight): counted, cooldown still consumed
+        self.last_trigger: dict | None = None
+
+    # -- serving-thread side -------------------------------------------------
+    def observe(self, wall_s: float, phases: dict) -> None:
+        self.dispatches += 1
+        self._win.append((wall_s, phases))
+        if len(self._win) < self._win_n:
+            return
+        window, self._win = self._win, []
+        with self._base_lock:
+            base = list(self._base)
+        trigger = self._judge(window, base) if len(base) >= \
+            self._min_baseline else None
+        with self._base_lock:
+            self._base.extend(window)
+        if trigger is not None:
+            self._trigger(trigger)
+
+    @staticmethod
+    def _p50(walls: list[float]) -> float:
+        ordered = sorted(walls)
+        return ordered[len(ordered) // 2]
+
+    @staticmethod
+    def _shares(records) -> dict[str, float]:
+        wall = sum(w for w, _ in records)
+        if wall <= 0:
+            return {}
+        sums: dict[str, float] = {}
+        for _, phases in records:
+            for name, v in phases.items():
+                sums[name] = sums.get(name, 0.0) + v
+        return {name: v / wall for name, v in sums.items()
+                if name in _HOST_PHASES}
+
+    def _judge(self, window, base) -> dict | None:
+        """Compare the just-filled window against a baseline copy; a
+        dict describing the regression, or None."""
+        now = time.monotonic()
+        if now < self._cooldown_until:
+            return None
+        base_p50 = self._p50([w for w, _ in base])
+        win_p50 = self._p50([w for w, _ in window])
+        if base_p50 > 0 and win_p50 >= self.multiplier * base_p50:
+            return {"reason": "step_ms_p50",
+                    "step_ms": round(win_p50 * 1e3, 3),
+                    "baseline_ms": round(base_p50 * 1e3, 3),
+                    "multiplier": self.multiplier}
+        base_shares = self._shares(base)
+        for name, share in self._shares(window).items():
+            ref = base_shares.get(name, 0.0)
+            if share - ref > self.share_jump:
+                return {"reason": "phase_share", "phase": name,
+                        "share": round(share, 4),
+                        "baseline_share": round(ref, 4),
+                        "jump_points": self.share_jump}
+        return None
+
+    def _trigger(self, trigger: dict) -> None:
+        """Start ONE bounded background capture; the cooldown begins now
+        (capture time included), so a sustained regression produces one
+        trace per cooldown window, not a trace storm."""
+        self._cooldown_until = (time.monotonic() + self.cooldown_s
+                                + self.capture_s)
+        self.last_trigger = {**trigger, "at": round(time.time(), 3)}
+        if not PROFILE_LOCK.acquire(blocking=False):
+            # a manual /debug/profile capture (or another core's auto
+            # capture) owns the process-global profiler right now
+            self.skipped_busy += 1
+            return
+        try:
+            t = threading.Thread(target=self._capture, args=(trigger,),
+                                 daemon=True,
+                                 name=f"gofr-autoprof-{self.model}")
+            t.start()
+        except BaseException:
+            # a failed thread start (resource pressure — exactly when
+            # regressions fire) must not leak the process-global
+            # profiler lock: the manual endpoint would 409 forever
+            PROFILE_LOCK.release()
+            self.failures += 1
+
+    # -- background capture thread ------------------------------------------
+    def _capture(self, trigger: dict) -> None:
+        try:
+            trace_dir = tempfile.mkdtemp(prefix="gofr-autoprof-")
+            try:
+                self._capture_fn(trace_dir, self.capture_s)
+                data, truncated = zip_dir_bytes(trace_dir, self._max_bytes)
+            finally:
+                shutil.rmtree(trace_dir, ignore_errors=True)
+            profile_id = self._vault.capture(
+                model=self.model, trigger=dict(self.last_trigger or trigger),
+                data=data, truncated=truncated)
+            self.captures += 1
+            self._events.emit("profile", model=self.model,
+                              profile_id=profile_id,
+                              bytes=len(data), **trigger)
+        except Exception:
+            self.failures += 1
+        finally:
+            PROFILE_LOCK.release()
+
+    def snapshot(self) -> dict:
+        """The ``autoprof`` block of ``/debug/serving``. Safe from any
+        thread (baseline copied under its lock)."""
+        with self._base_lock:
+            base = [w for w, _ in self._base]
+        return {
+            "dispatches": self.dispatches,
+            "captures": self.captures,
+            "failures": self.failures,
+            "skipped_busy": self.skipped_busy,
+            "multiplier": self.multiplier,
+            "cooldown_s": self.cooldown_s,
+            "capture_s": self.capture_s,
+            "baseline_ms": (round(self._p50(base) * 1e3, 3)
+                            if len(base) >= self._min_baseline else None),
+            "cooling_down": time.monotonic() < self._cooldown_until,
+            "last_trigger": self.last_trigger,
+        }
+
+
 # the process-global instances every serving component shares — ONE fleet
-# event stream and ONE crash vault per process, like the metrics registry
+# event stream, ONE crash vault, and ONE profile vault per process, like
+# the metrics registry
 _EVENTS = EventLog()
 _CRASHES = CrashVault()
+_PROFILES = ProfileVault()
 
 
 def event_log() -> EventLog:
@@ -369,3 +689,7 @@ def event_log() -> EventLog:
 
 def crash_vault() -> CrashVault:
     return _CRASHES
+
+
+def profile_vault() -> ProfileVault:
+    return _PROFILES

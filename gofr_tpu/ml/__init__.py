@@ -50,6 +50,11 @@ class MLDatasource:
         # silently under churn; the sampler pass publishes the delta as
         # app_ml_events_dropped_total so poller cursor gaps are visible
         self._events_dropped_seen = 0
+        # goodput/compile watermarks: the ledgers and program logs count
+        # monotonically; the sampler pass publishes deltas as Prometheus
+        # counters so the sources stay metrics-free
+        self._goodput_seen: dict[tuple[str, str], int] = {}
+        self._compile_seen: dict[str, tuple[float, int]] = {}
         self._maybe_register_sampler()
 
     def _maybe_register_sampler(self) -> None:
@@ -295,9 +300,13 @@ class MLDatasource:
         pass
 
     def refresh_device_metrics(self, metrics) -> None:
-        """Push HBM gauges per device (scraped by the metrics server)."""
+        """Push HBM gauges per device (scraped by the metrics server).
+        Backends whose devices report no memory stats (CPU) publish the
+        process RSS as the memory signal instead of silently nothing —
+        the dashboards keep a populated panel either way."""
         import jax
 
+        supported = False
         for dev in jax.devices():
             try:
                 stats = dev.memory_stats() or {}
@@ -305,9 +314,47 @@ class MLDatasource:
                 continue
             label = f"{dev.platform}:{dev.id}"
             if "bytes_in_use" in stats:
+                supported = True
                 metrics.set_gauge("app_tpu_hbm_bytes_in_use", stats["bytes_in_use"], device=label)
             if "bytes_limit" in stats:
                 metrics.set_gauge("app_tpu_hbm_bytes_limit", stats["bytes_limit"], device=label)
+        if not supported:
+            rss = _host_rss_bytes()
+            if rss is not None:
+                try:
+                    metrics.set_gauge("app_ml_host_rss_bytes", rss)
+                except Exception:
+                    pass  # bare managers in tests: the gauge is optional
+
+    def hbm_snapshot(self) -> dict:
+        """Per-device HBM for /debug/serving and /debug/programs: one
+        row per device — real byte counts where ``memory_stats()``
+        answers, an explicit ``"unsupported"`` where it doesn't (CPU
+        backends return None), never an absent key — with the process-RSS
+        fallback spelled out when nothing reported."""
+        import jax
+
+        devices: dict[str, Any] = {}
+        supported = False
+        for dev in jax.devices():
+            label = f"{dev.platform}:{dev.id}"
+            try:
+                stats = dev.memory_stats() or {}
+            except Exception:
+                stats = {}
+            if "bytes_in_use" in stats:
+                supported = True
+                row = {"bytes_in_use": int(stats["bytes_in_use"])}
+                if "bytes_limit" in stats:
+                    row["bytes_limit"] = int(stats["bytes_limit"])
+                devices[label] = row
+            else:
+                devices[label] = "unsupported"
+        out: dict[str, Any] = {"devices": devices}
+        if not supported:
+            out["fallback"] = "host_rss"
+            out["host_rss_bytes"] = _host_rss_bytes()
+        return out
 
     def sample_runtime_gauges(self, metrics=None) -> None:
         """One sampler pass: HBM occupancy + per-component queue depths +
@@ -351,10 +398,96 @@ class MLDatasource:
                 continue
             m.set_gauge("app_llm_active_slots", float(server.gen.n_live),
                         model=name)
+        self._export_goodput(m)
+        self._export_program_telemetry(m)
+
+    def _export_goodput(self, m) -> None:
+        """Serving economics at :2121 — wasted-token counter deltas per
+        (model, reason) plus the live goodput fraction gauge."""
+        from .goodput import goodput_ledger
+
+        ledger = goodput_ledger()
+        if ledger is None:
+            return
+        for (model, reason), total in ledger.wasted_totals().items():
+            seen = self._goodput_seen.get((model, reason), 0)
+            if total > seen:
+                try:
+                    m.add_counter("app_llm_tokens_wasted_total",
+                                  total - seen, model=model, reason=reason)
+                    self._goodput_seen[(model, reason)] = total
+                except Exception:
+                    pass
+        for model in ledger.models():
+            frac = ledger.snapshot_model(model)["goodput"]
+            if frac is not None:
+                try:
+                    m.set_gauge("app_llm_goodput_fraction", frac,
+                                model=model)
+                except Exception:
+                    pass
+
+    def _program_logs(self):
+        """Every (model, ProgramLog) pair in this datasource — engines
+        plus LLM generators, replica cores under their ``pool/idx``
+        names."""
+        for name, engine in self._engines.items():
+            log = getattr(engine, "programs", None)
+            if log is not None:
+                yield name, engine, log
+        for name, server in self._llms.items():
+            cores = (enumerate(server.replicas)
+                     if hasattr(server, "replicas") else [(None, server)])
+            for i, core in cores:
+                log = getattr(getattr(core, "gen", None), "programs", None)
+                if log is not None:
+                    yield (name if i is None else f"{name}/{i}"), None, log
+
+    def _export_program_telemetry(self, m) -> None:
+        """Compile-cost counters (deltas) + the program-inventory gauge."""
+        for model, _owner, log in self._program_logs():
+            totals = log.totals()
+            try:
+                m.set_gauge("app_ml_programs", float(totals["programs"]),
+                            model=model)
+            except Exception:
+                pass
+            seen_s, seen_h = self._compile_seen.get(model, (0.0, 0))
+            try:
+                if totals["compile_s"] > seen_s:
+                    m.add_counter("app_ml_compile_seconds_total",
+                                  totals["compile_s"] - seen_s, model=model)
+                    seen_s = totals["compile_s"]
+                if totals["cache_hits"] > seen_h:
+                    m.add_counter("app_ml_compile_cache_hits_total",
+                                  totals["cache_hits"] - seen_h, model=model)
+                    seen_h = totals["cache_hits"]
+            except Exception:
+                pass
+            self._compile_seen[model] = (seen_s, seen_h)
+
+    def programs_snapshot(self, cost: bool = True) -> dict:
+        """The /debug/programs body: every jitted/native program per
+        model — shapes, compile wall, backend compile seconds, cache
+        provenance, and (``cost=True``) XLA cost-analysis flops/bytes —
+        plus the live per-device HBM picture."""
+        out: dict[str, Any] = {"models": {}, "hbm": self.hbm_snapshot()}
+        for model, owner, log in self._program_logs():
+            row: dict[str, Any] = {"totals": log.totals(),
+                                   "entries": log.snapshot(cost=cost)}
+            pjrt = getattr(owner, "_pjrt", None) if owner is not None else None
+            if pjrt is not None:
+                row["pjrt"] = dict(pjrt.stats)
+            out["models"][model] = row
+        return out
 
     def serving_snapshot(self) -> dict:
         """Live structured state for the /debug/serving endpoint."""
-        snap: dict[str, Any] = {"models": {}, "llms": {}}
+        from .goodput import goodput_ledger
+
+        ledger = goodput_ledger()
+        snap: dict[str, Any] = {"models": {}, "llms": {},
+                                "hbm": self.hbm_snapshot()}
         for name, engine in self._engines.items():
             entry = {
                 "steps": engine.steps,
@@ -409,6 +542,14 @@ class MLDatasource:
                 # (queue pop / decide / assemble / launch / d2h issue /
                 # device wait / emit / other) and the top host-side stall
                 entry["stalls"] = server.recorder.snapshot()
+            if getattr(server, "autoprof", None) is not None:
+                # anomaly-triggered auto-profiler: baseline, trigger
+                # config, capture tally (the traces live at
+                # /debug/profile/auto)
+                entry["autoprof"] = server.autoprof.snapshot()
+            if ledger is not None:
+                # serving economics: the token-fate ledger for this core
+                entry["goodput"] = ledger.snapshot_model(server.name)
             return entry
 
         for name, server in self._llms.items():
@@ -422,6 +563,11 @@ class MLDatasource:
                     str(i): llm_entry(core)
                     for i, core in enumerate(server.replicas)
                 }
+                if ledger is not None:
+                    # fleet economics: the pool name aggregates its own
+                    # fleet-level waste (failover/migration) plus every
+                    # replica core's ledger
+                    entry["goodput"] = ledger.snapshot_model(name)
                 snap["llms"][name] = entry
                 continue
             snap["llms"][name] = llm_entry(server)

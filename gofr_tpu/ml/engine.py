@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..tracing import current_context
+from .programs import ProgramLog, abstractify, watch_compiles
 from .scheduler import maybe_enable_compilation_cache
 
 __all__ = ["Engine", "EngineConfig"]
@@ -87,12 +88,16 @@ class Engine:
         # recompiling them (same knob Generator.warmup honors)
         maybe_enable_compilation_cache()
         self.compiled_buckets: set[int] = set()  # batch dims seen on device
+        # program & compile telemetry (ml/programs.py): one row per
+        # compiled batch bucket — the /debug/programs inventory
+        self.programs = ProgramLog()
         if backend == "pjrt":
             # native PJRT C-API path: jax traces, our binding executes
             from .pjrt_backend import PjrtExecutor
 
             self._pjrt = PjrtExecutor(apply_fn, params,
-                                      plugin_path=plugin_path)
+                                      plugin_path=plugin_path,
+                                      programs=self.programs)
             self._run = self._pjrt
             self._params = params
         elif backend == "jit":
@@ -171,8 +176,23 @@ class Engine:
                           else jnp.asarray(x) for x in inputs]
             else:
                 arrays = [jnp.asarray(x) for x in inputs]
-            out = self._run(*arrays)
-            out = jax.tree.map(lambda a: np.asarray(a), out)  # blocks until done
+            # a batch bucket not yet seen on device means this execute
+            # pays a compile (jit retrace or native _compile_for): watch
+            # it so the inventory row carries true compile seconds and
+            # persistent-cache provenance
+            batch = (int(arrays[0].shape[0])
+                     if arrays and getattr(arrays[0], "ndim", 0) > 0
+                     else None)
+            acc = None
+            if batch is not None and batch not in self.compiled_buckets:
+                with watch_compiles() as acc:
+                    out = self._run(*arrays)
+                    # blocks until done — the compile completes inside
+                    # the watch window
+                    out = jax.tree.map(lambda a: np.asarray(a), out)
+            else:
+                out = self._run(*arrays)
+                out = jax.tree.map(lambda a: np.asarray(a), out)  # blocks
         except BaseException as exc:
             if span is not None:
                 span.record_exception(exc)
@@ -184,10 +204,27 @@ class Engine:
                 span.end()
         # successful steps only: a failed execute must not count as served
         # work or skew the step-latency histogram with its error path
-        if arrays and getattr(arrays[0], "ndim", 0) > 0:
-            self.compiled_buckets.add(int(arrays[0].shape[0]))
-        self.steps += 1
         dur = time.perf_counter() - start
+        if arrays and getattr(arrays[0], "ndim", 0) > 0:
+            b = int(arrays[0].shape[0])
+            # the native path records its own pjrt/… rows from
+            # _compile_for — a second apply/bN row here would double-count
+            # every compile second in the shared log
+            if (b not in self.compiled_buckets and acc is not None
+                    and self._pjrt is None):
+                kwargs: dict = {}
+                if not self.config.donate_inputs:
+                    # the plain jit path can re-lower for cost analysis;
+                    # the donate wrapper cannot (per-arity closures)
+                    kwargs = {"fn": self._apply,
+                              "abstract": abstractify(
+                                  (self._params, *arrays))}
+                self.programs.record(
+                    f"apply/b{b}", wall_s=dur, acc=acc,
+                    shapes={"inputs": [list(np.shape(a)) for a in arrays]},
+                    **kwargs)
+            self.compiled_buckets.add(b)
+        self.steps += 1
         if self._metrics is not None:
             try:
                 self._metrics.record_histogram(

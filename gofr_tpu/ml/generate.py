@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .kv_offload import HostKVStore
+from .programs import ProgramLog, abstractify, watch_compiles
 from .scheduler import TokenBudgetScheduler, maybe_enable_compilation_cache
 
 __all__ = ["Sampler", "sample_logits", "greedy", "Generator",
@@ -409,6 +410,16 @@ class Generator:
         # decide/dispatch/device_wait/emit phase durations; every site
         # guards with ``is not None`` — disabled costs one attribute test
         self.recorder = None
+        # goodput ledger handle (ml/goodput.py): the serving layer installs
+        # a model-bound ModelGoodput here so the spec verify path and the
+        # restore-fallback path can classify device tokens; same
+        # is-not-None contract as the recorder (GOFR_ML_GOODPUT=0)
+        self.goodput = None
+        # program & compile telemetry (ml/programs.py): one row per jitted
+        # program, recorded at warmup / first paged-op use — the
+        # /debug/programs inventory the serving layer labels with its
+        # model name
+        self.programs = ProgramLog()
         # async-prefetch failures (satellite: the bare except around
         # copy_to_host_async must be observable — a broken prefetch path
         # degrades every dispatch silently otherwise)
@@ -1158,7 +1169,21 @@ class Generator:
         key = tuple(int(t) for t in info["ids_full"])
         pages = np.asarray(info["pages"], np.int32)
         with self._mesh_ctx():
-            slabs = self._gather_pages(self.cache, pages)
+            if "paged/gather" not in self.programs:
+                # first spill compiles the gather op: record it like the
+                # warmup ladder (rare path — the membership test is one
+                # lock + set probe per spill)
+                args = (self.cache, pages)
+                abstract = abstractify(args)
+                t0 = time.perf_counter()
+                with watch_compiles() as acc:
+                    slabs = self._gather_pages(*args)
+                self.programs.record(
+                    "paged/gather", wall_s=time.perf_counter() - t0,
+                    acc=acc, shapes={"pages": list(pages.shape)},
+                    fn=self._gather_pages, abstract=abstract)
+            else:
+                slabs = self._gather_pages(self.cache, pages)
         try:
             for arr in slabs.values():
                 arr.copy_to_host_async()
@@ -1217,14 +1242,29 @@ class Generator:
         if len(self._free_pages) < n_need:
             self.host_kv.put_back(key, arrays, meta)
             self.kv_restore_fallbacks += 1
+            # goodput: the CALLER classifies the restore_fallback — only
+            # it knows how much of the lost reuse a shallower registered
+            # match still covers (prefix_cache.observe's floor)
             raise PagePoolExhausted(
                 f"restore needs {n_need} pages, {self.free_pages} free")
         pages = [self._free_pages.pop() for _ in range(n_need)]
         if n_need:
             dev_slabs = jax.device_put(arrays)  # one batched async H2D
             with self._mesh_ctx():
-                self.cache = self._scatter_pages(
-                    self.cache, np.asarray(pages, np.int32), dev_slabs)
+                page_arr = np.asarray(pages, np.int32)
+                if "paged/scatter" not in self.programs:
+                    args = (self.cache, page_arr, dev_slabs)
+                    abstract = abstractify(args)
+                    t0 = time.perf_counter()
+                    with watch_compiles() as acc:
+                        self.cache = self._scatter_pages(*args)
+                    self.programs.record(
+                        "paged/scatter", wall_s=time.perf_counter() - t0,
+                        acc=acc, shapes={"pages": list(page_arr.shape)},
+                        fn=self._scatter_pages, abstract=abstract)
+                else:
+                    self.cache = self._scatter_pages(
+                        self.cache, page_arr, dev_slabs)
         pid = self._next_prefix
         self._next_prefix += 1
         self._prefix_clock += 1
@@ -1541,37 +1581,49 @@ class Generator:
         np.asarray(self._tok_dev)
         return invalidated
 
-    def _warm_dispatch(self, fn, spec: bool | None = None) -> None:
+    def _warm_dispatch(self, fn, spec: bool | None = None,
+                       name: str | None = None) -> None:
         """One dead-batch dispatch of a chunk program (all slots garbage):
         compiles it on first use (warmup) and proves a rebuilt decode
         state executes (recover). ``spec`` overrides the ladder family
         (a spec generator warms its PLAIN fallback ladder too). Callers
-        hold the mesh context."""
+        hold the mesh context. ``name`` records the program (with its
+        compile wall and cache provenance) in the telemetry inventory —
+        unnamed calls (recover's re-warm probe) skip the bookkeeping."""
         spec = bool(self.spec_k) if spec is None else spec
         if spec and self.page_size:
-            (_row0, _e, _c, self._tok_dev, self.cache,
-             self._tokens_dev, self._draft_cache) = fn(
-                self.params, self._tok_dev, self.cache,
-                self._tokens_dev, self._draft_cache,
-                np.zeros((self.batch_slots,), bool),
-                np.zeros_like(self._table))
+            args = (self.params, self._tok_dev, self.cache,
+                    self._tokens_dev, self._draft_cache,
+                    np.zeros((self.batch_slots,), bool),
+                    np.zeros_like(self._table))
         elif spec:
-            (_row0, _e, _c, self._tok_dev, self.cache,
-             self._tokens_dev, self._draft_cache) = fn(
-                self.params, self._tok_dev, self.cache,
-                self._tokens_dev, self._draft_cache,
-                np.zeros((self.batch_slots,), bool))
+            args = (self.params, self._tok_dev, self.cache,
+                    self._tokens_dev, self._draft_cache,
+                    np.zeros((self.batch_slots,), bool))
         elif self.page_size:
-            _toks, self._tok_dev, self.cache = fn(
-                self.params, self._tok_dev, self.cache,
-                np.int32(0), self._base_key,
-                np.zeros_like(self._table),  # all-scratch tables
-            )
+            args = (self.params, self._tok_dev, self.cache,
+                    np.int32(0), self._base_key,
+                    np.zeros_like(self._table))  # all-scratch tables
         else:
-            _toks, self._tok_dev, self.cache = fn(
-                self.params, self._tok_dev, self.cache,
-                np.int32(0), self._base_key,
-            )
+            args = (self.params, self._tok_dev, self.cache,
+                    np.int32(0), self._base_key)
+        record = name is not None and name not in self.programs
+        if record:
+            abstract = abstractify(args)
+            t0 = time.perf_counter()
+            with watch_compiles() as acc:
+                out = fn(*args)
+            self.programs.record(
+                name, wall_s=time.perf_counter() - t0, acc=acc,
+                shapes={"tok": list(args[1].shape)}, fn=fn,
+                abstract=abstract)
+        else:
+            out = fn(*args)
+        if spec:
+            (_row0, _e, _c, self._tok_dev, self.cache,
+             self._tokens_dev, self._draft_cache) = out
+        else:
+            _toks, self._tok_dev, self.cache = out
 
     def warmup(self) -> None:
         """Compile the decode programs (full chunk + TTFT mini-chunk) and
@@ -1592,74 +1644,103 @@ class Generator:
             self.prefill_chunk
             or self.scheduler.budget
             < self.chunk * self.batch_slots * per_step)
+        # the decode family's telemetry name: a spec generator's primary
+        # ladder dispatches K+1-position verify windows, not plain chunks
+        fam = "spec/window" if self.spec_k else "decode/chunk"
         if full_ladder:
             # any ladder entry may be dispatched under load — compile them
             # all, largest first (the steady-state program is hot soonest)
-            fns = [self._chunk_fns[n] for n in reversed(self._chunk_ladder)]
+            fns = [(f"{fam}{n}", self._chunk_fns[n])
+                   for n in reversed(self._chunk_ladder)]
         else:
             # without chunked prefill (and with a budget covering the full
             # batch) plan() provably always picks `chunk`: the intermediate
             # ladder entries are unreachable — don't pay their compiles
-            fns = [self._chunk_fn]
+            fns = [(f"{fam}{self.chunk}", self._chunk_fn)]
             if self._mini_chunk_fn is not self._chunk_fn:
-                fns.append(self._mini_chunk_fn)
+                fns.append((f"{fam}1", self._mini_chunk_fn))
         with self._mesh_ctx():
-            for fn in fns:
-                self._warm_dispatch(fn)
+            for name, fn in fns:
+                self._warm_dispatch(fn, name=name)
             if self.spec_k and self._plain_armed:
                 # the all-disabled fallback dispatches the PLAIN ladder:
                 # compile it here too, or the first adversarial burst pays
                 # the compile exactly when it's already degraded
                 if full_ladder:
-                    plain = [self._plain_fns[n]
+                    plain = [(f"decode/chunk{n}", self._plain_fns[n])
                              for n in reversed(self._chunk_ladder)]
                 else:
-                    plain = [self._plain_fns[self.chunk]]
+                    plain = [(f"decode/chunk{self.chunk}",
+                              self._plain_fns[self.chunk])]
                     if self.chunk != 1:
-                        plain.append(self._plain_fns[1])
-                for fn in plain:
-                    self._warm_dispatch(fn, spec=False)
+                        plain.append(("decode/chunk1", self._plain_fns[1]))
+                for name, fn in plain:
+                    self._warm_dispatch(fn, spec=False, name=name)
             if self.prefill_chunk:
                 # segment program: startup pays the compile, not the first
                 # long prompt (len reset by the bucket prefills below)
                 seg = np.zeros((1, self.prefill_chunk), np.int32)
                 one = np.array([1], np.int32)
+                seg_name = f"prefill/segment{self.prefill_chunk}"
                 if self.page_size:
-                    _logits, self.cache = self._segment_prefill_paged(
-                        self.params, seg, one, self.cache,
-                        np.zeros((self._p_max,), np.int32), np.int32(0),
-                        np.int32(0),
-                        np.int32(self._p_max * self.page_size))
+                    fn = self._segment_prefill_paged
+                    args = (self.params, seg, one, self.cache,
+                            np.zeros((self._p_max,), np.int32), np.int32(0),
+                            np.int32(0),
+                            np.int32(self._p_max * self.page_size))
                 else:
-                    _logits, self.cache = self._segment_prefill(
-                        self.params, seg, one, self.cache, np.int32(0),
-                        np.int32(0), np.int32(self.cache["k"].shape[2]))
+                    fn = self._segment_prefill
+                    args = (self.params, seg, one, self.cache, np.int32(0),
+                            np.int32(0), np.int32(self.cache["k"].shape[2]))
+                abstract = abstractify(args)
+                t0 = time.perf_counter()
+                with watch_compiles() as acc:
+                    _logits, self.cache = fn(*args)
+                self.programs.record(
+                    seg_name, wall_s=time.perf_counter() - t0, acc=acc,
+                    shapes={"tokens": [1, self.prefill_chunk]}, fn=fn,
+                    abstract=abstract)
             for bucket in self.prefill_buckets:
                 padded = np.zeros((1, bucket), np.int32)
                 ones = np.array([1], np.int32)
-                if self.page_size:
-                    logits, self.cache = self._prefill_paged(
-                        self.params, padded, ones, self.cache,
-                        np.zeros((bucket // self.page_size,), np.int32),
-                        np.int32(0),
-                    )
-                else:
-                    logits, self.cache = self._prefill_into(
-                        self.params, padded, ones, self.cache, np.int32(0),
-                    )
-                self._after_prefill(logits, padded, ones, np.int32(0))
-                if self._admit_cap > 1:  # the wave-admission shapes too
-                    b = self._admit_cap
-                    toks_b = np.zeros((b, bucket), np.int32)
-                    lens_b = np.ones((b,), np.int32)
-                    slots_b = np.zeros((b,), np.int32)
-                    dead = np.zeros((b,), bool)  # all rows masked: no writes
-                    logits, self.cache = self._prefill_many(
-                        self.params, toks_b, lens_b, self.cache, slots_b,
-                        dead,
-                    )
-                    self._after_prefill(logits, toks_b, lens_b, slots_b,
-                                        dead)
+                # the bucket's whole warm block (prefill + first-token
+                # sampling [+ the wave shapes]) is one inventory row: its
+                # wall is what a cold restart pays for this bucket; the
+                # lazy cost analysis covers the main prefill program
+                t0 = time.perf_counter()
+                with watch_compiles() as acc:
+                    if self.page_size:
+                        fn = self._prefill_paged
+                        args = (self.params, padded, ones, self.cache,
+                                np.zeros((bucket // self.page_size,),
+                                         np.int32),
+                                np.int32(0))
+                    else:
+                        fn = self._prefill_into
+                        args = (self.params, padded, ones, self.cache,
+                                np.int32(0))
+                    abstract = abstractify(args)
+                    logits, self.cache = fn(*args)
+                    self._after_prefill(logits, padded, ones, np.int32(0))
+                    if self._admit_cap > 1:  # the wave-admission shapes too
+                        b = self._admit_cap
+                        toks_b = np.zeros((b, bucket), np.int32)
+                        lens_b = np.ones((b,), np.int32)
+                        slots_b = np.zeros((b,), np.int32)
+                        dead = np.zeros((b,), bool)  # all masked: no writes
+                        logits, self.cache = self._prefill_many(
+                            self.params, toks_b, lens_b, self.cache,
+                            slots_b, dead,
+                        )
+                        self._after_prefill(logits, toks_b, lens_b, slots_b,
+                                            dead)
+                self.programs.record(
+                    f"prefill/b{bucket}",
+                    wall_s=time.perf_counter() - t0, acc=acc,
+                    shapes={"tokens": [1, bucket],
+                            "wave": (self._admit_cap
+                                     if self._admit_cap > 1 else None)},
+                    fn=fn, abstract=abstract)
         # a REAL device->host fetch, not block_until_ready: through remote
         # transports the latter returns before queued work has drained, and
         # the first live request's token fetch would then absorb the entire
@@ -2319,6 +2400,7 @@ class Generator:
         self._resolve_first(row0)
         bursts: dict[int, list[int]] = {}
         n_windows = emits.shape[0]
+        rejected = 0  # draft positions the verify windows discarded
         for i, s in enumerate(self.slots):
             if not s.live or i in self._chunked:
                 continue  # mid-prefill rows decode garbage; drop it
@@ -2335,9 +2417,15 @@ class Generator:
                 if enabled:
                     s.spec_recent_w += 1
                     s.spec_recent_e += n
+                    # the device computed K+1 positions for this window;
+                    # n survived verification — the rest is the drafting
+                    # bill the goodput ledger itemizes
+                    rejected += self.spec_k + 1 - n
                 self.spec_emitted += self._apply_burst(
                     i, s, emits[w, i, :n], bursts)
             self._eval_spec_slot(s, enabled, seen)
+        if rejected and self.goodput is not None:
+            self.goodput.note("spec_rejected", rejected)
         self._fire_bursts(bursts)
 
     def _eval_spec_slot(self, s: _Slot, enabled: bool,

@@ -151,6 +151,17 @@ class Journey:
         with self._lock:
             self.data.update(data)
 
+    def count_mark(self, name: str) -> int:
+        """How many times this timeline recorded ``name`` — the pool's
+        failover accounting compares ``count_mark("admit")`` against the
+        charges it already made, so a replica that actually started the
+        request (its prefill is real lost work) is distinguishable from
+        one that merely queued it, across MULTIPLE reroute hops. Folded
+        repeats count their collapsed segments too."""
+        with self._lock:
+            return sum(1 + m.get("folded", 0)
+                       for m in self.marks if m["mark"] == name)
+
     def finish(self, reason: str, error: str | None = None) -> bool:
         """Seal the journey: close the tail segment as ``finish`` (carrying
         the reason), stamp the wall, and record any honesty remainder as

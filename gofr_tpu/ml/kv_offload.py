@@ -125,6 +125,12 @@ class HostKVStore:
         # name here so this tier's spill/restore events are attributable
         self.model = "llm"
         self._events = event_log()
+        # goodput ledger handle (ml/goodput.py), installed by the owning
+        # LLMServer next to ``model``: an entry the tier can NEVER hold
+        # (over-budget reject) means every future hit on that prefix
+        # re-prefills — classified at the reject, the point the fate of
+        # the already-paid KV is decided. None = ledger off.
+        self.goodput = None
         self.bytes_used = 0
         # lifetime totals for /debug/serving
         self.puts = 0
@@ -150,6 +156,9 @@ class HostKVStore:
         with self._lock:
             if nbytes > self.budget_bytes:
                 self.rejects += 1
+                lost = int(meta.get("len", 0))
+                if self.goodput is not None and lost:
+                    self.goodput.note("restore_fallback", lost)
                 return False
             old = self._entries.pop(key, None)
             if old is not None:

@@ -43,9 +43,11 @@ from ..testutil.faults import FaultInjector, fault_snapshot
 from ..tracing import current_context
 from .errors import (DeadlineExceeded, GeneratorCrashed, Overloaded,
                      ServerClosed)
-from ..flight_recorder import (DispatchRecorder, crash_vault, event_log,
+from ..flight_recorder import (AutoProfiler, DispatchRecorder,
+                              autoprof_enabled, crash_vault, event_log,
                               recorder_enabled)
 from .generate import PagePoolExhausted, PrefixEvicted
+from .goodput import goodput_ledger
 from .journey import Journey, journey_log, next_rid
 from .journey import seal as seal_journey
 from .prefix_cache import PrefixCacheConfig, RadixPrefixCache
@@ -266,6 +268,28 @@ class LLMServer:
         self.recorder = (DispatchRecorder(model=name, metrics=metrics)
                          if recorder_enabled() else None)
         generator.recorder = self.recorder
+        # anomaly-triggered auto-profiler (flight_recorder.py): observes
+        # every committed dispatch record through recorder.observer and
+        # captures a bounded jax.profiler trace when step time or a phase
+        # share regresses past its baseline. GOFR_ML_AUTOPROF=0 disables
+        # (observer stays None — zero per-commit work, like the recorder)
+        self.autoprof = None
+        if self.recorder is not None and autoprof_enabled():
+            self.autoprof = AutoProfiler(model=name)
+            self.recorder.observer = self.autoprof.observe
+        # goodput ledger (ml/goodput.py): classify every device-computed
+        # token at the point its fate is decided. The generator, prefix
+        # cache, and host KV tier get model-bound handles so their
+        # classification points stay one-liners; GOFR_ML_GOODPUT=0
+        # disables via the same is-not-None contract
+        self._goodput = goodput_ledger()
+        handle = (self._goodput.handle(name)
+                  if self._goodput is not None else None)
+        generator.goodput = handle
+        if self.prefix_cache is not None:
+            self.prefix_cache.goodput = handle
+        if getattr(generator, "host_kv", None) is not None:
+            generator.host_kv.goodput = handle
         # request journeys (journey.py): per-request lifecycle timelines,
         # tail-sampled at /debug/requests. GOFR_ML_JOURNEY=0 disables —
         # every instrumented site guards on is-not-None like the recorder
@@ -642,6 +666,10 @@ class LLMServer:
             if req is not None:
                 leftovers.append(req)
         for slot, req in list(self._active.items()):
+            # tokens computed for an in-flight slot a force-close dropped
+            # never ship as a completed answer (a graceful drain finishes
+            # them before this runs)
+            self._note_goodput("disconnected", self._slot_produced(slot))
             leftovers.append(req)
             del self._active[slot]
         exc = self._closed_error()
@@ -656,6 +684,22 @@ class LLMServer:
                 f"({self._max_restarts} restarts/"
                 f"{self._restart_window:g}s)")
         return ServerClosed()
+
+    def _note_goodput(self, reason: str, tokens: int) -> None:
+        """Classify device-computed tokens in the goodput ledger — one
+        call per fate decision, never per token."""
+        if self._goodput is not None and tokens > 0:
+            self._goodput.note(self.name, reason, int(tokens))
+
+    def _slot_produced(self, slot: int | None) -> int:
+        """Tokens the device computed for a slot, read defensively (the
+        crash paths run while the wreck is mid-teardown)."""
+        try:
+            if slot is None:
+                return 0
+            return int(getattr(self.gen.slots[slot], "produced", 0))
+        except Exception:
+            return 0
 
     def _finish_journey(self, req: _Request, reason: str,
                         error: str | None = None) -> None:
@@ -737,6 +781,7 @@ class LLMServer:
                               restarts=self._restarts_total,
                               budget=self._max_restarts)
             for slot, req in list(self._active.items()):
+                self._note_goodput("crashed", self._slot_produced(slot))
                 self._reject(req, crash)
                 del self._active[slot]
             if self._logger is not None:
@@ -764,6 +809,7 @@ class LLMServer:
         except Exception:
             quarantined = []
         for slot, req in list(self._active.items()):
+            self._note_goodput("crashed", self._slot_produced(slot))
             self._reject(req, crash)
             del self._active[slot]
         t0 = time.perf_counter()
@@ -1047,7 +1093,12 @@ class LLMServer:
                     # never learns caching was attempted
                     if self.prefix_cache is not None:
                         self.prefix_cache.invalidate(req.prefix)
-                        self.prefix_cache.record_miss()  # nothing saved
+                        # nothing saved — and the prefix-length tokens the
+                        # fleet already computed once re-prefill with the
+                        # full prompt (goodput: restore_fallback)
+                        self.prefix_cache.record_miss(
+                            lost_tokens=len(req.full_prompt)
+                            - len(req.prompt))
                     req.prompt = req.full_prompt
                     req.prefix = None
                     req.full_prompt = None
@@ -1353,7 +1404,9 @@ class LLMServer:
                 if req.deadline_hit:
                     # cancelled mid-generation by its deadline: free the
                     # slot (pages with it) and complete with the typed
-                    # 504 instead of a finish marker
+                    # 504 instead of a finish marker. The tokens it
+                    # produced never ship as an answer — wasted.
+                    self._note_goodput("deadline_cancelled", s.produced)
                     self.gen.release(slot)
                     del self._active[slot]
                     self._expire(req, "mid-generation")
@@ -1406,6 +1459,13 @@ class LLMServer:
                                          spec_emitted=s.spec_emitted)
                     self._finish_journey(req, reason)
                 req.finish_spans()
+                # goodput classification at the slot's fate decision: a
+                # natural finish delivered every produced token; a
+                # consumer that walked away mid-stream received nothing
+                # it will use (the slot was cancelled, not completed)
+                self._note_goodput(
+                    "disconnected" if req.cancelled else "delivered",
+                    produced)
                 # all of the slot's tokens were streamed via the callback
                 self.gen.release(slot)
                 del self._active[slot]

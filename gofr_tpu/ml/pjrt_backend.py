@@ -21,6 +21,7 @@ multi-chip path stays on jit/GSPMD where it belongs.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable
 
 import numpy as np
@@ -39,6 +40,7 @@ class PjrtExecutor:
         *,
         plugin_path: str | None = None,
         client_options: dict | None = None,
+        programs: Any = None,
     ) -> None:
         import jax
 
@@ -64,6 +66,11 @@ class PjrtExecutor:
             [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in leaves])
         del leaves
         self._cache: dict[tuple, tuple] = {}
+        # compile telemetry: wall seconds and entry count per native
+        # compile — the numbers /debug/programs shows for the native
+        # path (the Engine shares its ProgramLog via ``programs=``)
+        self.stats = {"compiles": 0, "compile_s": 0.0, "entries": 0}
+        self._programs = programs
 
     @property
     def platform_name(self) -> str:
@@ -75,6 +82,7 @@ class PjrtExecutor:
         def fn(params, *xs):
             return self._apply(params, *xs)
 
+        t0 = time.perf_counter()
         # keep_unused: the executable's argument list must stay aligned
         # with the flattened (params, *inputs) leaves we feed it
         lowered = jax.jit(fn, backend="cpu", keep_unused=True).lower(
@@ -83,6 +91,19 @@ class PjrtExecutor:
         out_shape = jax.eval_shape(fn, self._params_abstract, *np_inputs)
         _, out_tree = jax.tree.flatten(out_shape)
         exe = self._client.compile(hlo)
+        wall = time.perf_counter() - t0
+        # compile wall + entry count: the native path's share of the
+        # program inventory (trace + StableHLO lowering + plugin compile)
+        self.stats["compiles"] += 1
+        self.stats["compile_s"] += wall
+        self.stats["entries"] = len(self._cache) + 1
+        if self._programs is not None:
+            shapes = [list(a.shape) for a in np_inputs]
+            self._programs.record(
+                f"pjrt/{'x'.join(str(s) for s in (shapes[0] if shapes else ()))}"
+                f"#{self.stats['compiles']}",
+                wall_s=wall, kind="pjrt_native",
+                shapes={"inputs": shapes})
         return exe, out_tree
 
     def __call__(self, *inputs: Any) -> Any:

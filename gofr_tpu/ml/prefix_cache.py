@@ -147,6 +147,10 @@ class RadixPrefixCache:
         self.tokens_saved = 0
         self.offloads = 0   # registrations that moved to the host tier
         self.restores = 0   # offloaded registrations brought back
+        # goodput ledger handle (ml/goodput.py), installed by the owning
+        # LLMServer: restore fallbacks classify the re-prefilled tokens
+        # here, at the point the fallback is decided. None = ledger off.
+        self.goodput = None
 
     # -- admission path -------------------------------------------------------
     def observe(self, prompt_ids) -> tuple[int | None, int]:
@@ -181,13 +185,25 @@ class RadixPrefixCache:
                 # lost the race to pool pressure: the entry stays in the
                 # host tier, THIS request falls back to the shallower
                 # registered match (or full prefill) — same contract as
-                # the PrefixEvicted race
-                pass
+                # the PrefixEvicted race. Goodput charges only the reuse
+                # actually lost: the already-paid tokens past what the
+                # registered floor still covers re-prefill now.
+                if self.goodput is not None:
+                    lost = max(0, restore_node.reg_len - floor)
+                    if lost:
+                        self.goodput.note("restore_fallback", lost)
             except KeyError:
+                lost = 0
                 with self._lock:   # host tier dropped it (LRU): gone
                     if restore_node.pid is None:
+                        lost = restore_node.reg_len
                         restore_node.offload_key = None
                         restore_node.reg_len = 0
+                if self.goodput is not None and lost:
+                    # the tier lost an entry a prompt actually wanted:
+                    # those prefix tokens re-prefill although the fleet
+                    # already paid for them once
+                    self.goodput.note("restore_fallback", lost)
             if pid is not None:
                 with self._lock:
                     restore_node.pid = pid
@@ -255,12 +271,16 @@ class RadixPrefixCache:
             self._count("app_ml_prefix_hits_total", 1)
             self._count("app_ml_prefill_tokens_saved_total", shared)
 
-    def record_miss(self) -> None:
+    def record_miss(self, lost_tokens: int = 0) -> None:
         """A cache-split admission fell back to the full prompt (the
-        prefix evicted in the race window): nothing was saved."""
+        prefix evicted in the race window): nothing was saved.
+        ``lost_tokens`` is the already-paid prefix length that now
+        re-prefills — classified as goodput ``restore_fallback``."""
         with self._lock:
             self.misses += 1
             self._count("app_ml_prefix_misses_total", 1)
+        if self.goodput is not None and lost_tokens > 0:
+            self.goodput.note("restore_fallback", int(lost_tokens))
 
     def peek(self, prompt_ids) -> tuple[int | None, int]:
         """READ-ONLY longest usable registered match — no insert, no hit

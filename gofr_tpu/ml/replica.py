@@ -80,6 +80,7 @@ from .errors import (DeadlineExceeded, GeneratorCrashed, Overloaded,
                      ServerClosed)
 from ..flight_recorder import event_log
 from .generate import PrefixEvicted
+from .goodput import goodput_ledger
 from .journey import Journey, journey_log, next_rid
 from .journey import seal as seal_journey
 from .kv_offload import HostKVStore, OffloadConfig
@@ -380,7 +381,8 @@ class _FrontRequest:
     __slots__ = ("prompt", "max_new", "priority", "enqueued_at",
                  "deadline_at", "n_tokens", "future", "loop", "prefix",
                  "attempts", "cancelled", "streamed", "routed_idx",
-                 "last_replica", "want_role", "kv_holder", "rid", "journey")
+                 "last_replica", "want_role", "kv_holder", "rid", "journey",
+                 "admits_charged")
 
     def __init__(self, prompt, max_new: int, priority: int,
                  deadline_s: float, prefix: int | None) -> None:
@@ -398,6 +400,9 @@ class _FrontRequest:
         self.loop: asyncio.AbstractEventLoop | None = None  # owns future
         self.prefix = prefix          # FRONT pid (pool-level registration)
         self.attempts = 0             # completed failover reroutes
+        self.admits_charged = 0       # admit marks the goodput ledger
+        # already billed as failover_recompute (multi-hop reroutes must
+        # not re-charge a hop that only ever queued the request)
         self.cancelled = False        # consumer went away while queued
         self.streamed = False         # a token reached the consumer
         self.routed_idx: int | None = None  # replica slot reserved for us
@@ -467,6 +472,11 @@ class ReplicaPool:
         self._metrics = metrics
         self._tracer = tracer   # ml.route spans (one per routing attempt)
         self._events = event_log()  # fleet event log (flight_recorder.py)
+        # goodput ledger (ml/goodput.py): the pool classifies the fleet-
+        # level waste — failover re-prefills and migration cold starts —
+        # under the POOL name; its cores classify their own device-token
+        # fates under "name/idx". GOFR_ML_GOODPUT=0 disables both.
+        self._goodput = goodput_ledger()
         # request journeys (journey.py): the FRONT owns one timeline per
         # request; replica cores mark into it, so a rerouted or disagg
         # two-stage request stays ONE record. GOFR_ML_JOURNEY=0 disables.
@@ -1358,6 +1368,28 @@ class ReplicaPool:
                             raise
                         fr.attempts += 1
                         fr.last_replica = idx
+                        if self._goodput is not None:
+                            # the survivor re-prefills the whole prompt —
+                            # charged only when THIS hop's replica
+                            # actually ADMITTED it (its prefill is real
+                            # lost device work; a hop that merely queued
+                            # the request cost nothing — tracked by
+                            # comparing the journey's admit marks against
+                            # what was already billed). With journeys off
+                            # the admission evidence is gone: charge
+                            # every hop, the conservative
+                            # over-approximation.
+                            if fr.journey is not None:
+                                admits = fr.journey.count_mark("admit")
+                                charge = admits > fr.admits_charged
+                                fr.admits_charged = max(
+                                    fr.admits_charged, admits)
+                            else:
+                                charge = True
+                            if charge:
+                                self._goodput.note(self.name,
+                                                   "failover_recompute",
+                                                   fr.n_tokens)
                         if route_span is not None:
                             # this attempt's outcome: the request moved
                             # on (the next attempt's span carries the
@@ -1842,6 +1874,12 @@ class ReplicaPool:
             outcome = transport.migrate(src, dst, row["ids"], row["pid"],
                                         src_idx=idx, dst_idx=dst_idx)
             tally[outcome] += 1
+            if outcome == "failed" and self._goodput is not None:
+                # the pages left the draining replica and were lost on
+                # the way: the prefix cold-starts (re-prefills) on the
+                # survivor — already-paid device work, classified here
+                self._goodput.note(self.name, "migration_cold",
+                                   len(row["ids"]))
         return tally
 
     def _pick_migrate_dst(self, src_idx: int) -> int | None:

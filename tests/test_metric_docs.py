@@ -201,3 +201,54 @@ def test_every_documented_debug_route_still_exists():
         f"/debug routes documented in {DOC.relative_to(REPO)} but not "
         f"registered by gofr_tpu/: {sorted(ghosts)} — delete the stale "
         f"mentions or re-mount the route")
+
+
+# --------------------------------------------- goodput reason vocabulary
+# the goodput ledger's reason set is an operator-facing vocabulary (the
+# ``reason`` label of app_llm_tokens_wasted_total and the rows of
+# /debug/goodput): the doc's reason table and the code's WASTE_REASONS
+# tuple must agree exactly, both directions. goodput.py is stdlib-only
+# by contract, so it loads directly by path — no jax, no package init.
+def _code_goodput_reasons() -> set[str]:
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_gofr_goodput_vocab", REPO / "gofr_tpu" / "ml" / "goodput.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return {"delivered", *mod.WASTE_REASONS}
+
+
+def _doc_goodput_reasons() -> set[str]:
+    """Rows of the observability doc's goodput reason table: lines of
+    the form ``| `reason` | …`` after the ``| reason |`` header."""
+    reasons: set[str] = set()
+    in_table = False
+    for raw in DOC.read_text().splitlines():
+        line = raw.strip()
+        if re.match(r"\|\s*reason\s*\|", line):
+            in_table = True
+            continue
+        if in_table:
+            m = re.match(r"\|\s*`([a-z_]+)`\s*\|", line)
+            if m:
+                reasons.add(m.group(1))
+            elif not line.startswith("|"):
+                in_table = False
+    return reasons
+
+
+def test_every_goodput_reason_has_a_doc_row():
+    undocumented = _code_goodput_reasons() - _doc_goodput_reasons()
+    assert not undocumented, (
+        f"goodput reasons in gofr_tpu/ml/goodput.py missing from the "
+        f"{DOC.relative_to(REPO)} reason table: {sorted(undocumented)} — "
+        f"operators discover the wasted-token vocabulary there")
+
+
+def test_every_documented_goodput_reason_still_exists():
+    ghosts = _doc_goodput_reasons() - _code_goodput_reasons()
+    assert not ghosts, (
+        f"goodput reasons documented in {DOC.relative_to(REPO)} but "
+        f"absent from WASTE_REASONS: {sorted(ghosts)} — delete the stale "
+        f"rows or restore the reason")
