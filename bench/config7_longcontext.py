@@ -114,8 +114,118 @@ def _mixed_run(*, paged: bool, slots: int, n_pages: int | None,
     return out
 
 
+def _sp_probe(mode: str | None, shards: int, *, ctxs, cfg_kw: dict,
+              page_size: int, max_seq: int, buckets, min_tokens: int,
+              decode_chunks: int, chunk: int) -> dict:
+    """One sequence-parallel arm: admit a prompt per context length and
+    measure TTFT (admission wall — the prefill SP shards) and TPOT
+    (steady decode over the striped pool), plus the greedy tokens for
+    the cross-arm identity check. ``mode=None`` is the SP-off baseline
+    on the identical workload."""
+    from gofr_tpu.ml.generate import Generator
+    from gofr_tpu.ml.sp_serving import SPConfig
+    from gofr_tpu.models import llama
+
+    cfg = llama.LlamaConfig(**cfg_kw)
+    params = llama.params_from_config(cfg)
+    sp = (None if mode is None
+          else SPConfig(mode, min_tokens=min_tokens, shards=shards))
+    gen = Generator(params, cfg, batch_slots=1, max_seq=max_seq,
+                    prefill_buckets=buckets, chunk=chunk,
+                    page_size=page_size, sp=sp)
+    gen.warmup()
+    rng = np.random.default_rng(7)
+    rows = {}
+    for ctx in ctxs:
+        prompt = rng.integers(1, cfg_kw["vocab_size"], (ctx,)).astype(
+            np.int32)
+        t0 = time.perf_counter()
+        slot = gen.add_request(prompt,
+                               max_new_tokens=decode_chunks * chunk)
+        ttft_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        while gen.slots[slot].live:
+            gen.step()
+        gen.drain()
+        decode_s = time.perf_counter() - t1
+        toks = list(gen.slots[slot].tokens)
+        gen.release(slot)
+        rows[str(ctx)] = {
+            "ttft_ms": round(1e3 * ttft_s, 2),
+            "tpot_ms": round(1e3 * decode_s / max(1, len(toks)), 2),
+            "tokens": toks,
+        }
+    out = {"mode": mode or "off", "shards": shards if mode else 1,
+           "contexts": rows,
+           "sp_prefills": getattr(gen, "sp_prefills", 0),
+           "sp_fallbacks": getattr(gen, "sp_fallbacks", 0)}
+    del gen, params
+    return out
+
+
+def _sp_arm(on_tpu: bool) -> dict:
+    """BENCH_SP_ARM=1: TTFT/TPOT vs context length with SP off vs
+    ring/ulysses at a shard sweep, plus the token-identity verdict —
+    bench phase for ROADMAP item 2 (sequence-parallel serving)."""
+    import jax
+
+    from gofr_tpu.models.llama import tiny_llama
+
+    if len(jax.devices()) < 2:
+        # a custom XLA_FLAGS without the host-device-count trick (or a
+        # one-chip box): report the skip instead of crashing the config
+        return {"skipped": f"needs >= 2 devices, have "
+                           f"{len(jax.devices())}"}
+
+    if on_tpu:
+        cfg_kw = dict(vocab_size=32_128, dim=2048, n_layers=16, n_heads=16,
+                      n_kv_heads=8, ffn_dim=8192, max_seq_len=16_640)
+        ctxs, max_seq, buckets = (4096, 8192, 16384), 16_640, (16_384,)
+        page_size, min_tokens, decode_chunks, chunk = 128, 2048, 4, 16
+        arms = [("ring", 2), ("ring", 4), ("ulysses", 4)]
+    else:
+        tiny = tiny_llama(use_flash=False)
+        import jax.numpy as jnp
+
+        # f32: the bit-identity dtype (the cross-arm verdict below)
+        cfg_kw = dict(vocab_size=tiny.vocab_size, dim=tiny.dim,
+                      n_layers=tiny.n_layers, n_heads=tiny.n_heads,
+                      n_kv_heads=tiny.n_kv_heads, ffn_dim=tiny.ffn_dim,
+                      max_seq_len=128, use_flash=False, dtype=jnp.float32)
+        ctxs, max_seq, buckets = (32, 96), 128, (96,)
+        page_size, min_tokens, decode_chunks, chunk = 8, 16, 2, 4
+        arms = [("ring", 2), ("ring", 4), ("ulysses", 2)]
+    common = dict(ctxs=ctxs, cfg_kw=cfg_kw, page_size=page_size,
+                  max_seq=max_seq, buckets=buckets, min_tokens=min_tokens,
+                  decode_chunks=decode_chunks, chunk=chunk)
+    base = _sp_probe(None, 1, **common)
+    results = [base]
+    identical = True
+    for mode, shards in arms:
+        probe = _sp_probe(mode, shards, **common)
+        results.append(probe)
+        for ctx, row in probe["contexts"].items():
+            if row["tokens"] != base["contexts"][ctx]["tokens"]:
+                identical = False
+    # the measured table: tokens served their identity check — strip
+    # them so the JSON line stays readable
+    for probe in results:
+        for row in probe["contexts"].values():
+            row.pop("tokens")
+    return {"arms": results, "token_identity": identical,
+            "contexts": list(ctxs), "page_size": page_size}
+
+
 def main() -> None:
     os.environ.setdefault("LOG_LEVEL", "ERROR")
+    if os.environ.get("BENCH_SP_ARM") == "1":
+        # the SP arm shards over >= 2 devices; off-TPU that means the
+        # virtual CPU mesh (the tests/conftest.py trick). Must land
+        # before the first jax import; an operator's own XLA_FLAGS wins
+        # (setdefault) and the arm then skips gracefully below if it
+        # still sees one device.
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
     import jax
 
     on_tpu = jax.default_backend() == "tpu"
@@ -182,6 +292,12 @@ def main() -> None:
         n_pages=1 + 2 * dense_slots * (-(-max_seq // ps)),
         **{**common, "cfg_kw": dict(cfg_kw, kv_quant=True)})
 
+    # ---- sequence-parallel serving arm (BENCH_SP_ARM=1) ------------------
+    # TTFT/TPOT vs context length, SP off vs ring/ulysses at a shard
+    # sweep, with the greedy token-identity verdict (ROADMAP item 2)
+    sp_arm = (_sp_arm(on_tpu)
+              if os.environ.get("BENCH_SP_ARM") == "1" else None)
+
     emit(
         "longcontext_int8_speedup_8k", q8["tok_per_s"] / fp["tok_per_s"],
         "x", None,
@@ -203,6 +319,7 @@ def main() -> None:
                     paged_q_run["tok_per_s"] / dense_run["tok_per_s"], 3),
                 "page_size": ps,
             },
+            **({"sp_arm": sp_arm} if sp_arm is not None else {}),
             "backend": jax.default_backend(),
             "config": 7,
         },

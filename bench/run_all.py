@@ -20,7 +20,9 @@ import time
 # phase F: the tiered-KV-cache offload-on-vs-off A/B; phase G: the
 # resilience fault-vs-clean A/B; phase H: the flight-recorder stall
 # breakdown + recorder-overhead A/B; phase I: the speculation x
-# KV-precision grid; phase J: the disaggregated prefill/decode A/B)
+# KV-precision grid; phase J: the disaggregated prefill/decode A/B;
+# config7's SP arm: sequence-parallel prefill TTFT/TPOT vs context
+# length with the greedy token-identity verdict)
 CONFIGS = [
     ("config1_echo.py", {}),
     ("config2_mnist.py", {}),
@@ -32,7 +34,7 @@ CONFIGS = [
                           "BENCH_GOODPUT_ARM": "1"}),
     ("config5_sdxl.py", {}),
     ("config6_compute.py", {}),
-    ("config7_longcontext.py", {}),
+    ("config7_longcontext.py", {"BENCH_SP_ARM": "1"}),
     ("config8_speculative.py", {}),
 ]
 

@@ -87,11 +87,16 @@ PROFILE_LOCK = threading.Lock()
 # prefill replica) and ``land`` (adopting transported pages into a
 # decode replica's host tier) are the disaggregated-serving KV-transport
 # phases (ml/kv_transport.py), stamped by the serving thread of the
-# replica doing that side of the handoff. ``other`` is the honest
-# remainder: wall time of a dispatch pass no instrumented site claimed
-# (host bookkeeping loops, GC, OS scheduling).
-PHASES = ("queue_pop", "decide", "assemble", "launch", "d2h_issue",
-          "device_wait", "emit", "route", "ship", "land", "other")
+# replica doing that side of the handoff. ``sp_prefill`` is one
+# sequence-parallel prefill wave (GOFR_ML_SP, ml/sp_serving.py) — a
+# long prompt's sharded forward + KV landing, stamped by the generator
+# at admission so the attribution names the SP wave when long prompts
+# dominate a dispatch instead of lumping it into ``assemble``.
+# ``other`` is the honest remainder: wall time of a dispatch pass no
+# instrumented site claimed (host bookkeeping loops, GC, OS scheduling).
+PHASES = ("queue_pop", "decide", "assemble", "sp_prefill", "launch",
+          "d2h_issue", "device_wait", "emit", "route", "ship", "land",
+          "other")
 # phases that burn HOST time; ``device_wait`` is the one phase where the
 # host is merely blocked on device compute, so it never names a stall
 _HOST_PHASES = tuple(p for p in PHASES if p != "device_wait")

@@ -523,6 +523,13 @@ class MLDatasource:
             if getattr(server, "prefix_cache", None) is not None:
                 # prefix lengths, refcounts, hit counts + lifetime totals
                 entry["prefix_cache"] = server.prefix_cache.snapshot()
+            sp = getattr(server.gen, "sp_stats", None)
+            sp = sp() if sp is not None else None
+            if sp is not None:
+                # sequence-parallel serving (GOFR_ML_SP): mode, shard
+                # count, dual-path threshold, striping, and the
+                # prefill/fallback tally
+                entry["sp"] = sp
             spec = getattr(server.gen, "spec_stats", None)
             spec = spec() if spec is not None else None
             if spec is not None:

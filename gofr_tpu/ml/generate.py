@@ -161,7 +161,7 @@ class _Slot:
     __slots__ = ("live", "tokens", "max_new", "produced", "prompt_len",
                  "eos_hit", "evicted", "callback", "spec_windows",
                  "spec_emitted", "spec_disabled", "spec_cooldown_left",
-                 "spec_recent_w", "spec_recent_e", "hist")
+                 "spec_recent_w", "spec_recent_e", "hist", "sp_shards")
 
     def __init__(self) -> None:
         self.live = False
@@ -170,6 +170,10 @@ class _Slot:
         self.produced = 0
         self.prompt_len = 0
         self.eos_hit = False
+        # shard count of the sequence-parallel prefill that admitted
+        # this slot (0 = the single-device path) — journey marks and the
+        # sp debug block read it
+        self.sp_shards = 0
         # per-stream draft efficiency (spec mode): windows seen / tokens
         # emitted — the serving layer exports the acceptance rate
         self.spec_windows = 0
@@ -214,7 +218,7 @@ class Generator:
                  n_pages: int | None = None, draft_params: Any = None,
                  draft_cfg: Any = None, prefill_chunk: int = 0,
                  token_budget: int | None = None,
-                 host_kv: Any = None) -> None:
+                 host_kv: Any = None, sp: Any = None) -> None:
         import contextlib
 
         from ..models import llama
@@ -276,6 +280,25 @@ class Generator:
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_seq
         ) or (max_seq,)
+        # -- sequence-parallel serving plan (ml/sp_serving.py) ------------
+        # sp=None consults GOFR_ML_SP; unset/off resolves to None and
+        # constructs NO SP machinery — the single-device serving path
+        # stays byte-identical. A resolved plan may bring its own sp
+        # mesh (built over the visible devices) and is validated loudly
+        # HERE: shard bounds, bucket/max_seq divisibility, Ulysses head
+        # divisibility, and mode conflicts all reject at construction.
+        from .sp_serving import resolve as _resolve_sp
+
+        self._sp = _resolve_sp(
+            sp, cfg=cfg, mesh=mesh, prefill_buckets=self.prefill_buckets,
+            max_seq=max_seq, page_size=int(page_size), spec_k=self.spec_k,
+            shard_cache=shard_cache)
+        if self._sp is not None:
+            mesh = self._sp.mesh
+            self._mesh_ctx = lambda: mesh
+            self.sp_prefills = 0    # prompts prefilled sequence-parallel
+            self.sp_fallbacks = 0   # SP failures served single-device
+            self.sp_tokens = 0      # prompt tokens through the SP path
         self.mesh = mesh
         self._repl = None  # replicated sharding for host-visible outputs
         self.page_size = int(page_size)
@@ -310,10 +333,12 @@ class Generator:
             # (config7). n_pages defaults to the dense-equivalent so the
             # operator dials capacity down explicitly.
             if shard_cache or (
-                    mesh is not None
+                    self._sp is None and mesh is not None
                     and getattr(cfg, "sequence_parallel", False)):
                 raise ValueError(
-                    "page_size doesn't compose with shard_cache/sp yet")
+                    "page_size composes with sequence parallelism only "
+                    "through the serving plan (GOFR_ML_SP / sp=) — not "
+                    "shard_cache or a bare cfg.attn_impl mesh")
             for b in (*self.prefill_buckets, max_seq):
                 # max_seq included: it is the prefill-bucket fallback, and
                 # a non-multiple would silently drop trailing prompt rows
@@ -323,6 +348,11 @@ class Generator:
                         f"page_size")
             self._p_max = -(-max_seq // self.page_size)
             self.n_pages = n_pages or (1 + batch_slots * self._p_max)
+            if self._sp is not None and self.n_pages % self._sp.shards:
+                # striped pool: each device owns n_pages/shards pages —
+                # round UP so the operator's capacity ask stays a floor
+                self.n_pages += self._sp.shards - (
+                    self.n_pages % self._sp.shards)
             self._shard_cache = False
             self._reset_cache_storage()
             # shared-prefix bookkeeping: per-slot count of BORROWED pages
@@ -429,6 +459,14 @@ class Generator:
 
         sampler_cfg = self.sampler
         host_visible = self._host_visible
+        # decode programs under a dense SP plan trace with the sp config
+        # clone (attn_impl set) so _decode_layer picks sp_decode_attention
+        # over the S-sharded cache; the striped-pool plan routes through
+        # sp_paged_decode_step below instead
+        sp_plan = self._sp
+        decode_cfg = (sp_plan.sp_cfg
+                      if (sp_plan is not None and not self.page_size)
+                      else cfg)
 
         def make_chunk_fn(n_chunk: int):
             def chunk_fn(params, tok, cache, step0, base_key):
@@ -443,8 +481,8 @@ class Generator:
 
                 def body(carry, j):
                     tok, cache = carry
-                    logits, cache = llama.decode_step(params, tok, cache, cfg,
-                                                      mesh=mesh)
+                    logits, cache = llama.decode_step(params, tok, cache,
+                                                      decode_cfg, mesh=mesh)
                     key = jax.random.fold_in(base_key, step0 + j)
                     nxt = _sample_impl(logits, key, sampler_cfg)
                     return (nxt, cache), nxt
@@ -462,8 +500,14 @@ class Generator:
 
                 def body(carry, j):
                     tok, cache = carry
-                    logits, cache = llama.paged_decode_step(
-                        params, tok, cache, table, cfg)
+                    if sp_plan is not None:
+                        # striped pool: cross-device page gather via the
+                        # sp_decode_attention combine (models/llama.py)
+                        logits, cache = llama.sp_paged_decode_step(
+                            params, tok, cache, table, cfg, mesh)
+                    else:
+                        logits, cache = llama.paged_decode_step(
+                            params, tok, cache, table, cfg)
                     key = jax.random.fold_in(base_key, step0 + j)
                     nxt = _sample_impl(logits, key, sampler_cfg)
                     return (nxt, cache), nxt
@@ -566,6 +610,33 @@ class Generator:
                                                         mesh=mesh),
             donate_argnums=(3,),
         )
+        if self._sp is not None:
+            # the sequence-parallel prefill family: same landing scatter
+            # as the single-device programs, the forward traced with the
+            # sp config clone so attention shards the prompt over the
+            # mesh (ring/ulysses). Prompts under min_tokens never touch
+            # these — the dual-path threshold routes them to the plain
+            # programs above.
+            sp_cfg = self._sp.sp_cfg
+            if self.page_size:
+                ps = self.page_size
+
+                def make_sp_paged(set_len: bool):
+                    def f(p, t, l, c, row, slot):
+                        return llama.paged_prefill_into(
+                            p, t, l, sp_cfg, c, row, slot, ps, mesh=mesh,
+                            set_len=set_len)
+                    return jax.jit(f, donate_argnums=(3,))
+
+                self._sp_prefill_paged = make_sp_paged(True)
+                # prefix builds (register_prefix / the disagg ship path)
+                # fill pages without admitting a slot
+                self._sp_prefix_paged = make_sp_paged(False)
+            else:
+                self._sp_prefill_into = jax.jit(
+                    lambda p, t, l, c, slot: llama.prefill_into(
+                        p, t, l, sp_cfg, c, slot, mesh=mesh),
+                    donate_argnums=(3,))
         if self.prefill_chunk:
             if self.page_size:
                 ps = self.page_size
@@ -611,8 +682,10 @@ class Generator:
         # _admit_cap (bursts). Waves of 2..cap-1 pad to cap with masked
         # rows — a little extra MXU work instead of a fresh compile.
         # Paged mode admits per-request (each prefill scatters into its
-        # own page set).
-        self._admit_cap = 1 if self.page_size else min(8, batch_slots)
+        # own page set); SP mode does too — the dual-path threshold is
+        # per-prompt, and one sequence-parallel wave serves one prompt.
+        self._admit_cap = (1 if (self.page_size or self._sp is not None)
+                           else min(8, batch_slots))
 
         # -- speculative decoding (device-resident prompt lookup) ----------
         # (self.spec_k was parsed and validated at the top of __init__)
@@ -928,6 +1001,36 @@ class Generator:
                 np.uint32(self._n_requests), slots, valid)
 
     # -- paged-pool bookkeeping (page_size > 0) ------------------------------
+    def _pop_free_page(self) -> int | None:
+        """One page off the free pool, or None when dry. Striped (SP)
+        mode round-robins across the per-device stacks so a slot's
+        consecutive virtual pages land on different shards — the page
+        striping that spreads one long context across every HBM."""
+        if self._free_dev is None:
+            return self._free_pages.pop() if self._free_pages else None
+        n = len(self._free_dev)
+        for i in range(n):
+            d = (self._stripe_rr + i) % n
+            if self._free_dev[d]:
+                self._stripe_rr = (d + 1) % n
+                return self._free_dev[d].pop()
+        return None
+
+    def _return_pages(self, pages) -> None:
+        """Give pages back to the pool (their owning device's stack in
+        striped mode — a page's shard is fixed by its id)."""
+        if self._free_dev is None:
+            self._free_pages.extend(pages)
+            return
+        p_loc = self.n_pages // len(self._free_dev)
+        for pg in pages:
+            self._free_dev[pg // p_loc].append(pg)
+
+    def _n_free_pages(self) -> int:
+        if self._free_dev is None:
+            return len(self._free_pages)
+        return sum(len(stack) for stack in self._free_dev)
+
     def _alloc_pages_to(self, slot: int, upto_len: int) -> bool:
         """Grow the slot's page list to cover ``upto_len`` virtual
         positions (in order — virtual offsets stay contiguous). False when
@@ -935,9 +1038,9 @@ class Generator:
         need = min(-(-upto_len // self.page_size), self._p_max)
         pages = self._slot_pages[slot]
         while len(pages) < need:
-            if not self._free_pages:
+            pg = self._pop_free_page()
+            if pg is None:
                 return False
-            pg = self._free_pages.pop()
             pages.append(pg)
             self._table[slot, len(pages) - 1] = pg
             self._table_dirty = True
@@ -954,7 +1057,7 @@ class Generator:
 
     def _free_slot_pages(self, slot: int) -> None:
         shared = self._slot_shared[slot] if self.page_size else 0
-        self._free_pages.extend(self._slot_pages[slot][shared:])
+        self._return_pages(self._slot_pages[slot][shared:])
         if shared:
             pid = self._slot_prefix[slot]
             if pid in self._prefixes:
@@ -1005,7 +1108,7 @@ class Generator:
 
     @property
     def free_pages(self) -> int:
-        return len(self._free_pages) if self.page_size else 0
+        return self._n_free_pages() if self.page_size else 0
 
     def pool_stats(self) -> dict:
         """KV/slot occupancy snapshot for gauges and /debug/serving — the
@@ -1071,20 +1174,19 @@ class Generator:
         ps = self.page_size
         shared_len = (len(ids) // ps) * ps
         n_need = shared_len // ps
-        if len(self._free_pages) < n_need:
+        if self._n_free_pages() < n_need:
             # drop idle (refs == 0) prefixes LRU-first before giving up —
             # a rotating set of system prompts must not brick registration
             self._reclaim_prefix_pages(n_need)
-        if len(self._free_pages) < n_need:
+        if self._n_free_pages() < n_need:
             raise PagePoolExhausted(
                 f"prefix needs {n_need} pages, {self.free_pages} free")
-        pages = [self._free_pages.pop() for _ in range(n_need)]
+        pages = [self._pop_free_page() for _ in range(n_need)]
         if shared_len:
             bucket = next((b for b in self.prefill_buckets
                            if shared_len <= b), None)
             if bucket is None and not self.prefill_chunk:
-                for pg in pages:
-                    self._free_pages.append(pg)
+                self._return_pages(pages)
                 raise ValueError(
                     f"prefix length {shared_len} exceeds the largest "
                     f"prefill bucket {self.prefill_buckets[-1]} (set "
@@ -1097,18 +1199,35 @@ class Generator:
             # chunked-prefill ladder applied to registration, so a
             # disaggregated prefill replica can compute KV for prompts
             # no single prefill program covers
-            seg_cap = bucket if bucket is not None \
-                else self.prefill_buckets[-1]
-            with self._mesh_ctx():
-                for off in range(0, shared_len, seg_cap):
-                    seg = ids[off:min(off + seg_cap, shared_len)]
-                    toks = np.zeros((1, seg_cap), np.int32)
-                    toks[0, :len(seg)] = seg
-                    _logits, self.cache = self._prefix_prefill(
-                        self.params, toks,
-                        np.array([len(seg)], np.int32),
-                        self.cache, row, np.int32(off), np.int32(0),
-                    )
+            sp_built = False
+            if (self._sp is not None and bucket is not None
+                    and shared_len >= self._sp.min_tokens):
+                # sequence-parallel prefix build: the whole prefix in ONE
+                # wave sharded over the mesh — this is what turns a
+                # prefill-biased disagg replica into an SP prefill
+                # worker (its register→spill→ship path starts here). A
+                # recoverable failure falls through to the single-device
+                # segment ladder below, which rewrites every position —
+                # bit-identical, like the admission-path fallback.
+                toks_sp = np.zeros((1, bucket), np.int32)
+                toks_sp[0, :shared_len] = ids[:shared_len]
+                lens_sp = np.array([shared_len], np.int32)
+                with self._mesh_ctx():
+                    sp_built = self._run_sp_prefill(
+                        toks_sp, lens_sp, row, 0, prefix=True) is not None
+            if not sp_built:
+                seg_cap = bucket if bucket is not None \
+                    else self.prefill_buckets[-1]
+                with self._mesh_ctx():
+                    for off in range(0, shared_len, seg_cap):
+                        seg = ids[off:min(off + seg_cap, shared_len)]
+                        toks = np.zeros((1, seg_cap), np.int32)
+                        toks[0, :len(seg)] = seg
+                        _logits, self.cache = self._prefix_prefill(
+                            self.params, toks,
+                            np.array([len(seg)], np.int32),
+                            self.cache, row, np.int32(off), np.int32(0),
+                        )
             # the compute a restore avoids: re-registrations after a
             # discard land here, restores land in kv_restores instead
             self.prefix_prefills += 1
@@ -1141,7 +1260,7 @@ class Generator:
         forever). Borrowed prefixes (refs > 0) are never candidates —
         which also means a borrowed prefix can never be mid-spill: only
         fully idle page sets ever reach the host tier."""
-        while len(self._free_pages) < n_need:
+        while self._n_free_pages() < n_need:
             idle = [(info.get("pinned", False), info["last_use"], pid)
                     for pid, info in self._prefixes.items()
                     if info["refs"] == 0]
@@ -1152,7 +1271,7 @@ class Generator:
             # spill before freeing: the gather snapshots the pages into a
             # fresh device buffer, so reusing them right after is safe
             self._spill_prefix(info)
-            self._free_pages.extend(info["pages"])
+            self._return_pages(info["pages"])
             self.prefix_evictions += 1
         return True
 
@@ -1237,9 +1356,9 @@ class Generator:
             raise KeyError(f"prefix {key[:8]}... not in the host tier")
         arrays, meta = popped
         n_need = meta["len"] // self.page_size
-        if len(self._free_pages) < n_need:
+        if self._n_free_pages() < n_need:
             self._reclaim_prefix_pages(n_need)
-        if len(self._free_pages) < n_need:
+        if self._n_free_pages() < n_need:
             self.host_kv.put_back(key, arrays, meta)
             self.kv_restore_fallbacks += 1
             # goodput: the CALLER classifies the restore_fallback — only
@@ -1247,7 +1366,7 @@ class Generator:
             # match still covers (prefix_cache.observe's floor)
             raise PagePoolExhausted(
                 f"restore needs {n_need} pages, {self.free_pages} free")
-        pages = [self._free_pages.pop() for _ in range(n_need)]
+        pages = [self._pop_free_page() for _ in range(n_need)]
         if n_need:
             dev_slabs = jax.device_put(arrays)  # one batched async H2D
             with self._mesh_ctx():
@@ -1288,7 +1407,7 @@ class Generator:
         if info["refs"] > 0:
             raise RuntimeError(f"prefix {pid} still used by {info['refs']} slots")
         spilled = self._spill_prefix(info) if spill else False
-        self._free_pages.extend(info["pages"])
+        self._return_pages(info["pages"])
         del self._prefixes[pid]
         return spilled
 
@@ -1443,8 +1562,38 @@ class Generator:
         if self.page_size:
             self.cache = llama.init_paged_cache(
                 cfg, self.batch_slots, self.n_pages, self.page_size)
-            # page 0 is scratch; the free list is a stack of real pages
-            self._free_pages = list(range(self.n_pages - 1, 0, -1))
+            if self._sp is not None:
+                # stripe the POOL across the sp mesh: the page axis
+                # shards so device d owns pages [d*P_loc, (d+1)*P_loc) —
+                # a single request's KV spans every device's HBM, and
+                # sp_paged_decode_step combines the shards exactly
+                from ..parallel import NamedSharding
+                from ..parallel import P as _P
+
+                spec5 = _P(None, "sp", None, None, None)
+                spec4 = _P(None, "sp", None, None)
+                self.cache = {
+                    key: (arr if key == "len" else jax.device_put(
+                        arr, NamedSharding(
+                            self.mesh, spec5 if arr.ndim == 5 else spec4)))
+                    for key, arr in self.cache.items()
+                }
+            # page 0 is scratch; the free list is a stack of real pages.
+            # Striped mode keeps ONE STACK PER DEVICE and the allocator
+            # round-robins across them (_pop_free_page), so a slot's
+            # consecutive virtual pages land on different shards — the
+            # striping that spreads one long context over every HBM.
+            if self._sp is not None:
+                p_loc = self.n_pages // self._sp.shards
+                self._free_dev = [
+                    [pg for pg in range(self.n_pages - 1, 0, -1)
+                     if pg // p_loc == d]
+                    for d in range(self._sp.shards)]
+                self._stripe_rr = 0
+                self._free_pages: list[int] | None = None
+            else:
+                self._free_pages = list(range(self.n_pages - 1, 0, -1))
+                self._free_dev = None
             self._slot_pages: list[list[int]] = [
                 [] for _ in range(self.batch_slots)]
             self._table = np.zeros((self.batch_slots, self._p_max), np.int32)
@@ -1468,7 +1617,9 @@ class Generator:
                 },
             )()
             return
-        if self.mesh is not None and getattr(cfg, "sequence_parallel", False):
+        if self.mesh is not None and (
+                self._sp is not None
+                or getattr(cfg, "sequence_parallel", False)):
             # long-context serving: KV cache sequence axis sharded over sp,
             # decode attention combines shards via pmax/psum (ring.py)
             from ..parallel import NamedSharding
@@ -1512,7 +1663,7 @@ class Generator:
         for pid in [p for p, info in self._prefixes.items()
                     if info["refs"] > 0]:
             info = self._prefixes.pop(pid)
-            self._free_pages.extend(info["pages"])
+            self._return_pages(info["pages"])
             invalidated.append(pid)
         return invalidated
 
@@ -1551,7 +1702,7 @@ class Generator:
             for pid in borrowed:
                 info = self._prefixes.pop(pid, None)
                 if info is not None:
-                    self._free_pages.extend(info["pages"])
+                    self._return_pages(info["pages"])
                     invalidated.append(pid)
         leaves = jax.tree_util.tree_leaves(self.cache)
         if any(getattr(leaf, "is_deleted", lambda: False)()
@@ -1741,12 +1892,129 @@ class Generator:
                             "wave": (self._admit_cap
                                      if self._admit_cap > 1 else None)},
                     fn=fn, abstract=abstract)
+            if self._sp is not None:
+                # the SP prefill program for every bucket the dual-path
+                # threshold can route to: a cold first long prompt must
+                # not pay the compile the plain buckets already avoid
+                for bucket in self.prefill_buckets:
+                    if bucket < self._sp.min_tokens:
+                        continue
+                    padded = np.zeros((1, bucket), np.int32)
+                    ones = np.array([1], np.int32)
+                    if self.page_size:
+                        fn = self._sp_prefill_paged
+                        args = (self.params, padded, ones, self.cache,
+                                np.zeros((bucket // self.page_size,),
+                                         np.int32), np.int32(0))
+                    else:
+                        fn = self._sp_prefill_into
+                        args = (self.params, padded, ones, self.cache,
+                                np.int32(0))
+                    abstract = abstractify(args)
+                    t0 = time.perf_counter()
+                    with watch_compiles() as acc:
+                        logits, self.cache = fn(*args)
+                        self._after_prefill(logits, padded, ones,
+                                            np.int32(0))
+                    self.programs.record(
+                        f"sp_prefill/b{bucket}",
+                        wall_s=time.perf_counter() - t0, acc=acc,
+                        shapes={"tokens": [1, bucket],
+                                "shards": self._sp.shards},
+                        fn=fn, abstract=abstract)
         # a REAL device->host fetch, not block_until_ready: through remote
         # transports the latter returns before queued work has drained, and
         # the first live request's token fetch would then absorb the entire
         # warmup queue (~1.5 s measured) — exactly the TTFT hit warmup exists
         # to prevent.
         np.asarray(self._tok_dev)
+
+    # -- sequence-parallel prefill (ml/sp_serving.py plan) -------------------
+    def _sp_eligible(self, n: int) -> bool:
+        """Does a prompt of ``n`` tokens take the sequence-parallel
+        prefill path? The dual-path threshold: below min_tokens the
+        existing single-device program runs, byte-identically."""
+        return (self._sp is not None and n >= self._sp.min_tokens
+                and n <= self.prefill_buckets[-1])
+
+    def _routes_chunked(self, n: int) -> bool:
+        """Does a prompt of ``n`` tokens take the SEGMENTED prefill
+        path? SP-eligible prompts that fit a bucket prefill WHOLE
+        instead — one sequence-parallel wave beats prefill_chunk-sized
+        single-device segments."""
+        if not self.prefill_chunk or n <= self.prefill_chunk:
+            return False
+        return not self._sp_eligible(n)
+
+    def _run_sp_prefill(self, tokens, lens, row, slot, *,
+                        prefix: bool = False):
+        """One sequence-parallel prefill wave — a slot admission, or
+        (``prefix=True``) a register_prefix page build. The prompt's
+        forward shards over the sp mesh (ring/Ulysses) and its KV lands
+        sharded — striped pages (paged mode) or the S-sharded dense
+        row. Returns last-token logits, or None after a RECOVERABLE
+        failure (the ``sp_prefill``/``sp_gather`` fault points, or an
+        error that left the donated cache intact): the caller then runs
+        the single-device prefill program over the same rows/pages,
+        which overwrites them fully — the fallback is bit-identical to
+        never having tried SP. An error that CONSUMED the donated cache
+        mid-execution (e.g. OOM on a real chip) re-raises instead:
+        there is nothing valid left to fall back onto, and the serving
+        watchdog's rebuild is the existing contract for a destroyed
+        prefill dispatch. Charged to the token-budget scheduler at
+        tokens/shards: each shard sweeps only its slice of the prompt.
+        Callers hold the mesh context."""
+        sp = self._sp
+        rec = self.recorder
+        t0 = time.perf_counter()
+        try:
+            if self.fault is not None:
+                self.fault("sp_prefill")
+            if prefix:
+                logits, self.cache = self._sp_prefix_paged(
+                    self.params, tokens, lens, self.cache, row,
+                    np.int32(slot))
+            elif self.page_size:
+                logits, self.cache = self._sp_prefill_paged(
+                    self.params, tokens, lens, self.cache, row,
+                    np.int32(slot))
+            else:
+                logits, self.cache = self._sp_prefill_into(
+                    self.params, tokens, lens, self.cache, np.int32(slot))
+            if self.fault is not None:
+                self.fault("sp_gather")
+        except Exception as exc:
+            if any(getattr(leaf, "is_deleted", lambda: False)()
+                   for leaf in jax.tree_util.tree_leaves(self.cache)):
+                raise  # donated cache consumed: watchdog territory
+            self.sp_fallbacks += 1
+            _log.warning(
+                "sp prefill fell back to single-device (%s: %s)",
+                type(exc).__name__, exc)
+            return None
+        self.sp_prefills += 1
+        self.sp_tokens += int(lens[0])
+        if self.scheduler is not None:
+            self.scheduler.charge_sp(-(-int(lens[0]) // sp.shards))
+        if rec is not None:
+            # its own phase label: an SP wave is neither a plain
+            # assemble nor a decode launch, and the stall attribution
+            # must name it when long prompts dominate a dispatch
+            rec.note("sp_prefill", time.perf_counter() - t0)
+        return logits
+
+    def sp_stats(self) -> dict | None:
+        """Sequence-parallel serving block for /debug/serving — None
+        when GOFR_ML_SP is off (no SP machinery exists then)."""
+        if self._sp is None:
+            return None
+        return {
+            **self._sp.snapshot(),
+            "striped_pages": bool(self.page_size),
+            "prefills": self.sp_prefills,
+            "fallbacks": self.sp_fallbacks,
+            "tokens": self.sp_tokens,
+        }
 
     # -- request management ---------------------------------------------------
     def free_slot(self) -> int | None:
@@ -1790,7 +2058,7 @@ class Generator:
             if n == 0 or n >= self.max_seq:
                 raise ValueError(
                     f"prompt length {n} out of range (1..{self.max_seq - 1})")
-            if self.prefill_chunk and n > self.prefill_chunk:
+            if self._routes_chunked(n):
                 chunked.append((ids, n, max_new, callback))
             else:
                 prepped.append((ids, n, max_new, callback))
@@ -1814,9 +2082,10 @@ class Generator:
                 raise
             # preserve the caller's request order in the returned slots
             it_c, it_p = iter(slots_c), iter(slots_p)
-            return [next(it_c) if (self.prefill_chunk
-                                   and len(np.asarray(r[0]).reshape(-1))
-                                   > self.prefill_chunk) else next(it_p)
+            return [next(it_c)
+                    if self._routes_chunked(
+                        len(np.asarray(r[0]).reshape(-1)))
+                    else next(it_p)
                     for r in requests]
 
         out: list[int] = []
@@ -2047,6 +2316,7 @@ class Generator:
             self.fault("prefill")
         for start in range(0, len(prepped), self._admit_cap):
             wave = prepped[start:start + self._admit_cap]
+            sp_used = False  # this wave prefilled sequence-parallel
             slots = []
             for _ in wave:
                 i = self.free_slot()
@@ -2105,17 +2375,29 @@ class Generator:
                         pages = self._slot_pages[slots[0]]
                         row[:min(len(pages), len(row))] = \
                             pages[:len(row)]
-                        logits, self.cache = self._prefill_paged(
-                            self.params, tokens, lens, self.cache, row,
-                            np.int32(slots[0]),
-                        )
+                        logits = None
+                        if self._sp_eligible(int(lens[0])):
+                            logits = self._run_sp_prefill(
+                                tokens, lens, row, slots[0])
+                            sp_used = logits is not None
+                        if logits is None:
+                            logits, self.cache = self._prefill_paged(
+                                self.params, tokens, lens, self.cache,
+                                row, np.int32(slots[0]),
+                            )
                         self._after_prefill(logits, tokens, lens,
                                             np.int32(slots[0]))
                     elif b == 1:
-                        logits, self.cache = self._prefill_into(
-                            self.params, tokens, lens, self.cache,
-                            np.int32(slots[0]),
-                        )
+                        logits = None
+                        if self._sp_eligible(int(lens[0])):
+                            logits = self._run_sp_prefill(
+                                tokens, lens, None, slots[0])
+                            sp_used = logits is not None
+                        if logits is None:
+                            logits, self.cache = self._prefill_into(
+                                self.params, tokens, lens, self.cache,
+                                np.int32(slots[0]),
+                            )
                         self._after_prefill(logits, tokens, lens,
                                             np.int32(slots[0]))
                     else:
@@ -2143,6 +2425,11 @@ class Generator:
                 s.prompt_len = n
                 s.eos_hit = False
                 s.callback = callback
+                if sp_used:
+                    # journey marks and the sp debug block read the shard
+                    # count off the slot — admission is the one moment
+                    # the SP-vs-plain decision is known
+                    s.sp_shards = self._sp.shards
                 if self._plain_armed:
                     s.hist = [int(t) for t in _ids]
                 self.slots[slot] = s

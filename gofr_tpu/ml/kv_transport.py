@@ -81,7 +81,8 @@ import numpy as np
 from ..flight_recorder import event_log
 from ..tracing import format_traceparent, parse_traceparent
 
-__all__ = ["KVTransport", "encode_entry", "decode_entry"]
+__all__ = ["KVTransport", "encode_entry", "decode_entry",
+           "encode_entry_shards"]
 
 # reserved meta key carrying the W3C traceparent across the wire: it
 # rides the entry's JSON header (the one structured field both hosts
@@ -91,6 +92,14 @@ _TRACE_KEY = "_traceparent"
 # event) so the receiving host's land_bytes closes the migration ledger
 # there: sender ships == receiver adoptions + failures, fleet-wide
 _MIGRATE_KEY = "_migration"
+# reserved meta key marking one SHARD of a sequence-parallel ship:
+# ``[shard_idx, n_shards]``. A sequence-parallel prefill worker's KV is
+# page-striped across its devices, so the wire moves it as n_shards
+# page-sliced frames (each settles as its device's D2H finishes —
+# pipelining instead of one monolithic blob); the receiving host
+# reassembles the page axis in shard order before landing
+# (_pending_shards), so the store only ever sees whole entries.
+_SHARD_KEY = "_sp_shard"
 
 
 # -- wire codec (cross-host: rides multihost.send_bytes) ----------------------
@@ -127,6 +136,29 @@ def _dtype_by_name(name: str) -> np.dtype:
         import ml_dtypes
 
         return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_entry_shards(key, arrays: dict, meta: dict,
+                        n_shards: int) -> list[bytes]:
+    """Pack one host-tier entry as ``n_shards`` page-sliced frames — the
+    per-shard wire format of a sequence-parallel ship. Every slab plane
+    is page-major ([L, n_pages, ...]), so slicing axis 1 into contiguous
+    ranges cuts the entry exactly along the prefill worker's device
+    striping; frame ``i`` carries ``meta[_SHARD_KEY] = [i, n]``. With
+    ``n_shards <= 1`` (or fewer pages than shards) this degrades to the
+    single ``encode_entry`` frame."""
+    n_pg = min((a.shape[1] for a in arrays.values()), default=0)
+    n = max(1, int(n_shards))
+    if n <= 1 or n_pg < n:
+        return [encode_entry(key, arrays, meta)]
+    bounds = [round(i * n_pg / n) for i in range(n + 1)]
+    frames = []
+    for i in range(n):
+        lo, hi = bounds[i], bounds[i + 1]
+        frames.append(encode_entry(
+            key, {name: a[:, lo:hi] for name, a in arrays.items()},
+            {**meta, _SHARD_KEY: [i, n]}))
+    return frames
 
 
 def decode_entry(raw: bytes) -> tuple[tuple, dict, dict]:
@@ -176,6 +208,17 @@ class KVTransport:
         # "skipped": the survivor cold-starts that prefix, honestly.
         self.migrations = {"ships": 0, "adoptions": 0, "failures": 0,
                            "skipped": 0, "bytes": 0}
+        # sequence-parallel per-shard reassembly (land_bytes): frames of
+        # one sharded ship accumulate here, keyed by the prefix key,
+        # until every shard arrived — only whole entries ever land.
+        # BOUNDED: a sender dying mid-ship would otherwise pin its
+        # partial frames (full numpy copies) forever; past the cap the
+        # oldest incomplete set is dropped (counted, and the receiver
+        # full-prefills that prefix like any other lost handoff)
+        self._pending_shards: dict = {}
+        self._pending_cap = 8
+        self.sp_shard_frames = 0   # per-shard frames sent + received
+        self.sp_shards_dropped = 0  # incomplete sets evicted at the cap
 
     def _span(self, name: str, parent, **attrs):
         """One transport-hop span (None without a tracer). ``activate``
@@ -209,7 +252,7 @@ class KVTransport:
     # -- in-process handoff (the replica pool's path) ------------------------
     def ship(self, src: Any, dst: Any, prefix_ids,
              timeout_s: float = 120.0, *, journey=None, rid=None,
-             parent=None) -> tuple | None:
+             parent=None, shards: int = 0) -> tuple | None:
         """Compute ``prefix_ids``'s KV on the ``src`` serving core
         (prefill replica), spill it through the host tier, and land the
         settled pages in ``dst``'s host tier + radix trie (decode
@@ -237,10 +280,16 @@ class KVTransport:
             self.bytes_moved += nbytes
         self._count("app_ml_kv_transport_ships_total", 1)
         self._count("app_ml_kv_transport_bytes", nbytes)
+        # ``shards``: the source was a sequence-parallel prefill worker —
+        # the pages left its devices as that many stripes (in-process the
+        # handoff stays one zero-copy reference; the wire path moves real
+        # per-shard frames via ship_bytes_sharded)
+        sp_extra = {"sp_shards": shards} if shards else {}
         self._events.emit("kv_ship", model=self.name, tokens=len(key),
-                          bytes=nbytes, **self._rid_extra(rid, span, parent))
+                          bytes=nbytes, **sp_extra,
+                          **self._rid_extra(rid, span, parent))
         if journey is not None:
-            journey.mark("ship", bytes=nbytes, tokens=len(key))
+            journey.mark("ship", bytes=nbytes, tokens=len(key), **sp_extra)
         if span is not None:
             span.set_attributes({"ml.bytes": nbytes, "ml.tokens": len(key)})
         self._end(span)
@@ -426,6 +475,51 @@ class KVTransport:
         self._end(span)
         return raw
 
+    def ship_bytes_sharded(self, src: Any, prefix_ids, shards: int,
+                           timeout_s: float = 120.0, *, journey=None,
+                           rid=None, parent=None) -> list[bytes] | None:
+        """Cross-host sender half of a SEQUENCE-PARALLEL ship: export
+        once, encode as ``shards`` page-sliced frames (each one device's
+        stripe of the prefill worker's pool). Send every frame through
+        ``multihost.send_bytes``; the receiving host feeds each to
+        ``land_bytes``, which reassembles and lands the whole entry when
+        the last shard arrives. One ship in the counters regardless of
+        the frame count (the shard frames have their own tally)."""
+        span = self._span("ml.kv_ship", parent, **(
+            {"ml.rid": rid} if rid is not None else {}))
+        try:
+            entry = src.export_prefix_kv(prefix_ids, timeout_s)
+        except Exception:
+            entry = None
+        if entry is None:
+            with self._lock:
+                self.failures += 1
+            self._end(span, "export failed")
+            return None
+        key, arrays, meta = entry
+        ctx = span.context if span is not None else parent
+        if ctx is not None:
+            meta = {**meta, _TRACE_KEY: format_traceparent(ctx)}
+        frames = encode_entry_shards(key, arrays, meta, shards)
+        total = sum(len(f) for f in frames)
+        with self._lock:
+            self.ships += 1
+            self.bytes_moved += total
+            self.sp_shard_frames += len(frames)
+        self._count("app_ml_kv_transport_ships_total", 1)
+        self._count("app_ml_kv_transport_bytes", total)
+        self._events.emit("kv_ship", model=self.name, tokens=len(key),
+                          bytes=total, sp_shards=len(frames),
+                          **self._rid_extra(rid, span, parent))
+        if journey is not None:
+            journey.mark("ship", bytes=total, tokens=len(key),
+                         sp_shards=len(frames))
+        if span is not None:
+            span.set_attributes({"ml.bytes": total, "ml.tokens": len(key),
+                                 "ml.sp_shards": len(frames)})
+        self._end(span)
+        return frames
+
     def land_bytes(self, dst: Any, raw: bytes,
                    timeout_s: float = 30.0, *, journey=None,
                    rid=None) -> tuple | None:
@@ -456,6 +550,46 @@ class KVTransport:
                     self.migrations["failures"] += 1
                 self._count_outcome("failed")
             return None
+        shard = meta.pop(_SHARD_KEY, None)
+        if shard is not None:
+            # one stripe of a sequence-parallel ship: park it until the
+            # set completes, then land the reassembled entry whole. A
+            # shard set that never completes (sender died mid-ship) just
+            # ages here — the receiver falls back to full prefill like
+            # every other lost handoff, and a fresh ship of the same key
+            # restarts the set (idx collisions overwrite, harmlessly).
+            idx, total = int(shard[0]), int(shard[1])
+            with self._lock:
+                self.sp_shard_frames += 1
+                pend = self._pending_shards.setdefault(
+                    key, {"total": total, "parts": {}, "meta": None})
+                if pend["total"] != total:  # a restarted set wins
+                    pend = {"total": total, "parts": {}, "meta": None}
+                    self._pending_shards[key] = pend
+                pend["parts"][idx] = arrays
+                if idx == 0 or pend["meta"] is None:
+                    pend["meta"] = dict(meta)
+                # LRU by PROGRESS, not first arrival: re-inserting moves
+                # the key to the end of the dict, so the eviction below
+                # always hits the set that has gone longest without a
+                # frame — a live, actively-filling set under >cap
+                # concurrent sharded ships is never the victim
+                self._pending_shards[key] = self._pending_shards.pop(key)
+                if len(pend["parts"]) < total:
+                    while len(self._pending_shards) > self._pending_cap:
+                        oldest = next(k for k in self._pending_shards
+                                      if k != key)
+                        del self._pending_shards[oldest]
+                        self.sp_shards_dropped += 1
+                    return None  # waiting on the rest of the set
+                del self._pending_shards[key]
+            parts = pend["parts"]
+            arrays = {
+                name: np.concatenate(
+                    [parts[i][name] for i in range(total)], axis=1)
+                for name in parts[0]
+            }
+            meta = pend["meta"]
         parent = parse_traceparent(meta.pop(_TRACE_KEY, None))
         migration = bool(meta.pop(_MIGRATE_KEY, False))
         landed = self._land(dst, key, arrays, meta, timeout_s,
@@ -482,6 +616,9 @@ class KVTransport:
                 "failures": self.failures,
                 "bytes_moved": self.bytes_moved,
                 "migrations": dict(self.migrations),
+                "sp_shard_frames": self.sp_shard_frames,
+                "sp_shards_pending": len(self._pending_shards),
+                "sp_shards_dropped": self.sp_shards_dropped,
             }
 
     def _count(self, name: str, value: int) -> None:

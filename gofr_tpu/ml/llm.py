@@ -215,6 +215,10 @@ class LLMServer:
         self._kv_spills_seen = 0
         self._kv_restores_seen = 0
         self._spec_disables_seen = 0
+        # sequence-parallel serving watermarks (GOFR_ML_SP): prefill and
+        # fallback counters publish as deltas like the offload pair
+        self._sp_prefills_seen = 0
+        self._sp_fallbacks_seen = 0
         self._active: dict[int, _Request] = {}
         self._closed = False
         self.served = 0
@@ -1160,6 +1164,12 @@ class LLMServer:
                     sched = getattr(self.gen, "scheduler", None)
                     if sched is not None and sched.restore_debt:
                         extra["restore_debt"] = sched.restore_debt
+                    sp_shards = getattr(self.gen.slots[slot],
+                                        "sp_shards", 0)
+                    if sp_shards:
+                        # this prompt prefilled sequence-parallel: the
+                        # waterfall names the shard count that carried it
+                        extra["sp_shards"] = sp_shards
                     req.journey.mark("admit", **extra)
                 if req.full_prompt is not None and self.prefix_cache is not None:
                     # the hit is real only now: the slot borrowed the
@@ -1353,6 +1363,26 @@ class LLMServer:
                 self._metrics.set_gauge("app_llm_prefill_share",
                                         float(sched.prefill_share),
                                         model=self.name)
+            sp = getattr(self.gen, "sp_stats", None)
+            sp = sp() if sp is not None else None
+            if sp is not None:
+                # sequence-parallel serving: the shard-count gauge plus
+                # prefill/fallback counter deltas (watermark pattern)
+                self._metrics.set_gauge("app_ml_sp_shards",
+                                        float(sp["shards"]),
+                                        model=self.name)
+                if sp["prefills"] > self._sp_prefills_seen:
+                    self._metrics.add_counter(
+                        "app_ml_sp_prefills_total",
+                        sp["prefills"] - self._sp_prefills_seen,
+                        model=self.name)
+                    self._sp_prefills_seen = sp["prefills"]
+                if sp["fallbacks"] > self._sp_fallbacks_seen:
+                    self._metrics.add_counter(
+                        "app_ml_sp_fallbacks_total",
+                        sp["fallbacks"] - self._sp_fallbacks_seen,
+                        model=self.name)
+                    self._sp_fallbacks_seen = sp["fallbacks"]
             disables = int(getattr(self.gen, "spec_disables", 0))
             if disables > self._spec_disables_seen:
                 # adaptive speculation turned a slot OFF (accept rate

@@ -68,6 +68,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import concurrent.futures
+import functools
 import inspect
 import os
 import threading
@@ -350,25 +351,60 @@ def build_replica_generators(params, cfg, n: int, *, warmup: bool = True,
     subset gets the params committed to its device; a multi-device subset
     gets a tp mesh over the subset via ``parallel``'s machinery (the same
     Megatron split ``multihost.py`` uses per host), so each replica's
-    compute and KV cache live entirely on its own chips."""
+    compute and KV cache live entirely on its own chips.
+
+    With sequence-parallel serving armed (``GOFR_ML_SP`` or an ``sp=``
+    kwarg), a multi-device subset gets an **sp** mesh instead: the
+    replica's chips shard long prompts over the sequence axis, which is
+    what turns a disagg prefill-biased replica into a sequence-parallel
+    prefill worker."""
     import jax
 
     from .. import parallel as par
     from ..models import llama
     from .generate import Generator
+    from .sp_serving import SPConfig
 
+    # an explicit sp=None means the same as absent (Generator consults
+    # the env) — treat both uniformly so neither spelling lets a
+    # single-device replica auto-build a mesh over foreign devices
+    sp_req = gen_kwargs.get("sp")
+    if sp_req is None:
+        sp_req = SPConfig.from_env()
     gens = []
     for subset in split_devices(n, devices):
+        kw = dict(gen_kwargs)
         if len(subset) == 1:
             rep_params = jax.device_put(params, subset[0])
             mesh = None
+            if gen_kwargs.get("sp"):
+                # a truthy EXPLICIT sp= cannot be honored on one chip,
+                # and letting the Generator auto-build its mesh would
+                # reach across OTHER replicas' devices — reject loudly
+                raise ValueError(
+                    f"sp= requested but replica {len(gens)} owns a "
+                    f"single device ({subset[0]}) — sequence "
+                    f"parallelism needs >= 2 devices per replica "
+                    f"(fewer replicas, or more devices)")
+            if sp_req is not None:
+                # env-armed SP stays off on a one-chip replica for the
+                # same reason (shared CPU test fleets hit this path)
+                kw["sp"] = False
+        elif sp_req:
+            # the replica's chips carry the sp axis; SHARDING_RULES'
+            # tp patterns resolve to size-1 axes (weights replicate) —
+            # SP shards activations/KV over the sequence, not weights
+            mesh = par.make_mesh(par.MeshConfig(sp=len(subset)),
+                                 devices=subset)
+            specs = par.specs_from_rules(params, llama.SHARDING_RULES)
+            rep_params = par.shard_params(params, specs, mesh)
         else:
             mesh = par.make_mesh(
                 par.mesh_shape_for(len(subset), tp=len(subset)),
                 devices=subset)
             specs = par.specs_from_rules(params, llama.SHARDING_RULES)
             rep_params = par.shard_params(params, specs, mesh)
-        gen = Generator(rep_params, cfg, mesh=mesh, **gen_kwargs)
+        gen = Generator(rep_params, cfg, mesh=mesh, **kw)
         if warmup:
             gen.warmup()
         gens.append(gen)
@@ -1141,10 +1177,17 @@ class ReplicaPool:
             try:
                 dst = self._pick_decode_dst(idx)
                 if dst is not None:
+                    # a sequence-parallel prefill worker's pages left its
+                    # devices as sp-striped shards: stamp the count on
+                    # the ship's journey mark and fleet event
+                    src_sp = getattr(self.replicas[idx].gen, "sp_stats",
+                                     lambda: None)()
                     key = await asyncio.to_thread(
-                        self._transport.ship, self.replicas[idx],
-                        self.replicas[dst], self._ship_ids(fr.prompt),
-                        journey=fr.journey, rid=fr.rid, parent=parent)
+                        functools.partial(
+                            self._transport.ship, self.replicas[idx],
+                            self.replicas[dst], self._ship_ids(fr.prompt),
+                            journey=fr.journey, rid=fr.rid, parent=parent,
+                            shards=(src_sp or {}).get("shards", 0)))
                     if key is not None:
                         fr.kv_holder = dst
             finally:

@@ -190,6 +190,19 @@ class TokenBudgetScheduler:
         # decode instead of stacking on top of a full dispatch.
         self.restore_debt = 0
         self.restores_charged = 0
+        self.sp_charges = 0  # sequence-parallel prefill waves charged
+
+    def charge_sp(self, tokens: int) -> None:
+        """Charge one sequence-parallel prefill wave. The caller passes
+        tokens/shards — each sp shard swept only its slice of the
+        prompt, so the debt upcoming plans repay is the PER-DEVICE
+        device time, not the full prompt's (charging the full prompt
+        would make the scheduler throttle decode as if the prefill had
+        cost shards× what it did). Rides the restore-debt ledger: same
+        repayment cap, same stall-free floor."""
+        self.restore_debt = min(self.restore_debt + max(0, int(tokens)),
+                                4 * self.budget)
+        self.sp_charges += 1
 
     def charge_restore(self, tokens: int) -> None:
         """Debit ``tokens`` of restore DMA/scatter work against upcoming
@@ -270,6 +283,7 @@ class TokenBudgetScheduler:
             "last_unit": self.last_unit,
             "restore_debt": self.restore_debt,
             "restores_charged": self.restores_charged,
+            "sp_charges": self.sp_charges,
         }
 
 

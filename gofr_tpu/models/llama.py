@@ -929,12 +929,22 @@ def init_paged_cache(cfg: LlamaConfig, batch: int, n_pages: int,
 def paged_prefill_into(params: dict, tokens: jnp.ndarray,
                        seq_lens: jnp.ndarray, cfg: LlamaConfig, cache: dict,
                        table_row: jnp.ndarray, slot: jnp.ndarray,
-                       page_s: int) -> tuple[jnp.ndarray, dict]:
+                       page_s: int, mesh=None, set_len: bool = True
+                       ) -> tuple[jnp.ndarray, dict]:
     """Prefill ONE prompt [1, S_pad] and scatter its kv rows into the
     slot's pages (``table_row`` [S_pad // page_s]). Pages past the prompt
-    point at scratch page 0, so whole-page writes never need masking."""
+    point at scratch page 0, so whole-page writes never need masking.
+
+    ``mesh`` + a sequence-parallel ``cfg`` (``attn_impl="ring"|"ulysses"``)
+    is the long-context SP prefill path: the forward's attention shards
+    the prompt over the ``sp`` axis, and — when the pool itself is
+    STRIPED across the mesh (generate.py's sp paged layout) — the page
+    scatters below write each device's own shard (GSPMD routes each
+    page-sized slab to its owner). ``set_len=False`` is the prefix-build
+    variant (register_prefix): pages fill, no slot admits."""
     logits, filled = prefill(params, tokens, seq_lens, cfg,
-                             init_cache(cfg, 1, tokens.shape[1]))
+                             init_cache(cfg, 1, tokens.shape[1]),
+                             mesh=mesh)
     arrays = {key: cache[key] for key in cache if key != "len"}
     n_pg = tokens.shape[1] // page_s
     for j in range(n_pg):  # static unroll: one page-sized slab per write
@@ -945,7 +955,8 @@ def paged_prefill_into(params: dict, tokens: jnp.ndarray,
                 slab = filled[key][:, 0, j * page_s:(j + 1) * page_s]
             arrays[key] = jax.lax.dynamic_update_index_in_dim(
                 arrays[key], slab, table_row[j], axis=1)
-    new_len = cache["len"].at[slot].set(seq_lens[0])
+    new_len = (cache["len"].at[slot].set(seq_lens[0]) if set_len
+               else cache["len"])
     return logits, {**arrays, "len": new_len}
 
 
@@ -1146,6 +1157,167 @@ def paged_decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
     logits = _mm(x[:, 0], params["lm_head"]).astype(jnp.float32)
     S_virt = table.shape[1] * page_s
     return logits, {**arrays, "len": jnp.minimum(pos + 1, S_virt)}
+
+
+def sp_paged_decode_step(params: dict, tokens: jnp.ndarray, cache: dict,
+                         table: jnp.ndarray, cfg: LlamaConfig, mesh
+                         ) -> tuple[jnp.ndarray, dict]:
+    """``paged_decode_step`` against a page pool STRIPED across the
+    ``sp`` mesh axis: each device owns ``n_pages/sp`` pool pages (the
+    host allocator round-robins a slot's virtual pages across devices),
+    so a single request's KV can exceed one chip's HBM.
+
+    One shard_map wraps the whole step. Per shard: the new token's KV
+    row writes only on the page's OWNER (non-owners route the scatter
+    out of bounds, mode="drop"); attention gathers the shard's LOCAL
+    pages into a virtual sequence, masks pages it doesn't own plus
+    positions past ``len``, runs the grouped online-softmax, and the
+    shards combine EXACTLY with one ``pmax`` + two ``psum``s — the
+    ``sp_decode_attention`` combine (parallel/ring.py), page-routed.
+    Activations and weights are computed replicated (the psum result is
+    identical on every shard, so the layers stay in lockstep); only the
+    pool planes are sharded. Composes with int8/int4 pages
+    (``cfg.kv_quant``): each shard dequantizes only its own pages.
+    ``table`` holds GLOBAL page ids, unchanged from the single-device
+    layout — striping is purely the pool's device placement."""
+    from ..parallel import P as _P
+    from ..parallel import shard_map
+
+    b = tokens.shape[0]
+    page_s = cache["k"].shape[2]
+    p_max = table.shape[1]
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    n_rep = cfg.n_rep
+    arrays0 = {key: cache[key] for key in cache if key != "len"}
+    pos0 = cache["len"]
+    if cfg.kv_quant:
+        pool_specs = {
+            "k": _P(None, "sp", None, None), "v": _P(None, "sp", None, None)}
+        for pl in kv_plane_names(cfg):
+            pool_specs[f"k_{pl}"] = _P(None, "sp", None, None)
+            pool_specs[f"v_{pl}"] = _P(None, "sp", None, None)
+    else:
+        pool_specs = {"k": _P(None, "sp", None, None, None),
+                      "v": _P(None, "sp", None, None, None)}
+
+    def local(params, tokens, arrays, table, pos):
+        from ..ops import apply_rope, rms_norm, rope_table
+
+        shard = jax.lax.axis_index("sp")
+        p_loc = arrays["k"].shape[1]      # pages THIS device owns
+        base = shard * p_loc
+        rows = jnp.arange(b)
+        # the write target (global), exactly as paged_decode_step
+        page_g = jnp.where(
+            pos < p_max * page_s,
+            table[rows, jnp.minimum(pos // page_s, p_max - 1)], 0)
+        off = pos % page_s
+        # non-owned writes route out of bounds and drop
+        wpage = jnp.where((page_g >= base) & (page_g < base + p_loc),
+                          page_g - base, p_loc)
+        # local view of each row's table: owned pages + a clipped gather
+        # index (masked below, so the duplicate reads never contribute)
+        ltab = jnp.clip(table - base, 0, p_loc - 1)
+        owned = (table >= base) & (table < base + p_loc)  # [B, P_max]
+        vpos = jnp.arange(p_max * page_s).reshape(p_max, page_s)
+        valid = (owned[:, :, None]
+                 & (vpos[None] < (pos + 1)[:, None, None])
+                 ).reshape(b, -1)                         # [B, S_virt]
+        x = params["embed"][tokens][:, None, :].astype(cfg.dtype)
+        cos, sin = rope_table(pos[:, None], cfg.head_dim, cfg.rope_theta,
+                              scaling=cfg.rope_scaling)
+        kv_idx = jnp.arange(KV)[None, :]
+        scale = hd ** -0.5
+
+        def body(carry, lp):
+            x, arrays, layer = carry
+            h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+            q = _mm(h, lp["wq"]).reshape(b, 1, H, hd)
+            k = _mm(h, lp["wk"]).reshape(b, 1, KV, hd)
+            v = _mm(h, lp["wv"]).reshape(b, 1, KV, hd)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            if cfg.kv_quant:
+                kq, k_pl = kv_encode(cfg, k[:, 0])
+                vq, v_pl = kv_encode(cfg, v[:, 0])
+                w_kv = kq.shape[-1]
+                arrays = dict(arrays)
+                arrays["k"] = arrays["k"].at[layer, wpage, off].set(
+                    kq.reshape(b, KV * w_kv), mode="drop")
+                arrays["v"] = arrays["v"].at[layer, wpage, off].set(
+                    vq.reshape(b, KV * w_kv), mode="drop")
+                for bs, planes in (("k", k_pl), ("v", v_pl)):
+                    for pl, val in planes.items():
+                        key = f"{bs}_{pl}"
+                        arrays[key] = arrays[key].at[
+                            layer, wpage[:, None], kv_idx,
+                            off[:, None]].set(val, mode="drop")
+
+                def virt(name):
+                    q8 = jnp.take(jax.lax.dynamic_index_in_dim(
+                        arrays[name], layer, 0, keepdims=False),
+                        ltab, axis=0).reshape(b, -1, KV, w_kv)
+                    planes = {}
+                    for pl in kv_plane_names(cfg):
+                        p = jnp.take(jax.lax.dynamic_index_in_dim(
+                            arrays[f"{name}_{pl}"], layer, 0,
+                            keepdims=False), ltab, axis=0)  # [B,P,KV,ps]
+                        planes[pl] = jnp.swapaxes(
+                            p, -1, -2).reshape(b, -1, KV)
+                    return kv_decode(cfg, q8, planes, cfg.dtype)
+
+                k_virt, v_virt = virt("k"), virt("v")
+            else:
+                dt = arrays["k"].dtype
+                arrays = {
+                    "k": arrays["k"].at[layer, wpage, off].set(
+                        k[:, 0].astype(dt), mode="drop"),
+                    "v": arrays["v"].at[layer, wpage, off].set(
+                        v[:, 0].astype(dt), mode="drop"),
+                }
+                k_l = jax.lax.dynamic_index_in_dim(arrays["k"], layer, 0,
+                                                   keepdims=False)
+                v_l = jax.lax.dynamic_index_in_dim(arrays["v"], layer, 0,
+                                                   keepdims=False)
+                k_virt = jnp.take(k_l, ltab, axis=0).reshape(b, -1, KV, hd)
+                v_virt = jnp.take(v_l, ltab, axis=0).reshape(b, -1, KV, hd)
+            # grouped online-softmax over LOCAL keys, exact cross-shard
+            # combine: one pmax (global row max) + two psums (rescaled
+            # numerator / denominator) — _sp_decode_local's math over a
+            # page-gathered virtual sequence
+            qg = (q[:, 0].reshape(b, KV, n_rep, hd).astype(jnp.float32)
+                  * scale)
+            att = jnp.einsum("bgrd,bsgd->bgrs", qg,
+                             k_virt.astype(jnp.float32))
+            att = jnp.where(valid[:, None, None, :], att, -1e30)
+            m = jnp.max(att, axis=-1, keepdims=True)
+            m_glob = jax.lax.pmax(m, "sp")
+            p = jnp.exp(att - m_glob)
+            l_loc = jnp.sum(p, axis=-1, keepdims=True)
+            acc_loc = jnp.einsum("bgrs,bsgd->bgrd", p,
+                                 v_virt.astype(jnp.float32))
+            l_glob = jax.lax.psum(l_loc, "sp")
+            acc_glob = jax.lax.psum(acc_loc, "sp")
+            o = (acc_glob / jnp.maximum(l_glob, 1e-30)).astype(x.dtype)
+            o = o.reshape(b, 1, H * hd)
+            x = x + _mm(o, lp["wo"])
+            h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+            x = x + _swiglu(h2, lp)
+            return (x, arrays, layer + 1), None
+
+        (x, arrays, _), _ = jax.lax.scan(
+            body, (x, arrays, jnp.int32(0)), params["layers"])
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        logits = _mm(x[:, 0], params["lm_head"]).astype(jnp.float32)
+        new_len = jnp.minimum(pos + 1, p_max * page_s)
+        return logits, arrays, new_len
+
+    logits, arrays, new_len = shard_map(
+        local, mesh=mesh,
+        in_specs=(_P(), _P(), pool_specs, _P(), _P()),
+        out_specs=(_P(), pool_specs, _P()), check_vma=False,
+    )(params, tokens, arrays0, table, pos0)
+    return logits, {**arrays, "len": new_len}
 
 
 def paged_decode_window(params: dict, toks: jnp.ndarray, cache: dict,

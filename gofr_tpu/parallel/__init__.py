@@ -38,6 +38,7 @@ __all__ = [
     "MeshConfig",
     "make_mesh",
     "mesh_shape_for",
+    "shard_map",
     "shard_params",
     "shard_like",
     "constrain",
@@ -45,6 +46,23 @@ __all__ = [
     "init_distributed",
     "pad_to_multiple",
 ]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with a jax<0.4.38 fallback — the compat twin of
+    ``constrain``'s ``get_abstract_mesh`` fallback. Older releases ship
+    it as ``jax.experimental.shard_map.shard_map`` with the replication
+    check under its old name (``check_rep``); without this shim every
+    sequence-parallel path (ring/Ulysses attention, the sp decode
+    combine, pipeline parallelism) is dead on this image's jax."""
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        return native(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
 
 AXES = ("dp", "fsdp", "pp", "ep", "tp", "sp")
 

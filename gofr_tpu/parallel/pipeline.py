@@ -25,7 +25,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
-from . import P
+from . import P, shard_map
 
 __all__ = ["pipeline_apply", "pipeline_layers", "stack_stages"]
 
@@ -100,7 +100,7 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jnp.ndarray,
         )
         return outs
 
-    return jax.shard_map(
+    return shard_map(
         body, mesh=mesh,
         in_specs=(param_specs, x_spec), out_specs=x_spec,
         check_vma=False,

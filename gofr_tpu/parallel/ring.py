@@ -21,7 +21,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from . import P
+from . import P, shard_map
 
 __all__ = ["ring_attention_local", "ring_attention", "sp_decode_attention"]
 
@@ -88,7 +88,7 @@ def ring_attention(q, k, v, mesh, kv_len=None, *, causal: bool = True,
     if kv_len is None:
         fn = functools.partial(ring_attention_local, axis_name=seq_axis,
                                causal=causal)
-        return jax.shard_map(
+        return shard_map(
             fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )(q, k, v)
@@ -97,7 +97,7 @@ def ring_attention(q, k, v, mesh, kv_len=None, *, causal: bool = True,
         return ring_attention_local(q, k, v, kv_len, axis_name=seq_axis,
                                     causal=causal)
 
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec, P(batch_axis)),
         out_specs=spec, check_vma=False,
     )(q, k, v, jnp.asarray(kv_len, jnp.int32))
@@ -202,7 +202,7 @@ def sp_decode_attention(q, k_cache, v_cache, kv_len, mesh, *, layer=None,
                                     axis_name=seq_axis, n_rep=n_rep,
                                     k_scale=k_sc, v_scale=v_sc)
 
-        return jax.shard_map(
+        return shard_map(
             fn, mesh=mesh,
             in_specs=(q_spec, cache_spec, cache_spec, P(batch_axis), P(),
                       scale_spec, scale_spec),
@@ -213,7 +213,7 @@ def sp_decode_attention(q, k_cache, v_cache, kv_len, mesh, *, layer=None,
         return _sp_decode_local(q, k, v, kv_len, layer, axis_name=seq_axis,
                                 n_rep=n_rep)
 
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh,
         in_specs=(q_spec, cache_spec, cache_spec, P(batch_axis), P()),
         out_specs=q_spec, check_vma=False,

@@ -21,7 +21,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from . import P
+from . import P, shard_map
 
 __all__ = ["ulysses_attention_local", "ulysses_attention"]
 
@@ -59,7 +59,7 @@ def ulysses_attention(q, k, v, mesh, kv_len=None, *, causal: bool = True,
     if kv_len is None:
         fn = functools.partial(ulysses_attention_local, axis_name=seq_axis,
                                causal=causal)
-        return jax.shard_map(
+        return shard_map(
             fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )(q, k, v)
@@ -68,7 +68,7 @@ def ulysses_attention(q, k, v, mesh, kv_len=None, *, causal: bool = True,
         return ulysses_attention_local(q, k, v, kv_len, axis_name=seq_axis,
                                        causal=causal)
 
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec, P(batch_axis)),
         out_specs=spec, check_vma=False,
     )(q, k, v, jnp.asarray(kv_len, jnp.int32))

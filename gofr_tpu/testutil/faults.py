@@ -29,6 +29,12 @@ Points (where the serving stack calls ``fire``):
 - ``migrate``  — one live-KV-migration attempt off a draining replica
   (fired on the SOURCE replica's serving thread, so
   ``GOFR_ML_FAULT_REPLICA`` narrows it to one replica's exports)
+- ``sp_prefill`` — a sequence-parallel prefill wave (GOFR_ML_SP), fired
+  BEFORE the sharded forward dispatches; the generator falls back to
+  the single-device full prefill, bit-identically
+- ``sp_gather`` — the landing/gather side of an SP prefill wave, fired
+  after the sharded forward completed; the landed shards are discarded
+  and the single-device full prefill rewrites the rows/pages
 
 The injector only exists when the env var is set (``from_env`` returns
 ``None`` otherwise) and the instrumented call sites guard with an
@@ -54,7 +60,8 @@ __all__ = ["FAULT_POINTS", "FaultInjector", "InjectedFault",
            "fault_snapshot"]
 
 FAULT_POINTS = ("step", "prefill", "spill", "restore", "emit", "route",
-                "ship", "land", "scale_up", "scale_down", "migrate")
+                "ship", "land", "scale_up", "scale_down", "migrate",
+                "sp_prefill", "sp_gather")
 
 
 class InjectedFault(RuntimeError):
