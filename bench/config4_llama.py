@@ -1761,6 +1761,139 @@ async def main() -> None:
                 if "ledger" in clean_l and "ledger" in chaos_l else None),
         }
 
+    # ---- phase M: serving time machine — traffic capture & replay -------
+    # Capture a mixed-load window (priorities + deadlines) with
+    # GOFR_ML_CAPTURE armed and price the capture overhead against a
+    # capture-off boot of the SAME window; then replay the bundle at 1x
+    # and 4x speed against a fresh capture-off boot, reporting the
+    # output-digest identity rate (must be 1.0 greedy), TTFT/TPOT deltas
+    # vs the recorded percentiles, and the goodput delta. Skipped under
+    # the headline watchdog budget unless BENCH_REPLAY_ARM=1
+    # (bench/run_all.py sets it).
+    replay_arm = None
+    if os.environ.get("BENCH_REPLAY_ARM",
+                      "0" if skip_jitter else "1") == "1":
+        import aiohttp
+
+        from gofr_tpu.ml.capture import decode_bundle, traffic_capture
+        from gofr_tpu.ml.replay import ReplayHarness
+
+        n_req_m = int(os.environ.get("BENCH_REPLAY_REQUESTS",
+                                     "32" if on_tpu else "12"))
+        new_m = max(8, max_new // 8) if on_tpu else 8
+        prio_cycle = ("high", "normal", "normal", "low")
+
+        async def replay_window(gen_fn) -> dict:
+            """The mixed-load window both arms run — priorities cycle,
+            every request carries a generous deadline (the TTL plumbing
+            is exercised, nothing trips, so greedy replay identity can
+            hold); returns the tok/s the overhead pct compares."""
+            tokens_got = [0]
+            t0 = time.perf_counter()
+
+            async def one(i: int) -> None:
+                body = {"prompt_ids": rng.integers(
+                            1, vocab_hi, (prompt_len,)).tolist(),
+                        "max_new_tokens": new_m,
+                        "priority": prio_cycle[i % len(prio_cycle)],
+                        "deadline_s": 60.0}
+                async for msg in gen_fn(body):
+                    tokens_got[0] += n_toks(msg)
+
+            for lo in range(0, n_req_m, 8):
+                await asyncio.gather(*(one(i)
+                                       for i in range(lo,
+                                                      min(lo + 8,
+                                                          n_req_m))))
+            wall = time.perf_counter() - t0
+            return {"tokens": tokens_got[0], "wall_s": round(wall, 3),
+                    "tok_s": round(tokens_got[0] / wall, 1)}
+
+        arms_m: dict = {}
+        bundle_m = None
+        raw_len_m = 0
+        for mode in ("capture", "off"):
+            if mode == "capture":
+                os.environ["GOFR_ML_CAPTURE"] = os.environ.get(
+                    "BENCH_REPLAY_RING", "512")
+            appM = chM = None
+            try:
+                appM = build_app()
+                await boot(appM)
+                chM = grpc.aio.insecure_channel(
+                    f"127.0.0.1:{ports['GRPC_PORT']}")
+                genM = chM.unary_stream(
+                    "/llm.Chat/Generate",
+                    request_serializer=lambda o: json.dumps(o).encode(),
+                    response_deserializer=lambda raw: (json.loads(raw)
+                                                       if raw else {}),
+                )
+                async for _ in genM(req(4)):    # warm compiles
+                    pass
+                cap = traffic_capture()
+                if cap is not None:
+                    cap.clear()  # the warmup request is not the window
+                arms_m[mode] = await replay_window(genM)
+                if mode == "capture":
+                    async with aiohttp.ClientSession() as s:
+                        r = await s.get(
+                            f"http://127.0.0.1:{ports['HTTP_PORT']}"
+                            f"/debug/capture")
+                        raw = await r.read()
+                    raw_len_m = len(raw)
+                    bundle_m = decode_bundle(raw)
+            except Exception as exc:    # optional arm: record, don't abort
+                arms_m[mode] = {"error": str(exc)}
+            finally:
+                os.environ.pop("GOFR_ML_CAPTURE", None)
+                if chM is not None:
+                    await chM.close()
+                if appM is not None:
+                    await appM.shutdown()
+
+        verdicts_m: dict = {}
+        if bundle_m is not None and bundle_m.get("requests"):
+            appR = None
+            try:
+                appR = build_app()
+                await boot(appR)
+                # drive the serving core directly: the harness IS the
+                # client, scheduling at the bundle's recorded offsets
+                serverR = appR.container.ml.llm("chat")
+                await serverR.generate(
+                    bundle_m["requests"][0]["tokens"], 4)  # warm compiles
+                for speed in (1.0, 4.0):
+                    verdicts_m[f"x{speed:g}"] = await ReplayHarness(
+                        serverR, bundle_m, speed=speed).run()
+            except Exception as exc:
+                verdicts_m["error"] = str(exc)
+            finally:
+                if appR is not None:
+                    await appR.shutdown()
+        cap_on_m = arms_m.get("capture", {})
+        cap_off_m = arms_m.get("off", {})
+        overhead_pct = None
+        if cap_on_m.get("tok_s") and cap_off_m.get("tok_s"):
+            overhead_pct = round(
+                100.0 * (cap_off_m["tok_s"] - cap_on_m["tok_s"])
+                / cap_off_m["tok_s"], 2)
+        rates_m = [v["identity"]["rate"] for v in verdicts_m.values()
+                   if isinstance(v, dict) and "identity" in v]
+        replay_arm = {
+            "requests": n_req_m,
+            "captured": len((bundle_m or {}).get("requests", ())),
+            "bundle_bytes": raw_len_m,
+            "capture_window": cap_on_m,
+            "off_window": cap_off_m,
+            # the zero-ish cost of recording the window (tok/s delta)
+            "capture_overhead_pct": overhead_pct,
+            "replay": verdicts_m,
+            # the acceptance invariant: greedy same-config replay is
+            # bit-identical at EVERY speed
+            "identity_ok": (bool(rates_m)
+                            and all(r == 1.0 for r in rates_m)),
+        }
+
     agg_tok_s = sum(token_counts) / elapsed
     emit(
         "llama_served_tok_per_s", agg_tok_s, "tok/s", 2000.0,
@@ -1834,6 +1967,11 @@ async def main() -> None:
             # goodput fraction, auto-profiler trigger count)
             "goodput": (goodput_arm if goodput_arm is not None
                         else "skipped (headline budget)"),
+            # phase M: serving time machine — capture a mixed window,
+            # replay it at 1x and 4x (digest identity must be 1.0
+            # greedy), capture overhead pct vs capture-off
+            "replay": (replay_arm if replay_arm is not None
+                       else "skipped (headline budget)"),
             "preset": os.environ.get("LLAMA_PRESET", "tiny"),
             "backend": jax.default_backend(),
             "config": 4,

@@ -21,6 +21,8 @@ import time
 # resilience fault-vs-clean A/B; phase H: the flight-recorder stall
 # breakdown + recorder-overhead A/B; phase I: the speculation x
 # KV-precision grid; phase J: the disaggregated prefill/decode A/B;
+# phase M: the traffic-capture & replay arm — capture a mixed window,
+# replay at 1x/4x, digest identity + capture overhead pct;
 # config7's SP arm: sequence-parallel prefill TTFT/TPOT vs context
 # length with the greedy token-identity verdict)
 CONFIGS = [
@@ -31,7 +33,8 @@ CONFIGS = [
                           "BENCH_FAULT_ARM": "1", "BENCH_STALL_ARM": "1",
                           "BENCH_SPEC_ARM": "1", "BENCH_DISAGG_ARM": "1",
                           "BENCH_ELASTIC_ARM": "1",
-                          "BENCH_GOODPUT_ARM": "1"}),
+                          "BENCH_GOODPUT_ARM": "1",
+                          "BENCH_REPLAY_ARM": "1"}),
     ("config5_sdxl.py", {}),
     ("config6_compute.py", {}),
     ("config7_longcontext.py", {"BENCH_SP_ARM": "1"}),

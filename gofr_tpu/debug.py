@@ -44,6 +44,11 @@ the server doing right now?". The TPU-native equivalents here:
   zips captured when a serving core's step time or phase shares
   regressed past their rolling baseline; the index lists triggers, the
   id route streams the zip.
+- ``GET /debug/capture`` — the traffic-capture bundle (ml/capture.py,
+  armed via ``GOFR_ML_CAPTURE``): the recorded request window as one
+  length-prefixed binary download for ``python -m gofr_tpu.ml.replay``;
+  ``?rid=`` exports a single request, unarmed answers a JSON
+  ``enabled: false``.
 """
 
 from __future__ import annotations
@@ -125,7 +130,12 @@ def _histogram_percentiles(manager, model_names) -> dict:
 
 def serving_snapshot(container) -> dict:
     """Structured state of the inference plane (the /debug/serving body)."""
-    snap: dict = {"ts": time.time()}
+    # the runtime fingerprint — the SAME dict a capture bundle's header
+    # snapshots (jax/backend/device kind+count, armed GOFR_ML_* knobs):
+    # the bench used to infer backend provenance from discovery strings
+    from .ml.capture import runtime_fingerprint
+
+    snap: dict = {"ts": time.time(), "runtime": runtime_fingerprint()}
     ml = getattr(container, "ml", None)
     if ml is not None and hasattr(ml, "serving_snapshot"):
         snap.update(ml.serving_snapshot())
@@ -318,6 +328,32 @@ def register_debug_routes(app, aio_app: web.Application) -> None:
                      f'attachment; filename="{profile_id}.zip"'},
         )
 
+    async def capture_handler(request: web.Request) -> web.Response:
+        from .ml.capture import traffic_capture
+
+        cap = traffic_capture()
+        if cap is None:
+            return web.json_response(
+                {"data": {"enabled": False,
+                          "reason": "GOFR_ML_CAPTURE unset or 0"}})
+        rid = request.query.get("rid") or None
+        if rid is not None and cap.get(rid) is None:
+            return web.json_response(
+                {"error": {"message": f"unknown request id {rid!r}"}},
+                status=404)
+        # encode() walks the bounded ring and packs token arrays — debug
+        # work, kept off the event loop like the programs snapshot
+        loop = asyncio.get_running_loop()
+        body = await loop.run_in_executor(None,
+                                          lambda: cap.encode(rid=rid))
+        name = f"capture-{rid}.gfrb" if rid is not None else "capture.gfrb"
+        return web.Response(
+            body=body,
+            content_type="application/octet-stream",
+            headers={"Content-Disposition":
+                     f'attachment; filename="{name}"'},
+        )
+
     async def crash_list_handler(_: web.Request) -> web.Response:
         from .flight_recorder import crash_vault
 
@@ -344,6 +380,7 @@ def register_debug_routes(app, aio_app: web.Application) -> None:
     aio_app.router.add_get("/debug/profile/auto/{profile_id}",
                            autoprofile_handler)
     aio_app.router.add_get("/debug/goodput", goodput_handler)
+    aio_app.router.add_get("/debug/capture", capture_handler)
     aio_app.router.add_get("/debug/programs", programs_handler)
     aio_app.router.add_get("/debug/events", events_handler)
     aio_app.router.add_get("/debug/crash", crash_list_handler)

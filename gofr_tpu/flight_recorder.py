@@ -361,15 +361,21 @@ class CrashVault:
         self._n = 0
 
     def capture(self, *, model: str, trigger: dict, state: dict,
-                events: list[dict]) -> str:
+                events: list[dict], capture: dict | None = None) -> str:
         """Store one bundle; returns its id (``/debug/crash/<id>``).
         Oldest bundles roll off past the capacity — postmortems read the
-        bundle soon after the incident, not weeks later."""
+        bundle soon after the incident, not weeks later. ``capture`` is
+        the traffic-capture tail (ml/capture.py export, present only
+        when ``GOFR_ML_CAPTURE`` is armed): it lands under
+        ``state.capture`` so a saved crash body feeds
+        ``python -m gofr_tpu.ml.replay`` directly."""
         with self._lock:
             self._n += 1
             # replica core names carry a slash ("chat/0") that would split
             # the URL path — flatten it for the id, keep it in the body
             crash_id = f"{model.replace('/', '-')}-{self._n}"
+            if capture is not None:
+                state = {**state, "capture": capture}
             self._bundles[crash_id] = {
                 "id": crash_id,
                 "at": round(time.time(), 6),

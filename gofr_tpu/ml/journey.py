@@ -32,6 +32,11 @@ percentiles over the ring, active/retained counts, exemplar index) and
 flight recorder: each ``DispatchRecorder`` commit records the rids it
 served, and a journey's prefill/decode marks carry the dispatch seq that
 produced them — forensics can pivot request↔dispatch in both directions.
+With traffic capture armed (``GOFR_ML_CAPTURE``, ml/capture.py) the
+links extend to the replay axis: the capture record shares the journey's
+rid, and the journey's request summary carries the ``output_digest`` the
+replay verdict compares — so "this exact request" pivots across
+journey ↔ dispatch ↔ captured-bundle row with one key.
 
 Everything here is host-side stdlib — no jax imports, safe to import
 from the debug endpoints without paying the ml package's startup cost.

@@ -41,6 +41,7 @@ from typing import Any, AsyncIterator
 
 from ..testutil.faults import FaultInjector, fault_snapshot
 from ..tracing import current_context
+from .capture import sampler_snapshot, traffic_capture
 from .errors import (DeadlineExceeded, GeneratorCrashed, Overloaded,
                      ServerClosed)
 from ..flight_recorder import (AutoProfiler, DispatchRecorder,
@@ -300,6 +301,18 @@ class LLMServer:
         self._journeys = journey_log()
         self._events = event_log()
         self._crashes = crash_vault()
+        # traffic capture (ml/capture.py): record every request THIS
+        # front admits (a pool core sees rid= from its front and skips —
+        # the front already captured it) for deterministic replay.
+        # GOFR_ML_CAPTURE unset/0 constructs no capture machinery at all
+        # — the stream path guards on is-not-None like every recorder
+        self._capture = traffic_capture()
+        self._cap_sampler = None
+        if self._capture is not None:
+            self._cap_sampler = sampler_snapshot(generator)
+            self._capture.note_model(
+                name, kind="server", slots=generator.batch_slots,
+                page_size=getattr(generator, "page_size", 0))
         # a ReplicaPool front installs a fleet-shape provider here so a
         # core's crash bundle snapshots the CURRENT membership (elastic
         # fleets change shape at runtime); standalone servers leave None
@@ -926,9 +939,14 @@ class LLMServer:
                 state["pool"] = self.gen.pool_stats()
             except Exception:
                 pass
+            # capture-on only: the newest captured requests ride the
+            # bundle, so the crash replays offline straight from a saved
+            # /debug/crash/<id> body (python -m gofr_tpu.ml.replay)
+            capture_tail = (self._capture.export(newest=32)
+                            if self._capture is not None else None)
             return self._crashes.capture(
                 model=self.name, trigger=trigger, state=state,
-                events=self._events.tail(128))
+                events=self._events.tail(128), capture=capture_tail)
         except Exception:
             return None
 
@@ -1575,7 +1593,8 @@ class LLMServer:
                             priority: int | str | None = None,
                             deadline_s: float | None = None,
                             rid: str | None = None,
-                            journey=None) -> AsyncIterator[list[int]]:
+                            journey=None,
+                            mode: str = "chunks") -> AsyncIterator[list[int]]:
         """Yield BURSTS of tokens — each list is the slot's share of one
         processed decode chunk (the first is ``[first_token]`` from the
         TTFT mini-chunk). The low-overhead surface for transports that can
@@ -1602,7 +1621,10 @@ class LLMServer:
         ``ReplicaPool`` front passes its own so the fleet hop and the
         core hop share ONE timeline); standalone callers leave them unset
         and the server records a journey itself when ``GOFR_ML_JOURNEY``
-        enables them.
+        enables them. ``mode`` labels the consumer surface
+        (``chunks``/``stream``/``generate`` — the ``stream``/``generate``
+        wrappers set it) in the traffic-capture record so a replayed
+        bundle is honest about how the window was consumed.
         """
         if self._closed or self._draining:
             raise self._closed_error()
@@ -1621,8 +1643,21 @@ class LLMServer:
                 "ml.queue", parent=ctx, activate=False,
                 attributes={"ml.model": self.name},
             )
+        cap_rec = None
         if rid is None:
-            rid = next_rid()
+            if self._capture is not None:
+                # capture at the submit boundary, BEFORE any radix split
+                # mutates the prompt: the bundle carries the full token
+                # ids the caller sent. Pool cores never get here — their
+                # front passed rid= after capturing the fleet request.
+                rid = next_rid()
+                cap_rec = self._capture.admit(
+                    rid, model=self.name, tokens=prompt_ids,
+                    max_new=max_new_tokens, priority=prio, deadline_s=ttl,
+                    mode=mode, sampler=self._cap_sampler,
+                    prefix=prefix is not None)
+            else:
+                rid = next_rid()
         owned = False
         if journey is None and self._journeys is not None:
             journey = self._journeys.start(Journey(
@@ -1641,6 +1676,8 @@ class LLMServer:
             # into out_q, which we're abandoning; mark cancelled so the
             # serving thread reaps it if it was somehow admitted.
             req.cancelled = True
+            if cap_rec is not None:
+                cap_rec.finish("error")
             if owned:
                 self._finish_journey(req, "error", "server closed")
             raise self._closed_error()
@@ -1652,15 +1689,28 @@ class LLMServer:
                 if isinstance(item, _Finish):
                     if info is not None:
                         info["finish_reason"] = item.reason
+                    if cap_rec is not None:
+                        # the digest↔rid crosslink: the capture record
+                        # and the journey waterfall share the rid, and
+                        # the journey's request summary names the digest
+                        digest = cap_rec.finish(item.reason)
+                        if journey is not None and digest is not None:
+                            journey.note(output_digest=digest)
                     return
                 if isinstance(item, Exception):
+                    if cap_rec is not None:
+                        cap_rec.finish(_abort_reason(item) or "error")
                     raise item
+                if cap_rec is not None:
+                    cap_rec.add_tokens(item)
                 yield item
         finally:
             # consumer closed the stream (disconnect, break, cancellation):
             # flag it so the serving thread frees the slot instead of
             # decoding to max_new_tokens for nobody
             req.cancelled = True
+            if cap_rec is not None and not cap_rec.done:
+                cap_rec.finish("cancelled")
             if owned and journey is not None and not journey.done:
                 # abandonment, not a serving failure (errors and natural
                 # completions sealed the journey before we got here)
@@ -1675,7 +1725,7 @@ class LLMServer:
         of ``stream_chunks``)."""
         agen = self.stream_chunks(prompt_ids, max_new_tokens, prefix=prefix,
                                   info=info, priority=priority,
-                                  deadline_s=deadline_s)
+                                  deadline_s=deadline_s, mode="stream")
         try:
             async for burst in agen:
                 for tok in burst:
@@ -1695,7 +1745,8 @@ class LLMServer:
         async for burst in self.stream_chunks(prompt_ids, max_new_tokens,
                                               prefix=prefix, info=info,
                                               priority=priority,
-                                              deadline_s=deadline_s):
+                                              deadline_s=deadline_s,
+                                              mode="generate"):
             out.extend(burst)
         return out
 

@@ -82,6 +82,7 @@ from .errors import (DeadlineExceeded, GeneratorCrashed, Overloaded,
 from ..flight_recorder import event_log
 from .generate import PrefixEvicted
 from .goodput import goodput_ledger
+from .capture import sampler_snapshot, traffic_capture
 from .journey import Journey, journey_log, next_rid
 from .journey import seal as seal_journey
 from .kv_offload import HostKVStore, OffloadConfig
@@ -517,6 +518,17 @@ class ReplicaPool:
         # request; replica cores mark into it, so a rerouted or disagg
         # two-stage request stays ONE record. GOFR_ML_JOURNEY=0 disables.
         self._journeys = journey_log()
+        # traffic capture (ml/capture.py): the FRONT owns one capture
+        # record per fleet request (cores skip — they see rid=); the
+        # bundle's fleet block names this pool's shape. GOFR_ML_CAPTURE
+        # unset/0 constructs nothing.
+        self._capture = traffic_capture()
+        self._cap_sampler = None
+        if self._capture is not None:
+            self._cap_sampler = sampler_snapshot(generators[0])
+            self._capture.note_model(
+                name, kind="pool", replicas=len(generators),
+                slots=sum(g.batch_slots for g in generators))
         # routing-decision wall time: the pool's contribution to the
         # dispatch-phase breakdown (phase="route" of
         # app_llm_dispatch_phase_seconds) and the routing debug block
@@ -685,6 +697,11 @@ class ReplicaPool:
         # crash bundles on this core snapshot the CURRENT fleet shape —
         # in an elastic fleet "how many replicas" is a timestamped fact
         core.fleet_info = self._fleet_shape
+        if core._capture is not None:
+            # the capture bundle's fleet block names serving FRONTS; a
+            # pool core never owns a capture record (it sees rid= from
+            # this front), so its self-registration is withdrawn
+            core._capture.forget_model(core.name)
         return core
 
     def _live_indices(self) -> list[int]:
@@ -1281,6 +1298,7 @@ class ReplicaPool:
                             info: dict | None = None,
                             priority: int | str | None = None,
                             deadline_s: float | None = None,
+                            mode: str = "chunks",
                             ) -> AsyncIterator[list[int]]:
         """Yield BURSTS of tokens, like ``LLMServer.stream_chunks``, with
         fleet semantics: the request parks in the fleet queue, routes to
@@ -1309,6 +1327,19 @@ class ReplicaPool:
             fr.journey = self._journeys.start(Journey(
                 fr.rid, model=self.name,
                 trace_id=ctx.trace_id if ctx is not None else None))
+        cap_rec = None
+        eff_info = info
+        if self._capture is not None:
+            # one capture record per FLEET request (the core skips: it
+            # sees rid=); the private info dict recovers the real finish
+            # reason when the caller passed none
+            cap_rec = self._capture.admit(
+                fr.rid, model=self.name, tokens=prompt_ids,
+                max_new=max_new_tokens, priority=prio, deadline_s=ttl,
+                mode=mode, sampler=self._cap_sampler,
+                prefix=prefix is not None)
+            if eff_info is None:
+                eff_info = {}
         try:
             self._admit(fr)  # fleet shedding; may raise Overloaded
             if (self._disagg and fr.prefix is None
@@ -1368,10 +1399,12 @@ class ReplicaPool:
                         agen = core.stream_chunks(
                             fr.prompt, fr.max_new,
                             prefix=self._core_pid(fr.prefix, idx),
-                            info=info, priority=fr.priority,
+                            info=eff_info, priority=fr.priority,
                             deadline_s=self._remaining(fr),
                             rid=fr.rid, journey=fr.journey)
                         async for burst in agen:
+                            if cap_rec is not None:
+                                cap_rec.add_tokens(burst)
                             if self._role_ctl is not None and burst:
                                 # fleet latency samples for the role
                                 # controller: TTFT on the first burst,
@@ -1388,6 +1421,11 @@ class ReplicaPool:
                                 last_burst = now
                             fr.streamed = True
                             yield burst
+                        if cap_rec is not None:
+                            digest = cap_rec.finish(
+                                eff_info.get("finish_reason") or "stop")
+                            if fr.journey is not None and digest is not None:
+                                fr.journey.note(output_digest=digest)
                         with self._lock:
                             self.served += 1
                         return
@@ -1471,6 +1509,8 @@ class ReplicaPool:
             # the typed outcome seals the fleet journey (shed/deadline/
             # crashed/error) — natural completions were sealed by the
             # core at slot finish, so this never double-stamps
+            if cap_rec is not None and not cap_rec.done:
+                cap_rec.finish(_abort_reason(exc) or "error")
             if fr.journey is not None and not fr.journey.done:
                 self._finish_journey(fr, _abort_reason(exc) or "error",
                                      str(exc))
@@ -1484,6 +1524,8 @@ class ReplicaPool:
                     self._outstanding[fr.routed_idx] -= 1
                     fr.routed_idx = None
             self._kick()
+            if cap_rec is not None and not cap_rec.done:
+                cap_rec.finish("cancelled")
             if fr.journey is not None and not fr.journey.done:
                 # consumer walked away mid-flight (GeneratorExit/aclose):
                 # an abandonment, not a serving failure
@@ -1516,7 +1558,7 @@ class ReplicaPool:
         """Token-at-a-time view of ``stream_chunks``."""
         agen = self.stream_chunks(prompt_ids, max_new_tokens, prefix=prefix,
                                   info=info, priority=priority,
-                                  deadline_s=deadline_s)
+                                  deadline_s=deadline_s, mode="stream")
         try:
             async for burst in agen:
                 for tok in burst:
@@ -1533,7 +1575,8 @@ class ReplicaPool:
         async for burst in self.stream_chunks(prompt_ids, max_new_tokens,
                                               prefix=prefix, info=info,
                                               priority=priority,
-                                              deadline_s=deadline_s):
+                                              deadline_s=deadline_s,
+                                              mode="generate"):
             out.extend(burst)
         return out
 
@@ -1707,6 +1750,13 @@ class ReplicaPool:
                "fleet_size": size, **data}
         with self._lock:
             self._scale_history.append(rec)
+        if self._capture is not None:
+            # keep the capture bundle's fleet block a CURRENT fact: an
+            # elastic fleet's replica count changes at runtime
+            self._capture.note_model(
+                self.name, kind="pool", replicas=size,
+                slots=sum(self.replicas[i].gen.batch_slots
+                          for i in self._live_indices()))
         # literal kinds: the event vocabulary is greppable (the doc-drift
         # guard reconciles .emit("…") literals against the doc table)
         if kind == "scale_up":

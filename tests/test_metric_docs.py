@@ -203,6 +203,63 @@ def test_every_documented_debug_route_still_exists():
         f"mentions or re-mount the route")
 
 
+# ------------------------------------------------ fault-point vocabulary
+# the chaos hook's point names are operator-facing (the GOFR_ML_FAULT
+# spec grammar and the /debug/serving fault snapshots): the doc's
+# fault-point table and testutil/faults.py FAULT_POINTS must agree
+# exactly, both directions. faults.py is stdlib-only by contract, so it
+# loads directly by path — no jax, no package init.
+def _load_by_path(module_name: str, path: pathlib.Path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(module_name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _code_fault_points() -> set[str]:
+    mod = _load_by_path("_gofr_fault_vocab",
+                       REPO / "gofr_tpu" / "testutil" / "faults.py")
+    return set(mod.FAULT_POINTS)
+
+
+def _doc_fault_points() -> set[str]:
+    """Rows of the observability doc's fault-point table: lines of the
+    form ``| `point` | …`` after the ``| point |`` header."""
+    points: set[str] = set()
+    in_table = False
+    for raw in DOC.read_text().splitlines():
+        line = raw.strip()
+        if re.match(r"\|\s*point\s*\|", line):
+            in_table = True
+            continue
+        if in_table:
+            m = re.match(r"\|\s*`([a-z_]+)`\s*\|", line)
+            if m:
+                points.add(m.group(1))
+            elif not line.startswith("|"):
+                in_table = False
+    return points
+
+
+def test_every_fault_point_has_a_doc_row():
+    undocumented = _code_fault_points() - _doc_fault_points()
+    assert not undocumented, (
+        f"fault points in gofr_tpu/testutil/faults.py missing from the "
+        f"{DOC.relative_to(REPO)} fault-point table: "
+        f"{sorted(undocumented)} — operators discover the GOFR_ML_FAULT "
+        f"vocabulary there")
+
+
+def test_every_documented_fault_point_still_exists():
+    ghosts = _doc_fault_points() - _code_fault_points()
+    assert not ghosts, (
+        f"fault points documented in {DOC.relative_to(REPO)} but absent "
+        f"from FAULT_POINTS: {sorted(ghosts)} — delete the stale rows or "
+        f"restore the point")
+
+
 # --------------------------------------------- goodput reason vocabulary
 # the goodput ledger's reason set is an operator-facing vocabulary (the
 # ``reason`` label of app_llm_tokens_wasted_total and the rows of
@@ -210,12 +267,8 @@ def test_every_documented_debug_route_still_exists():
 # tuple must agree exactly, both directions. goodput.py is stdlib-only
 # by contract, so it loads directly by path — no jax, no package init.
 def _code_goodput_reasons() -> set[str]:
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "_gofr_goodput_vocab", REPO / "gofr_tpu" / "ml" / "goodput.py")
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    mod = _load_by_path("_gofr_goodput_vocab",
+                        REPO / "gofr_tpu" / "ml" / "goodput.py")
     return {"delivered", *mod.WASTE_REASONS}
 
 
