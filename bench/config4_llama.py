@@ -1894,6 +1894,202 @@ async def main() -> None:
                             and all(r == 1.0 for r in rates_m)),
         }
 
+    # ---- phase N: fused decode windows — single-step vs fused A/B -------
+    # The ISSUE-17 acceptance surface: for each variant (plain paged /
+    # spec-enabled / int8-KV pages) boot window-off (today's single-step
+    # dispatch) and window-on (GOFR_ML_DECODE_WINDOW=K — K device steps
+    # per program launch) over the SAME steady mixed load, and report
+    # steady tok/s, the flight recorder's LAUNCH phase share (the number
+    # the fusion exists to collapse), top_stall, client-side TTFT/TPOT
+    # p50/p99, the realized decode_window block, and greedy token
+    # identity off-vs-on. f32 on the CPU preset: identity crosses
+    # program shapes, where bf16 can flip a near-tie argmax. Skipped
+    # under the headline watchdog budget unless BENCH_WINDOW_ARM=1
+    # (bench/run_all.py sets it).
+    window_arm = None
+    if os.environ.get("BENCH_WINDOW_ARM",
+                      "0" if skip_jitter else "1") == "1":
+        window_n = float(os.environ.get("BENCH_WINDOW_WINDOW_S", "1.6"))
+        reps_n = int(os.environ.get("BENCH_WINDOW_REPS", "3"))
+        steady_new_n = int(os.environ.get("BENCH_WINDOW_STEADY_NEW",
+                                          "128" if on_tpu else "96"))
+        win_k_n = os.environ.get("BENCH_WINDOW_K", "8")
+        page_n = "16" if on_tpu else "8"
+        dtype_n = os.environ.get("BENCH_WINDOW_DTYPE",
+                                 "" if on_tpu else "float32")
+        streams_n = int(os.environ.get("BENCH_WINDOW_STREAMS",
+                                       "8" if on_tpu else "4"))
+        ident_prompt_n = rng.integers(1, vocab_hi, (prompt_len,)).tolist()
+        # the spec variant wants a repetition-heavy prompt so prompt
+        # lookup actually accepts (phase I's motif pattern); the plain
+        # variants use it too so every cell runs the SAME workload
+        motif_n = rng.integers(1, vocab_hi, (4,)).tolist()
+        steady_prompt_n = (motif_n * (3 * max(prompt_len, 8)))[
+            :3 * max(prompt_len, 8)]
+
+        async def fused_window_run(gen_fn) -> dict:
+            """One time-bounded steady-decode window; collects
+            client-side TTFT (first chunk) and TPOT (inter-chunk mean)
+            samples next to the aggregate tok/s."""
+            stop = asyncio.Event()
+            steady_tokens = [0]
+            ttfts_n: list = []
+            tpots_n: list = []
+
+            async def steady_loop():
+                while not stop.is_set():
+                    body = {"prompt_ids": steady_prompt_n,
+                            "max_new_tokens": steady_new_n}
+                    t_req = time.perf_counter()
+                    t_first = None
+                    n_got = 0
+                    async for msg in gen_fn(body):
+                        now = time.perf_counter()
+                        if t_first is None:
+                            t_first = now
+                            ttfts_n.append(t_first - t_req)
+                        n_got += n_toks(msg)
+                        steady_tokens[0] += n_toks(msg)
+                        if stop.is_set():
+                            break
+                    if t_first is not None and n_got > 1:
+                        tpots_n.append(
+                            (time.perf_counter() - t_first) / (n_got - 1))
+
+            tasks = [asyncio.create_task(steady_loop())
+                     for _ in range(streams_n)]
+            t0 = time.perf_counter()
+            try:
+                await asyncio.sleep(window_n)
+            finally:
+                window = time.perf_counter() - t0
+                stop.set()
+                for task in tasks:
+                    task.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+            out = {"steady_tok_s": round(steady_tokens[0] / window, 1)}
+            if ttfts_n:
+                out["ttft_p50_ms"] = round(
+                    percentile(ttfts_n, 50) * 1e3, 2)
+                out["ttft_p99_ms"] = round(
+                    percentile(ttfts_n, 99) * 1e3, 2)
+            if tpots_n:
+                out["tpot_p50_ms"] = round(
+                    percentile(tpots_n, 50) * 1e3, 3)
+                out["tpot_p99_ms"] = round(
+                    percentile(tpots_n, 99) * 1e3, 3)
+            return out
+
+        variants_n = [v.strip() for v in os.environ.get(
+            "BENCH_WINDOW_VARIANTS", "plain,spec,kv8").split(",")
+            if v.strip()]
+        grid_n: dict = {}
+        for variant in variants_n:
+            cells_n: dict = {}
+            ident_n: dict = {}
+            for mode in ("off", "on"):
+                os.environ["LLM_PAGE_SIZE"] = page_n
+                if dtype_n:
+                    os.environ["LLAMA_DTYPE"] = dtype_n
+                if variant == "spec":
+                    os.environ["LLM_SPEC_K"] = os.environ.get(
+                        "BENCH_WINDOW_SPEC_K", "2")
+                elif variant == "kv8":
+                    os.environ["GOFR_ML_KV_BITS"] = "8"
+                if mode == "on":
+                    os.environ["GOFR_ML_DECODE_WINDOW"] = win_k_n
+                appN = chN = None
+                try:
+                    appN = build_app()
+                    await boot(appN)
+                    chN = grpc.aio.insecure_channel(
+                        f"127.0.0.1:{ports['GRPC_PORT']}")
+                    genN = chN.unary_stream(
+                        "/llm.Chat/Generate",
+                        request_serializer=lambda o: json.dumps(o).encode(),
+                        response_deserializer=lambda raw: (json.loads(raw)
+                                                           if raw else {}),
+                    )
+                    async for _ in genN(req(4)):        # warm compiles
+                        pass
+                    toks_n: list = []
+                    async for msg in genN({"prompt_ids": ident_prompt_n,
+                                           "max_new_tokens": 16}):
+                        toks_n.extend(msg.get("tokens", ()))
+                    ident_n[mode] = toks_n
+                    # warm the steady shape (and promote it in the radix
+                    # cache) so ladder compiles stay out of the window
+                    for _ in range(2):
+                        async for _ in genN({"prompt_ids": steady_prompt_n,
+                                             "max_new_tokens": 8}):
+                            pass
+                    runs_n = [await fused_window_run(genN)
+                              for _ in range(reps_n)]
+                    cell = max(runs_n, key=lambda r: r["steady_tok_s"])
+                    entry = await _debug_llm(ports)
+                    stalls = entry.get("stalls", {})
+                    win = stalls.get("window", {})
+                    phases_n = {name: p.get("share")
+                                for name, p in
+                                win.get("phases", {}).items()}
+                    cell.update({
+                        "step_ms": win.get("per_dispatch_ms"),
+                        # the headline number of the whole PR: how much
+                        # of the dispatch wall is program launch
+                        "launch_share": phases_n.get("launch"),
+                        "phases": phases_n,
+                        "top_stall": stalls.get("top_stall"),
+                    })
+                    if mode == "on":
+                        cell["decode_window"] = entry.get("decode_window")
+                        cell["recorder_windows"] = stalls.get(
+                            "decode_window")
+                    cells_n[mode] = cell
+                except Exception as exc:  # optional arm: record, don't abort
+                    cells_n[mode] = {"error": str(exc)}
+                finally:
+                    os.environ.pop("GOFR_ML_DECODE_WINDOW", None)
+                    os.environ.pop("GOFR_ML_KV_BITS", None)
+                    os.environ.pop("LLM_SPEC_K", None)
+                    os.environ.pop("LLM_PAGE_SIZE", None)
+                    os.environ.pop("LLAMA_DTYPE", None)
+                    if chN is not None:
+                        await chN.close()
+                    if appN is not None:
+                        await appN.shutdown()
+            off_n, on_n = cells_n.get("off", {}), cells_n.get("on", {})
+            speedup_n = None
+            if off_n.get("steady_tok_s") and on_n.get("steady_tok_s"):
+                speedup_n = round(
+                    on_n["steady_tok_s"] / off_n["steady_tok_s"], 3)
+            identical_n = (ident_n.get("off") == ident_n.get("on")
+                           if len(ident_n) == 2 else None)
+            grid_n[variant] = {
+                "off": off_n,
+                "on": on_n,
+                # the fused window is lossless under greedy — identity
+                # is an acceptance gate, not a statistic
+                "tokens_identical": identical_n,
+                "window_speedup": speedup_n,
+                # the flight-recorder acceptance: launch stops being the
+                # top stall once K steps share one launch
+                "launch_share_delta": (
+                    round(off_n["launch_share"] - on_n["launch_share"], 4)
+                    if isinstance(off_n.get("launch_share"), float)
+                    and isinstance(on_n.get("launch_share"), float)
+                    else None),
+                "launch_top_stall_off": off_n.get("top_stall"),
+                "launch_top_stall_on": on_n.get("top_stall"),
+            }
+            if identical_n is False:
+                grid_n[variant]["ident_tokens"] = ident_n
+        window_arm = {
+            "window_k": int(win_k_n),
+            "page_size": int(page_n),
+            "dtype": dtype_n or "preset-default",
+            "grid": grid_n,
+        }
+
     agg_tok_s = sum(token_counts) / elapsed
     emit(
         "llama_served_tok_per_s", agg_tok_s, "tok/s", 2000.0,
@@ -1972,6 +2168,11 @@ async def main() -> None:
             # greedy), capture overhead pct vs capture-off
             "replay": (replay_arm if replay_arm is not None
                        else "skipped (headline budget)"),
+            # phase N: fused decode windows — single-step vs fused over
+            # plain/spec/int8 variants (steady tok/s, launch share,
+            # TTFT/TPOT p50/p99, realized window stats, token identity)
+            "decode_window": (window_arm if window_arm is not None
+                              else "skipped (headline budget)"),
             "preset": os.environ.get("LLAMA_PRESET", "tiny"),
             "backend": jax.default_backend(),
             "config": 4,

@@ -22,7 +22,9 @@ import time
 # breakdown + recorder-overhead A/B; phase I: the speculation x
 # KV-precision grid; phase J: the disaggregated prefill/decode A/B;
 # phase M: the traffic-capture & replay arm — capture a mixed window,
-# replay at 1x/4x, digest identity + capture overhead pct;
+# replay at 1x/4x, digest identity + capture overhead pct; phase N: the
+# fused-decode-window single-step-vs-fused A/B (steady tok/s, launch
+# phase share, TTFT/TPOT percentiles, greedy token identity);
 # config7's SP arm: sequence-parallel prefill TTFT/TPOT vs context
 # length with the greedy token-identity verdict)
 CONFIGS = [
@@ -34,7 +36,8 @@ CONFIGS = [
                           "BENCH_SPEC_ARM": "1", "BENCH_DISAGG_ARM": "1",
                           "BENCH_ELASTIC_ARM": "1",
                           "BENCH_GOODPUT_ARM": "1",
-                          "BENCH_REPLAY_ARM": "1"}),
+                          "BENCH_REPLAY_ARM": "1",
+                          "BENCH_WINDOW_ARM": "1"}),
     ("config5_sdxl.py", {}),
     ("config6_compute.py", {}),
     ("config7_longcontext.py", {"BENCH_SP_ARM": "1"}),

@@ -127,6 +127,10 @@ class DispatchRecorder:
         self._lock = threading.Lock()
         self._pending: dict[str, float] = {}
         self._pending_rids: list[str] = []  # rids served this pass
+        # fused-decode-window dim of the current pass: (planned K,
+        # realized steps) — stamped by the generator's processing pass so
+        # the committed record describes the window whose tokens it drained
+        self._pending_window: tuple[int, int] | None = None
         self._anchor: float | None = None  # pass start (perf_counter)
         self.dispatches = 0
         self.totals = dict.fromkeys(PHASES, 0.0)  # lifetime seconds
@@ -169,11 +173,19 @@ class DispatchRecorder:
         Serving-thread only, like ``note``."""
         self._pending_rids.append(rid)
 
+    def note_window(self, k: int, realized: int) -> None:
+        """Tag the current pass with the fused decode window it drained:
+        ``k`` planned device steps, ``realized`` steps the early-exit
+        masks actually ran. One window per pass (the pipeline is 1-deep);
+        a later call overwrites. Serving-thread only, like ``note``."""
+        self._pending_window = (int(k), int(realized))
+
     def reset(self) -> None:
         """Drop the current pass unrecorded (idle poll: no dispatch to
         attribute the wait to) and re-anchor the wall clock."""
         self._pending.clear()
         self._pending_rids.clear()
+        self._pending_window = None
         self._anchor = time.perf_counter()
 
     def commit(self) -> None:
@@ -192,6 +204,10 @@ class DispatchRecorder:
             # record names every request this dispatch served
             rec["rids"] = list(dict.fromkeys(self._pending_rids))
             self._pending_rids.clear()
+        if self._pending_window is not None:
+            k, realized = self._pending_window
+            rec["window"] = {"k": k, "realized": realized}
+            self._pending_window = None
         with self._lock:
             self.dispatches += 1
             rec["seq"] = self.dispatches  # the journey marks' pivot key
@@ -251,6 +267,22 @@ class DispatchRecorder:
         host = {n: v for n, v in sums.items() if n in _HOST_PHASES}
         top = max(host, key=host.get) if host and wall > 0 else None
         attributed = sum(v for n, v in sums.items() if n != "other")
+        # fused-window dim over the ring: how many dispatches were window
+        # launches, the planned K vs what the early-exit masks realized —
+        # named decode_window because "window" above is the ROLLING ring
+        # window of this snapshot, a different thing entirely
+        win_recs = [r["window"] for r in records if "window" in r]
+        decode_window = None
+        if win_recs:
+            planned = sum(w["k"] for w in win_recs)
+            realized = sum(w["realized"] for w in win_recs)
+            decode_window = {
+                "windows": len(win_recs),
+                "mean_k": round(planned / len(win_recs), 2),
+                "mean_realized": round(realized / len(win_recs), 2),
+                "realized_share": (round(realized / planned, 4)
+                                   if planned else None),
+            }
         return {
             "dispatches": dispatches,
             "window": {
@@ -261,6 +293,7 @@ class DispatchRecorder:
                 "phases": phases,
             },
             "top_stall": top,
+            "decode_window": decode_window,
             "attributed_share": (round(attributed / wall, 4)
                                  if wall > 0 else None),
             # lifetime per-phase seconds: the ring answers "what's slow

@@ -536,6 +536,12 @@ class MLDatasource:
                 # speculative serving: K, draft mode, lifetime windows/
                 # acceptance, adaptive per-slot disable + re-probe state
                 entry["speculation"] = spec
+            win = getattr(server.gen, "window_stats", None)
+            win = win() if win is not None else None
+            if win is not None:
+                # fused decode windows (GOFR_ML_DECODE_WINDOW): K,
+                # planned-vs-realized device steps, overshoot charge
+                entry["decode_window"] = win
             if hasattr(server, "scheduler_snapshot"):
                 # token budget, chunk-size mix, SLO steering state, and
                 # per-priority ready-queue depth/age

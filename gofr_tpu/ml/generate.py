@@ -41,7 +41,8 @@ from .programs import ProgramLog, abstractify, watch_compiles
 from .scheduler import TokenBudgetScheduler, maybe_enable_compilation_cache
 
 __all__ = ["Sampler", "sample_logits", "greedy", "Generator",
-           "PagePoolExhausted", "PrefixEvicted", "spec_k_from_env"]
+           "PagePoolExhausted", "PrefixEvicted", "spec_k_from_env",
+           "decode_window_from_env", "DecodeWindowUnsupported"]
 
 _log = logging.getLogger("gofr_tpu.ml.generate")
 
@@ -97,6 +98,50 @@ def spec_k_from_env(default: int = 0) -> int:
     so a malformed value fails the boot with the knob's name instead of
     a bare int() traceback."""
     return _env_int("GOFR_ML_SPEC_K", default)
+
+
+# the K "auto" resolves to: big enough that a window amortizes the
+# ~tens-of-ms host round-trip per launch, small enough that early-exit
+# waste past a short answer stays a fraction of the window
+_WINDOW_AUTO = 32
+
+
+def decode_window_from_env(default: int = 0) -> int:
+    """``GOFR_ML_DECODE_WINDOW`` — the fused-decode-window size K (one
+    jitted program runs up to K sampling steps; the host intervenes only
+    at admission/completion boundaries). Accepts ``0``/``off`` (today's
+    single-step dispatch, the default), ``auto`` (a tuned power of two),
+    or an explicit power-of-two K. Malformed, negative, or
+    non-power-of-two values fail loudly at construction with the knob's
+    name — a silently-clamped window would misreport every launch-share
+    number the mode exists to collapse."""
+    raw = os.environ.get("GOFR_ML_DECODE_WINDOW", "").strip().lower()
+    if not raw:
+        return default
+    if raw in ("0", "off"):
+        return 0
+    if raw == "auto":
+        return _WINDOW_AUTO
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"GOFR_ML_DECODE_WINDOW must be an integer, 'auto', or "
+            f"'off', got {raw!r}") from None
+    if value < 1 or value & (value - 1):
+        raise ValueError(
+            f"GOFR_ML_DECODE_WINDOW must be a power of two >= 1 "
+            f"(or 0/off/auto), got {value}")
+    return value
+
+
+class DecodeWindowUnsupported(ValueError):
+    """Fused decode windows require the paged KV cache: the on-device
+    early-exit loop freezes a finished row by holding its page-table
+    ``len`` in place, and the dense decode path has no such per-row
+    write routing (int4 KV already rejects dense for the same reason).
+    Construct the Generator with ``page_size > 0`` or leave
+    ``GOFR_ML_DECODE_WINDOW`` unset."""
 
 
 class PagePoolExhausted(RuntimeError):
@@ -161,7 +206,8 @@ class _Slot:
     __slots__ = ("live", "tokens", "max_new", "produced", "prompt_len",
                  "eos_hit", "evicted", "callback", "spec_windows",
                  "spec_emitted", "spec_disabled", "spec_cooldown_left",
-                 "spec_recent_w", "spec_recent_e", "hist", "sp_shards")
+                 "spec_recent_w", "spec_recent_e", "hist", "sp_shards",
+                 "deadline_at")
 
     def __init__(self) -> None:
         self.live = False
@@ -170,6 +216,11 @@ class _Slot:
         self.produced = 0
         self.prompt_len = 0
         self.eos_hit = False
+        # absolute time.monotonic() deadline the serving layer stamps at
+        # slot binding (None outside a served request): the fused decode
+        # window derives a per-slot step bound from it so a window never
+        # burns K steps for a request its deadline will reap mid-window
+        self.deadline_at: float | None = None
         # shard count of the sequence-parallel prefill that admitted
         # this slot (0 = the single-device path) — journey marks and the
         # sp debug block read it
@@ -218,7 +269,8 @@ class Generator:
                  n_pages: int | None = None, draft_params: Any = None,
                  draft_cfg: Any = None, prefill_chunk: int = 0,
                  token_budget: int | None = None,
-                 host_kv: Any = None, sp: Any = None) -> None:
+                 host_kv: Any = None, sp: Any = None,
+                 decode_window: int | None = None) -> None:
         import contextlib
 
         from ..models import llama
@@ -242,6 +294,39 @@ class Generator:
         self._eos_arr = (np.fromiter(self._eos, np.int64, len(self._eos))
                          if self._eos else None)
         self.chunk = chunk
+        # -- fused decode windows (GOFR_ML_DECODE_WINDOW) ------------------
+        # decode_window: None -> env (0 = off, the byte-identical
+        # single-step path). Window mode re-points ``chunk`` at K so the
+        # WHOLE existing dispatch machinery composes unchanged: the
+        # pre-jitted ladder entries become window sizes, the token-budget
+        # scheduler's plan() charges K tokens/slot through the same
+        # ladder-entry * unit_tokens contract speculation uses, and
+        # _grow_pages' pipeline margin covers K steps per dispatch.
+        if decode_window is None:
+            decode_window = decode_window_from_env(0)
+        self.decode_window = int(decode_window)
+        if self.decode_window < 0 or (
+                self.decode_window and
+                self.decode_window & (self.decode_window - 1)):
+            raise ValueError(
+                f"decode_window must be 0 or a power of two, got "
+                f"{self.decode_window}")
+        if self.decode_window:
+            if not page_size:
+                raise DecodeWindowUnsupported(
+                    "fused decode windows (GOFR_ML_DECODE_WINDOW="
+                    f"{self.decode_window}) require the paged KV cache — "
+                    "set page_size > 0")
+            self.chunk = self.decode_window
+            # window-mode-only state (is-not-None contract: none of this
+            # exists when the knob is off)
+            self.windows = 0                  # fused windows processed
+            self.window_steps_planned = 0     # sum of dispatched K
+            self.window_steps_realized = 0    # device steps actually run
+            self.window_overshoot = 0         # tokens computed past a
+            #                                   slot's EOS/budget (ledger)
+            self._step_ema: float | None = None  # s per planned step
+            self._last_dispatch: tuple | None = None
         # -- speculation knobs (parsed EARLY: the auto token budget below
         # charges verify windows at K+1 tokens per slot) -----------------
         # spec_k: None -> env GOFR_ML_SPEC_K (0 = off); malformed or
@@ -528,12 +613,92 @@ class Generator:
             return jax.jit(paged_chunk_fn if self.page_size else chunk_fn,
                            donate_argnums=(1, 2))
 
+        # EOS membership as a host constant the jitted window programs
+        # embed — the device-side mirror of _apply_burst's np.isin, so the
+        # on-device early exit and the host truncation agree exactly
+        eos_const = (np.asarray(sorted(self._eos), np.int32)
+                     if self._eos else None)
+
+        def is_eos_dev(t):
+            """Elementwise EOS membership for any-shaped int32 tokens."""
+            if eos_const is None:
+                return jnp.zeros(t.shape, bool)
+            return jnp.any(t[..., None] == eos_const, axis=-1)
+
+        def make_window_fn(n_win: int):
+            """One FUSED decode window: up to ``n_win`` sampling steps in
+            ONE jitted program (paged cache only). Per-slot early-exit
+            masks — EOS, the remaining ``max_new``/capacity budget, the
+            deadline step bound — freeze finished rows on device (their
+            token and page-table ``len`` stop advancing), and a whole-batch
+            ``lax.cond`` skips the model sweep entirely once every row is
+            frozen. The host drains ONE async D2H per window instead of
+            one per chunk dispatch: this is the launch-share collapse the
+            flight recorder measures.
+
+            Signature: (params, tok, cache, step0, base_key, active0 [B]
+            bool, step_cap [B] int32, table) -> (block [n_win+1, B] with
+            row 0 the input-token ride-along, n_out [B] tokens emitted per
+            row, realized scalar steps actually run, carry tok, cache)."""
+            def window_fn(params, tok, cache, step0, base_key, active0,
+                          step_cap, table):
+                tok_in = tok
+                # pre-mask: a row whose input token is already EOS (a
+                # first token the host hasn't folded in yet) or whose
+                # step budget is zero must not emit anything
+                active0 = active0 & ~is_eos_dev(tok) & (step_cap > 0)
+
+                def run(carry, j):
+                    tok, cache0, active, n_out, realized = carry
+                    if sp_plan is not None:
+                        logits, cache2 = llama.sp_paged_decode_step(
+                            params, tok, cache0, table, cfg, mesh)
+                    else:
+                        logits, cache2 = llama.paged_decode_step(
+                            params, tok, cache0, table, cfg)
+                    key = jax.random.fold_in(base_key, step0 + j)
+                    nxt = _sample_impl(logits, key, sampler_cfg)
+                    # freeze finished rows: token and len stop advancing
+                    # (the KV row their garbage step wrote sits past their
+                    # final len and is never attended)
+                    nxt = jnp.where(active, nxt, tok)
+                    cache2 = {**cache2,
+                              "len": jnp.where(active, cache2["len"],
+                                               cache0["len"])}
+                    n_out = n_out + active.astype(jnp.int32)
+                    active = active & ~is_eos_dev(nxt) & (n_out < step_cap)
+                    return (nxt, cache2, active, n_out, realized + 1), nxt
+
+                def body(carry, j):
+                    # whole-batch early exit: once every row is frozen the
+                    # remaining scan iterations skip the model sweep
+                    return jax.lax.cond(
+                        jnp.any(carry[2]), run,
+                        lambda c, _j: (c, c[0]), carry, j)
+
+                carry0 = (tok, cache, active0,
+                          jnp.zeros(tok.shape, jnp.int32), jnp.int32(0))
+                (tok, cache, _act, n_out, realized), toks = jax.lax.scan(
+                    body, carry0, jnp.arange(n_win))
+                block = jnp.concatenate([tok_in[None], toks], axis=0)
+                return block, n_out, realized, tok, cache
+
+            # same donation contract as the chunk ladder: cache + token
+            # row in place, the page table reused un-donated
+            return jax.jit(window_fn, donate_argnums=(1, 2))
+
+        self._is_eos_dev = is_eos_dev  # _init_spec's windowed fns reuse it
+
         # Pre-jitted chunk ladder: one decode program per power-of-two size
         # up to `chunk`. The fixed path only ever uses `chunk` and the
         # 1-step TTFT mini-chunk; the token-budget scheduler picks the
         # ladder entry that fills the per-dispatch budget given live slots.
+        # Window mode swaps the entry factory: ladder entries ARE window
+        # sizes and every program carries the early-exit machinery.
         self._chunk_ladder = _chunk_ladder(self.chunk)
-        self._chunk_fns = {n: make_chunk_fn(n) for n in self._chunk_ladder}
+        make_decode_fn = (make_window_fn if self.decode_window
+                          else make_chunk_fn)
+        self._chunk_fns = {n: make_decode_fn(n) for n in self._chunk_ladder}
         # the PLAIN decode ladder survives _init_spec's spec-window ladder:
         # when adaptive speculation has disabled every decodable slot
         # (lookup mode), step() degrades the whole dispatch to these —
@@ -571,6 +736,10 @@ class Generator:
             TokenBudgetScheduler(token_budget, self._chunk_ladder,
                                  self.prefill_chunk, slots=batch_slots)
             if token_budget > 0 else None)
+        if self.scheduler is not None and self.decode_window:
+            # same budget arithmetic, honest labeling: plan() picks ladder
+            # entries that are now WINDOW sizes (K steps/slot per entry)
+            self.scheduler.window_mode = True
 
         def post_prefill(tok_dev, logits, prefill_key, n_req, slot):
             """Sample the first token and park it in the device-resident
@@ -794,7 +963,97 @@ class Generator:
                                           draft_cfg)
             return jnp.moveaxis(drafts, 0, 1), dcache
 
+        windowed = bool(self.decode_window)
+        is_eos_dev = self._is_eos_dev
+
         def make_spec_chunk_fn(n_windows: int):
+            def spec_window_fn(params, tok, cache, tokens_dev, draft_cache,
+                               spec_on, active0, step_cap, table):
+                """Fused-window speculation: spec verify windows ARE the
+                K-step windows. Each scan iteration drafts, verifies, and
+                accepts exactly like ``spec_chunk_fn`` below, but per-slot
+                early-exit masks fold into the accept path: a frozen row
+                (EOS emitted, step budget spent) emits nothing and stops
+                advancing, and a whole-batch ``lax.cond`` skips the sweep
+                once every row froze. Capping a row's emit count below
+                n_acc+1 is LOSSLESS — the capped prefix is the verifier's
+                own greedy chain. Returns (row0, emits [W, B, K+1], counts
+                [W, B], realized scalar windows actually run, carry tok,
+                cache, tokens_dev, draft_cache)."""
+                tok_in = tok
+                ar = jnp.arange(K + 1)[None, :]
+                rows = jnp.arange(B)
+                active0 = active0 & ~is_eos_dev(tok) & (step_cap > 0)
+
+                def run(carry):
+                    tok, cache, td, dcache, active, n_out, realized = carry
+                    h = cache["len"] + 1  # [B] history length
+                    if draft_params is not None:
+                        draft, dcache = run_draft_model(tok, dcache)
+                    else:
+                        draft = jax.vmap(draft_row)(td, h)       # [B, K]
+                    window = jnp.concatenate([tok[:, None], draft], axis=1)
+                    logits, cache = llama.paged_decode_window(
+                        params, window, cache, table, cfg)
+                    S_max = table.shape[1] * self.page_size
+                    greedy_t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    match = (draft == greedy_t[:, :K]).astype(jnp.int32)
+                    n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                    n_acc = jnp.where(spec_on & active, n_acc, 0)
+                    g_last = jnp.take_along_axis(greedy_t, n_acc[:, None], 1)
+                    draft_pad = jnp.concatenate(
+                        [draft, jnp.zeros((B, 1), jnp.int32)], axis=1)
+                    emit = jnp.where(
+                        ar < n_acc[:, None], draft_pad,
+                        jnp.where(ar == n_acc[:, None], g_last, 0))
+                    # the early-exit fold: frozen rows emit nothing;
+                    # active rows cap at their remaining step budget
+                    # (>= 1 by the active mask, so the verified next
+                    # token always lands)
+                    n_emit = jnp.where(
+                        active,
+                        jnp.minimum(n_acc + 1,
+                                    jnp.maximum(step_cap - n_out, 0)),
+                        0)
+                    emit = jnp.where(ar < n_emit[:, None], emit, 0)
+                    new_len = jnp.minimum(cache["len"] + n_emit, S_max)
+                    cache = {**cache, "len": new_len}
+                    if draft_params is not None:
+                        d_S = dcache["k"].shape[2]
+                        dcache = {**dcache,
+                                  "len": jnp.minimum(new_len, d_S)}
+                    widx = jnp.where(ar < n_emit[:, None],
+                                     h[:, None] + ar, hist_cap)
+                    td = td.at[rows[:, None], widx].set(emit, mode="drop")
+                    # carry token = the LAST token this row emitted (its
+                    # next window continues the verified chain even when
+                    # the budget cap truncated the accepted prefix);
+                    # frozen rows keep their token
+                    last = jnp.take_along_axis(
+                        emit, jnp.maximum(n_emit - 1, 0)[:, None], 1)[:, 0]
+                    tok = jnp.where(n_emit > 0, last, tok)
+                    n_out = n_out + n_emit
+                    hit = jnp.any((ar < n_emit[:, None]) & is_eos_dev(emit),
+                                  axis=1)
+                    active = active & ~hit & (n_out < step_cap)
+                    return ((tok, cache, td, dcache, active, n_out,
+                             realized + 1), (emit, n_emit))
+
+                def body(carry, _):
+                    def skip(c):
+                        return c, (jnp.zeros((B, K + 1), jnp.int32),
+                                   jnp.zeros((B,), jnp.int32))
+                    return jax.lax.cond(jnp.any(carry[4]), run, skip, carry)
+
+                carry0 = (tok, cache, tokens_dev, draft_cache, active0,
+                          jnp.zeros((B,), jnp.int32), jnp.int32(0))
+                (tok, cache, tokens_dev, draft_cache, _act, _n_out,
+                 realized), (emits, counts) = jax.lax.scan(
+                    body, carry0, None, length=n_windows)
+                return (host_visible(tok_in), host_visible(emits),
+                        host_visible(counts), host_visible(realized),
+                        host_visible(tok), cache, tokens_dev, draft_cache)
+
             def spec_chunk_fn(params, tok, cache, tokens_dev, draft_cache,
                               spec_on, table=None):
                 """``n_windows`` draft→verify→accept rounds. Returns
@@ -870,7 +1129,8 @@ class Generator:
 
             # donate tok + cache + history + draft cache (the token row
             # rides its buffer across dispatches, like the plain ladder)
-            return jax.jit(spec_chunk_fn, donate_argnums=(1, 2, 3, 4))
+            return jax.jit(spec_window_fn if windowed else spec_chunk_fn,
+                           donate_argnums=(1, 2, 3, 4))
 
         # spec mode replaces the PRIMARY ladder (the plain one survives in
         # self._plain_fns for the all-disabled fallback): entries are
@@ -1742,15 +2002,28 @@ class Generator:
         compile wall and cache provenance) in the telemetry inventory —
         unnamed calls (recover's re-warm probe) skip the bookkeeping."""
         spec = bool(self.spec_k) if spec is None else spec
-        if spec and self.page_size:
+        win = bool(self.decode_window)
+        B = self.batch_slots
+        if spec and win:
+            # all-frozen probe: active0 all False realizes zero steps, so
+            # the dead-batch dispatch stays side-effect free
             args = (self.params, self._tok_dev, self.cache,
                     self._tokens_dev, self._draft_cache,
-                    np.zeros((self.batch_slots,), bool),
+                    np.zeros((B,), bool), np.zeros((B,), bool),
+                    np.zeros((B,), np.int32), np.zeros_like(self._table))
+        elif spec and self.page_size:
+            args = (self.params, self._tok_dev, self.cache,
+                    self._tokens_dev, self._draft_cache,
+                    np.zeros((B,), bool),
                     np.zeros_like(self._table))
         elif spec:
             args = (self.params, self._tok_dev, self.cache,
                     self._tokens_dev, self._draft_cache,
-                    np.zeros((self.batch_slots,), bool))
+                    np.zeros((B,), bool))
+        elif win:
+            args = (self.params, self._tok_dev, self.cache,
+                    np.int32(0), self._base_key, np.zeros((B,), bool),
+                    np.zeros((B,), np.int32), np.zeros_like(self._table))
         elif self.page_size:
             args = (self.params, self._tok_dev, self.cache,
                     np.int32(0), self._base_key,
@@ -1770,9 +2043,14 @@ class Generator:
                 abstract=abstract)
         else:
             out = fn(*args)
-        if spec:
+        if spec and win:
+            (_row0, _e, _c, _rw, self._tok_dev, self.cache,
+             self._tokens_dev, self._draft_cache) = out
+        elif spec:
             (_row0, _e, _c, self._tok_dev, self.cache,
              self._tokens_dev, self._draft_cache) = out
+        elif win:
+            _block, _n, _r, self._tok_dev, self.cache = out
         else:
             _toks, self._tok_dev, self.cache = out
 
@@ -1796,8 +2074,12 @@ class Generator:
             or self.scheduler.budget
             < self.chunk * self.batch_slots * per_step)
         # the decode family's telemetry name: a spec generator's primary
-        # ladder dispatches K+1-position verify windows, not plain chunks
-        fam = "spec/window" if self.spec_k else "decode/chunk"
+        # ladder dispatches K+1-position verify windows, not plain chunks;
+        # a fused-window generator's ladder entries are multi-step windows
+        win = bool(self.decode_window)
+        fam = ("spec/window" if self.spec_k
+               else "decode/window" if win else "decode/chunk")
+        plain_fam = "decode/window" if win else "decode/chunk"
         if full_ladder:
             # any ladder entry may be dispatched under load — compile them
             # all, largest first (the steady-state program is hot soonest)
@@ -1818,13 +2100,13 @@ class Generator:
                 # compile it here too, or the first adversarial burst pays
                 # the compile exactly when it's already degraded
                 if full_ladder:
-                    plain = [(f"decode/chunk{n}", self._plain_fns[n])
+                    plain = [(f"{plain_fam}{n}", self._plain_fns[n])
                              for n in reversed(self._chunk_ladder)]
                 else:
-                    plain = [(f"decode/chunk{self.chunk}",
+                    plain = [(f"{plain_fam}{self.chunk}",
                               self._plain_fns[self.chunk])]
                     if self.chunk != 1:
-                        plain.append(("decode/chunk1", self._plain_fns[1]))
+                        plain.append((f"{plain_fam}1", self._plain_fns[1]))
                 for name, fn in plain:
                     self._warm_dispatch(fn, spec=False, name=name)
             if self.prefill_chunk:
@@ -2471,6 +2753,51 @@ class Generator:
         return sum(s.live for s in self.slots)
 
     # -- decode ---------------------------------------------------------------
+    def _plan_window(self, use_spec: bool,
+                     n_steps: int) -> tuple[np.ndarray, np.ndarray]:
+        """Per-slot masks for the next fused window dispatch: ``active0``
+        (decodable rows) and ``step_cap`` (tokens each row may still emit
+        on device). The cap folds three bounds — remaining ``max_new``,
+        remaining sequence capacity, and the deadline step bound (time to
+        the slot's deadline over the observed per-step wall) — MINUS the
+        token capacity of windows already in flight: host ``produced``
+        lags the one-deep pipeline, and without the subtraction a row
+        could be granted the same budget twice. Conservative under-
+        production is safe (the next window continues); _apply_burst is
+        the final host-side truncation either way."""
+        active0 = np.array(
+            [s.live and i not in self._chunked
+             for i, s in enumerate(self.slots)], bool)
+        pending = 0
+        for k, _item, m in self._inflight:
+            if k == "window":
+                pending += m[0]
+            elif k == "specwin":
+                pending += m[0] * (self.spec_k + 1)
+        step_cap = np.zeros((self.batch_slots,), np.int32)
+        now = time.perf_counter()  # slot.deadline_at's clock (llm.py)
+        for i, s in enumerate(self.slots):
+            if not active0[i]:
+                continue
+            cap = min(s.max_new - s.produced,
+                      self.max_seq - s.prompt_len - s.produced) - pending
+            if s.deadline_at is not None and self._step_ema:
+                cap = min(cap, int(max(s.deadline_at - now, 0.0)
+                                   / self._step_ema))
+            step_cap[i] = max(cap, 0)
+        # dispatch cadence EMA, in seconds per planned device step: the
+        # deadline bound's clock (advisory — the serving reaper stays
+        # authoritative)
+        t = time.perf_counter()
+        if self._last_dispatch is not None:
+            t_prev, n_prev = self._last_dispatch
+            per = (t - t_prev) / max(n_prev, 1)
+            self._step_ema = (per if self._step_ema is None
+                              else 0.8 * self._step_ema + 0.2 * per)
+        unit = (self.spec_k + 1) if use_spec else 1
+        self._last_dispatch = (t, n_steps * unit)
+        return active0, step_cap
+
     def step(self) -> None:
         """Dispatch one chunk of decode steps; process the previous
         chunk's tokens (host bookkeeping lags one dispatch — the device
@@ -2550,6 +2877,19 @@ class Generator:
             # host mirror so the re-probe drafts from real history
             self.drain()
             self._reseed_spec_rows()
+        win = self.decode_window
+        active0 = step_cap = None
+        if win:
+            active0, step_cap = self._plan_window(use_spec, n_steps)
+            if not mini and not bool((active0 & (step_cap > 0)).any()):
+                # no row can emit anything this window (budgets spent
+                # host-side, or everything decodable died since the last
+                # dispatch): settle the pipeline instead of burning a
+                # launch on an all-frozen program. The mini path never
+                # takes this exit — pending firsts ride the next input
+                # row, so it must always dispatch.
+                self.drain()
+                return
         t_asm = time.perf_counter() if rec is not None else 0.0
         with self._mesh_ctx():
             if self.page_size:
@@ -2561,7 +2901,24 @@ class Generator:
                 if rec is not None:
                     rec.note("assemble", time.perf_counter() - t_asm)
             t_launch = time.perf_counter() if rec is not None else 0.0
-            if self.spec_k and use_spec:
+            if win and self.spec_k and use_spec:
+                (row0, emits, counts, realized, self._tok_dev, self.cache,
+                 self._tokens_dev, self._draft_cache) = fn(
+                    self.params, self._tok_dev, self.cache,
+                    self._tokens_dev, self._draft_cache, spec_mask,
+                    active0, step_cap, table)
+                kind = "specwin"
+                item: Any = (row0, emits, counts, realized)
+                meta: Any = (n_steps, active0, spec_mask)
+            elif win:
+                (block, n_out, realized, self._tok_dev, self.cache) = fn(
+                    self.params, self._tok_dev, self.cache,
+                    np.int32(self.steps), self._base_key, active0,
+                    step_cap, table)
+                kind = "window"
+                item = (block, n_out, realized)
+                meta = (n_steps, active0)
+            elif self.spec_k and use_spec:
                 if self.page_size:
                     (row0, emits, counts, self._tok_dev, self.cache,
                      self._tokens_dev, self._draft_cache) = fn(
@@ -2573,19 +2930,21 @@ class Generator:
                      self._tokens_dev, self._draft_cache) = fn(
                         self.params, self._tok_dev, self.cache,
                         self._tokens_dev, self._draft_cache, spec_mask)
-                item: Any = (row0, emits, counts)
+                kind = "spec"
+                item = (row0, emits, counts)
+                meta = spec_mask
             elif self.page_size:
                 toks, self._tok_dev, self.cache = fn(
                     self.params, self._tok_dev, self.cache,
                     np.int32(self.steps), self._base_key, table,
                 )
-                item = toks
+                kind, item, meta = "chunk", toks, None
             else:
                 toks, self._tok_dev, self.cache = fn(
                     self.params, self._tok_dev, self.cache,
                     np.int32(self.steps), self._base_key,
                 )
-                item = toks
+                kind, item, meta = "chunk", toks, None
         self.steps += n_steps
         if self.spec_k and not use_spec:
             # a plain dispatch leaves the device drafting rows behind the
@@ -2613,7 +2972,7 @@ class Generator:
                     "token prefetch (copy_to_host_async) failed; falling "
                     "back to blocking reads [%s: %s]",
                     type(exc).__name__, exc)
-        self._inflight.append((item, spec_mask if use_spec else None))
+        self._inflight.append((kind, item, meta))
         if rec is not None:
             # issuing the async D2H of the token block — the other half of
             # what used to be one "dispatch" phase (the blocking read-back
@@ -2635,19 +2994,31 @@ class Generator:
             self._pop_process()
 
     def _pop_process(self) -> None:
-        item, mask = self._inflight.popleft()
+        kind, item, meta = self._inflight.popleft()
         rec = self.recorder
         t0 = time.perf_counter() if rec is not None else 0.0
-        if isinstance(item, tuple):  # a spec-window chunk
-            row0, emits, counts = (np.asarray(x) for x in item)
-            if rec is not None:
-                rec.note("device_wait", time.perf_counter() - t0)
-            self._process_spec(row0, emits, counts, mask)
-        else:
+        if kind == "chunk":
             toks = np.asarray(item)
             if rec is not None:
                 rec.note("device_wait", time.perf_counter() - t0)
             self._process(toks)
+        elif kind == "spec":
+            row0, emits, counts = (np.asarray(x) for x in item)
+            if rec is not None:
+                rec.note("device_wait", time.perf_counter() - t0)
+            self._process_spec(row0, emits, counts, meta)
+        elif kind == "window":
+            block, n_out, realized = (np.asarray(x) for x in item)
+            if rec is not None:
+                rec.note("device_wait", time.perf_counter() - t0)
+            self._process_window(block, n_out, int(realized), meta)
+        else:  # "specwin"
+            row0, emits, counts, realized = (np.asarray(x) for x in item)
+            if rec is not None:
+                rec.note("device_wait", time.perf_counter() - t0)
+            planned, active0, mask = meta
+            self._process_spec(row0, emits, counts, mask, planned=planned,
+                               active0=active0, realized_w=int(realized))
 
     def _apply_burst(self, i: int, s: _Slot, col: np.ndarray,
                      bursts: dict) -> int:
@@ -2677,29 +3048,94 @@ class Generator:
         self._maybe_finish(i)
         return len(burst)
 
+    def _process_window(self, block: np.ndarray, n_out: np.ndarray,
+                        realized: int, meta) -> None:
+        """Apply one fused decode window — token block [K+1, B] with row 0
+        the input-token ride-along, per-row emit counts [B], and the
+        realized step count — to slot state. Each active row applies only
+        its own ``n_out`` tokens; device steps a row computed past its
+        EOS or budget (the pipeline lag, a host-side death since
+        dispatch) are charged to the goodput ledger as
+        ``window_overshoot`` — computed, never delivered."""
+        planned, active0 = meta
+        self.windows += 1
+        self.window_steps_planned += planned
+        self.window_steps_realized += realized
+        rec = self.recorder
+        if rec is not None:
+            # stamped from the PROCESSING pass: the committed dispatch
+            # record describes the window whose tokens this pass drained
+            rec.note_window(planned, realized)
+        self._resolve_first(block[0])
+        body = block[1:]
+        bursts: dict[int, list[int]] = {}
+        overshoot = 0
+        for i, s in enumerate(self.slots):
+            if not active0[i] or i in self._chunked:
+                continue  # frozen at dispatch, or mid-prefill garbage
+            n = int(n_out[i])
+            applied = (self._apply_burst(i, s, body[:n, i], bursts)
+                       if s.live else 0)
+            overshoot += max(n - applied, 0)
+        if overshoot:
+            self.window_overshoot += overshoot
+            if self.goodput is not None:
+                self.goodput.note("window_overshoot", overshoot)
+        self._fire_bursts(bursts)
+
     def _process_spec(self, row0: np.ndarray, emits: np.ndarray,
-                      counts: np.ndarray, mask) -> None:
+                      counts: np.ndarray, mask, planned: int | None = None,
+                      active0=None, realized_w: int | None = None) -> None:
         """Apply one speculative chunk — input row [B] (resolves pending
         firsts), emitted candidates [W, B, K+1], counts [W, B], and the
         per-slot enable mask the dispatch ran with — to slot state. Each
         window contributes 1..K+1 tokens per live slot; windows of
-        mask-disabled slots emit exactly 1 (their plain-decode token)."""
+        mask-disabled slots emit exactly 1 (their plain-decode token).
+
+        The fused-window dispatch path (``realized_w`` not None) adds the
+        early-exit accounting: frozen rows emit 0 for a window (their
+        verify positions are ``window_overshoot``), only ``realized_w``
+        of the planned windows actually ran, and rows that died host-side
+        since dispatch charge their computed tokens the same way."""
         self._resolve_first(row0)
+        windowed = realized_w is not None
+        if windowed:
+            self.windows += 1
+            self.window_steps_planned += planned
+            self.window_steps_realized += realized_w
+            if self.recorder is not None:
+                self.recorder.note_window(planned, realized_w)
         bursts: dict[int, list[int]] = {}
         n_windows = emits.shape[0]
-        rejected = 0  # draft positions the verify windows discarded
+        rejected = 0   # draft positions the verify windows discarded
+        overshoot = 0  # positions computed past a row's EOS/budget
         for i, s in enumerate(self.slots):
-            if not s.live or i in self._chunked:
+            if windowed:
+                if not active0[i] or i in self._chunked:
+                    continue
+            elif not s.live or i in self._chunked:
                 continue  # mid-prefill rows decode garbage; drop it
             enabled = mask is None or bool(mask[i])
+            was_live = s.live
             seen = 0
             for w in range(n_windows):
-                if not s.live:
+                if windowed:
+                    if w >= realized_w:
+                        break  # the whole batch froze before this window
+                elif not s.live:
                     break
+                n = int(counts[w, i])
+                if windowed and n == 0:
+                    # this row was frozen for this window while the batch
+                    # kept running: its share of the verify sweep bought
+                    # nothing (disabled rows only burn their one plain
+                    # position — matching the spec_rejected convention of
+                    # billing only enabled rows for the K+1 sweep)
+                    overshoot += (self.spec_k + 1) if enabled else 1
+                    continue
                 seen += 1
                 self.spec_windows += 1
                 s.spec_windows += 1
-                n = int(counts[w, i])
                 s.spec_emitted += n
                 if enabled:
                     s.spec_recent_w += 1
@@ -2708,11 +3144,19 @@ class Generator:
                     # n survived verification — the rest is the drafting
                     # bill the goodput ledger itemizes
                     rejected += self.spec_k + 1 - n
-                self.spec_emitted += self._apply_burst(
-                    i, s, emits[w, i, :n], bursts)
-            self._eval_spec_slot(s, enabled, seen)
+                applied = (self._apply_burst(i, s, emits[w, i, :n], bursts)
+                           if s.live else 0)
+                self.spec_emitted += applied
+                if windowed:
+                    overshoot += n - applied
+            if not windowed or was_live:
+                self._eval_spec_slot(s, enabled, seen)
         if rejected and self.goodput is not None:
             self.goodput.note("spec_rejected", rejected)
+        if overshoot:
+            self.window_overshoot += overshoot
+            if self.goodput is not None:
+                self.goodput.note("window_overshoot", overshoot)
         self._fire_bursts(bursts)
 
     def _eval_spec_slot(self, s: _Slot, enabled: bool,
@@ -2797,6 +3241,26 @@ class Generator:
             "disables_total": self.spec_disables,
             "reprobes_total": self.spec_reprobes,
             "plain_fallback_armed": self._plain_armed,
+        }
+
+    def window_stats(self) -> dict | None:
+        """Fused-window block for /debug/serving (None when window mode
+        is off): configured K, lifetime window/step totals, how much of
+        the planned work the early-exit masks actually ran, and the
+        overshoot charge."""
+        if not self.decode_window:
+            return None
+        planned = self.window_steps_planned
+        return {
+            "window": self.decode_window,
+            "windows": self.windows,
+            "steps_planned": planned,
+            "steps_realized": self.window_steps_realized,
+            "realized_share": (round(self.window_steps_realized / planned, 4)
+                               if planned else None),
+            "overshoot_tokens": self.window_overshoot,
+            "step_ema_s": (round(self._step_ema, 6)
+                           if self._step_ema is not None else None),
         }
 
     def _process(self, toks: np.ndarray) -> None:

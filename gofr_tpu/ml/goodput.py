@@ -21,7 +21,11 @@ answer) or one of the wasted reasons:
   race);
 - ``migration_cold`` — prefix tokens that left a draining replica during
   an elastic scale event and were lost on the way (the survivor
-  cold-starts them).
+  cold-starts them);
+- ``window_overshoot`` — tokens a fused decode window computed past a
+  slot's EOS/budget before the on-device early-exit mask froze the row
+  (the price of batching K steps into one program; delivered tokens in
+  the same window still count as delivered).
 
 The ledger **balances by construction**: every classification point
 increments exactly one reason, so ``delivered + sum(wasted reasons) ==
@@ -55,7 +59,7 @@ __all__ = ["WASTE_REASONS", "GoodputLedger", "ModelGoodput",
 # app_llm_tokens_wasted_total); ``delivered`` is the ledger's other side
 WASTE_REASONS = ("spec_rejected", "deadline_cancelled", "crashed",
                  "disconnected", "failover_recompute", "restore_fallback",
-                 "migration_cold")
+                 "migration_cold", "window_overshoot")
 
 
 def goodput_enabled() -> bool:

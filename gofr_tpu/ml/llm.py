@@ -1159,6 +1159,10 @@ class LLMServer:
             for (req, _), slot in zip(batch, slots, strict=True):
                 req.slot = slot
                 self._active[slot] = req
+                # fused decode windows read this to bound on-device steps
+                # so a window can't burn K steps for a slot the reaper is
+                # about to cancel; the serving reaper stays authoritative
+                self.gen.slots[slot].deadline_at = req.deadline_at
                 self._admit_times.append(now)
                 trace = (req.trace_ctx.trace_id
                          if req.trace_ctx is not None else None)

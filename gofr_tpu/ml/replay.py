@@ -314,15 +314,47 @@ def _summarize(bundle: dict) -> dict:
     }
 
 
+async def _selftest_leg(speed: float, build_capture, build_replica) -> dict:
+    """One capture→replay leg: serve a fixed mixed window on a fresh
+    capture server, then replay the bundle on the replica the caller
+    builds (identical by default; the window leg arms the fused path)."""
+    from .capture import traffic_capture
+
+    cap = traffic_capture()
+    assert cap is not None, "selftest requires GOFR_ML_CAPTURE armed"
+    cap.clear()
+    server = build_capture()
+    try:
+        prompts = [[3, 1, 4, 1], [2, 7, 1], [5, 9, 2, 6, 5], [3, 5, 8]]
+        await asyncio.gather(*(
+            server.generate(p, 6, priority=prio, deadline_s=30.0)
+            for p, prio in zip(prompts, ("high", "normal", "low", "normal"),
+                               strict=True)))
+    finally:
+        server.close()
+    bundle = cap.export()
+    replica = build_replica()
+    try:
+        return await ReplayHarness(replica, bundle, speed=speed).run()
+    finally:
+        replica.close()
+
+
 async def _selftest(speed: float) -> dict:
     """Capture a fresh mixed window against a tiny in-process model, then
-    replay it on an identical server — the zero-dependency proof that
-    capture→replay is deterministic (greedy identity rate must be 1.0)."""
+    replay it — the zero-dependency proof that capture→replay is
+    deterministic (greedy identity rate must be 1.0). Two legs: the
+    original identical-server replay, and a fused-window leg that
+    captures on a paged single-step server and replays with
+    GOFR_ML_DECODE_WINDOW armed — the ISSUE-17 gate that the fused path
+    reproduces production windows bit-for-bit. The window leg runs in
+    float32: cross-PROGRAM identity is the claim, and bf16 rounding can
+    flip a near-tie argmax between program shapes."""
     os.environ.setdefault("GOFR_ML_CAPTURE", "256")
     import jax
+    import jax.numpy as jnp
 
     from ..models import llama
-    from .capture import traffic_capture
     from .generate import Generator
     from .llm import LLMServer
 
@@ -335,25 +367,28 @@ async def _selftest(speed: float) -> dict:
                       prefill_buckets=(8, 16)),
             name="replay-selftest")
 
-    cap = traffic_capture()
-    assert cap is not None, "selftest requires GOFR_ML_CAPTURE armed"
-    cap.clear()
-    server = build()
-    try:
-        prompts = [[3, 1, 4, 1], [2, 7, 1], [5, 9, 2, 6, 5], [3, 5, 8]]
-        await asyncio.gather(*(
-            server.generate(p, 6, priority=prio, deadline_s=30.0)
-            for p, prio in zip(prompts, ("high", "normal", "low", "normal"),
-                               strict=True)))
-    finally:
-        server.close()
-    bundle = cap.export()
-    replica = build()
-    try:
-        verdict = await ReplayHarness(replica, bundle, speed=speed).run()
-    finally:
-        replica.close()
-    return verdict
+    plain = await _selftest_leg(speed, build, build)
+
+    cfg_w = llama.tiny_llama(use_flash=False, dtype=jnp.float32)
+    params_w = llama.init_params(cfg_w, jax.random.PRNGKey(0))
+
+    def build_paged(window: int) -> LLMServer:
+        return LLMServer(
+            Generator(params_w, cfg_w, batch_slots=2, max_seq=64,
+                      prefill_buckets=(8, 16), page_size=8,
+                      decode_window=window),
+            name="replay-selftest")
+
+    window = await _selftest_leg(
+        speed, lambda: build_paged(0), lambda: build_paged(4))
+
+    # the composite rate main() gates on: BOTH legs must be 1.0
+    rates = (plain["identity"]["rate"], window["identity"]["rate"])
+    return {
+        "identity": {"rate": min(rates)},
+        "plain": plain,
+        "window": window,
+    }
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -191,6 +191,11 @@ class TokenBudgetScheduler:
         self.restore_debt = 0
         self.restores_charged = 0
         self.sp_charges = 0  # sequence-parallel prefill waves charged
+        # fused-decode-window generators flip this on: ladder entries are
+        # then WINDOW sizes (K device steps per dispatch), so plan() picks
+        # windows through the same c*rows*unit <= budget arithmetic —
+        # display-only here, the math is unchanged by construction
+        self.window_mode = False
 
     def charge_sp(self, tokens: int) -> None:
         """Charge one sequence-parallel prefill wave. The caller passes
@@ -273,6 +278,7 @@ class TokenBudgetScheduler:
         dispatches = dict(self.dispatches)
         return {
             "budget": self.budget,
+            "plans": "windows" if self.window_mode else "chunks",
             "prefill_share": round(self.prefill_share, 4),
             "ladder": list(self.ladder),
             "last_chunk": self.last_chunk,

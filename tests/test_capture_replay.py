@@ -265,6 +265,53 @@ def test_mixed_pool_window_replays_bit_identical(model, run, monkeypatch):
     assert verdict["ttft"]["delta_p50_ms"] is not None
 
 
+def test_window_replay_on_fused_path_is_identical(run, monkeypatch):
+    """The ISSUE-17 replay gate: a captured production window replayed
+    with GOFR_ML_DECODE_WINDOW armed reports digest identity 1.0 — the
+    fused multi-step path reproduces the single-step path's outputs
+    bit-for-bit. float32: the comparison crosses program shapes, where
+    bf16 rounding can flip a near-tie argmax."""
+    import jax.numpy as jnp
+
+    cfg = llama.tiny_llama(use_flash=False, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    cap = _arm(monkeypatch)
+
+    def build(**kw):
+        return LLMServer(
+            Generator(params, cfg, batch_slots=2, max_seq=64,
+                      prefill_buckets=(8, 16), page_size=8, **kw),
+            name="cap-window")
+
+    server = build(decode_window=0)
+
+    async def window(srv):
+        return await asyncio.gather(*(
+            srv.generate(p, 6, deadline_s=30.0)
+            for p in ([3, 1, 4, 1], [2, 7, 1], [5, 9, 2, 6, 5])))
+
+    try:
+        run(window(server))
+    finally:
+        server.close()
+    bundle = cap.export()
+    assert len(bundle["requests"]) == 3
+
+    # the replica picks the window up from the ENV, like production
+    monkeypatch.setenv("GOFR_ML_DECODE_WINDOW", "4")
+    replica = build()
+    try:
+        assert replica.gen.decode_window == 4
+        verdict = run(ReplayHarness(replica, bundle, speed=8.0).run())
+        stats = replica.gen.window_stats()
+    finally:
+        replica.close()
+    assert verdict["identity"]["compared"] == 3
+    assert verdict["identity"]["rate"] == 1.0
+    assert verdict["replay_failed"] == 0 and verdict["skipped"] == 0
+    assert stats["windows"] >= 1, "the replay must have run fused windows"
+
+
 def test_journey_carries_output_digest(model, run, monkeypatch):
     """The digest↔rid crosslink: the capture row and the journey share
     the rid, and the journey's request summary names the digest."""

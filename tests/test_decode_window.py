@@ -1,0 +1,220 @@
+"""Fused decode windows (tier-1): device-resident multi-step decode.
+
+The headline contracts under test: ``GOFR_ML_DECODE_WINDOW`` unset (or
+0) leaves the single-step hot path byte-identical with NO window
+machinery constructed (the test_journey zero-overhead pattern); greedy
+output on the fused path is bit-identical to the single-step path —
+plain, speculative, and int8/int4 KV pages; the knob validates loudly
+(0/off, auto, power-of-two) and dense generators reject window mode
+with a typed error at construction; tokens a window computed past a
+slot's host-side death are charged to the goodput ledger as
+``window_overshoot``; the flight recorder's dispatch records carry the
+window dim and the scheduler snapshot says it plans windows.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.flight_recorder import DispatchRecorder
+from gofr_tpu.ml.generate import (DecodeWindowUnsupported, Generator,
+                                  decode_window_from_env)
+from gofr_tpu.ml.goodput import WASTE_REASONS, GoodputLedger
+from gofr_tpu.models import llama
+
+PROMPTS = ([3, 1, 4, 1], [2, 7, 1, 8])
+
+
+@pytest.fixture(scope="module")
+def model():
+    # float32: the identity claims below compare DIFFERENT program
+    # shapes (1-step vs K-step), and bf16 rounding can flip a near-tie
+    # argmax between them
+    cfg = llama.tiny_llama(use_flash=False, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _gen(model, **kw):
+    cfg, params = model
+    kw.setdefault("batch_slots", 2)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("prefill_buckets", (8, 16))
+    kw.setdefault("page_size", 8)
+    return Generator(params, cfg, **kw)
+
+
+def _serve(gen, prompts=PROMPTS, max_new=(10, 7)):
+    outs: dict[int, list[int]] = {}
+
+    def cb(slot):
+        def f(_s, toks):
+            outs.setdefault(slot, []).extend(int(t) for t in toks)
+        return f
+
+    for i, (p, n) in enumerate(zip(prompts, max_new, strict=True)):
+        gen.add_request(list(p), n, callback=cb(i))
+    for _ in range(200):
+        if gen.n_live == 0:
+            break
+        gen.step()
+    gen.drain()
+    return outs
+
+
+# ----------------------------------------------------------- env validation
+def test_window_knob_validation(monkeypatch):
+    monkeypatch.delenv("GOFR_ML_DECODE_WINDOW", raising=False)
+    assert decode_window_from_env() == 0
+    for off in ("0", "off", "OFF"):
+        monkeypatch.setenv("GOFR_ML_DECODE_WINDOW", off)
+        assert decode_window_from_env() == 0
+    monkeypatch.setenv("GOFR_ML_DECODE_WINDOW", "auto")
+    assert decode_window_from_env() == 32
+    monkeypatch.setenv("GOFR_ML_DECODE_WINDOW", "4")
+    assert decode_window_from_env() == 4
+    for bad in ("banana", "3", "-2", "1.5"):
+        monkeypatch.setenv("GOFR_ML_DECODE_WINDOW", bad)
+        with pytest.raises(ValueError, match="GOFR_ML_DECODE_WINDOW"):
+            decode_window_from_env()
+
+
+def test_dense_generator_rejects_window_mode(model):
+    with pytest.raises(DecodeWindowUnsupported, match="paged"):
+        _gen(model, page_size=0, decode_window=4)
+
+
+def test_constructor_rejects_non_power_of_two(model):
+    with pytest.raises(ValueError, match="power of two"):
+        _gen(model, decode_window=3)
+
+
+def test_env_arms_paged_generator(model, monkeypatch):
+    monkeypatch.setenv("GOFR_ML_DECODE_WINDOW", "4")
+    gen = _gen(model)
+    assert gen.decode_window == 4 and gen.chunk == 4
+
+
+# ----------------------------------------------------- zero-overhead contract
+def test_window_unset_constructs_nothing(model, monkeypatch):
+    """Knob unset: no window machinery anywhere (decode_window 0, no
+    stats block, scheduler plans chunks) and greedy output is
+    byte-identical to an explicit single-step generator."""
+    monkeypatch.delenv("GOFR_ML_DECODE_WINDOW", raising=False)
+    gen = _gen(model, token_budget=64)
+    assert gen.decode_window == 0
+    assert gen.window_stats() is None
+    assert gen.scheduler.window_mode is False
+    assert gen.scheduler.snapshot()["plans"] == "chunks"
+    # the is-not-None contract: window-mode state is never constructed
+    assert not hasattr(gen, "windows")
+    assert not hasattr(gen, "window_overshoot")
+    out = _serve(gen)
+    exp = _serve(_gen(model, decode_window=0))
+    assert out == exp
+
+
+# --------------------------------------------------------- greedy identity
+def test_fused_window_greedy_identity(model):
+    exp = _serve(_gen(model, decode_window=0))
+    gen = _gen(model, decode_window=4)
+    assert _serve(gen) == exp
+    stats = gen.window_stats()
+    assert stats["window"] == 4 and stats["windows"] >= 1
+    assert stats["steps_realized"] <= stats["steps_planned"]
+
+
+def test_fused_window_greedy_identity_with_budget_scheduler(model):
+    exp = _serve(_gen(model, decode_window=0, token_budget=64))
+    gen = _gen(model, decode_window=4, token_budget=64)
+    assert _serve(gen) == exp
+    assert gen.scheduler.window_mode is True
+    assert gen.scheduler.snapshot()["plans"] == "windows"
+
+
+def test_fused_window_spec_identity(model):
+    exp = _serve(_gen(model, decode_window=0, spec_k=2))
+    gen = _gen(model, decode_window=4, spec_k=2)
+    assert _serve(gen) == exp
+    assert gen.window_stats()["windows"] >= 1
+    assert gen.spec_stats()["windows"] >= 1
+
+
+@pytest.mark.parametrize("kv_bits", [8, 4])
+def test_fused_window_quantized_kv_identity(kv_bits):
+    cfg = llama.tiny_llama(use_flash=False, dtype=jnp.float32,
+                           kv_bits=kv_bits)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    model = (cfg, params)
+    exp = _serve(_gen(model, decode_window=0))
+    assert _serve(_gen(model, decode_window=4)) == exp
+
+
+# ------------------------------------------------------ overshoot economics
+def test_window_overshoot_charged_to_goodput(model):
+    """A slot reaped host-side while a window is in flight: the tokens
+    the device computed for it are charged as window_overshoot — never
+    delivered, and the reason is registered in the taxonomy."""
+    assert "window_overshoot" in WASTE_REASONS
+    gen = _gen(model, decode_window=4)
+    ledger = GoodputLedger()
+    gen.goodput = ledger.handle("win-test")
+    outs: dict[int, list[int]] = {}
+    slot = gen.add_request([3, 1, 4, 1], 16,
+                           callback=lambda s, t: outs.setdefault(
+                               s, []).extend(int(x) for x in t))
+    gen.step()  # mini dispatch (first token), drains synchronously
+    gen.step()  # full window dispatched, now in flight
+    gen.slots[slot].live = False  # the serving reaper's cancel
+    gen.drain()
+    assert gen.window_overshoot > 0
+    wasted = ledger.wasted_totals()
+    assert wasted[("win-test", "window_overshoot")] == gen.window_overshoot
+    # the ledger stays balanced: the overshoot tokens never reached the
+    # slot's burst, so they are not also in the delivered column
+    snap = ledger.snapshot_model("win-test")
+    assert snap["delivered"] == 0
+    assert snap["wasted"]["window_overshoot"] == gen.window_overshoot
+    assert snap["device_tokens"] == snap["delivered"] + snap["wasted_total"]
+
+
+# ------------------------------------------------------------- observability
+def test_dispatch_records_carry_window_dim(model):
+    gen = _gen(model, decode_window=4)
+    rec = DispatchRecorder(model="win-rec", ring=64)
+    gen.recorder = rec
+    outs: dict[int, list[int]] = {}
+    gen.add_request([3, 1, 4, 1], 8,
+                    callback=lambda s, t: outs.setdefault(
+                        s, []).extend(int(x) for x in t))
+    for _ in range(50):
+        if gen.n_live == 0:
+            break
+        gen.step()
+        rec.commit()
+    gen.drain()
+    rec.commit()
+    tail = rec.tail(64)
+    windows = [r["window"] for r in tail if "window" in r]
+    assert windows, "window dispatches must stamp the window dim"
+    assert all(0 <= w["realized"] <= w["k"] for w in windows)
+    snap = rec.snapshot()
+    dw = snap["decode_window"]
+    assert dw is not None and dw["windows"] == len(windows)
+    assert dw["realized_share"] is None or 0.0 <= dw["realized_share"] <= 1.0
+    # single-step generators never stamp it: the block stays None
+    rec2 = DispatchRecorder(model="plain-rec")
+    rec2.note("launch", 0.001)
+    rec2.commit()
+    assert rec2.snapshot()["decode_window"] is None
+
+
+def test_window_stats_block(model):
+    gen = _gen(model, decode_window=4)
+    _serve(gen)
+    stats = gen.window_stats()
+    assert set(stats) == {"window", "windows", "steps_planned",
+                          "steps_realized", "realized_share",
+                          "overshoot_tokens", "step_ema_s"}
+    assert stats["realized_share"] is None or stats["realized_share"] <= 1.0
