@@ -24,7 +24,9 @@ import time
 # phase M: the traffic-capture & replay arm — capture a mixed window,
 # replay at 1x/4x, digest identity + capture overhead pct; phase N: the
 # fused-decode-window single-step-vs-fused A/B (steady tok/s, launch
-# phase share, TTFT/TPOT percentiles, greedy token identity);
+# phase share, TTFT/TPOT percentiles, greedy token identity); phase O:
+# the pipelined-serving-loop double-buffered-dispatch A/B (steady
+# tok/s, device_idle_share, greedy token identity);
 # config7's SP arm: sequence-parallel prefill TTFT/TPOT vs context
 # length with the greedy token-identity verdict)
 CONFIGS = [
@@ -37,7 +39,8 @@ CONFIGS = [
                           "BENCH_ELASTIC_ARM": "1",
                           "BENCH_GOODPUT_ARM": "1",
                           "BENCH_REPLAY_ARM": "1",
-                          "BENCH_WINDOW_ARM": "1"}),
+                          "BENCH_WINDOW_ARM": "1",
+                          "BENCH_PIPELINE_ARM": "1"}),
     ("config5_sdxl.py", {}),
     ("config6_compute.py", {}),
     ("config7_longcontext.py", {"BENCH_SP_ARM": "1"}),

@@ -128,9 +128,23 @@ class DispatchRecorder:
         self._pending: dict[str, float] = {}
         self._pending_rids: list[str] = []  # rids served this pass
         # fused-decode-window dim of the current pass: (planned K,
-        # realized steps) — stamped by the generator's processing pass so
-        # the committed record describes the window whose tokens it drained
-        self._pending_window: tuple[int, int] | None = None
+        # realized steps, windows settled) — stamped by the generator's
+        # processing pass so the committed record describes the window(s)
+        # whose tokens it drained
+        self._pending_window: tuple[int, int, int] | None = None
+        # overlap dim of the current pass: the in-flight depth its
+        # dispatch launched on top of (2 = double-buffered under
+        # GOFR_ML_PIPELINE — per-dispatch phases no longer tile the wall)
+        self._pending_overlap = 0
+        # device-idle estimate state: settles credit estimated
+        # device-busy seconds to the pass; blocking settles whose
+        # dispatch launched onto an EMPTY device calibrate an EMA of
+        # device seconds per planned step (their launch→settle span IS
+        # the execution time — the device started at launch and the host
+        # blocked until it finished)
+        self._pending_busy = 0.0
+        self._pending_settled = 0
+        self._exec_ema: float | None = None  # device s per planned step
         self._anchor: float | None = None  # pass start (perf_counter)
         self.dispatches = 0
         self.totals = dict.fromkeys(PHASES, 0.0)  # lifetime seconds
@@ -174,11 +188,46 @@ class DispatchRecorder:
         self._pending_rids.append(rid)
 
     def note_window(self, k: int, realized: int) -> None:
-        """Tag the current pass with the fused decode window it drained:
+        """Tag the current pass with a fused decode window it drained:
         ``k`` planned device steps, ``realized`` steps the early-exit
-        masks actually ran. One window per pass (the pipeline is 1-deep);
-        a later call overwrites. Serving-thread only, like ``note``."""
-        self._pending_window = (int(k), int(realized))
+        masks actually ran. A pass can settle MORE than one window (the
+        double-buffered pipeline drains both at a barrier), so calls
+        accumulate into the committed record. Serving-thread only, like
+        ``note``."""
+        if self._pending_window is None:
+            self._pending_window = (int(k), int(realized), 1)
+        else:
+            k0, r0, n0 = self._pending_window
+            self._pending_window = (k0 + int(k), r0 + int(realized), n0 + 1)
+
+    def note_overlap(self, depth: int) -> None:
+        """Tag the current pass with the in-flight depth its dispatch
+        launched on top of (1 = the classic lag-one pipeline, 2 =
+        double-buffered under GOFR_ML_PIPELINE). The committed record
+        keeps the max over the pass. Serving-thread only, like ``note``."""
+        if depth > self._pending_overlap:
+            self._pending_overlap = depth
+
+    def note_settle(self, span_s: float, depth0: int, steps: int,
+                    wait_s: float) -> None:
+        """One in-flight dispatch settled: ``span_s`` seconds from its
+        launch to now, ``depth0`` dispatches already outstanding when it
+        launched, ``steps`` planned device positions, ``wait_s`` the
+        blocking read-back tail just measured. Feeds the device-idle
+        estimate: a settle that actually BLOCKED on a dispatch launched
+        onto an empty device pins the execution time exactly (span =
+        device run time), calibrating an EMA of device seconds per
+        planned step; every settle then credits min(span, max(wait,
+        ema*steps)) estimated device-busy seconds to the current pass.
+        Serving-thread only, like ``note``."""
+        if wait_s > 1e-6 and depth0 == 0:
+            per = span_s / max(1, steps)
+            self._exec_ema = (per if self._exec_ema is None
+                              else 0.8 * self._exec_ema + 0.2 * per)
+        est = (wait_s if self._exec_ema is None
+               else max(wait_s, self._exec_ema * max(1, steps)))
+        self._pending_busy += min(span_s, est)
+        self._pending_settled += 1
 
     def reset(self) -> None:
         """Drop the current pass unrecorded (idle poll: no dispatch to
@@ -186,6 +235,9 @@ class DispatchRecorder:
         self._pending.clear()
         self._pending_rids.clear()
         self._pending_window = None
+        self._pending_overlap = 0
+        self._pending_busy = 0.0
+        self._pending_settled = 0
         self._anchor = time.perf_counter()
 
     def commit(self) -> None:
@@ -205,9 +257,20 @@ class DispatchRecorder:
             rec["rids"] = list(dict.fromkeys(self._pending_rids))
             self._pending_rids.clear()
         if self._pending_window is not None:
-            k, realized = self._pending_window
-            rec["window"] = {"k": k, "realized": realized}
+            k, realized, n = self._pending_window
+            rec["window"] = {"k": k, "realized": realized, "n": n}
             self._pending_window = None
+        if self._pending_overlap:
+            rec["overlap"] = self._pending_overlap
+            self._pending_overlap = 0
+        if self._pending_settled:
+            # estimated device-busy seconds the settles of this pass
+            # vouch for — the device-idle share's numerator. Clipped at
+            # wall so a span that began in an earlier pass (the
+            # double-buffered lag) can never claim more than this record
+            rec["busy_s"] = min(self._pending_busy, wall)
+            self._pending_busy = 0.0
+            self._pending_settled = 0
         with self._lock:
             self.dispatches += 1
             rec["seq"] = self.dispatches  # the journey marks' pivot key
@@ -276,13 +339,23 @@ class DispatchRecorder:
         if win_recs:
             planned = sum(w["k"] for w in win_recs)
             realized = sum(w["realized"] for w in win_recs)
+            n_windows = sum(w.get("n", 1) for w in win_recs)
             decode_window = {
-                "windows": len(win_recs),
-                "mean_k": round(planned / len(win_recs), 2),
-                "mean_realized": round(realized / len(win_recs), 2),
+                "windows": n_windows,
+                "mean_k": round(planned / n_windows, 2),
+                "mean_realized": round(realized / n_windows, 2),
                 "realized_share": (round(realized / planned, 4)
                                    if planned else None),
             }
+        # device-idle estimate over the ring: the settles' estimated
+        # device-busy seconds (launch→settle spans, calibrated by
+        # blocking settles) against the wall — the share of the serving
+        # thread's wall during which the device had nothing to chew on.
+        # An ESTIMATE: prefill dispatches aren't credited, so it reads
+        # high on admission-heavy windows; the pipeline A/B compares
+        # like against like
+        busy = sum(r.get("busy_s", 0.0) for r in records)
+        overlapped = sum(1 for r in records if r.get("overlap", 0) >= 2)
         return {
             "dispatches": dispatches,
             "window": {
@@ -294,6 +367,9 @@ class DispatchRecorder:
             },
             "top_stall": top,
             "decode_window": decode_window,
+            "device_idle_share": (round(max(0.0, 1.0 - busy / wall), 4)
+                                  if wall > 0 and busy > 0.0 else None),
+            "overlapped_dispatches": overlapped,
             "attributed_share": (round(attributed / wall, 4)
                                  if wall > 0 else None),
             # lifetime per-phase seconds: the ring answers "what's slow

@@ -542,6 +542,13 @@ class MLDatasource:
                 # fused decode windows (GOFR_ML_DECODE_WINDOW): K,
                 # planned-vs-realized device steps, overshoot charge
                 entry["decode_window"] = win
+            pipe = getattr(server.gen, "pipeline_stats", None)
+            pipe = pipe() if pipe is not None else None
+            if pipe is not None:
+                # double-buffered dispatch (GOFR_ML_PIPELINE): overlapped
+                # windows, the speculative re-dispatch bill, and the
+                # recorder's device-idle estimate
+                entry["pipeline"] = pipe
             if hasattr(server, "scheduler_snapshot"):
                 # token budget, chunk-size mix, SLO steering state, and
                 # per-priority ready-queue depth/age

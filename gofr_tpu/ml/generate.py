@@ -135,6 +135,25 @@ def decode_window_from_env(default: int = 0) -> int:
     return value
 
 
+def pipeline_from_env(default: int = 0) -> int:
+    """``GOFR_ML_PIPELINE`` — double-buffered dispatch: ``1``/``on``
+    keeps TWO decode dispatches in flight across serve passes (window
+    N+1 launches before the host blocks on N, so N's settle/emit host
+    work overlaps N+1's device compute), ``0``/``off``/unset keeps the
+    classic lag-one pipeline. Malformed values fail loudly at
+    construction with the knob's name — a silently-ignored arm would
+    quietly benchmark the wrong serving loop."""
+    raw = os.environ.get("GOFR_ML_PIPELINE", "").strip().lower()
+    if not raw:
+        return default
+    if raw in ("0", "off"):
+        return 0
+    if raw in ("1", "on"):
+        return 1
+    raise ValueError(
+        f"GOFR_ML_PIPELINE must be 0/off or 1/on, got {raw!r}")
+
+
 class DecodeWindowUnsupported(ValueError):
     """Fused decode windows require the paged KV cache: the on-device
     early-exit loop freezes a finished row by holding its page-table
@@ -270,7 +289,8 @@ class Generator:
                  draft_cfg: Any = None, prefill_chunk: int = 0,
                  token_budget: int | None = None,
                  host_kv: Any = None, sp: Any = None,
-                 decode_window: int | None = None) -> None:
+                 decode_window: int | None = None,
+                 pipeline: int | None = None) -> None:
         import contextlib
 
         from ..models import llama
@@ -327,6 +347,25 @@ class Generator:
             #                                   slot's EOS/budget (ledger)
             self._step_ema: float | None = None  # s per planned step
             self._last_dispatch: tuple | None = None
+        # -- double-buffered dispatch (GOFR_ML_PIPELINE) -------------------
+        # pipeline: None -> env (0 = off, the classic lag-one pipeline
+        # and the byte-identical default). Armed, step() settles down to
+        # TWO outstanding dispatches instead of one: fused windows feed
+        # next-tokens back on-device, so window N+1 never needs N's
+        # drained results — N's settle/emit host work overlaps N+1's
+        # device compute. Admission stays a boundary-only concern:
+        # _admit_waiting's drain barrier flushes BOTH windows before a
+        # slot is reused, and prefill dispatches (they mutate the page
+        # table) never ride the in-flight queue at depth.
+        if pipeline is None:
+            pipeline = pipeline_from_env()
+        self.pipeline = 1 if pipeline else 0
+        if self.pipeline:
+            # pipeline-only state (is-not-None contract: none of this
+            # exists when the knob is off)
+            self.pipeline_windows = 0    # passes that ended double-buffered
+            self.pipeline_overshoot = 0  # tokens computed for slots
+            #                              already dead at settle (ledger)
         # -- speculation knobs (parsed EARLY: the auto token budget below
         # charges verify windows at K+1 tokens per slot) -----------------
         # spec_k: None -> env GOFR_ML_SPEC_K (0 = off); malformed or
@@ -2769,7 +2808,7 @@ class Generator:
             [s.live and i not in self._chunked
              for i, s in enumerate(self.slots)], bool)
         pending = 0
-        for k, _item, m in self._inflight:
+        for k, _item, m, _stamp in self._inflight:
             if k == "window":
                 pending += m[0]
             elif k == "specwin":
@@ -2972,12 +3011,23 @@ class Generator:
                     "token prefetch (copy_to_host_async) failed; falling "
                     "back to blocking reads [%s: %s]",
                     type(exc).__name__, exc)
-        self._inflight.append((kind, item, meta))
+        stamp = None
+        if rec is not None:
+            # launch stamp for the overlap accounting: when this dispatch
+            # was issued, how many dispatches were already outstanding,
+            # and its planned device positions — settled back into the
+            # recorder's device-idle estimate in _pop_process
+            stamp = (t_d2h, len(self._inflight), n_steps * unit)
+        self._inflight.append((kind, item, meta, stamp))
         if rec is not None:
             # issuing the async D2H of the token block — the other half of
             # what used to be one "dispatch" phase (the blocking read-back
             # is device_wait, in _pop_process)
             rec.note("d2h_issue", time.perf_counter() - t_d2h)
+            # the record's ``overlap`` dim: how many in-flight dispatches
+            # this launch rode on top of (1 = the classic lag-one
+            # pipeline, 2 = double-buffered under GOFR_ML_PIPELINE)
+            rec.note_overlap(len(self._inflight) - 1)
         if mini:
             # TTFT: the chunk carrying new requests' first tokens is read
             # back NOW instead of lagging one dispatch — one blocking
@@ -2985,8 +3035,17 @@ class Generator:
             # latency; steady-state decode keeps the async pipeline.
             self.drain()
         else:
-            while len(self._inflight) > 1:
+            # double-buffered dispatch (GOFR_ML_PIPELINE=1): hold TWO
+            # dispatches outstanding across serve passes — window N
+            # settles only once N+2 has launched, so the blocking
+            # read-back finds N's tokens long landed while N+1 computes
+            # through this pass's emit/admission host work. Off, the
+            # classic lag-one pipeline: exactly one stays outstanding.
+            depth = 2 if self.pipeline else 1
+            while len(self._inflight) > depth:
                 self._pop_process()
+            if self.pipeline and len(self._inflight) >= 2:
+                self.pipeline_windows += 1
 
     def drain(self) -> None:
         """Flush pending token chunks into host bookkeeping."""
@@ -2994,31 +3053,43 @@ class Generator:
             self._pop_process()
 
     def _pop_process(self) -> None:
-        kind, item, meta = self._inflight.popleft()
+        kind, item, meta, stamp = self._inflight.popleft()
         rec = self.recorder
         t0 = time.perf_counter() if rec is not None else 0.0
         if kind == "chunk":
             toks = np.asarray(item)
             if rec is not None:
-                rec.note("device_wait", time.perf_counter() - t0)
+                self._note_settle(rec, stamp, t0)
             self._process(toks)
         elif kind == "spec":
             row0, emits, counts = (np.asarray(x) for x in item)
             if rec is not None:
-                rec.note("device_wait", time.perf_counter() - t0)
+                self._note_settle(rec, stamp, t0)
             self._process_spec(row0, emits, counts, meta)
         elif kind == "window":
             block, n_out, realized = (np.asarray(x) for x in item)
             if rec is not None:
-                rec.note("device_wait", time.perf_counter() - t0)
+                self._note_settle(rec, stamp, t0)
             self._process_window(block, n_out, int(realized), meta)
         else:  # "specwin"
             row0, emits, counts, realized = (np.asarray(x) for x in item)
             if rec is not None:
-                rec.note("device_wait", time.perf_counter() - t0)
+                self._note_settle(rec, stamp, t0)
             planned, active0, mask = meta
             self._process_spec(row0, emits, counts, mask, planned=planned,
                                active0=active0, realized_w=int(realized))
+
+    @staticmethod
+    def _note_settle(rec, stamp, t0: float) -> None:
+        """Close the books on one settled dispatch: the blocking read-back
+        is ``device_wait``, and the launch stamp (when the recorder was
+        armed at launch) feeds the recorder's launch→settle span into its
+        device-idle estimate."""
+        now = time.perf_counter()
+        rec.note("device_wait", now - t0)
+        if stamp is not None:
+            t_launch, depth0, steps = stamp
+            rec.note_settle(now - t_launch, depth0, steps, now - t0)
 
     def _apply_burst(self, i: int, s: _Slot, col: np.ndarray,
                      bursts: dict) -> int:
@@ -3070,17 +3141,31 @@ class Generator:
         body = block[1:]
         bursts: dict[int, list[int]] = {}
         overshoot = 0
+        lagged = 0  # tokens for rows already dead when this window settled
         for i, s in enumerate(self.slots):
             if not active0[i] or i in self._chunked:
                 continue  # frozen at dispatch, or mid-prefill garbage
             n = int(n_out[i])
+            was_live = s.live
             applied = (self._apply_burst(i, s, body[:n, i], bursts)
-                       if s.live else 0)
-            overshoot += max(n - applied, 0)
+                       if was_live else 0)
+            if was_live or not self.pipeline:
+                overshoot += max(n - applied, 0)
+            else:
+                # the slot finished, released, or was reaped while this
+                # window sat in flight behind another (GOFR_ML_PIPELINE):
+                # its tokens are the double-buffer's speculative
+                # re-dispatch bill, itemized apart from the window's own
+                # early-exit raggedness
+                lagged += max(n - applied, 0)
         if overshoot:
             self.window_overshoot += overshoot
             if self.goodput is not None:
                 self.goodput.note("window_overshoot", overshoot)
+        if lagged:
+            self.pipeline_overshoot += lagged
+            if self.goodput is not None:
+                self.goodput.note("pipeline_overshoot", lagged)
         self._fire_bursts(bursts)
 
     def _process_spec(self, row0: np.ndarray, emits: np.ndarray,
@@ -3109,6 +3194,7 @@ class Generator:
         n_windows = emits.shape[0]
         rejected = 0   # draft positions the verify windows discarded
         overshoot = 0  # positions computed past a row's EOS/budget
+        lagged = 0     # positions for rows already dead at settle
         for i, s in enumerate(self.slots):
             if windowed:
                 if not active0[i] or i in self._chunked:
@@ -3118,6 +3204,7 @@ class Generator:
             enabled = mask is None or bool(mask[i])
             was_live = s.live
             seen = 0
+            over_row = 0
             for w in range(n_windows):
                 if windowed:
                     if w >= realized_w:
@@ -3131,7 +3218,7 @@ class Generator:
                     # nothing (disabled rows only burn their one plain
                     # position — matching the spec_rejected convention of
                     # billing only enabled rows for the K+1 sweep)
-                    overshoot += (self.spec_k + 1) if enabled else 1
+                    over_row += (self.spec_k + 1) if enabled else 1
                     continue
                 seen += 1
                 self.spec_windows += 1
@@ -3148,7 +3235,15 @@ class Generator:
                            if s.live else 0)
                 self.spec_emitted += applied
                 if windowed:
-                    overshoot += n - applied
+                    over_row += n - applied
+            if was_live or not self.pipeline:
+                overshoot += over_row
+            else:
+                # dead before this dispatch ever settled: the whole row's
+                # verify-sweep bill is the double-buffer's speculative
+                # re-dispatch charge (GOFR_ML_PIPELINE), not the window's
+                # own early-exit economics
+                lagged += over_row
             if not windowed or was_live:
                 self._eval_spec_slot(s, enabled, seen)
         if rejected and self.goodput is not None:
@@ -3157,6 +3252,10 @@ class Generator:
             self.window_overshoot += overshoot
             if self.goodput is not None:
                 self.goodput.note("window_overshoot", overshoot)
+        if lagged:
+            self.pipeline_overshoot += lagged
+            if self.goodput is not None:
+                self.goodput.note("pipeline_overshoot", lagged)
         self._fire_bursts(bursts)
 
     def _eval_spec_slot(self, s: _Slot, enabled: bool,
@@ -3261,6 +3360,25 @@ class Generator:
             "overshoot_tokens": self.window_overshoot,
             "step_ema_s": (round(self._step_ema, 6)
                            if self._step_ema is not None else None),
+        }
+
+    def pipeline_stats(self) -> dict | None:
+        """Double-buffer block for /debug/serving (None when
+        GOFR_ML_PIPELINE is off): the depth, how many passes actually
+        ended with two dispatches outstanding, the speculative
+        re-dispatch bill, and the flight recorder's device-idle estimate
+        (None when the recorder is off)."""
+        if not self.pipeline:
+            return None
+        idle = None
+        rec = self.recorder
+        if rec is not None:
+            idle = rec.snapshot().get("device_idle_share")
+        return {
+            "depth": 2,
+            "windows_overlapped": self.pipeline_windows,
+            "overshoot_tokens": self.pipeline_overshoot,
+            "device_idle_share": idle,
         }
 
     def _process(self, toks: np.ndarray) -> None:

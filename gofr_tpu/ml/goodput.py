@@ -25,7 +25,13 @@ answer) or one of the wasted reasons:
 - ``window_overshoot`` — tokens a fused decode window computed past a
   slot's EOS/budget before the on-device early-exit mask froze the row
   (the price of batching K steps into one program; delivered tokens in
-  the same window still count as delivered).
+  the same window still count as delivered);
+- ``pipeline_overshoot`` — tokens a double-buffered dispatch
+  (``GOFR_ML_PIPELINE``) computed for a slot that had already finished,
+  been released, or been reaped by the time its window settled — the
+  window was speculatively re-dispatched while its predecessor was
+  still in flight (the price of keeping two windows outstanding;
+  ``window_overshoot`` keeps naming live rows' early-exit raggedness).
 
 The ledger **balances by construction**: every classification point
 increments exactly one reason, so ``delivered + sum(wasted reasons) ==
@@ -59,7 +65,7 @@ __all__ = ["WASTE_REASONS", "GoodputLedger", "ModelGoodput",
 # app_llm_tokens_wasted_total); ``delivered`` is the ledger's other side
 WASTE_REASONS = ("spec_rejected", "deadline_cancelled", "crashed",
                  "disconnected", "failover_recompute", "restore_fallback",
-                 "migration_cold", "window_overshoot")
+                 "migration_cold", "window_overshoot", "pipeline_overshoot")
 
 
 def goodput_enabled() -> bool:

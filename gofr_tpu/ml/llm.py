@@ -1270,12 +1270,17 @@ class LLMServer:
             # the two halves of the request↔dispatch pivot.
             name = "prefill" if req.first_token_at is None else "decode"
             rec = self.recorder
+            # in-flight depth at emit time (0 = fully drained, 1 = the
+            # lag-one pipeline, 2 = double-buffered, GOFR_ML_PIPELINE):
+            # the waterfall shows overlapped dispatches honestly instead
+            # of implying serial device time
+            depth = len(self.gen._inflight)
             if rec is not None:
                 rec.note_rid(req.rid)
-                req.journey.mark(name, tokens=len(tokens),
+                req.journey.mark(name, tokens=len(tokens), inflight=depth,
                                  dispatch=rec.dispatches + 1)
             else:
-                req.journey.mark(name, tokens=len(tokens))
+                req.journey.mark(name, tokens=len(tokens), inflight=depth)
         now = time.perf_counter()
         if (self._controller is not None and tokens
                 and req.last_burst_at is not None):

@@ -343,13 +343,17 @@ async def _selftest_leg(speed: float, build_capture, build_replica) -> dict:
 async def _selftest(speed: float) -> dict:
     """Capture a fresh mixed window against a tiny in-process model, then
     replay it — the zero-dependency proof that capture→replay is
-    deterministic (greedy identity rate must be 1.0). Two legs: the
-    original identical-server replay, and a fused-window leg that
-    captures on a paged single-step server and replays with
+    deterministic (greedy identity rate must be 1.0). Three legs: the
+    original identical-server replay; a fused-window leg that captures
+    on a paged single-step server and replays with
     GOFR_ML_DECODE_WINDOW armed — the ISSUE-17 gate that the fused path
-    reproduces production windows bit-for-bit. The window leg runs in
-    float32: cross-PROGRAM identity is the claim, and bf16 rounding can
-    flip a near-tie argmax between program shapes."""
+    reproduces production windows bit-for-bit; and a pipelined leg that
+    replays the same single-step capture with GOFR_ML_PIPELINE on top of
+    the window — the double-buffered serving loop must not change one
+    token either. The paged legs run in float32: cross-PROGRAM identity
+    is the claim, and bf16 rounding can flip a near-tie argmax between
+    program shapes. The verdict gates on the MIN identity across all
+    legs."""
     os.environ.setdefault("GOFR_ML_CAPTURE", "256")
     import jax
     import jax.numpy as jnp
@@ -372,22 +376,28 @@ async def _selftest(speed: float) -> dict:
     cfg_w = llama.tiny_llama(use_flash=False, dtype=jnp.float32)
     params_w = llama.init_params(cfg_w, jax.random.PRNGKey(0))
 
-    def build_paged(window: int) -> LLMServer:
+    def build_paged(window: int, pipeline: int = 0) -> LLMServer:
         return LLMServer(
             Generator(params_w, cfg_w, batch_slots=2, max_seq=64,
                       prefill_buckets=(8, 16), page_size=8,
-                      decode_window=window),
+                      decode_window=window, pipeline=pipeline),
             name="replay-selftest")
 
     window = await _selftest_leg(
         speed, lambda: build_paged(0), lambda: build_paged(4))
 
-    # the composite rate main() gates on: BOTH legs must be 1.0
-    rates = (plain["identity"]["rate"], window["identity"]["rate"])
+    pipelined = await _selftest_leg(
+        speed, lambda: build_paged(0),
+        lambda: build_paged(4, pipeline=1))
+
+    # the composite rate main() gates on: ALL legs must be 1.0
+    rates = (plain["identity"]["rate"], window["identity"]["rate"],
+             pipelined["identity"]["rate"])
     return {
         "identity": {"rate": min(rates)},
         "plain": plain,
         "window": window,
+        "pipelined": pipelined,
     }
 
 

@@ -312,6 +312,57 @@ def test_window_replay_on_fused_path_is_identical(run, monkeypatch):
     assert stats["windows"] >= 1, "the replay must have run fused windows"
 
 
+def test_pipelined_replay_on_double_buffered_path_is_identical(
+        run, monkeypatch):
+    """The ISSUE-18 replay gate: a captured single-step window replayed
+    with GOFR_ML_PIPELINE=1 + GOFR_ML_DECODE_WINDOW=4 — two dispatches
+    in flight — keeps digest identity 1.0. Budgets are big enough that
+    the planner actually double-buffers (a window's conservative grant
+    must not exhaust max_new in one dispatch)."""
+    import jax.numpy as jnp
+
+    cfg = llama.tiny_llama(use_flash=False, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    cap = _arm(monkeypatch)
+
+    def build(**kw):
+        return LLMServer(
+            Generator(params, cfg, batch_slots=2, max_seq=64,
+                      prefill_buckets=(8, 16), page_size=8, **kw),
+            name="cap-pipe")
+
+    server = build(decode_window=0, pipeline=0)
+
+    async def window(srv):
+        return await asyncio.gather(*(
+            srv.generate(p, 14, deadline_s=30.0)
+            for p in ([3, 1, 4, 1], [2, 7, 1], [5, 9, 2, 6, 5])))
+
+    try:
+        run(window(server))
+    finally:
+        server.close()
+    bundle = cap.export()
+    assert len(bundle["requests"]) == 3
+
+    # the replica arms BOTH knobs from the ENV, like production
+    monkeypatch.setenv("GOFR_ML_DECODE_WINDOW", "4")
+    monkeypatch.setenv("GOFR_ML_PIPELINE", "1")
+    replica = build()
+    try:
+        assert replica.gen.decode_window == 4
+        assert replica.gen.pipeline == 1
+        verdict = run(ReplayHarness(replica, bundle, speed=8.0).run())
+        stats = replica.gen.pipeline_stats()
+    finally:
+        replica.close()
+    assert verdict["identity"]["compared"] == 3
+    assert verdict["identity"]["rate"] == 1.0
+    assert verdict["replay_failed"] == 0 and verdict["skipped"] == 0
+    assert stats["windows_overlapped"] >= 1, \
+        "the replay must have held two dispatches in flight"
+
+
 def test_journey_carries_output_digest(model, run, monkeypatch):
     """The digest↔rid crosslink: the capture row and the journey share
     the rid, and the journey's request summary names the digest."""
