@@ -2283,6 +2283,144 @@ async def main() -> None:
             "grid": grid_o,
         }
 
+    # ---- phase P: self-tuning — replay-driven config search + canary ----
+    # Ride the committed bench/ bundle through the offline tuner
+    # (ml/tune.py): replay the SAME captured window across a config grid
+    # on the tiny reference model, prune identity violators, and report
+    # the scoreboard, the winner, and the steady decode tok/s lift vs
+    # the default arm. Then boot the winner as a shadow canary on a
+    # 1-replica pool, mirror the bundle's prompts through it, and report
+    # the promotion verdict plus the canary waste ledger (balanced:
+    # every client token delivered, every completed mirror billed as
+    # ``canary`` waste). Skipped under the headline watchdog budget
+    # unless BENCH_TUNE_ARM=1 (bench/run_all.py sets it).
+    tune_arm = None
+    if os.environ.get("BENCH_TUNE_ARM",
+                      "0" if skip_jitter else "1") == "1":
+        from gofr_tpu.flight_recorder import event_log
+        from gofr_tpu.ml.goodput import goodput_ledger
+        from gofr_tpu.ml.replay import load_bundle
+        from gofr_tpu.ml.replica import ReplicaPool
+        from gofr_tpu.ml.tune import Tuner, _tiny_builder, default_grid
+
+        tune_arm = {}
+        profile_p = None
+        bundle_p = None
+        try:
+            bundle_p = load_bundle(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tune_window.bundle"))
+            grid_p = default_grid(bundle_p)[:int(os.environ.get(
+                "BENCH_TUNE_ARMS", "5"))]
+            tuner_p = Tuner(bundle_p, _tiny_builder(), grid_p,
+                            speed=float(os.environ.get("BENCH_TUNE_SPEED",
+                                                       "1000")))
+            result_p = await tuner_p.run()
+            winner_p = result_p.get("winner") or {}
+            tune_arm.update({
+                "bundle_requests": len(bundle_p.get("requests", ())),
+                "arms": result_p["arms"],
+                "pruned": result_p["pruned"],
+                "scoreboard": [
+                    {k: r.get(k) for k in ("arm", "score", "steady_tok_s",
+                                           "identity", "pruned",
+                                           "pruned_reason")}
+                    for r in result_p["scoreboard"]],
+                "winner": winner_p.get("arm"),
+                "winner_knobs": winner_p.get("knobs"),
+                "speedup_vs_default": result_p.get("speedup_vs_default"),
+                # the acceptance gate: the recommendation is CORRECT
+                # (identity 1.0) before it is fast
+                "identity_ok": winner_p.get("identity") == 1.0,
+            })
+            profile_p = tuner_p.profile(result_p)
+        except Exception as exc:    # optional arm: record, don't abort
+            tune_arm["error"] = str(exc)
+
+        if profile_p is not None and not profile_p.get("knobs"):
+            tune_arm["canary"] = "skipped (default arm won: nothing to arm)"
+        elif profile_p is not None and bundle_p is not None:
+            # canary leg: shadow the winner on a live 1-replica pool and
+            # let the mirrored window judge it. Window == request count
+            # so the verdict lands exactly when the LAST mirror's pair
+            # completes — no canary work is in flight when the billing
+            # flips, and the waste count is deterministic.
+            os.environ["GOFR_ML_CANARY_SAMPLE"] = "1"
+            os.environ["GOFR_ML_CANARY_WINDOW"] = str(
+                len(bundle_p["requests"]))
+            poolP = None
+            try:
+                import jax.numpy as jnp
+
+                from gofr_tpu.ml.generate import Generator
+                from gofr_tpu.models import llama as llama_mod
+
+                cfg_p = llama_mod.tiny_llama(use_flash=False,
+                                             dtype=jnp.float32)
+                params_p = llama_mod.init_params(cfg_p,
+                                                 jax.random.PRNGKey(0))
+
+                def gen_p():
+                    return Generator(params_p, cfg_p, batch_slots=2,
+                                     max_seq=64, prefill_buckets=(8, 16),
+                                     page_size=8)
+
+                led_p = goodput_ledger()
+                base_p = (led_p.snapshot_model("tune-canary")
+                          if led_p is not None else None)
+                since_p = event_log().cursor
+                poolP = ReplicaPool([gen_p()], name="tune-canary",
+                                    spawn=lambda idx: gen_p(),
+                                    canary={"knobs": profile_p["knobs"]})
+                # the candidate pays its own JIT compiles on its first
+                # mirror — on CPU that dwarfs the primary's warm latency,
+                # so the verdict here is identity + ledger, not SLO
+                poolP._canary.slo_slack = float("inf")
+                outs_p = []
+                for r in bundle_p["requests"]:
+                    outs_p.append(await poolP.generate(
+                        list(r["tokens"]), int(r["max_new"]),
+                        deadline_s=60.0))
+                t0p = time.perf_counter()
+                while (poolP._canary is not None
+                       and time.perf_counter() - t0p < 60.0):
+                    await asyncio.sleep(0.05)
+                while (poolP._canary_last is None
+                       and time.perf_counter() - t0p < 60.0):
+                    await asyncio.sleep(0.05)
+                snap_p = poolP.routing_snapshot().get("canary")
+                after_p = (led_p.snapshot_model("tune-canary")
+                           if led_p is not None else None)
+                delivered_p = wasted_p = None
+                if base_p is not None and after_p is not None:
+                    delivered_p = (after_p["delivered"]
+                                   - base_p["delivered"])
+                    wasted_p = (after_p["wasted"].get("canary", 0)
+                                - base_p["wasted"].get("canary", 0))
+                client_toks_p = sum(len(o) for o in outs_p)
+                tune_arm["canary"] = {
+                    "verdict": snap_p,
+                    "client_tokens": client_toks_p,
+                    "delivered_tokens": delivered_p,
+                    "canary_waste_tokens": wasted_p,
+                    # balanced: mirrored answers never billed delivered
+                    "ledger_balanced": (delivered_p == client_toks_p
+                                        if delivered_p is not None
+                                        else None),
+                    "fleet_size": poolP.fleet_size(),
+                    "events": [e["kind"] for e in event_log().query(
+                        since_p, model="tune-canary",
+                        kind=("canary_promote",
+                              "canary_rollback"))["events"]],
+                }
+            except Exception as exc:    # optional arm: record only
+                tune_arm["canary"] = {"error": str(exc)}
+            finally:
+                os.environ.pop("GOFR_ML_CANARY_SAMPLE", None)
+                os.environ.pop("GOFR_ML_CANARY_WINDOW", None)
+                if poolP is not None:
+                    poolP.close()
+
     agg_tok_s = sum(token_counts) / elapsed
     emit(
         "llama_served_tok_per_s", agg_tok_s, "tok/s", 2000.0,
@@ -2371,6 +2509,12 @@ async def main() -> None:
             # device_idle_share, TTFT/TPOT p50/p99, token identity)
             "pipeline": (pipeline_arm if pipeline_arm is not None
                          else "skipped (headline budget)"),
+            # phase P: self-tuning — replay-driven config search over
+            # the committed bundle (scoreboard, winner, lift vs default)
+            # + the winner shadow-canaried on a live pool (verdict,
+            # balanced canary waste ledger)
+            "tune": (tune_arm if tune_arm is not None
+                     else "skipped (headline budget)"),
             "preset": os.environ.get("LLAMA_PRESET", "tiny"),
             "backend": jax.default_backend(),
             "config": 4,

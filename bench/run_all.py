@@ -26,7 +26,10 @@ import time
 # fused-decode-window single-step-vs-fused A/B (steady tok/s, launch
 # phase share, TTFT/TPOT percentiles, greedy token identity); phase O:
 # the pipelined-serving-loop double-buffered-dispatch A/B (steady
-# tok/s, device_idle_share, greedy token identity);
+# tok/s, device_idle_share, greedy token identity); phase P: the
+# self-tuning arm — replay-driven config search over the committed
+# bench/ bundle (scoreboard, winner, lift vs default) + the winner
+# shadow-canaried on a live pool (verdict, balanced canary ledger);
 # config7's SP arm: sequence-parallel prefill TTFT/TPOT vs context
 # length with the greedy token-identity verdict)
 CONFIGS = [
@@ -40,7 +43,8 @@ CONFIGS = [
                           "BENCH_GOODPUT_ARM": "1",
                           "BENCH_REPLAY_ARM": "1",
                           "BENCH_WINDOW_ARM": "1",
-                          "BENCH_PIPELINE_ARM": "1"}),
+                          "BENCH_PIPELINE_ARM": "1",
+                          "BENCH_TUNE_ARM": "1"}),
     ("config5_sdxl.py", {}),
     ("config6_compute.py", {}),
     ("config7_longcontext.py", {"BENCH_SP_ARM": "1"}),

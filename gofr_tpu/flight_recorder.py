@@ -22,7 +22,8 @@ before the crash?":
   recording entirely (the instrumented sites guard on ``is not None``).
 - ``EventLog`` — the **fleet event log**: one process-global bounded ring
   of typed serving events (admit, route, failover, spill, restore, shed,
-  deadline, crash, recover, dead, drain) written by ``LLMServer``,
+  deadline, crash, recover, dead, drain, scale and canary
+  promote/rollback) written by ``LLMServer``,
   ``ReplicaPool``, ``RadixPrefixCache`` and ``HostKVStore``, and read by
   ``GET /debug/events?since=<cursor>&model=…``. Appends are O(1) under a
   tiny lock; the ring (``GOFR_ML_EVENT_RING``, default 2048) bounds

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import sys
 from typing import Any
 
 from .engine import Engine, EngineConfig
@@ -119,7 +120,7 @@ class MLDatasource:
 
     def register_llm(self, name: str, params: Any, cfg: Any, *,
                      generator: Any = None, replicas: int | None = None,
-                     **gen_kwargs):
+                     profile: Any = None, **gen_kwargs):
         """Mount a continuous-batching LLM: ``ctx.ml.llm(name)`` gives the
         async generate/stream API (llm.py); pass a ready Generator or the
         (params, cfg) to build one.
@@ -137,7 +138,72 @@ class MLDatasource:
         can scale at runtime (``scale_to``/``add_replica``/
         ``remove_replica`` + the autoscale loop); when the fleet is
         built from ``(params, cfg)`` a default ``spawn=`` factory is
-        wired so scale-ups can build new replica cores."""
+        wired so scale-ups can build new replica cores.
+
+        ``profile=`` (default ``GOFR_ML_PROFILE``) applies a tuned
+        profile (ml/tune.py): the knob map overlays the environment for
+        the duration of *construction* — loud validation, fingerprint-
+        drift warnings, and a ``tuned_profile`` block in
+        ``/debug/serving``. Unset constructs nothing and the boot stays
+        byte-identical. ``canary=`` (default ``GOFR_ML_CANARY``) mounts
+        the pool front (even at 1 replica) with a shadow-canary core
+        built from the candidate profile via the ``spawn=`` factory —
+        see replica.py for the mirror/promotion lifecycle."""
+        prof = profile
+        if prof is None and os.environ.get("GOFR_ML_PROFILE", "").strip():
+            prof = os.environ["GOFR_ML_PROFILE"].strip()
+        if prof is None:
+            server = self._build_llm(name, params, cfg, generator,
+                                     replicas, gen_kwargs)
+        else:
+            from .tune import (TUNABLE_KNOBS, load_profile,
+                               profile_boot_warnings, profile_overlay)
+
+            if isinstance(prof, str):
+                prof = load_profile(prof)
+            elif isinstance(prof, dict):
+                prof = dict(prof)
+                knobs = prof.get("knobs")
+                if not isinstance(knobs, dict):
+                    raise ValueError(
+                        f"llm {name}: profile= dict has no 'knobs' map")
+                bad = set(knobs) - TUNABLE_KNOBS
+                if bad:
+                    raise ValueError(
+                        f"llm {name}: profile sets non-tunable knob(s) "
+                        f"{sorted(bad)}")
+                prof["knobs"] = {k: str(v) for k, v in knobs.items()}
+            else:
+                raise TypeError(
+                    f"llm {name}: profile= must be a path or a loaded "
+                    f"profile dict, got {type(prof).__name__}")
+            warnings = profile_boot_warnings(prof)
+            for line in warnings:
+                if self._logger is not None:
+                    self._logger.warnf("llm %s: %s", name, line)
+                else:
+                    print(f"WARNING: llm {name}: {line}", file=sys.stderr)
+            with profile_overlay(prof["knobs"]):
+                server = self._build_llm(name, params, cfg, generator,
+                                         replicas, gen_kwargs,
+                                         profile_knobs=prof["knobs"])
+            # what /debug/serving shows under ``profile``: enough to
+            # audit WHICH knob map steered this boot and what drifted
+            server.tuned_profile = {
+                "path": prof.get("path"),
+                "created_at": prof.get("created_at"),
+                "knobs": dict(prof["knobs"]),
+                "warnings": warnings,
+            }
+        self._llms[name] = server
+        return server
+
+    def _build_llm(self, name: str, params: Any, cfg: Any, generator: Any,
+                   replicas: int | None, gen_kwargs: dict,
+                   profile_knobs: dict | None = None):
+        """The construction half of ``register_llm`` — split out so a
+        tuned profile can overlay the environment around ALL of it (the
+        replica count, the Generator env defaults, the pool knobs)."""
         from .generate import Generator
         from .llm import LLMServer
         from .replica import (ReplicaPool, build_replica_generators,
@@ -158,9 +224,15 @@ class MLDatasource:
         pool_kwargs = {
             k: gen_kwargs.pop(k)
             for k in ("depth_per_replica", "affinity_min_tokens", "disagg",
-                      "spawn", "elastic", "replicas_min", "replicas_max")
+                      "spawn", "elastic", "replicas_min", "replicas_max",
+                      "canary")
             if k in gen_kwargs
         }
+        if profile_knobs:
+            # scale-ups spawn cores OUTSIDE this boot's overlay; the pool
+            # re-applies the knob map around every spawn call so a fleet
+            # never mixes tuned and untuned cores
+            pool_kwargs["profile_knobs"] = dict(profile_knobs)
         explicit = (replicas is not None
                     or os.environ.get("GOFR_ML_REPLICAS", "").strip() != "")
         if replicas is None:
@@ -224,11 +296,18 @@ class MLDatasource:
                 if warm:
                     # startup pays every compile, not a request
                     gens[0].warmup()
-        from .replica import disagg_from_env, elastic_from_env
+        from .replica import canary_from_env, disagg_from_env, elastic_from_env
 
         elastic_req = pool_kwargs.get("elastic")
         if elastic_req is None:
             elastic_req = elastic_from_env()
+        # a shadow canary needs the pool front (the mirror + promotion
+        # machinery live there) even at fleet size 1
+        canary_req = pool_kwargs.get("canary")
+        if canary_req is None:
+            canary_req = canary_from_env()
+            if canary_req is not None:
+                pool_kwargs["canary"] = canary_req
         if len(gens) == 1:
             disagg_req = pool_kwargs.get("disagg")
             if disagg_req is None:
@@ -240,7 +319,7 @@ class MLDatasource:
                 raise ValueError(
                     f"llm {name}: disaggregated prefill/decode "
                     f"(GOFR_ML_DISAGG/disagg=) requires replicas >= 2")
-        if len(gens) > 1 or elastic_req:
+        if len(gens) > 1 or elastic_req or canary_req:
             server = ReplicaPool(gens, name=name, logger=self._logger,
                                  metrics=self._metrics, tracer=self._tracer,
                                  **pool_kwargs, **server_kwargs)
@@ -248,7 +327,6 @@ class MLDatasource:
             server = LLMServer(gens[0], name=name, logger=self._logger,
                                metrics=self._metrics, tracer=self._tracer,
                                **server_kwargs)
-        self._llms[name] = server
         if self._logger is not None:
             self._logger.infof("llm %s registered (%d replica(s), %d slots)",
                                name, len(gens),
@@ -570,6 +648,10 @@ class MLDatasource:
             if ledger is not None:
                 # serving economics: the token-fate ledger for this core
                 entry["goodput"] = ledger.snapshot_model(server.name)
+            if getattr(server, "tuned_profile", None) is not None:
+                # the tuned profile (ml/tune.py) that steered this boot:
+                # knob map, provenance, and any drift warned at apply
+                entry["profile"] = server.tuned_profile
             return entry
 
         for name, server in self._llms.items():
@@ -588,6 +670,8 @@ class MLDatasource:
                     # fleet-level waste (failover/migration) plus every
                     # replica core's ledger
                     entry["goodput"] = ledger.snapshot_model(name)
+                if getattr(server, "tuned_profile", None) is not None:
+                    entry["profile"] = server.tuned_profile
                 snap["llms"][name] = entry
                 continue
             snap["llms"][name] = llm_entry(server)

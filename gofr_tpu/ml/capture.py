@@ -137,10 +137,13 @@ def runtime_fingerprint() -> dict:
     return out
 
 
-def fingerprint_drift(recorded: dict, current: dict) -> list[str]:
+def fingerprint_drift(recorded: dict, current: dict,
+                      ignore=()) -> list[str]:
     """Human-readable differences between a bundle's recorded runtime
     fingerprint and the live one — the lines replay warns with. Empty
-    means the runtimes match on everything the fingerprint tracks."""
+    means the runtimes match on everything the fingerprint tracks.
+    ``ignore`` names extra knobs whose differences are expected (a tuned
+    profile's own knob map differs from the tuning run by design)."""
     drift: list[str] = []
     recorded = recorded or {}
     current = current or {}
@@ -158,7 +161,8 @@ def fingerprint_drift(recorded: dict, current: dict) -> list[str]:
     # and a replaying one — that is the tool working, not the workload
     # drifting
     for name in sorted((set(rk) | set(ck))
-                       - {"GOFR_ML_CAPTURE", "GOFR_ML_REPLAY_SPEED"}):
+                       - {"GOFR_ML_CAPTURE", "GOFR_ML_REPLAY_SPEED"}
+                       - set(ignore)):
         if rk.get(name) != ck.get(name):
             drift.append(f"knob {name}: recorded {rk.get(name)!r}, "
                          f"now {ck.get(name)!r}")

@@ -31,7 +31,12 @@ answer) or one of the wasted reasons:
   been released, or been reaped by the time its window settled — the
   window was speculatively re-dispatched while its predecessor was
   still in flight (the price of keeping two windows outstanding;
-  ``window_overshoot`` keeps naming live rows' early-exit raggedness).
+  ``window_overshoot`` keeps naming live rows' early-exit raggedness);
+- ``canary`` — tokens a shadow-canary replica (``GOFR_ML_CANARY``)
+  computed for mirrored traffic samples. Canary output never reaches a
+  client, so nothing it produces is ``delivered``; the mirror is the
+  price of judging a candidate config on live traffic, and charging it
+  here keeps the ledger balanced by construction.
 
 The ledger **balances by construction**: every classification point
 increments exactly one reason, so ``delivered + sum(wasted reasons) ==
@@ -65,7 +70,8 @@ __all__ = ["WASTE_REASONS", "GoodputLedger", "ModelGoodput",
 # app_llm_tokens_wasted_total); ``delivered`` is the ledger's other side
 WASTE_REASONS = ("spec_rejected", "deadline_cancelled", "crashed",
                  "disconnected", "failover_recompute", "restore_fallback",
-                 "migration_cold", "window_overshoot", "pipeline_overshoot")
+                 "migration_cold", "window_overshoot", "pipeline_overshoot",
+                 "canary")
 
 
 def goodput_enabled() -> bool:

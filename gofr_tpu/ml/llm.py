@@ -288,6 +288,12 @@ class LLMServer:
         # classification points stay one-liners; GOFR_ML_GOODPUT=0
         # disables via the same is-not-None contract
         self._goodput = goodput_ledger()
+        # what this server's DELIVERED tokens bill as. A shadow-canary
+        # core (replica.py) flips this to "canary": its output never
+        # reaches a client, so every token it computes is waste by
+        # definition — and the flip is the ONE switch that keeps the
+        # ledger balanced without touching any classification site
+        self.delivery_reason = "delivered"
         handle = (self._goodput.handle(name)
                   if self._goodput is not None else None)
         generator.goodput = handle
@@ -704,8 +710,12 @@ class LLMServer:
 
     def _note_goodput(self, reason: str, tokens: int) -> None:
         """Classify device-computed tokens in the goodput ledger — one
-        call per fate decision, never per token."""
+        call per fate decision, never per token. ``delivered`` routes
+        through ``delivery_reason`` so a shadow-canary core's completed
+        answers bill as ``canary`` waste (they never reach a client)."""
         if self._goodput is not None and tokens > 0:
+            if reason == "delivered":
+                reason = self.delivery_reason
             self._goodput.note(self.name, reason, int(tokens))
 
     def _slot_produced(self, slot: int | None) -> int:

@@ -253,6 +253,23 @@ class ReplayHarness:
                                               block["replayed"], "p50_ms")
             block["delta_p99_ms"] = _delta_ms(block["recorded"],
                                               block["replayed"], "p99_ms")
+        # throughput: what the tuner ranks arms by. ``steady_tok_s`` is
+        # the decode-regime rate — total post-first tokens over total
+        # decode time (Σ tpot·(n−1) per request), immune to the replay's
+        # arrival-schedule idle gaps that make raw tok/s lie about a
+        # config's speed. ``tok_s`` keeps the wall-clock rate for
+        # whole-window comparisons at equal speed factors.
+        out_tokens = sum(r["n_out"] for r in results)
+        decode_toks = sum(r["n_out"] - 1 for r in results
+                          if r["tpot_s"] is not None and r["n_out"] > 1)
+        decode_s = sum(r["tpot_s"] * (r["n_out"] - 1) for r in results
+                       if r["tpot_s"] is not None and r["n_out"] > 1)
+        throughput = {
+            "out_tokens": out_tokens,
+            "tok_s": round(out_tokens / wall_s, 3) if wall_s > 0 else None,
+            "steady_tok_s": (round(decode_toks / decode_s, 3)
+                             if decode_s > 0 else None),
+        }
         verdict: dict = {
             "requests": len(rows) + skipped,
             "replayed": len(results),
@@ -264,6 +281,7 @@ class ReplayHarness:
                 "matched": matched,
                 "rate": round(matched / compared, 4) if compared else None,
             },
+            "throughput": throughput,
             "recorded_failed": recorded_failed,
             "replay_failed": replay_failed,
             "ttft": ttft,

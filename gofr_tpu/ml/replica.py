@@ -82,7 +82,7 @@ from .errors import (DeadlineExceeded, GeneratorCrashed, Overloaded,
 from ..flight_recorder import event_log
 from .generate import PrefixEvicted
 from .goodput import goodput_ledger
-from .capture import sampler_snapshot, traffic_capture
+from .capture import sampler_snapshot, token_digest, traffic_capture
 from .journey import Journey, journey_log, next_rid
 from .journey import seal as seal_journey
 from .kv_offload import HostKVStore, OffloadConfig
@@ -159,6 +159,52 @@ def elastic_from_env() -> bool:
     if raw == "1":
         return True
     raise ValueError(f"GOFR_ML_ELASTIC must be 0 or 1, got {raw!r}")
+
+
+def canary_from_env() -> str | None:
+    """``GOFR_ML_CANARY=<path>``: a candidate tuned profile (ml/tune.py)
+    to boot as a shadow canary. Unset/empty constructs nothing — the
+    pool front is not even mounted for it."""
+    raw = os.environ.get("GOFR_ML_CANARY", "").strip()
+    return raw or None
+
+
+class _Canary:
+    """One shadow-canary campaign: the candidate core plus the judging
+    state. All mutable fields are guarded by the pool's ``_canary_lock``
+    (the core itself has its own serving thread and needs none).
+
+    Lifecycle: ``shadowing`` — the front mirrors every Nth admitted
+    request to the candidate core (mirrored tokens bill to the
+    ``canary`` waste reason; the output is compared, never delivered) —
+    until the verdict window fills or a disqualifier lands. Any digest
+    mismatch or candidate-core error rolls back IMMEDIATELY; a full
+    window of identity-true results whose median TTFT/TPOT stay within
+    ``slo_slack`` of the primaries' promotes the core into the fleet.
+    """
+
+    __slots__ = ("profile", "core", "sample_every", "window", "slo_slack",
+                 "seen", "mirrored", "errors", "decided", "decide_reason",
+                 "state", "pending", "results")
+
+    def __init__(self, profile: dict, core, *, sample_every: int,
+                 window: int, slo_slack: float = 2.0) -> None:
+        self.profile = profile
+        self.core = core
+        self.sample_every = max(1, int(sample_every))
+        self.window = max(1, int(window))
+        self.slo_slack = float(slo_slack)
+        self.seen = 0        # front admissions observed while shadowing
+        self.mirrored = 0    # ...of which were mirrored to the candidate
+        self.errors = 0      # candidate-core failures (each is fatal)
+        self.decided = False
+        self.decide_reason: str | None = None
+        self.state = "shadowing"  # shadowing | promoted | rolled_back
+        # rid -> {"canary": result, "primary": result} halves; a pair
+        # judges when both land, a failed primary tombstones its rid
+        self.pending: dict[str, dict] = {}
+        self.results: collections.deque[dict] = collections.deque(
+            maxlen=self.window)
 
 
 def _fleet_bound_from_env(name: str, default: int, floor: int) -> int:
@@ -412,6 +458,38 @@ def build_replica_generators(params, cfg, n: int, *, warmup: bool = True,
     return gens
 
 
+class _CanaryProbe:
+    """Primary-side shadow of one mirrored request: the client stream
+    feeds it per burst (two attribute writes + an extend — no hashing
+    until the request completes) so the judge can compare digests and
+    latency against the canary's run of the same prompt."""
+
+    __slots__ = ("out", "submit", "first", "last")
+
+    def __init__(self) -> None:
+        self.out: list[int] = []
+        self.submit = time.perf_counter()
+        self.first: float | None = None
+        self.last: float | None = None
+
+    def feed(self, burst) -> None:
+        now = time.perf_counter()
+        if self.first is None:
+            self.first = now
+        self.last = now
+        self.out.extend(burst)
+
+    def result(self) -> dict:
+        n = len(self.out)
+        return {
+            "digest": token_digest(self.out) if self.out else None,
+            "ttft_s": (self.first - self.submit
+                       if self.first is not None else None),
+            "tpot_s": ((self.last - self.first) / (n - 1)
+                       if self.first is not None and n > 1 else None),
+        }
+
+
 class _FrontRequest:
     """One request parked at (or transiting) the fleet front."""
 
@@ -475,6 +553,8 @@ class ReplicaPool:
                  spawn: Any = None, elastic: Any = None,
                  replicas_min: int | None = None,
                  replicas_max: int | None = None,
+                 canary: Any = None,
+                 profile_knobs: dict | None = None,
                  **server_kwargs) -> None:
         generators = list(generators)
         if not generators:
@@ -566,6 +646,10 @@ class ReplicaPool:
         # path byte-identical to the static-fleet behavior: the only new
         # work on the hot path is one empty-set membership test.
         self._spawn = spawn          # builds a Generator for a new replica
+        # the boot profile's knob map (register_llm applied it around THIS
+        # construction): scale-ups re-apply it around every spawn call so
+        # an elastic fleet never mixes tuned and untuned cores
+        self._profile_knobs = dict(profile_knobs) if profile_knobs else None
         self._elastic = (elastic_from_env() if elastic is None
                          else bool(elastic))
         self._n_min = (_fleet_bound_from_env("GOFR_ML_REPLICAS_MIN", 1, 1)
@@ -671,9 +755,19 @@ class ReplicaPool:
         self._loop: asyncio.AbstractEventLoop | None = None
         self._wake: asyncio.Event | None = None
         self._dispatcher: asyncio.Task | None = None
+        # -- shadow canary (GOFR_ML_CANARY / canary=) ------------------------
+        # boot the candidate-profile core LAST: it rides the spawn=
+        # factory and the settled pool state above. OFF constructs
+        # nothing — the hot path's only new work is one is-not-None test.
+        self._canary: _Canary | None = None
+        self._canary_lock = threading.Lock()
+        self._canary_last: dict | None = None  # the settled verdict block
+        canary_req = canary if canary is not None else canary_from_env()
+        if canary_req:
+            self._boot_canary(canary_req)
 
     # -- membership -----------------------------------------------------------
-    def _build_core(self, gen, idx: int) -> LLMServer:
+    def _build_core(self, gen, idx: int, name: str | None = None) -> LLMServer:
         """One serving core at pool index ``idx`` — the ONE construction
         path for replicas present at startup and replicas added at
         runtime, so the per-replica fault derivation (seed offset = pool
@@ -691,7 +785,7 @@ class ReplicaPool:
             core_fault = self._fault
         ck.setdefault("fault", core_fault or False)
         core = LLMServer(
-            gen, name=f"{self.name}/{idx}", logger=self._logger,
+            gen, name=name or f"{self.name}/{idx}", logger=self._logger,
             metrics=self._metrics, tracer=self._tracer, max_queue=0,
             max_queued_tokens=0, default_deadline_s=0.0, **ck)
         # crash bundles on this core snapshot the CURRENT fleet shape —
@@ -1328,6 +1422,7 @@ class ReplicaPool:
                 fr.rid, model=self.name,
                 trace_id=ctx.trace_id if ctx is not None else None))
         cap_rec = None
+        probe = None  # canary mirror: the primary-side digest/latency
         eff_info = info
         if self._capture is not None:
             # one capture record per FLEET request (the core skips: it
@@ -1342,6 +1437,11 @@ class ReplicaPool:
                 eff_info = {}
         try:
             self._admit(fr)  # fleet shedding; may raise Overloaded
+            if self._canary is not None:
+                # shadow mirror: every Nth admitted request also replays
+                # on the candidate core, fire-and-forget — its output is
+                # judged against this stream's, never delivered
+                probe = self._canary_pick(fr)
             if (self._disagg and fr.prefix is None
                     and fr.n_tokens >= self._ship_min
                     and not self._already_resident(fr.prompt)):
@@ -1405,6 +1505,8 @@ class ReplicaPool:
                         async for burst in agen:
                             if cap_rec is not None:
                                 cap_rec.add_tokens(burst)
+                            if probe is not None:
+                                probe.feed(burst)
                             if self._role_ctl is not None and burst:
                                 # fleet latency samples for the role
                                 # controller: TTFT on the first burst,
@@ -1426,6 +1528,11 @@ class ReplicaPool:
                                 eff_info.get("finish_reason") or "stop")
                             if fr.journey is not None and digest is not None:
                                 fr.journey.note(output_digest=digest)
+                        if probe is not None:
+                            # the pair judges once the canary half lands
+                            self._canary_result(fr.rid, "primary",
+                                                probe.result())
+                            probe = None
                         with self._lock:
                             self.served += 1
                         return
@@ -1516,6 +1623,10 @@ class ReplicaPool:
                                      str(exc))
             raise
         finally:
+            if probe is not None:
+                # the primary never completed (failed/abandoned): its
+                # mirror pair can never judge — discard it
+                self._canary_drop(fr.rid)
             with self._lock:
                 fr.cancelled = True
                 if fr.routed_idx is not None:
@@ -1732,15 +1843,348 @@ class ReplicaPool:
             # the LLMServer constructor's own stamp already ran
         return True
 
-    def _call_spawn(self, idx: int):
+    def _call_spawn(self, idx: int, knobs: dict | None = None):
         """Build a Generator for pool index ``idx`` via the ``spawn=``
         factory (called with the index when its signature takes one, so
-        a factory can place the replica on spare devices)."""
+        a factory can place the replica on spare devices). ``knobs``
+        overlays the environment around the call — default is the boot
+        profile's map, so an elastic scale-up builds the same config the
+        fleet booted with; pass ``{}`` to suppress (the canary boot
+        applies its own candidate overlay instead)."""
         try:
             takes_idx = bool(inspect.signature(self._spawn).parameters)
         except (TypeError, ValueError):
             takes_idx = True
+        if knobs is None:
+            knobs = self._profile_knobs
+        if knobs:
+            from .tune import profile_overlay
+
+            with profile_overlay(knobs):
+                return self._spawn(idx) if takes_idx else self._spawn()
         return self._spawn(idx) if takes_idx else self._spawn()
+
+    # -- shadow canary --------------------------------------------------------
+    def _boot_canary(self, spec) -> None:
+        """Construct the candidate core for one canary campaign: spawn a
+        generator and build its ``LLMServer`` under the candidate
+        profile's env overlay, bill everything it delivers to the
+        ``canary`` waste reason, and start shadowing. The core is NOT a
+        fleet member — no router ever picks it, no client ever reads
+        it — until a promotion verdict appends it to the membership."""
+        from .tune import load_profile, profile_overlay
+
+        if self._spawn is None:
+            raise ValueError(
+                f"llm {self.name}: a shadow canary needs the spawn= "
+                f"factory to build its candidate core — pass spawn=, or "
+                f"register from (params, cfg), which wires a default")
+        prof = load_profile(spec) if isinstance(spec, str) else dict(spec)
+        knobs = prof.get("knobs")
+        if not isinstance(knobs, dict) or not knobs:
+            raise ValueError(
+                f"llm {self.name}: canary profile has no 'knobs' map")
+        knobs = {k: str(v) for k, v in knobs.items()}
+        prof["knobs"] = knobs
+        idx = len(self.replicas)  # the index a promotion would take
+        with profile_overlay(knobs):
+            gen = self._call_spawn(idx, knobs={})
+            core = self._build_core(gen, idx, name=f"{self.name}/canary")
+        # the ONE switch that keeps the goodput ledger balanced: every
+        # token the candidate computes for a completed mirror bills as
+        # ``canary`` waste (its output never reaches a client); crash/
+        # deadline fates keep their own reasons
+        core.delivery_reason = "canary"
+        self._canary = _Canary(
+            prof, core,
+            sample_every=_fleet_bound_from_env("GOFR_ML_CANARY_SAMPLE",
+                                               8, 1),
+            window=_fleet_bound_from_env("GOFR_ML_CANARY_WINDOW", 16, 1))
+        if self._logger is not None:
+            try:
+                self._logger.infof(
+                    "llm %s: shadow canary armed (%s; mirror 1/%d, "
+                    "window %d)", self.name, ",".join(sorted(knobs)),
+                    self._canary.sample_every, self._canary.window)
+            except Exception:
+                pass
+
+    def _canary_pick(self, fr: "_FrontRequest"):
+        """Front-side sampling: every Nth admitted request is mirrored.
+        Returns the primary-side probe (digest + latency accumulator)
+        for a mirrored request, None otherwise. The mirror task is
+        fire-and-forget on the caller's loop — nothing it does can
+        surface on the client stream."""
+        canary = self._canary
+        if canary is None:
+            return None
+        with self._canary_lock:
+            if self._canary is not canary or canary.decided:
+                return None
+            canary.seen += 1
+            if canary.seen % canary.sample_every:
+                return None
+            canary.mirrored += 1
+        if fr.journey is not None:
+            # journey-tagged: the request's ONE fleet timeline records
+            # that a shadow copy ran (the copy's own journey rides
+            # "<rid>/canary")
+            fr.journey.note(canary_mirrored=True)
+        try:
+            asyncio.get_running_loop().create_task(
+                self._canary_run(canary, fr.rid, list(fr.prompt),
+                                 fr.max_new, fr.priority,
+                                 self._remaining(fr)))
+        except RuntimeError:
+            return None
+        return _CanaryProbe()
+
+    async def _canary_run(self, canary: "_Canary", rid: str, prompt,
+                          max_new: int, prio: int, ttl: float) -> None:
+        """Drive the candidate core through one mirrored request. The
+        whole body is guarded: a canary-core crash is a ROLLBACK signal,
+        never a client-visible failure."""
+        out: list[int] = []
+        first = last = None
+        submit = time.perf_counter()
+        try:
+            # rid= makes the core skip capture (mirrors must not pollute
+            # bundles) and tags the shadow journey
+            agen = canary.core.stream_chunks(
+                prompt, max_new, priority=prio, deadline_s=ttl,
+                rid=f"{rid}/canary")
+            try:
+                async for burst in agen:
+                    now = time.perf_counter()
+                    if first is None:
+                        first = now
+                    last = now
+                    out.extend(burst)
+            finally:
+                await agen.aclose()
+        except Exception as exc:
+            decide = None
+            with self._canary_lock:
+                if self._canary is canary and not canary.decided:
+                    canary.errors += 1
+                    canary.decided = True
+                    canary.decide_reason = (
+                        f"canary_error:{type(exc).__name__}")
+                    decide = "rollback"
+            if decide is not None:
+                self._canary_settle(canary, decide)
+            return
+        n = len(out)
+        self._canary_result(rid, "canary", {
+            "digest": token_digest(out) if out else None,
+            "ttft_s": (first - submit) if first is not None else None,
+            "tpot_s": ((last - first) / (n - 1)
+                       if first is not None and n > 1 else None),
+        })
+
+    def _canary_result(self, rid: str, side: str, data: dict) -> None:
+        """Register one half of a mirrored pair; judge when both have
+        landed. Identity is a per-request digest comparison — ONE
+        mismatch disqualifies the candidate immediately."""
+        canary = self._canary
+        if canary is None:
+            return
+        decide = None
+        with self._canary_lock:
+            if self._canary is not canary or canary.decided:
+                return
+            pend = canary.pending.get(rid)
+            if pend is not None and pend.get("dropped"):
+                canary.pending.pop(rid, None)
+                return
+            if pend is None:
+                pend = canary.pending[rid] = {}
+            pend[side] = data
+            if "canary" not in pend or "primary" not in pend:
+                return
+            canary.pending.pop(rid, None)
+            canary.results.append({
+                "identity": (pend["canary"]["digest"]
+                             == pend["primary"]["digest"]),
+                "ttft_s": pend["canary"]["ttft_s"],
+                "tpot_s": pend["canary"]["tpot_s"],
+                "primary_ttft_s": pend["primary"]["ttft_s"],
+                "primary_tpot_s": pend["primary"]["tpot_s"],
+            })
+            decide = self._canary_decide_locked(canary)
+        if decide is not None:
+            self._canary_settle(canary, decide)
+
+    def _canary_drop(self, rid: str) -> None:
+        """The primary failed/was abandoned: its pair can never judge.
+        Tombstone the rid so a late canary half is discarded too."""
+        canary = self._canary
+        if canary is None:
+            return
+        with self._canary_lock:
+            pend = canary.pending.get(rid)
+            if pend is not None and "canary" in pend:
+                canary.pending.pop(rid, None)
+            else:
+                canary.pending[rid] = {"dropped": True}
+
+    def _canary_decide_locked(self, canary: "_Canary") -> str | None:
+        """The promotion verdict (holding ``_canary_lock``): any digest
+        mismatch rolls back NOW; a full window of identity-true results
+        promotes iff the candidate's median TTFT/TPOT stay within
+        ``slo_slack`` of the primaries' over the same pairs."""
+        if canary.decided:
+            return None
+        if any(not r["identity"] for r in canary.results):
+            canary.decided = True
+            canary.decide_reason = "identity"
+            return "rollback"
+        if len(canary.results) < canary.window:
+            return None
+
+        def _median(key: str) -> float | None:
+            vals = sorted(r[key] for r in canary.results
+                          if r.get(key) is not None)
+            return vals[len(vals) // 2] if vals else None
+
+        for ck, pk, label in (("ttft_s", "primary_ttft_s", "ttft"),
+                              ("tpot_s", "primary_tpot_s", "tpot")):
+            c, p = _median(ck), _median(pk)
+            if c is not None and p is not None and p > 0 \
+                    and c > canary.slo_slack * p:
+                canary.decided = True
+                canary.decide_reason = (
+                    f"slo:{label} median {c * 1e3:.2f}ms > "
+                    f"{canary.slo_slack:g}x primary {p * 1e3:.2f}ms")
+                return "rollback"
+        canary.decided = True
+        canary.decide_reason = "verdict_ok"
+        return "promote"
+
+    def _canary_settle(self, canary: "_Canary", decide: str) -> None:
+        """Realize a verdict OFF the request path: promotion takes the
+        scale lock and rollback joins a serving thread — neither may
+        block a consumer's stream loop."""
+        threading.Thread(target=self._canary_apply, args=(canary, decide),
+                         daemon=True,
+                         name=f"gofr-canary-{self.name}").start()
+
+    def _canary_apply(self, canary: "_Canary", decide: str) -> None:
+        try:
+            if decide == "promote":
+                self._promote_canary(canary)
+            else:
+                self._rollback_canary(canary,
+                                      canary.decide_reason or "rollback")
+        except Exception as exc:
+            if self._logger is not None:
+                try:
+                    self._logger.warnf(
+                        "llm %s: canary %s failed (%s: %s)", self.name,
+                        decide, type(exc).__name__, exc)
+                except Exception:
+                    pass
+
+    def _promote_canary(self, canary: "_Canary") -> None:
+        """The candidate earned fleet membership: append its (already
+        warm, already serving) core to the membership lists under the
+        scale lock — the same accounting order as ``add_replica`` — and
+        flip its billing to ``delivered``. The core keeps its
+        ``<pool>/canary`` name; the ledger's prefix rollup and the event
+        log's model filter both already aggregate it."""
+        with self._scale_lock:
+            if self._closed or self._canary is not canary:
+                return
+            idx = len(self.replicas)
+            core = canary.core
+            if self._disagg:
+                _ensure_host_store(core.gen)
+            backfilled = self._backfill_pins(core, idx)
+            if self._closed:
+                with self._prefix_lock:
+                    for info in self._prefixes.values():
+                        info["by_replica"].pop(idx, None)
+                return
+            with self._canary_lock:
+                self._canary = None
+            canary.state = "promoted"
+            # billing flips BEFORE the core becomes routable: a promoted
+            # replica's answers are real deliveries
+            core.delivery_reason = "delivered"
+            with self._lock:
+                self._capacity.append(
+                    max(1, core.gen.batch_slots) * self._depth)
+                self._outstanding.append(0)
+                self._routed.append(collections.Counter())
+                self._dead_seen.append(False)
+                self._last_states.append("serving")
+                self.replicas.append(core)
+            self._sync_roles()
+            self._canary_last = {
+                "state": "promoted", "replica": idx,
+                "knobs": dict(canary.profile["knobs"]),
+                "mirrored": canary.mirrored,
+                "results": len(canary.results),
+                "at": round(time.time(), 3),
+            }
+            self._note_scale("scale_up", replica=idx, canary=True,
+                             backfilled_pins=backfilled)
+            self._events.emit("canary_promote", model=self.name,
+                              replica=idx, mirrored=canary.mirrored,
+                              window=len(canary.results),
+                              knobs=dict(canary.profile["knobs"]))
+            self._kick()
+
+    def _rollback_canary(self, canary: "_Canary", reason: str) -> None:
+        """The candidate is out: detach it (mirroring stops at the next
+        is-None check) and close its core — no drain, nothing it holds
+        was ever client-visible."""
+        with self._canary_lock:
+            if self._canary is not canary:
+                return
+            self._canary = None
+        canary.state = "rolled_back"
+        self._canary_last = {
+            "state": "rolled_back", "reason": reason,
+            "knobs": dict(canary.profile["knobs"]),
+            "mirrored": canary.mirrored,
+            "results": len(canary.results),
+            "at": round(time.time(), 3),
+        }
+        self._events.emit("canary_rollback", model=self.name,
+                          reason=reason, mirrored=canary.mirrored,
+                          window=len(canary.results),
+                          knobs=dict(canary.profile["knobs"]))
+        if self._logger is not None:
+            try:
+                self._logger.warnf("llm %s: canary rolled back (%s)",
+                                   self.name, reason)
+            except Exception:
+                pass
+        try:
+            canary.core.close(0.0)
+        except Exception:
+            pass
+
+    def _canary_snapshot(self) -> dict | None:
+        """The ``routing.canary`` debug block: live shadow state while a
+        campaign runs, the settled verdict after it ends, None when the
+        feature was never armed."""
+        canary = self._canary
+        if canary is None:
+            return self._canary_last
+        with self._canary_lock:
+            return {
+                "state": canary.state,
+                "knobs": dict(canary.profile.get("knobs") or {}),
+                "sample_every": canary.sample_every,
+                "window": canary.window,
+                "seen": canary.seen,
+                "mirrored": canary.mirrored,
+                "results": len(canary.results),
+                "errors": canary.errors,
+                "pending": len(canary.pending),
+            }
 
     def _note_scale(self, kind: str, **data) -> None:
         """One realized scale event: history row, typed fleet event, and
@@ -1821,30 +2265,7 @@ class ReplicaPool:
         # backfill every pool-pinned prefix BEFORE the replica becomes
         # routable: affinity routing may hand it a prefix= request the
         # moment it joins, and _core_pid must find a live registration.
-        # A failed backfill skips THAT pin (existing holders still serve
-        # it; this replica answers those requests with PrefixEvicted
-        # avoidance — the router only picks holders).
-        with self._prefix_lock:
-            pins = [(pid, info["ids"]) for pid, info in
-                    self._prefixes.items()]
-        backfilled = 0
-        for pid, ids in pins:
-            if self._closed:
-                break
-            try:
-                core_pid = core.register_prefix(ids)
-            except Exception:
-                continue
-            with self._prefix_lock:
-                info = self._prefixes.get(pid)
-                if info is not None:
-                    info["by_replica"][idx] = core_pid
-                    backfilled += 1
-                    continue
-            try:  # pin dropped while we backfilled: release the orphan
-                core.drop_prefix(core_pid)
-            except Exception:
-                pass
+        backfilled = self._backfill_pins(core, idx)
         if self._closed:
             # close() raced the build and is waiting on the scale lock:
             # abort cleanly — the half-built core never becomes routable,
@@ -1871,6 +2292,36 @@ class ReplicaPool:
             build_ms=round((time.perf_counter() - t0) * 1e3, 1))
         self._kick()
         return idx
+
+    def _backfill_pins(self, core: LLMServer, idx: int) -> int:
+        """Register every pool-pinned prefix on a core about to join the
+        routable set at index ``idx``. A failed backfill skips THAT pin
+        (existing holders still serve it; this core answers those
+        requests through the holders-only router preference). Shared by
+        scale-up and canary promotion — the two paths a warm core enters
+        the fleet through."""
+        with self._prefix_lock:
+            pins = [(pid, info["ids"]) for pid, info in
+                    self._prefixes.items()]
+        backfilled = 0
+        for pid, ids in pins:
+            if self._closed:
+                break
+            try:
+                core_pid = core.register_prefix(ids)
+            except Exception:
+                continue
+            with self._prefix_lock:
+                info = self._prefixes.get(pid)
+                if info is not None:
+                    info["by_replica"][idx] = core_pid
+                    backfilled += 1
+                    continue
+            try:  # pin dropped while we backfilled: release the orphan
+                core.drop_prefix(core_pid)
+            except Exception:
+                pass
+        return backfilled
 
     def remove_replica(self, idx: int, *, migrate: bool = True,
                        drain_s: float | None = None) -> dict:
@@ -2123,6 +2574,9 @@ class ReplicaPool:
         with self._prefix_lock:
             pinned = len(self._prefixes)
         fault_snap = fault_snapshot(self._fault)
+        # taken BEFORE self._lock: the canary methods never nest the two
+        # locks the other way, keeping the order acyclic
+        canary_snap = self._canary_snapshot()
         with self._lock:
             return {
                 "replicas": len(self.replicas),
@@ -2166,6 +2620,10 @@ class ReplicaPool:
                     "controller": self._role_ctl.snapshot(),
                     **self._transport.snapshot(),
                 }),
+                # shadow canary: live campaign state while one shadows,
+                # the settled promote/rollback verdict after, None when
+                # GOFR_ML_CANARY was never armed
+                "canary": canary_snap,
                 # elastic fleet: membership bounds + autoscale controller
                 # + the realized scale events and the migration ledger
                 # (ships == adoptions + failures, the scale-event
@@ -2250,6 +2708,18 @@ class ReplicaPool:
         # inside.)
         self._scale_lock.acquire()
         self._scale_lock.release()
+        # the shadow canary is not a fleet member: detach and close it
+        # here, no drain — nothing it holds was ever client-visible. (A
+        # promotion that won the scale lock above already moved its core
+        # into self.replicas and cleared this slot.)
+        with self._canary_lock:
+            canary = self._canary
+            self._canary = None
+        if canary is not None:
+            try:
+                canary.core.close(0.0)
+            except Exception:
+                pass
         if drain_s is None:
             drain_s = self._drain_default
         if drain_s > 0:
