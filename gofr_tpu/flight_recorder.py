@@ -380,7 +380,15 @@ class DispatchRecorder:
 
 
 class EventLog:
-    """Bounded ring of typed serving events with a monotonic cursor."""
+    """Bounded ring of typed serving events with a monotonic cursor.
+
+    The event-kind vocabulary is documented in
+    docs/tpu/observability.md (the fleet narration table): admission
+    and routing, replica lifecycle, KV movement, elastic scaling,
+    canary promotion — and the federation membership kinds
+    (``peer_up`` / ``peer_suspect`` / ``peer_dead`` / ``host_join`` /
+    ``host_leave``) that narrate the cross-host fleet (federation.py).
+    """
 
     def __init__(self, capacity: int | None = None) -> None:
         if capacity is None:

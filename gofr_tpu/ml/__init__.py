@@ -120,7 +120,8 @@ class MLDatasource:
 
     def register_llm(self, name: str, params: Any, cfg: Any, *,
                      generator: Any = None, replicas: int | None = None,
-                     profile: Any = None, **gen_kwargs):
+                     profile: Any = None, federation: Any = None,
+                     **gen_kwargs):
         """Mount a continuous-batching LLM: ``ctx.ml.llm(name)`` gives the
         async generate/stream API (llm.py); pass a ready Generator or the
         (params, cfg) to build one.
@@ -148,7 +149,30 @@ class MLDatasource:
         byte-identical. ``canary=`` (default ``GOFR_ML_CANARY``) mounts
         the pool front (even at 1 replica) with a shadow-canary core
         built from the candidate profile via the ``spawn=`` factory —
-        see replica.py for the mirror/promotion lifecycle."""
+        see replica.py for the mirror/promotion lifecycle.
+
+        ``federation=`` (default ``GOFR_ML_FEDERATION``) wraps the host-
+        local server in a ``FederatedPool`` (federation.py): gossip
+        membership, cross-host digest routing, and host-level failover
+        over the multihost wire. Unset constructs NO federation
+        machinery — the return value is the bare server, byte-
+        identical to a non-federated boot."""
+        # parse the fleet map BEFORE building the server: a typo'd
+        # GOFR_ML_FEDERATION must fail the boot without leaking a live
+        # serving thread it would never mount
+        fed_cfg = federation
+        if fed_cfg is None and \
+                os.environ.get("GOFR_ML_FEDERATION", "").strip():
+            from .federation import federation_from_env
+
+            fed_cfg = federation_from_env()
+        if fed_cfg is not None:
+            from .federation import FederationConfig
+
+            if not isinstance(fed_cfg, FederationConfig):
+                raise TypeError(
+                    f"llm {name}: federation= must be a FederationConfig, "
+                    f"got {type(fed_cfg).__name__}")
         prof = profile
         if prof is None and os.environ.get("GOFR_ML_PROFILE", "").strip():
             prof = os.environ["GOFR_ML_PROFILE"].strip()
@@ -195,6 +219,19 @@ class MLDatasource:
                 "knobs": dict(prof["knobs"]),
                 "warnings": warnings,
             }
+        if fed_cfg is not None:
+            from .federation import FederatedPool
+
+            server = FederatedPool(server, fed_cfg, name=name,
+                                   logger=self._logger,
+                                   metrics=self._metrics,
+                                   tracer=self._tracer)
+            if self._logger is not None:
+                self._logger.infof(
+                    "llm %s federated: host %s listening on %s:%d "
+                    "(%d peer(s))", name, fed_cfg.host_id,
+                    server.listen_addr[0], server.listen_addr[1],
+                    len(fed_cfg.peers))
         self._llms[name] = server
         return server
 
@@ -470,10 +507,12 @@ class MLDatasource:
         for name, server in self._llms.items():
             m.set_gauge("app_ml_queue_depth", server.queue_depth(),
                         component="llm", model=name)
-            if hasattr(server, "replicas"):
-                # replica pool: per-replica state/occupancy gauges
+            if hasattr(server, "export_gauges"):
+                # replica pool and/or federated front: per-replica
+                # state/occupancy gauges (+ per-peer state when federated)
                 server.export_gauges(m)
-                continue
+                if hasattr(server, "replicas"):
+                    continue
             m.set_gauge("app_llm_active_slots", float(server.gen.n_live),
                         model=name)
         self._export_goodput(m)
@@ -655,26 +694,37 @@ class MLDatasource:
             return entry
 
         for name, server in self._llms.items():
-            if hasattr(server, "replicas"):
+            # a federated front wraps the host-local server: snapshot
+            # the local shape as usual, then attach the per-host fleet
+            # view (and let the federated health own the top-level state)
+            fed = None
+            inner = server
+            if hasattr(server, "federation_snapshot"):
+                fed = server.federation_snapshot()
+                inner = server.local
+            if hasattr(inner, "replicas"):
                 # replica pool: fleet health + routing state once, then
                 # one full per-replica row each (states, pools, caches,
                 # schedulers, resilience) keyed by replica index
-                entry = dict(server.health_check()["details"])
+                entry = dict(inner.health_check()["details"])
                 entry["routing"] = server.routing_snapshot()
                 entry["replicas"] = {
                     str(i): llm_entry(core)
-                    for i, core in enumerate(server.replicas)
+                    for i, core in enumerate(inner.replicas)
                 }
                 if ledger is not None:
                     # fleet economics: the pool name aggregates its own
                     # fleet-level waste (failover/migration) plus every
                     # replica core's ledger
                     entry["goodput"] = ledger.snapshot_model(name)
-                if getattr(server, "tuned_profile", None) is not None:
-                    entry["profile"] = server.tuned_profile
-                snap["llms"][name] = entry
-                continue
-            snap["llms"][name] = llm_entry(server)
+                if getattr(inner, "tuned_profile", None) is not None:
+                    entry["profile"] = inner.tuned_profile
+            else:
+                entry = llm_entry(inner)
+            if fed is not None:
+                entry["state"] = server.health()
+                entry["federation"] = fed
+            snap["llms"][name] = entry
         return snap
 
     def health_check(self) -> dict:

@@ -36,7 +36,13 @@ answer) or one of the wasted reasons:
   computed for mirrored traffic samples. Canary output never reaches a
   client, so nothing it produces is ``delivered``; the mirror is the
   price of judging a candidate config on live traffic, and charging it
-  here keeps the ledger balanced by construction.
+  here keeps the ledger balanced by construction;
+- ``federation_recompute`` — prompt tokens re-prefilled on the local
+  host after a federated remote route failed before its first burst
+  (the peer died, partitioned, or went silent past the liveness
+  deadline): the remote host may have spent prefill the fleet never
+  saw, so the local recompute is charged as waste — the federation
+  cousin of ``failover_recompute`` one level up.
 
 The ledger **balances by construction**: every classification point
 increments exactly one reason, so ``delivered + sum(wasted reasons) ==
@@ -71,7 +77,7 @@ __all__ = ["WASTE_REASONS", "GoodputLedger", "ModelGoodput",
 WASTE_REASONS = ("spec_rejected", "deadline_cancelled", "crashed",
                  "disconnected", "failover_recompute", "restore_fallback",
                  "migration_cold", "window_overshoot", "pipeline_overshoot",
-                 "canary")
+                 "canary", "federation_recompute")
 
 
 def goodput_enabled() -> bool:

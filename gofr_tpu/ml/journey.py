@@ -8,7 +8,12 @@ admitted by ``LLMServer`` or ``ReplicaPool`` gets one bounded timeline
 record keyed by a process-unique ``rid``: monotonic-stamped lifecycle
 marks — fleet routing (+reason), disagg KV ship/land (+bytes), slot
 admission (+restore debt), the prefill segment, each decode/emit burst,
-and the finish reason — that **tile the request wall**: every mark closes
+and the finish reason — that **tile the request wall**. A federated hop
+(federation.py) records the same way: the client host's journey marks
+``route`` with ``replica="fed:<host>"`` and the remote attempt's bursts,
+while the trace id rides the ``gen`` frame's traceparent so the serving
+host's span — and its own journey, under its own rid — parent into ONE
+distributed trace across the socket. Marks tile the wall: every mark closes
 the elapsed segment since the previous one, so a journey's marks sum to
 its wall time under the same honesty contract as ``DispatchRecorder``
 (any unattributed remainder is an explicit ``other``, and no segment is

@@ -190,7 +190,10 @@ class KVTransport:
     ``import_prefix_kv``)."""
 
     def __init__(self, *, name: str = "llm", metrics=None,
-                 tracer=None) -> None:
+                 tracer=None, pending_cap: int = 8) -> None:
+        if pending_cap < 1:
+            raise ValueError(
+                f"pending_cap must be at least 1, got {pending_cap}")
         self.name = name
         self._metrics = metrics
         self._tracer = tracer   # ml.kv_ship / ml.kv_land spans
@@ -216,7 +219,7 @@ class KVTransport:
         # oldest incomplete set is dropped (counted, and the receiver
         # full-prefills that prefix like any other lost handoff)
         self._pending_shards: dict = {}
-        self._pending_cap = 8
+        self._pending_cap = int(pending_cap)
         self.sp_shard_frames = 0   # per-shard frames sent + received
         self.sp_shards_dropped = 0  # incomplete sets evicted at the cap
 
@@ -409,6 +412,21 @@ class KVTransport:
         self._events.emit("migrate", model=self.name, tokens=len(key),
                           bytes=len(raw), outcome="shipped_bytes")
         return raw
+
+    def account_lost_migration(self, n: int = 1) -> None:
+        """Sender-side failure accounting for ``migrate_bytes`` frames
+        that never reached a peer (the wire write failed, the link was
+        partitioned). The export already counted a ship, but no receiver
+        will ever count the adoption or failure — without this entry the
+        fleet-wide ships == adoptions + failures ledger can never close."""
+        if n <= 0:
+            return
+        with self._lock:
+            self.migrations["failures"] += n
+        for _ in range(n):
+            self._count_outcome("failed")
+        self._events.emit("migrate", model=self.name, outcome="lost_frame",
+                          count=n)
 
     @staticmethod
     def _header_says_migration(raw: bytes) -> bool:

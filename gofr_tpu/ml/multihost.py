@@ -89,22 +89,36 @@ _OP_NOOP = 4  # heartbeat: keeps followers' broadcast wait from timing out
 _BIN_FLAG = 0x8000_0000
 
 
-def send_frame(sock: socket.socket, obj: Any) -> None:
+def send_frame(sock: socket.socket, obj: Any, fault=None) -> None:
+    """Send one JSON frame. ``fault`` is an optional chaos hook (a
+    ``FaultInjector`` or None): when armed, the ``peer_send`` point fires
+    BEFORE the bytes hit the wire, so an injected fault looks exactly
+    like a send failure — frame lost, sender sees the exception. Unarmed
+    (the default None) costs one comparison."""
+    if fault is not None:
+        fault("peer_send")
     raw = json.dumps(obj).encode()
     sock.sendall(struct.pack(">I", len(raw)) + raw)
 
 
-def send_bytes(sock: socket.socket, payload: bytes) -> None:
-    """Send one raw-bytes frame (received as ``bytes`` by ``recv_frame``)."""
+def send_bytes(sock: socket.socket, payload: bytes, fault=None) -> None:
+    """Send one raw-bytes frame (received as ``bytes`` by ``recv_frame``).
+    ``fault`` arms the same ``peer_send`` chaos point as ``send_frame``."""
+    if fault is not None:
+        fault("peer_send")
     if len(payload) >= _BIN_FLAG:
         raise ValueError(
             f"binary frame too large ({len(payload)} bytes; max 2 GiB)")
     sock.sendall(struct.pack(">I", _BIN_FLAG | len(payload)) + payload)
 
 
-def recv_frame(sock: socket.socket) -> Any | None:
+def recv_frame(sock: socket.socket, fault=None) -> Any | None:
     """One frame: parsed JSON for JSON frames, ``bytes`` for binary
-    frames, ``None`` on EOF."""
+    frames, ``None`` on EOF. ``fault`` arms the ``peer_recv`` chaos
+    point before the header read — an injected fault propagates to the
+    reader loop like a torn connection."""
+    if fault is not None:
+        fault("peer_recv")
     header = _recv_exact(sock, 4)
     if header is None:
         return None
@@ -615,6 +629,13 @@ class MultiHostWorker:
                 cmd = self._zero_cmd()
                 cmd[0] = _OP_NOOP
                 self._broadcast(cmd)
+                # ... and the model-port clients get the same liveness
+                # signal: an id-less noop frame (ignored by the client
+                # dispatcher) resets their missed-heartbeat window, so a
+                # silently dead rank 0 — no FIN, no data — is the ONLY
+                # thing that lets the gap deadline expire
+                for c in list(self._conns):
+                    c.send({"noop": True})
 
     def _zero_step(self):
         cmd = self._zero_cmd()
@@ -629,7 +650,18 @@ class MultiHostLLMClient:
     dispatches frames to per-request queues. The front-end app holds one
     of these per model-worker deployment."""
 
-    def __init__(self, host: str, port: int) -> None:
+    def __init__(self, host: str, port: int, *,
+                 heartbeat_gap_s: float = 15.0) -> None:
+        # liveness deadline for a connection with streams in flight: the
+        # worker heartbeats idle conns every ``heartbeat_s`` (5 s) and
+        # every token burst also counts, so 3 missed beats means rank 0
+        # is silently dead (no FIN, no data — a kill -9'd host, a black-
+        # holed route). Without this the reader parks on readexactly()
+        # forever and every in-flight request hangs with it.
+        if heartbeat_gap_s <= 0:
+            raise ValueError(
+                f"heartbeat_gap_s must be positive, got {heartbeat_gap_s}")
+        self.heartbeat_gap_s = float(heartbeat_gap_s)
         self.host, self.port = host, port
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
@@ -683,12 +715,32 @@ class MultiHostLLMClient:
             await self._writer.drain()
 
     async def _read_frames(self) -> None:
-        """Single dispatcher: route each frame to its request's queue."""
+        """Single dispatcher: route each frame to its request's queue.
+
+        Every read is bounded by ``heartbeat_gap_s``: between frames a
+        healthy worker is never silent longer than its idle heartbeat,
+        so a gap past the window on a connection WITH in-flight streams
+        means rank 0 died without a FIN — declare the connection lost
+        (the finally fires the CONN_LOST broadcast; un-yielded requests
+        take the one-shot reconnect, yielded ones surface a typed
+        ``GeneratorCrashed``). An IDLE connection may legitimately sit
+        silent between heartbeats racing our timer, so gaps there just
+        re-arm the wait."""
+        gap = self.heartbeat_gap_s
         try:
             while True:
-                header = await self._reader.readexactly(4)
+                try:
+                    header = await asyncio.wait_for(
+                        self._reader.readexactly(4), timeout=gap)
+                except asyncio.TimeoutError:
+                    if not self._streams:
+                        continue
+                    break
                 (size,) = struct.unpack(">I", header)
-                frame = json.loads(await self._reader.readexactly(size))
+                # a torn frame (header landed, body never did) is fatal
+                # even when idle: the stream is desynced past repair
+                frame = json.loads(await asyncio.wait_for(
+                    self._reader.readexactly(size), timeout=gap))
                 if not isinstance(frame, dict):
                     continue
                 if frame.get("stopped"):

@@ -1393,6 +1393,7 @@ class ReplicaPool:
                             priority: int | str | None = None,
                             deadline_s: float | None = None,
                             mode: str = "chunks",
+                            front: bool = False,
                             ) -> AsyncIterator[list[int]]:
         """Yield BURSTS of tokens, like ``LLMServer.stream_chunks``, with
         fleet semantics: the request parks in the fleet queue, routes to
@@ -1401,7 +1402,12 @@ class ReplicaPool:
         transparently re-admits to a survivor with priority and deadline
         preserved (greedy reroutes are bit-identical). Once a token has
         been yielded a crash surfaces as the typed ``GeneratorCrashed``:
-        the stream cannot be resumed mid-generation."""
+        the stream cannot be resumed mid-generation.
+
+        ``front=True`` admits at the head of the request's priority class
+        instead of the tail — the federation layer re-admits a dead
+        peer's queued work this way (ml/federation.py), so a host loss
+        doesn't also cost those requests their queue position."""
         if self._closed:
             raise self._closed_error()
         prio = normalize_priority(priority)
@@ -1475,10 +1481,11 @@ class ReplicaPool:
                             # forever
                             raise self._closed_error()
                         fr.routed_idx = None
-                        if fr.attempts:
+                        if fr.attempts or front:
                             # rerouted work keeps its place at the head of
                             # its class (enqueued_at preserved, so aging
-                            # continues)
+                            # continues); front=True gets the same slot on
+                            # first admission (federated re-admits)
                             self._queue.push_front(fr)
                         else:
                             self._queue.push(fr)
@@ -2532,6 +2539,42 @@ class ReplicaPool:
         with self._lock:
             fleet = len(self._queue)
         return fleet + sum(c.queue_depth() for c in self.replicas)
+
+    def pinned_prefix_tokens(self, limit: int = 32) -> list[list[int]]:
+        """Token runs of the pool-level pinned prefixes — what a joining
+        federated host backfills (ml/federation.py pin_sync), exactly
+        like ``_backfill_pins`` warms a runtime-built replica."""
+        with self._prefix_lock:
+            rows = [list(map(int, meta["ids"]))
+                    for meta in self._prefixes.values()]
+        return rows[:limit]
+
+    def hot_prefix_rows(self, limit: int = 16) -> list[dict]:
+        """The pool's hottest cached prefixes — pins first, then each
+        live replica's radix rows hit-descending, deduped by token run.
+        Each row: ``{"ids": [tok, ...], "pinned": bool}``. This is the
+        digest-summary source the federation layer gossips (peers match
+        ``token_digest(prompt[:len])`` against it) and the migration
+        worklist of a leaving host."""
+        rows: list[dict] = []
+        seen: set[tuple] = set()
+
+        def _add(ids, pinned: bool) -> None:
+            toks = [int(t) for t in ids]
+            key = tuple(toks)
+            if toks and key not in seen:
+                seen.add(key)
+                rows.append({"ids": toks, "pinned": pinned})
+
+        for ids in self.pinned_prefix_tokens(limit):
+            _add(ids, True)
+        for i in self._live_indices():
+            cache = getattr(self.replicas[i], "prefix_cache", None)
+            if cache is None:
+                continue
+            for row in cache.hot_prefixes(limit):
+                _add(row["ids"], False)
+        return rows[:limit]
 
     def health(self) -> str:
         """``serving`` — every live replica healthy; ``degraded`` — ANY

@@ -35,6 +35,16 @@ Points (where the serving stack calls ``fire``):
 - ``sp_gather`` — the landing/gather side of an SP prefill wave, fired
   after the sharded forward completed; the landed shards are discarded
   and the single-device full prefill rewrites the rows/pages
+- ``peer_send`` — a federation/multihost wire write (``send_frame`` /
+  ``send_bytes`` in ml/multihost.py), fired before the bytes hit the
+  socket: the frame is lost and the sender sees a send failure
+- ``peer_recv`` — a federation/multihost wire read (``recv_frame``),
+  fired before the header read: the reader treats it as a torn
+  connection, exactly like a peer that died mid-frame
+- ``peer_partition`` — a network partition at the federation link layer
+  (ml/federation.py): outbound frames fail to send and inbound frames
+  are silently dropped, so the peer looks alive-but-unreachable (gossip
+  silence → suspect → dead) rather than cleanly disconnected
 
 The injector only exists when the env var is set (``from_env`` returns
 ``None`` otherwise) and the instrumented call sites guard with an
@@ -61,7 +71,8 @@ __all__ = ["FAULT_POINTS", "FaultInjector", "InjectedFault",
 
 FAULT_POINTS = ("step", "prefill", "spill", "restore", "emit", "route",
                 "ship", "land", "scale_up", "scale_down", "migrate",
-                "sp_prefill", "sp_gather")
+                "sp_prefill", "sp_gather", "peer_send", "peer_recv",
+                "peer_partition")
 
 
 class InjectedFault(RuntimeError):

@@ -22,6 +22,10 @@ jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu" and len(jax.devices()) == 8
 
 import asyncio  # noqa: E402
+import faulthandler  # noqa: E402
+import signal  # noqa: E402
+import sys  # noqa: E402
+import threading  # noqa: E402
 
 import pytest  # noqa: E402
 
@@ -30,6 +34,43 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: long soak tests excluded from tier-1 (-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "timeout(seconds): per-test wall-clock bound (SIGALRM; main "
+        "thread, POSIX only) — a wedged socket test fails ALONE with a "
+        "stack dump instead of eating the whole suite's budget")
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    """Marker-scoped per-test timeout: ``@pytest.mark.timeout(N)`` (or a
+    module-level ``pytestmark``) arms a SIGALRM that dumps every
+    thread's stack to stderr and fails the ONE test that wedged. Hand-
+    rolled on purpose — the federation/multihost tests drive real
+    sockets and a lost wakeup there must not stall tier-1; tests
+    without the marker are untouched."""
+    marker = item.get_closest_marker("timeout")
+    seconds = float(marker.args[0]) if marker and marker.args else None
+    usable = (seconds is not None and seconds > 0
+              and hasattr(signal, "SIGALRM")
+              and threading.current_thread() is threading.main_thread())
+    if not usable:
+        return (yield)
+
+    def _expired(signum, frame):
+        sys.stderr.write(
+            f"\n=== test timeout ({seconds:g}s) in {item.nodeid} — "
+            f"dumping all thread stacks ===\n")
+        faulthandler.dump_traceback(file=sys.stderr)
+        pytest.fail(f"test exceeded {seconds:g}s timeout", pytrace=False)
+
+    previous = signal.signal(signal.SIGALRM, _expired)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        return (yield)
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, previous)
 
 
 @pytest.fixture

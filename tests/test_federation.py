@@ -1,0 +1,518 @@
+"""Federated serving fleet: gossip membership, cross-host digest
+routing, and host-level failover (tier-1, CPU, loopback sockets).
+
+The headline contract under test: two federated hosts on loopback, a
+hot prefix promoted on host B, and three requests routed from A to B by
+its gossiped digests — killing B abruptly surfaces ``GeneratorCrashed``
+on the mid-stream request and completes the queued ones on A's local
+pool front-of-class with greedy output bit-identical to the
+single-host path (the recompute charged as ``federation_recompute``);
+``health()`` answers ``degraded`` until B rejoins. A partition injected
+at the ``peer_partition`` point falls back locally on the SAME call,
+and a graceful ``leave()`` live-migrates the hot subtree with the
+fleet-wide ships == adoptions + failures ledger closing. With
+``GOFR_ML_FEDERATION`` unset, ``register_llm`` constructs NO federation
+machinery at all.
+"""
+
+import asyncio
+import threading
+import time
+
+import jax
+import pytest
+
+from gofr_tpu.flight_recorder import event_log
+from gofr_tpu.ml import MLDatasource
+from gofr_tpu.ml.errors import (DeadlineExceeded, GeneratorCrashed,
+                                Overloaded, ServerClosed)
+from gofr_tpu.ml.federation import (FederatedPool, FederationConfig,
+                                    federation_from_env)
+from gofr_tpu.ml.generate import Generator
+from gofr_tpu.ml.goodput import goodput_ledger
+from gofr_tpu.ml.kv_offload import HostKVStore, OffloadConfig
+from gofr_tpu.ml.llm import LLMServer
+from gofr_tpu.ml.replica import ReplicaPool
+from gofr_tpu.models import llama
+from gofr_tpu.testutil import get_free_port
+
+# every test here drives real sockets: a lost wakeup must fail the ONE
+# test with a stack dump (conftest SIGALRM marker), never eat the suite
+pytestmark = pytest.mark.timeout(120)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.tiny_llama(use_flash=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _gen(model, **kw):
+    cfg, params = model
+    kw.setdefault("batch_slots", 1)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("prefill_buckets", (8, 16))
+    kw.setdefault("page_size", 4)  # paged: arms the framework radix cache
+    kw.setdefault("chunk", 2)
+    return Generator(params, cfg, **kw)
+
+
+@pytest.fixture(scope="module")
+def ref(model):
+    """Single-host greedy reference: ONE shared generator (compiles are
+    the expensive part on the CPU mesh) — ``ref(prompt, n)`` is the
+    bit-identical baseline every federated path must reproduce."""
+    gen = _gen(model)
+    return lambda prompt, n: gen.generate(list(prompt), n)
+
+
+def _sleep_hook(point: str, seconds: float):
+    def hook(p):
+        if p == point:
+            time.sleep(seconds)
+
+    return hook
+
+
+def _wait(pred, timeout_s: float = 10.0, msg: str = "condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"never held within {timeout_s}s: {msg}")
+
+
+def _cfg(hid, port, peers, **kw):
+    kw.setdefault("gossip_s", 0.1)
+    kw.setdefault("suspect_beats", 2)
+    kw.setdefault("dead_beats", 5)
+    # queued remote streams must not trip the liveness bound while a
+    # slow slot drains ahead of them — the tests kill links explicitly
+    kw.setdefault("frame_gap_s", 30.0)
+    return FederationConfig(hid, ("127.0.0.1", port), peers, **kw)
+
+
+def _pair(name, local_a, local_b, **cfg_kw):
+    """Two federated hosts ("a", "b") peering over loopback."""
+    pa, pb = get_free_port(), get_free_port()
+    cfg_a = _cfg("a", pa, {"b": ("127.0.0.1", pb)}, **cfg_kw)
+    cfg_b = _cfg("b", pb, {"a": ("127.0.0.1", pa)}, **cfg_kw)
+    fed_a = FederatedPool(local_a, cfg_a, name=f"{name}-a")
+    fed_b = FederatedPool(local_b, cfg_b, name=f"{name}-b")
+    return fed_a, fed_b, cfg_a, cfg_b
+
+
+def _warm_hot_prefix(run, fed, prompt, n=4):
+    """Serve ``prompt`` twice so the shared prefix auto-promotes
+    (promote_hits=2: the second occurrence already reuses) and return
+    the REGISTERED token run — page alignment may register one token
+    short of the prompt, so tests must extend what the trie actually
+    holds, not what they sent."""
+
+    async def scenario():
+        await fed.generate(list(prompt), n)
+        await fed.generate(list(prompt), n)
+
+    run(scenario())
+    rows = {}
+
+    def ready():
+        if hasattr(fed.local, "hot_prefix_rows"):
+            got = fed.local.hot_prefix_rows(16)
+        else:
+            got = fed.local.prefix_cache.hot_prefixes(16)
+        rows["rows"] = got
+        return bool(got)
+
+    _wait(ready, 5.0, "hot prefix never registered")
+    return [int(t) for t in rows["rows"][0]["ids"]]
+
+
+def _routable_peer(fed, hid):
+    peer = fed._peers[hid]
+    return (peer.state == "up" and peer.warm and bool(peer.digests)
+            and peer.health in ("serving", "degraded"))
+
+
+# 12 tokens: deep enough past the affinity floor (8) after the
+# page-aligned registration shaves one
+WARM = [5, 9, 2, 7, 1, 4, 8, 3, 6, 11, 13, 2]
+
+
+# ------------------------------------------------------------- construction
+def test_federation_from_env(monkeypatch):
+    monkeypatch.delenv("GOFR_ML_FEDERATION", raising=False)
+    monkeypatch.delenv("GOFR_ML_FEDERATION_SELF", raising=False)
+    assert federation_from_env() is None
+
+    spec = "a=10.0.0.1:9101, b=10.0.0.2:9101"
+    monkeypatch.setenv("GOFR_ML_FEDERATION", spec)
+    with pytest.raises(ValueError, match="GOFR_ML_FEDERATION_SELF"):
+        federation_from_env()  # members without naming which one is me
+    monkeypatch.setenv("GOFR_ML_FEDERATION_SELF", "c")
+    with pytest.raises(ValueError, match="not a member"):
+        federation_from_env()
+    monkeypatch.setenv("GOFR_ML_FEDERATION_SELF", "b")
+    cfg = federation_from_env()
+    assert cfg.host_id == "b" and cfg.listen == ("10.0.0.2", 9101)
+    assert cfg.peers == {"a": ("10.0.0.1", 9101)}
+    assert cfg.gossip_s == 1.0 and cfg.frame_gap_s == 6.0  # 6 beats
+
+    for bad in ("oops", "a=nohost", "a=h:notaport", "=h:1"):
+        monkeypatch.setenv("GOFR_ML_FEDERATION", bad)
+        with pytest.raises(ValueError):
+            federation_from_env()
+    monkeypatch.setenv("GOFR_ML_FEDERATION", spec)
+    monkeypatch.setenv("GOFR_ML_FED_GOSSIP_S", "0.25")
+    monkeypatch.setenv("GOFR_ML_FED_SUSPECT_BEATS", "2")
+    monkeypatch.setenv("GOFR_ML_FED_DEAD_BEATS", "4")
+    cfg = federation_from_env()
+    assert (cfg.gossip_s, cfg.suspect_beats, cfg.dead_beats) == (0.25, 2, 4)
+    monkeypatch.setenv("GOFR_ML_FED_GOSSIP_S", "fast")
+    with pytest.raises(ValueError, match="GOFR_ML_FED_GOSSIP_S"):
+        federation_from_env()
+
+
+def test_federation_config_validation():
+    with pytest.raises(ValueError, match="non-empty"):
+        FederationConfig("", ("127.0.0.1", 1), {})
+    with pytest.raises(ValueError, match="peer with itself"):
+        FederationConfig("a", ("127.0.0.1", 1), {"a": ("127.0.0.1", 2)})
+    with pytest.raises(ValueError, match="gossip_s"):
+        FederationConfig("a", ("127.0.0.1", 1), {}, gossip_s=0)
+    with pytest.raises(ValueError, match="suspect_beats"):
+        FederationConfig("a", ("127.0.0.1", 1), {},
+                         suspect_beats=6, dead_beats=3)
+    cfg = FederationConfig("a", ("127.0.0.1", 1), {},
+                           gossip_s=0.1, suspect_beats=2, dead_beats=5)
+    # the liveness deadline floors at 2s so slow CI never false-kills
+    assert cfg.frame_gap_s == 2.0
+    assert cfg.suspect_after_s() == pytest.approx(0.2)
+    assert cfg.dead_after_s() == pytest.approx(0.5)
+
+
+def test_register_llm_without_env_builds_no_federation(model, monkeypatch):
+    """The zero-overhead acceptance guard: GOFR_ML_FEDERATION unset
+    keeps register_llm on the existing code path — a bare server, no
+    FederatedPool, no sockets, no federation threads."""
+    monkeypatch.delenv("GOFR_ML_FEDERATION", raising=False)
+    monkeypatch.delenv("GOFR_ML_FEDERATION_SELF", raising=False)
+    before = {t.name for t in threading.enumerate()
+              if t.name.startswith("gofr-fed")}
+    ml = MLDatasource()
+    server = ml.register_llm("fedzero", None, None, generator=_gen(model))
+    try:
+        assert isinstance(server, LLMServer)
+        assert not hasattr(server, "federation_snapshot")
+        grew = {t.name for t in threading.enumerate()
+                if t.name.startswith("gofr-fed")} - before
+        assert not grew
+        assert "federation" not in ml.serving_snapshot()["llms"]["fedzero"]
+    finally:
+        server.close()
+    # a typo'd fleet map is a startup error, never a silently solo host
+    monkeypatch.setenv("GOFR_ML_FEDERATION", "a=127.0.0.1:1")
+    monkeypatch.setenv("GOFR_ML_FEDERATION_SELF", "nope")
+    with pytest.raises(ValueError, match="not a member"):
+        ml.register_llm("fedbad", None, None, generator=object())
+
+
+def test_register_llm_single_member_wires_federation(model, monkeypatch, run):
+    """A one-host fleet from the env: register_llm wraps the server in a
+    FederatedPool, output stays bit-identical to the bare path, and the
+    serving snapshot grows the federation block."""
+    port = get_free_port()
+    monkeypatch.setenv("GOFR_ML_FEDERATION", f"solo=127.0.0.1:{port}")
+    monkeypatch.setenv("GOFR_ML_FEDERATION_SELF", "solo")
+    ml = MLDatasource()
+    server = ml.register_llm("fedsolo", None, None, generator=_gen(model))
+    try:
+        assert isinstance(server, FederatedPool)
+        assert server.health() == "serving"
+        assert server.health_check()["status"] == "UP"
+        snap = ml.serving_snapshot()["llms"]["fedsolo"]
+        assert snap["federation"]["host"] == "solo"
+        assert snap["federation"]["remote"] == {
+            "routed": 0, "served": 0, "failovers": 0}
+        assert server.routing_snapshot()["federation"]["hosts"] == {}
+    finally:
+        server.close()
+    assert server.health() == "dead"
+    with pytest.raises(ServerClosed):
+        run(server.generate(WARM[:6], 2))
+
+
+# ------------------------------------------- remote routing + host failover
+def test_remote_route_and_kill_host_fails_over(model, run, ref):
+    """The acceptance scenario: A routes three prompts to B on its
+    gossiped hot-prefix digests; killing B mid-stream crashes the
+    yielded stream typed, re-admits the queued two on A front-of-class
+    with bit-identical output, flips health to degraded, and a rejoined
+    B brings it back to serving."""
+    ev = event_log()
+    fed_a, fed_b, _cfg_a, cfg_b = _pair(
+        "fedkill",
+        ReplicaPool([_gen(model)], name="fedkill-a"),
+        ReplicaPool([_gen(model)], name="fedkill-b"))
+    fed_b2 = None
+    try:
+        reg = _warm_hot_prefix(run, fed_b, WARM)
+        assert len(reg) >= 8  # past the affinity floor
+        _wait(lambda: _routable_peer(fed_a, "b"), 10.0,
+              "A never saw B up+warm with digests")
+        # slow B's decode so the kill lands mid-stream with two queued
+        fed_b.local.replicas[0].gen.fault = _sleep_hook("step", 0.05)
+        p1, p2, p3 = reg + [17], reg + [19], reg + [23]
+        cursor = ev.cursor
+
+        async def scenario():
+            s1 = fed_a.stream_chunks(p1, 40)
+            first = await s1.__anext__()  # B is streaming to A
+            assert first
+            t2 = asyncio.create_task(fed_a.generate(p2, 6))
+            t3 = asyncio.create_task(fed_a.generate(p3, 6))
+            for _ in range(500):
+                if fed_a.remote_routed == 3:
+                    break
+                await asyncio.sleep(0.01)
+            assert fed_a.remote_routed == 3
+            await asyncio.to_thread(fed_b.close)
+            with pytest.raises(GeneratorCrashed):
+                async for _ in s1:
+                    pass
+            # queued work re-admits locally, greedy-identical
+            assert await t2 == ref(p2, 6)
+            assert await t3 == ref(p3, 6)
+
+        run(scenario())
+        assert fed_a.remote_failovers == 2
+        ledger = goodput_ledger()
+        assert ledger is not None
+        wasted = ledger.snapshot_model("fedkill-a")["wasted"]
+        assert wasted.get("federation_recompute") == len(p2) + len(p3)
+        _wait(lambda: fed_a._peers["b"].state == "dead", 10.0,
+              "B never declared dead")
+        assert fed_a.health() == "degraded"
+        dead = ev.query(since=cursor, model="fedkill-a",
+                        kind="peer_dead")["events"]
+        assert any(e.get("host") == "b" for e in dead)
+        snap = fed_a.federation_snapshot()
+        assert snap["hosts"]["b"]["state"] == "dead"
+        assert snap["remote"]["routed"] == 3
+        assert snap["remote"]["failovers"] == 2
+        # rejoin on the same address: membership heals to serving
+        fed_b2 = FederatedPool(ReplicaPool([_gen(model)], name="fedkill-b"),
+                               cfg_b, name="fedkill-b")
+        _wait(lambda: fed_a.health() == "serving", 10.0,
+              "fleet never healed after rejoin")
+        joins = ev.query(since=cursor, model="fedkill-a",
+                         kind="host_join")["events"]
+        assert any(e.get("host") == "b" for e in joins)
+    finally:
+        fed_a.close()
+        fed_b.close()
+        if fed_b2 is not None:
+            fed_b2.close()
+
+
+def test_partition_falls_back_locally_same_call(model, run, ref):
+    """An injected ``peer_partition`` loses frames both ways without
+    tearing sockets down: the routed request falls back locally on the
+    SAME call with correct output (recompute charged), and gossip
+    silence drives the peer suspect -> dead on BOTH sides."""
+    ev = event_log()
+    fed_a, fed_b, _a, _b = _pair(
+        "fedpart",
+        LLMServer(_gen(model), name="fedpart-a"),
+        LLMServer(_gen(model), name="fedpart-b"),
+        suspect_beats=4, dead_beats=8)
+    try:
+        reg = _warm_hot_prefix(run, fed_b, WARM)
+        _wait(lambda: _routable_peer(fed_a, "b"), 10.0,
+              "A never saw B up+warm with digests")
+        # a prompt shorter than B's digested run stays local and is
+        # bit-identical to the bare (unfederated) path
+        local = WARM[:9]
+        assert run(fed_a.generate(local, 4)) == ref(local, 4)
+        assert fed_a.remote_routed == 0
+        cursor = ev.cursor
+
+        def _partition(point):
+            if point == "peer_partition":
+                raise RuntimeError("injected partition")
+
+        fed_a._fault = _partition
+        prompt = reg + [17]
+
+        async def scenario():
+            # the remote attempt dies at the send; the caller's SAME
+            # stream finishes on the local path, bit-identically
+            assert await fed_a.generate(prompt, 6) == \
+                ref(prompt, 6)
+
+        run(scenario())
+        assert fed_a.remote_routed == 1 and fed_a.remote_failovers == 1
+        ledger = goodput_ledger()
+        wasted = ledger.snapshot_model("fedpart-a")["wasted"]
+        assert wasted.get("federation_recompute") == len(prompt)
+        # dropped beats both ways: each side walks suspect -> dead
+        _wait(lambda: fed_a._peers["b"].state == "dead", 10.0,
+              "A never declared partitioned B dead")
+        _wait(lambda: fed_b._peers["a"].state == "dead", 10.0,
+              "B never declared partitioned A dead")
+        for fed in (fed_a, fed_b):
+            assert fed.health() == "degraded"
+        kinds = [e["kind"] for e in ev.query(
+            since=cursor, model="fedpart-a",
+            kind=("peer_suspect", "peer_dead"))["events"]]
+        assert "peer_suspect" in kinds and "peer_dead" in kinds
+    finally:
+        fed_a.close()
+        fed_b.close()
+
+
+# ------------------------------------------------------- host leave (drain)
+def test_leave_migrates_hot_subtree_and_ledger_closes(model, run, ref):
+    """A graceful ``leave()`` live-migrates the leaver's hot subtree to
+    the survivor over ``migrate_bytes`` frames and the FLEET-WIDE
+    migration ledger closes: B's ships == A's adoptions + everyone's
+    failures. The survivor marks the leaver ``left`` (not dead) and
+    stays serving."""
+    ev = event_log()
+    fed_a, fed_b, _a, _b = _pair(
+        "fedleave",
+        LLMServer(_gen(model, host_kv=HostKVStore(
+            OffloadConfig(budget_mb=64))), name="fedleave-a"),
+        LLMServer(_gen(model, host_kv=HostKVStore(
+            OffloadConfig(budget_mb=64))), name="fedleave-b"))
+    try:
+        _warm_hot_prefix(run, fed_b, WARM)
+        # leave targets the least-loaded ROUTABLE survivor: B must see
+        # A up+warm (digests not required)
+        _wait(lambda: fed_b._peers["a"].state == "up"
+              and fed_b._peers["a"].warm, 10.0, "B never saw A up+warm")
+        cursor = ev.cursor
+        res = fed_b.leave()
+        assert res["target"] == "a"
+        assert res["migrated"] >= 1 and res["lost_frames"] == 0
+        ships = fed_b._transport.migrations["ships"]
+        assert ships == res["migrated"]
+
+        def closed():
+            a, b = (fed_a._transport.migrations,
+                    fed_b._transport.migrations)
+            return (a["adoptions"] + a["failures"] + b["failures"]
+                    == ships)
+
+        _wait(closed, 10.0, "migration ledger never closed fleet-wide")
+        assert fed_a._transport.migrations["adoptions"] == ships
+        _wait(lambda: fed_a._peers["b"].state == "left", 10.0,
+              "A never saw B leave")
+        # a clean departure is not a failure: the survivor stays serving
+        assert fed_a.health() == "serving"
+        leaves = ev.query(since=cursor, kind="host_leave")["events"]
+        assert any(e.get("host") == "b" and e.get("local")
+                   for e in leaves)       # the leaver's own tally
+        assert any(e.get("host") == "b" and not e.get("local")
+                   for e in leaves)       # the survivor's view
+        # leaving again is idempotent; the leaver drains local traffic
+        assert fed_b.leave() == {"already_leaving": True}
+        prompt = WARM[:9]
+        assert run(fed_b.generate(prompt, 4)) == \
+            ref(prompt, 4)
+    finally:
+        fed_a.close()
+        fed_b.close()
+
+
+# ------------------------------------------------------------- chaos soak
+@pytest.mark.slow
+@pytest.mark.timeout(480)
+def test_federation_chaos_soak(model, run, ref):
+    """Soak: traffic through a 2-host fleet across a kill, a rejoin,
+    and a graceful leave. Invariant: every request either completes
+    greedy-bit-identical to the single-host path or raises a TYPED
+    serving error — never a hang, never a wrong token."""
+    fed_a, fed_b, _a, cfg_b = _pair(
+        "fedsoak",
+        ReplicaPool([_gen(model)], name="fedsoak-a"),
+        ReplicaPool([_gen(model)], name="fedsoak-b"))
+    exp = {}
+
+    def expected(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in exp:
+            exp[key] = ref(list(prompt), n)
+        return exp[key]
+
+    async def one(fed, prompt, n):
+        try:
+            out = await fed.generate(list(prompt), n)
+        except (GeneratorCrashed, ServerClosed, DeadlineExceeded,
+                Overloaded) as exc:
+            return ("typed", type(exc).__name__)
+        assert out == expected(prompt, n), \
+            f"wrong tokens for {prompt}: {out}"
+        return ("ok", out)
+
+    fed_b2 = None
+    try:
+        reg = _warm_hot_prefix(run, fed_b, WARM)
+        _wait(lambda: _routable_peer(fed_a, "b"), 15.0,
+              "A never saw B up+warm")
+        outcomes = []
+
+        async def phase_kill():
+            fed_b.local.replicas[0].gen.fault = _sleep_hook("step", 0.03)
+            tasks = [asyncio.create_task(one(fed_a, reg + [t], 8))
+                     for t in (17, 19, 23, 29)]
+            await asyncio.sleep(0.3)     # let routing + streaming start
+            await asyncio.to_thread(fed_b.close)
+            outcomes.extend(await asyncio.gather(*tasks))
+
+        run(phase_kill())
+        _wait(lambda: fed_a.health() == "degraded", 10.0,
+              "A never degraded after the kill")
+        # rejoin and drive traffic until remote routing works again
+        fed_b2 = FederatedPool(ReplicaPool([_gen(model)], name="fedsoak-b"),
+                               cfg_b, name="fedsoak-b")
+        _wait(lambda: fed_a.health() == "serving", 15.0,
+              "fleet never healed after rejoin")
+        _warm_hot_prefix(run, fed_b2, WARM)
+        _wait(lambda: _routable_peer(fed_a, "b"), 15.0,
+              "A never saw the rejoined B routable")
+
+        async def phase_steady():
+            tasks = [asyncio.create_task(one(fed_a, reg + [t], 6))
+                     for t in (31, 37, 41)]
+            outcomes.extend(await asyncio.gather(*tasks))
+
+        run(phase_steady())
+        # steady state: everything delivered, nothing typed
+        assert all(kind == "ok" for kind, _ in outcomes[-3:])
+        # graceful departure under traffic
+        res = fed_b2.leave()
+        assert res["target"] == "a"
+
+        async def phase_drain():
+            tasks = [asyncio.create_task(one(fed_a, reg + [t], 4))
+                     for t in (43, 47)]
+            outcomes.extend(await asyncio.gather(*tasks))
+
+        run(phase_drain())
+        assert all(kind == "ok" for kind, _ in outcomes[-2:])
+        assert all(kind in ("ok", "typed") for kind, _ in outcomes)
+        # at least the steady+drain phases delivered real tokens
+        assert sum(1 for kind, _ in outcomes if kind == "ok") >= 5
+        ships = fed_b2._transport.migrations["ships"]
+        a_mig = fed_a._transport.migrations
+        _wait(lambda: (a_mig["adoptions"] + a_mig["failures"]
+                       + fed_b2._transport.migrations["failures"]) == ships,
+              10.0, "soak migration ledger never closed")
+    finally:
+        fed_a.close()
+        fed_b.close()
+        if fed_b2 is not None:
+            fed_b2.close()

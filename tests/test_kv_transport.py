@@ -490,3 +490,54 @@ def test_segmented_register_prefix_long_prefix(model):
     # without chunked prefill the old loud error stands, naming the knob
     with pytest.raises(ValueError, match="prefill_chunk"):
         _gen(model).register_prefix(long_pfx)
+
+
+# ------------------------------------------ shard-reassembly buffer bound
+def test_pending_shard_sets_bounded_with_eviction():
+    """The shard-reassembly buffer is BOUNDED: flooding incomplete
+    partial sets (a sender that dies mid-ship, repeatedly) evicts the
+    stalest set at the cap and counts it in ``sp_shards_dropped`` —
+    memory stays bounded, nothing crashes, and a complete set arriving
+    AFTER the flood still reassembles and lands."""
+    from gofr_tpu.ml.kv_transport import encode_entry_shards
+
+    landed = {}
+
+    class Dst:
+        def import_prefix_kv(self, key, arrays, meta, timeout_s):
+            landed["key"] = key
+            landed["arrays"] = arrays
+            return True
+
+    def shard0(key_base):
+        arrays = {"k": np.full((2, 4, 8, 4), key_base, np.float32)}
+        meta = {"len": 16, "tail": [], "ids_full": list(range(key_base,
+                                                              key_base + 4))}
+        return encode_entry_shards(tuple(range(key_base, key_base + 4)),
+                                   arrays, meta, 2)
+
+    cap = 3
+    t = KVTransport(name="flood", pending_cap=cap)
+    # flood: 10 distinct sets, each sending only shard 0 of 2 — none can
+    # ever complete, so without the cap the dict would grow unbounded
+    for i in range(10):
+        assert t.land_bytes(Dst(), shard0(100 * (i + 1))[0]) is None
+        assert len(t._pending_shards) <= cap
+    snap = t.snapshot()
+    assert snap["sp_shards_pending"] == cap
+    assert snap["sp_shards_dropped"] == 10 - cap
+    assert t.lands == 0 and not landed
+
+    # a COMPLETE set arriving after the flood still lands whole: the cap
+    # bounds memory, it does not wedge the transport
+    frames = shard0(9000)
+    assert t.land_bytes(Dst(), frames[0]) is None  # evicts one more stale set
+    assert t.land_bytes(Dst(), frames[1]) == tuple(range(9000, 9004))
+    assert landed["key"] == tuple(range(9000, 9004))
+    snap = t.snapshot()
+    assert snap["sp_shards_pending"] == cap - 1  # completed set removed
+    assert snap["sp_shards_dropped"] == 10 - cap + 1
+
+    # the cap is a loud constructor contract, not a silent clamp
+    with pytest.raises(ValueError):
+        KVTransport(name="bad", pending_cap=0)

@@ -16,11 +16,16 @@ import os
 import struct
 import subprocess
 import sys
+import time
 
 import pytest
 
 from gofr_tpu.ml.errors import GeneratorCrashed, ServerClosed
 from gofr_tpu.testutil import get_free_port
+
+# socket tests: a wedged wire test must fail ALONE with a stack dump
+# (conftest's SIGALRM marker), not eat the whole tier-1 budget
+pytestmark = pytest.mark.timeout(570)
 
 _WORKER = r"""
 import os, sys
@@ -596,6 +601,76 @@ def test_client_retry_budget_is_one(run):
                 await llm.close()
 
     run(scenario())
+
+
+@pytest.mark.timeout(60)
+def test_client_heartbeat_gap_detects_silent_dead_port(run):
+    """THE liveness fix: a model port that accepts the request and then
+    goes silent — no FIN, no reset, no frames, the silently-dead-rank-0
+    shape — must surface as the typed GeneratorCrashed within the
+    missed-heartbeat window (x2: the one-shot reconnect gets the same
+    silence), never hang the caller forever."""
+
+    async def scenario():
+        from gofr_tpu.ml.multihost import MultiHostLLMClient
+
+        async def _silent(port, frame, reader, writer):
+            await asyncio.sleep(30)  # alive socket, no frames ever
+
+        async with _FakeModelPort([_silent, _silent]) as port:
+            llm = MultiHostLLMClient("127.0.0.1", port.port,
+                                     heartbeat_gap_s=0.3)
+            t0 = time.monotonic()
+            try:
+                with pytest.raises(GeneratorCrashed):
+                    await asyncio.wait_for(llm.generate([5, 9], 8), 15)
+                # two gap windows (first attempt + the transparent
+                # retry), not the 30 s the port would have slept
+                assert time.monotonic() - t0 < 5
+                assert len(port.requests) == 2
+            finally:
+                await llm.close()
+
+    run(scenario())
+
+
+@pytest.mark.timeout(60)
+def test_client_idle_heartbeat_gap_is_not_fatal(run):
+    """The gap deadline only reaps a connection with streams IN FLIGHT:
+    an idle client (nothing awaited) rides out any silence, and the
+    worker's id-less noop heartbeat frames are ignored by the stream
+    dispatcher — no reconnect, no phantom tokens."""
+
+    async def scenario():
+        from gofr_tpu.ml.multihost import MultiHostLLMClient
+
+        async def _serve_with_noop(port, frame, reader, writer):
+            rid = frame["id"]
+            port.send(writer, {"noop": True})  # worker idle heartbeat
+            port.send(writer, {"id": rid, "tokens": [1, 2]})
+            port.send(writer, {"id": rid, "done": True})
+            await writer.drain()
+
+        async with _FakeModelPort([_serve_with_noop]) as port:
+            llm = MultiHostLLMClient("127.0.0.1", port.port,
+                                     heartbeat_gap_s=0.2)
+            try:
+                await llm._ensure()
+                await asyncio.sleep(0.7)  # several idle gaps: conn lives
+                assert await llm.generate([4], 4) == [1, 2]
+                assert len(port.requests) == 1  # same connection, no retry
+            finally:
+                await llm.close()
+
+    run(scenario())
+
+
+def test_client_heartbeat_gap_validated():
+    """A non-positive gap would disable liveness silently — loud instead."""
+    from gofr_tpu.ml.multihost import MultiHostLLMClient
+
+    with pytest.raises(ValueError, match="heartbeat_gap_s"):
+        MultiHostLLMClient("127.0.0.1", 1, heartbeat_gap_s=0.0)
 
 
 def test_client_frames_carry_traceparent(run):
